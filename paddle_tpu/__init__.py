@@ -1,0 +1,107 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability surface of the reference
+(PaddlePaddle ~v2.0, /root/reference/), re-designed for TPU:
+
+- eager ("dygraph") mode runs each op through XLA with a vjp-recorded
+  autograd tape (framework/core.py);
+- static mode is ``jax.jit`` tracing of the same code (jit/to_static) —
+  the ProgramDesc IR of the reference collapses into jaxpr/StableHLO;
+- distributed training is sharding annotations over a ``jax.sharding.Mesh``
+  (data/tensor/pipeline/sequence/expert axes) with XLA ICI collectives,
+  replacing NCCL rings, graph-rewrite meta-optimizers and SSA executors;
+- the parameter-server sparse path is a host-side embedding service.
+
+Top-level API mirrors ``paddle.*`` so reference user code ports by
+changing the import.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+    Tensor, device_count, enable_grad, get_device, grad,
+    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_xpu,
+    is_grad_enabled, no_grad, seed, set_device, set_grad_enabled, to_tensor,
+    get_flags, set_flags,
+)
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool, complex64, complex128, dtype, finfo, float16, float32,
+    float64, iinfo, int8, int16, int32, int64, uint8,
+    is_floating_point, is_integer,
+)
+from .tensor import *  # noqa: F401,F403
+from .tensor import __all__ as _tensor_all
+from .tensor import linalg  # noqa: F401  (paddle.linalg namespace)
+
+from . import framework  # noqa: F401
+
+# subpackages import lazily-tolerant: during the staged build some may not
+# exist yet; once present they are first-class members of the namespace.
+import importlib as _importlib
+
+_SUBPACKAGES = [
+    "amp", "autograd", "device", "distributed", "hapi", "inference", "io",
+    "jit", "metric", "nn", "onnx", "optimizer", "profiler", "regularizer",
+    "static", "sysconfig", "text", "utils", "vision", "incubate",
+]
+
+for _pkg in _SUBPACKAGES:
+    try:
+        globals()[_pkg] = _importlib.import_module(f".{_pkg}", __name__)
+    except ModuleNotFoundError as _e:
+        # tolerate only the subpackage itself being absent (staged build);
+        # broken internals must surface
+        if _e.name != f"{__name__}.{_pkg}":
+            raise
+
+if "io" in globals() and hasattr(globals().get("framework"), "io"):
+    try:
+        from .framework.io import load, save  # noqa: F401
+    except ModuleNotFoundError:
+        pass
+if "hapi" in globals():
+    from .hapi import Model, flops, summary  # noqa: F401
+
+# paddle-compat mode toggles: the reference flips between dygraph and
+# static graph globally; here "static" only changes default tracing hints,
+# since jit tracing subsumes the static graph.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ signal handlers (platform/init.cc);
+    JAX runtime handles its own."""
+
+
+def set_default_dtype(d):
+    from .framework import dtype as _d
+    global _default_dtype
+    _default_dtype = _d.convert_dtype(d)
+
+
+def get_default_dtype():
+    return globals().get("_default_dtype", "float32")
+
+
+def summary_(*a, **k):  # placeholder to avoid name clash
+    raise NotImplementedError

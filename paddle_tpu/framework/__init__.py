@@ -1,0 +1,20 @@
+"""paddle_tpu.framework — core runtime (tensor, autograd, place, rng, flags).
+
+Replaces the reference's L0–L3 native layers (platform/, memory/,
+framework/, imperative/ — see /root/reference/paddle/fluid/) with a thin
+TPU-native core: jax.Array storage, XLA memory, vjp-tape autograd.
+"""
+from . import dtype  # noqa: F401  (the module; the class is dtype.dtype)
+from .core import (GradNode, Tensor, enable_grad, grad, is_grad_enabled,  # noqa: F401
+                   no_grad, run_backward, set_grad_enabled, to_tensor)
+# NOTE: deliberately no `from .dtype import *` — it would shadow the
+# submodule name `framework.dtype` with the dtype *class*.
+from .dtype import (bfloat16, complex64, complex128, convert_dtype, finfo,  # noqa: F401
+                    float16, float32, float64, iinfo, int8, int16, int32,
+                    int64, is_floating_point, is_integer, uint8)
+from .errors import *  # noqa: F401,F403
+from .flags import FLAGS, define_flag, get_flags, set_flags  # noqa: F401
+from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+                    XPUPlace, device_count, get_device, is_compiled_with_cuda,
+                    is_compiled_with_tpu, is_compiled_with_xpu, set_device)
+from .random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,545 @@
+"""Eager Tensor + autograd engine.
+
+TPU-native re-design of the reference's imperative runtime:
+
+- ``Tensor``     <- VarBase (reference: paddle/fluid/imperative/layer.h) —
+  an eager tensor that lives in TPU HBM as a ``jax.Array``.
+- ``_apply``     <- Tracer::TraceOp (reference: paddle/fluid/imperative/tracer.cc:132)
+  — every op call runs eagerly AND records a backward node.
+- ``GradNode`` / ``backward`` <- BasicEngine
+  (reference: paddle/fluid/imperative/basic_engine.cc:39 Init, :265 Execute)
+  — reverse topological sweep with gradient accumulation
+  (reference: imperative/gradient_accumulator.cc).
+
+The key design difference from the reference: the reference re-implements
+per-op analytic gradients (grad-op makers, framework/grad_op_desc_maker.h:61);
+here every op's backward is derived on the fly with ``jax.vjp``, so the op
+library needs forward definitions only, and the same code path traces under
+``jax.jit`` for the static/to_static mode (XLA then fuses the whole step —
+the dygraph/static duality collapses into "traced or not").
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .place import CPUPlace, Place, TPUPlace, _default_place
+
+__all__ = [
+    "Tensor", "to_tensor", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "GradNode",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling autograd recording.
+
+    Parity with paddle.no_grad (reference: python/paddle/fluid/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def _is_float_dtype(v) -> bool:
+    return jnp.issubdtype(v.dtype, jnp.floating) or jnp.issubdtype(v.dtype, jnp.complexfloating)
+
+
+class GradNode:
+    """One recorded op in the backward graph.
+
+    Holds the vjp closure from ``jax.vjp`` plus strong refs to the parent
+    tensors whose gradients it produces (the reference keeps the same refs in
+    OpBase's saved VariableWrappers).
+    """
+
+    __slots__ = ("vjp_fn", "parents", "out_avals", "name")
+
+    def __init__(self, vjp_fn, parents: Sequence["Tensor"], out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.parents = list(parents)
+        self.out_avals = out_avals  # list of (shape, dtype) per output
+        self.name = name
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_out={len(self.out_avals)})"
+
+
+class Tensor:
+    """Eager tensor backed by a ``jax.Array`` (or a tracer under jit).
+
+    API parity target: paddle.Tensor / VarBase. ``stop_gradient`` defaults to
+    True like the reference (parameters flip it to False).
+    """
+
+    __slots__ = ("_value", "_node", "_out_idx", "stop_gradient", "grad",
+                 "name", "persistable", "_hooks", "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        self._value = value
+        self._node: Optional[GradNode] = None
+        self._out_idx = 0
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name
+        self.persistable = False
+        self._hooks: List[Callable] = []
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self) -> dtypes.dtype:
+        d = self._value.dtype
+        if d == jnp.bfloat16:
+            return dtypes.bfloat16
+        return dtypes.dtype(str(d))
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._value.devices()))
+            if dev.platform == "cpu":
+                return CPUPlace()
+            return TPUPlace(dev.id)
+        except Exception:  # tracer or sharded
+            return _default_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    def dim(self) -> int:
+        return self.ndim
+
+    # ------------------------------------------------------------------
+    # host interop
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        return _apply(lambda x: x + 0, self, op_name="clone")
+
+    def register_hook(self, hook: Callable):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+    zero_grad = clear_grad
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        """Reverse sweep from this tensor (parity: VarBase._run_backward ->
+        BasicEngine, reference pybind/imperative.cc:921)."""
+        run_backward(self, grad_tensor, retain_graph)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return _apply(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            new = _apply(lambda x, v: x.at[idx].set(v), self, value,
+                         op_name="setitem")
+        else:
+            new = _apply(lambda x: x.at[idx].set(value), self,
+                         op_name="setitem")
+        # in-place semantics: rebind the storage and graph node
+        self._value = new._value
+        self._node = new._node
+        self._out_idx = new._out_idx
+        if not new.stop_gradient:
+            self.stop_gradient = False
+
+    # ------------------------------------------------------------------
+    # core ops as methods (the wide op surface is attached by paddle_tpu.tensor)
+    # ------------------------------------------------------------------
+    def astype(self, d) -> "Tensor":
+        jd = dtypes.to_jax(d)
+        return _apply(lambda x: x.astype(jd), self, op_name="cast")
+
+    cast = astype
+
+    def _to_place(self, place: Place) -> "Tensor":
+        dev = place.jax_device()
+        t = Tensor(jax.device_put(self._value, dev),
+                   stop_gradient=self.stop_gradient, name=self.name)
+        return t
+
+    def cpu(self):
+        return self._to_place(CPUPlace())
+
+    def tpu(self, idx: int = 0):
+        return self._to_place(TPUPlace(idx))
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self.cpu()
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=6, separator=", ",
+                                   threshold=40)
+        except Exception:
+            body = f"<traced {self._value.aval if hasattr(self._value, 'aval') else self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    __str__ = __repr__
+
+    # arithmetic dunders are installed below / by paddle_tpu.tensor
+    __hash__ = object.__hash__
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    if isinstance(idx, slice):
+        return slice(_unwrap_index(idx.start) if isinstance(idx.start, Tensor) else idx.start,
+                     _unwrap_index(idx.stop) if isinstance(idx.stop, Tensor) else idx.stop,
+                     idx.step)
+    return idx
+
+
+# ----------------------------------------------------------------------
+# dispatch: run an op eagerly, record vjp for backward
+# ----------------------------------------------------------------------
+
+def _apply(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
+           **kwargs) -> Any:
+    """Execute ``fn`` over the jax values of ``args``; record a GradNode.
+
+    This is the single choke point every op goes through — the analog of
+    Tracer::TraceOp (reference imperative/tracer.cc:132): run forward,
+    then (if grads are on) create the backward node via jax.vjp.
+    """
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    # which positions do we differentiate w.r.t.?
+    diff_pos = []
+    if is_grad_enabled():
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor) and not a.stop_gradient and _is_float_dtype(a._value):
+                diff_pos.append(i)
+
+    if not diff_pos:
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, None, stop_gradient=True)
+
+    def closed(*diff_vals):
+        v = list(vals)
+        for p, dv in zip(diff_pos, diff_vals):
+            v[p] = dv
+        return fn(*v, **kwargs)
+
+    out_val, vjp_fn = jax.vjp(closed, *[vals[p] for p in diff_pos])
+    parents = [args[p] for p in diff_pos]
+    outs = out_val if isinstance(out_val, (tuple, list)) else (out_val,)
+    out_avals = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(vjp_fn, parents, out_avals, name=op_name or getattr(fn, "__name__", "op"))
+    return _wrap_outputs(out_val, node, stop_gradient=False)
+
+
+def _wrap_outputs(out, node, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        res = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=stop_gradient)
+            t._node = node
+            t._out_idx = i
+            res.append(t)
+        return tuple(res) if isinstance(out, tuple) else res
+    t = Tensor(out, stop_gradient=stop_gradient)
+    t._node = node
+    return t
+
+
+# ----------------------------------------------------------------------
+# backward engine
+# ----------------------------------------------------------------------
+
+def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
+                 retain_graph: bool = False):
+    """BasicEngine::Execute analog (reference imperative/basic_engine.cc:265).
+
+    Topologically sorts the GradNode DAG reachable from ``t`` and runs each
+    node's vjp once all its output cotangents have been accumulated.
+    """
+    if t.stop_gradient:
+        raise RuntimeError(
+            "backward() on a tensor with stop_gradient=True; nothing to do")
+    if grad_tensor is None:
+        seed = jnp.ones(t._value.shape, t._value.dtype)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if t._node is None:
+        _accum_leaf(t, seed)
+        return
+
+    # ---- collect nodes + output-tensor registry (postorder topo) ----
+    order: List[GradNode] = []
+    seen = set()
+
+    def visit(node: GradNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for p in node.parents:
+            if p._node is not None:
+                visit(p._node)
+        order.append(node)
+
+    visit(t._node)
+    order.reverse()  # reverse topo: consumers before producers
+
+    # cotangent buffers keyed by node id -> list per output
+    cots = {id(n): [None] * len(n.out_avals) for n in order}
+    c = cots[id(t._node)]
+    c[t._out_idx] = seed if c[t._out_idx] is None else c[t._out_idx] + seed
+
+    # tensor-level hooks on the root
+    for h in t._hooks:
+        g = h(Tensor(c[t._out_idx]))
+        if g is not None:
+            c[t._out_idx] = g._value if isinstance(g, Tensor) else g
+
+    for node in order:
+        buf = cots[id(node)]
+        full = []
+        for i, (shape, dt) in enumerate(node.out_avals):
+            full.append(buf[i] if buf[i] is not None else jnp.zeros(shape, dt))
+        arg = tuple(full) if len(full) > 1 else full[0]
+        in_grads = node.vjp_fn(arg)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+        for parent, g in zip(node.parents, in_grads):
+            if g is None:
+                continue
+            for h in parent._hooks:
+                out = h(Tensor(g))
+                if out is not None:
+                    g = out._value if isinstance(out, Tensor) else out
+            if parent._node is None:
+                _accum_leaf(parent, g)
+            else:
+                pbuf = cots.get(id(parent._node))
+                if pbuf is None:
+                    continue
+                i = parent._out_idx
+                pbuf[i] = g if pbuf[i] is None else pbuf[i] + g
+        cots[id(node)] = None  # release
+
+    if not retain_graph:
+        # detach the swept subgraph so a second backward() raises clearly
+        t._node = None
+
+
+def _accum_leaf(parent: Tensor, g):
+    if parent.stop_gradient:
+        return
+    if parent.grad is None:
+        parent.grad = Tensor(g)
+    else:
+        parent.grad = Tensor(parent.grad._value + g)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (reference: imperative/partial_grad_engine.cc).
+
+    Computes grads of ``outputs`` w.r.t. ``inputs`` without touching
+    ``.grad`` on other leaves.
+    """
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    # snapshot .grad of EVERY leaf reachable from the outputs so the sweep
+    # doesn't pollute unrelated leaves (contract: only `inputs` results are
+    # reported; nothing else may change)
+    leaves = []
+    seen_nodes = set()
+    seen_leaves = set()
+
+    def collect(t: Tensor):
+        node = t._node
+        if node is None:
+            if id(t) not in seen_leaves:
+                seen_leaves.add(id(t))
+                leaves.append(t)
+            return
+        if id(node) in seen_nodes:
+            return
+        seen_nodes.add(id(node))
+        for p in node.parents:
+            collect(p)
+
+    for o in outs:
+        collect(o)
+    saved = [(t, t.grad) for t in leaves]
+    for i in ins:
+        if id(i) not in seen_leaves:
+            saved.append((i, i.grad))
+        i.grad = None
+    for t in leaves:
+        t.grad = None
+
+    retain = True if retain_graph is None else retain_graph
+    for k, o in enumerate(outs):
+        go = None
+        if grad_outputs is not None and grad_outputs[k] is not None:
+            go = grad_outputs[k]
+        run_backward(o, go, retain_graph=retain)
+    res = []
+    for i in ins:
+        if i.grad is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; pass "
+                    "allow_unused=True to return None for it")
+            res.append(None)
+        else:
+            res.append(i.grad)
+    for t, g in saved:
+        t.grad = g
+    return res
+
+
+# ----------------------------------------------------------------------
+# construction
+# ----------------------------------------------------------------------
+
+def to_tensor(data, dtype=None, place: Optional[Place] = None,
+              stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.to_jax(dtype))
+        t = Tensor(v, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (jnp.ndarray, jax.Array)) and not isinstance(data, np.ndarray):
+        v = data
+    else:
+        v = np.asarray(data)
+        if v.dtype == np.float64 and dtype is None:
+            v = v.astype(np.float32)  # TPU-native default float
+        if v.dtype == np.int64 and dtype is None:
+            v = v.astype(np.int32)
+    if dtype is not None:
+        jd = dtypes.to_jax(dtype)
+        v = jnp.asarray(v, dtype=jd)
+    if isinstance(v, jax.core.Tracer):
+        return Tensor(v, stop_gradient=stop_gradient)
+    dev = (place or _default_place()).jax_device()
+    arr = jax.device_put(v, dev)
+    return Tensor(arr, stop_gradient=stop_gradient)

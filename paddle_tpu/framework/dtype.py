@@ -1,0 +1,147 @@
+"""Dtype system.
+
+TPU-native re-design of the reference's VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto:106 ``VarType.Type``).
+Instead of a protobuf enum keyed into C++ kernels, dtypes here are thin
+aliases over JAX/numpy dtypes; bfloat16 is first-class because the MXU
+natively computes in bf16.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "dtype", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64",
+    "complex128", "bool", "convert_dtype", "iinfo", "finfo",
+    "is_floating_point", "is_integer",
+]
+
+# canonical names -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+
+class dtype:
+    """A named dtype, comparable with strings, numpy dtypes and itself.
+
+    Mirrors the surface of ``paddle.dtype`` while resolving to a JAX dtype
+    for execution.
+    """
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str):
+        if isinstance(name, dtype):
+            name = name.name
+        name = convert_dtype(name)
+        self.name = name
+        self.np_dtype = np.dtype(_NAME_TO_DTYPE[name])
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    __str__ = __repr__
+
+    def __eq__(self, other):
+        try:
+            return convert_dtype(other) == self.name
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+
+def convert_dtype(d) -> str:
+    """Normalise any dtype-like object to its canonical string name."""
+    if isinstance(d, dtype):
+        return d.name
+    if isinstance(d, str):
+        name = d
+        if name in ("float", ):
+            name = "float32"
+        if name in ("int", ):
+            name = "int32"
+        if name in _NAME_TO_DTYPE:
+            return name
+        raise ValueError(f"Unknown dtype string {d!r}")
+    if d is float:
+        return "float32"
+    if d is int:
+        return "int64"
+    if d is builtins.bool:
+        return "bool"
+    npd = np.dtype(d)
+    if npd == np.dtype(jnp.bfloat16):
+        return "bfloat16"
+    name = npd.name
+    if name in _NAME_TO_DTYPE:
+        return name
+    raise ValueError(f"Unsupported dtype {d!r}")
+
+
+def to_jax(d):
+    """dtype-like -> jnp dtype usable by jax.numpy."""
+    return _NAME_TO_DTYPE[convert_dtype(d)]
+
+
+uint8 = dtype("uint8")
+int8 = dtype("int8")
+int16 = dtype("int16")
+int32 = dtype("int32")
+int64 = dtype("int64")
+float16 = dtype("float16")
+bfloat16 = dtype("bfloat16")
+float32 = dtype("float32")
+float64 = dtype("float64")
+complex64 = dtype("complex64")
+complex128 = dtype("complex128")
+bool = dtype("bool")  # noqa: A001 - mirrors paddle.bool
+
+
+def iinfo(d):
+    return jnp.iinfo(to_jax(d))
+
+
+def finfo(d):
+    return jnp.finfo(to_jax(d))
+
+
+def is_floating_point(x):
+    from .core import Tensor
+    d = x.dtype if isinstance(x, Tensor) else x
+    return dtype(convert_dtype(d)).is_floating
+
+
+def is_integer(x):
+    from .core import Tensor
+    d = x.dtype if isinstance(x, Tensor) else x
+    return dtype(convert_dtype(d)).is_integer

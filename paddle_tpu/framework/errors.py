@@ -1,0 +1,90 @@
+"""Typed error system.
+
+TPU-native analog of PADDLE_ENFORCE + platform/errors.h
+(reference: paddle/fluid/platform/enforce.h, error_codes.proto). The
+reference encodes error categories in a proto enum and throws C++
+exceptions with demangled stacks; here each category is an exception type
+and ``enforce`` raises with a formatted, hint-carrying message.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError", "ExecutionTimeoutError",
+    "UnimplementedError", "UnavailableError", "FatalError", "ExternalError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_shape_match",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all framework errors (parity: platform::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg="", error_cls=InvalidArgumentError):
+    if not cond:
+        raise error_cls(msg or "Enforce condition failed")
+
+
+def enforce_eq(a, b, msg="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_gt(a, b, msg="", error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(f"{msg} (expected {a!r} > {b!r})")
+
+
+def enforce_shape_match(shape_a, shape_b, msg=""):
+    if list(shape_a) != list(shape_b):
+        raise InvalidArgumentError(
+            f"{msg} shape mismatch: {list(shape_a)} vs {list(shape_b)}")

@@ -1,0 +1,83 @@
+"""Global flags registry.
+
+TPU-native analog of the reference's gflags spine
+(reference: paddle/fluid/platform/flags.cc — 32 core DEFINEs — exposed to
+Python via pybind/global_value_getter_setter.cc, settable from env
+``FLAGS_*`` at import, or paddle.set_flags).
+
+Here flags are a plain typed registry; env vars ``FLAGS_*`` seed initial
+values at import. Flags that only made sense for CUDA memory pools are
+registered for API compatibility and ignored (XLA owns HBM).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+__all__ = ["get_flags", "set_flags", "define_flag", "FLAGS"]
+
+_lock = threading.Lock()
+_registry: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    with _lock:
+        _registry[name] = value
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for k, v in flags.items():
+            _registry[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    with _lock:
+        return {k: _registry.get(k) for k in flags}
+
+
+class _Flags:
+    def __getattr__(self, name):
+        key = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+        with _lock:
+            if key in _registry:
+                return _registry[key]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        key = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+        set_flags({key: value})
+
+
+FLAGS = _Flags()
+
+# core flags (parity names from platform/flags.cc)
+define_flag("FLAGS_check_nan_inf", False,
+            "scan op outputs for nan/inf after each eager op "
+            "(reference platform/flags.cc:44)")
+define_flag("FLAGS_benchmark", False,
+            "block_until_ready after each eager op for accurate timing "
+            "(reference platform/flags.cc FLAGS_benchmark)")
+define_flag("FLAGS_seed", 0, "global RNG seed")
+define_flag("FLAGS_allocator_strategy", "xla",
+            "ignored; XLA owns device memory (reference flags.cc:316)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "ignored on TPU (reference flags.cc:407)")
+define_flag("FLAGS_selected_gpus", "", "ignored; use set_device/jax devices")
+define_flag("FLAGS_cudnn_deterministic", True,
+            "TPU execution is deterministic by default (reference flags.cc:98)")

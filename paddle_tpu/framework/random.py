@@ -1,0 +1,79 @@
+"""RNG state.
+
+TPU-native re-design of the reference's stateful generators
+(reference: paddle/fluid/framework/generator.h:119 DefaultCPUGenerator,
+:126 GetDefaultCUDAGenerator — std::mt19937_64 / curand states).
+
+JAX randomness is functional (explicit keys). To preserve the reference's
+*stateful* API (``paddle.seed``, ops drawing fresh numbers each call) we
+keep a process-global key and split it on every draw. Inside ``jax.jit``
+traces the split still works (the key is a traced value only if captured;
+here it is a host-side constant per trace, matching dygraph semantics).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "split_key", "Generator"]
+
+_lock = threading.Lock()
+# Lazily initialised: creating a PRNGKey touches the device backend, which
+# must not happen at import time (the TPU tunnel is single-tenant).
+_KEY = None
+
+
+def _key():
+    global _KEY
+    if _KEY is None:
+        _KEY = jax.random.PRNGKey(0)
+    return _KEY
+
+
+def seed(s: int):
+    """Reset the global RNG. Mirrors paddle.seed."""
+    global _KEY
+    with _lock:
+        _KEY = jax.random.PRNGKey(int(s) & 0xFFFFFFFF)
+    return Generator(_KEY)
+
+
+def split_key(num: int = 1):
+    """Draw ``num`` fresh subkeys, advancing global state."""
+    global _KEY
+    with _lock:
+        keys = jax.random.split(_key(), num + 1)
+        _KEY = keys[0]
+        subs = keys[1:]
+    return subs[0] if num == 1 else list(subs)
+
+
+def get_rng_state():
+    return _key()
+
+
+def set_rng_state(state):
+    global _KEY
+    with _lock:
+        _KEY = state
+
+
+class Generator:
+    """Per-stream generator (parity surface with framework/generator.h)."""
+
+    def __init__(self, key=None):
+        self._key = key if key is not None else jax.random.PRNGKey(0)
+
+    def manual_seed(self, s: int):
+        self._key = jax.random.PRNGKey(int(s) & 0xFFFFFFFF)
+        return self
+
+    def split(self, num: int = 1):
+        keys = jax.random.split(self._key, num + 1)
+        self._key = keys[0]
+        return keys[1] if num == 1 else list(keys[1:])
+
+    @property
+    def state(self):
+        return self._key

@@ -1,0 +1,35 @@
+"""paddle_tpu.nn — layers + functional (parity surface: python/paddle/nn/)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer.activation import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.layers import Layer, Parameter, create_parameter  # noqa: F401
+from .layer.loss import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from .layer import (activation, common, container, conv, loss, norm,  # noqa: F401
+                    pooling, rnn, transformer)
+from .layer.layers import Layer as _Layer
+
+# paddle.nn.functional style alias
+from . import functional as F  # noqa: F401
+
+from .layer.activation import __all__ as _a
+from .layer.common import __all__ as _c
+from .layer.container import __all__ as _ct
+from .layer.conv import __all__ as _cv
+from .layer.loss import __all__ as _l
+from .layer.norm import __all__ as _n
+from .layer.pooling import __all__ as _p
+from .layer.rnn import __all__ as _r
+from .layer.transformer import __all__ as _t
+
+__all__ = (["Layer", "Parameter", "create_parameter", "functional",
+            "initializer", "ClipGradByGlobalNorm", "ClipGradByNorm",
+            "ClipGradByValue"] + _a + _c + _ct + _cv + _l + _n + _p + _r + _t)

@@ -1,0 +1,14 @@
+"""paddle_tpu.nn.functional — functional mirror of the layer library
+(parity surface: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+
+from . import activation, attention, common, conv, loss, pooling, vision  # noqa: F401
+
+__all__ = (activation.__all__ + attention.__all__ + common.__all__ +
+           conv.__all__ + loss.__all__ + pooling.__all__ + vision.__all__)

@@ -1,0 +1,203 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py;
+reference kernels paddle/fluid/operators/activation_op.{cc,cu}). Each is a
+single jnp/lax expression that XLA fuses into adjacent matmuls — the
+reference's fused variants (operators/fused/fused_bn_activation_op.*) are
+therefore unnecessary as separate entities."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+from ...framework.random import split_key
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "mish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "tanh",
+    "softmax", "log_softmax", "softplus", "softsign", "logsigmoid",
+    "maxout", "thresholded_relu", "glu", "gumbel_softmax", "tanh_",
+]
+
+
+def relu(x, name=None):
+    return _apply(jax.nn.relu, x, op_name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def relu6(x, name=None):
+    return _apply(jax.nn.relu6, x, op_name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return _apply(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                  x, op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _apply(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return _apply(lambda v: jax.nn.gelu(v, approximate=approximate), x,
+                  op_name="gelu")
+
+
+def silu(x, name=None):
+    return _apply(jax.nn.silu, x, op_name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return _apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x,
+                  op_name="mish")
+
+
+def sigmoid(x, name=None):
+    return _apply(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x,
+                  op_name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return _apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x,
+                  op_name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _apply(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x,
+                  op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                      jnp.where(v < -threshold, v + threshold,
+                                                0.0)),
+                  x, op_name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return _apply(lambda v: v - jnp.tanh(v), x, op_name="tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x,
+                  op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return _apply(f, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    if training:
+        k = split_key()
+
+        def f(v):
+            slope = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, slope * v)
+        return _apply(f, x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def tanh(x, name=None):
+    return _apply(jnp.tanh, x, op_name="tanh")
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework import dtype as _d
+            v = v.astype(_d.to_jax(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return _apply(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _apply(lambda v: jax.nn.log_softmax(v, axis=axis), x,
+                  op_name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _apply(lambda v: jnp.where(beta * v > threshold, v,
+                                      jax.nn.softplus(beta * v) / beta),
+                  x, op_name="softplus")
+
+
+def softsign(x, name=None):
+    return _apply(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def logsigmoid(x, name=None):
+    return _apply(jax.nn.log_sigmoid, x, op_name="logsigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return _apply(f, x, op_name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _apply(lambda v: jnp.where(v > threshold, v, 0.0), x,
+                  op_name="thresholded_relu")
+
+
+def glu(x, axis=-1, name=None):
+    def f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return _apply(f, x, op_name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    k = split_key()
+
+    def f(v):
+        g = jax.random.gumbel(k, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis,
+                                    dtype=y.dtype)
+            # straight-through estimator
+            return onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return _apply(f, x, op_name="gumbel_softmax")

@@ -1,0 +1,463 @@
+"""Common functionals: linear, dropout, norm application, padding,
+interpolate, one_hot, embedding (parity: python/paddle/nn/functional/common.py
++ input.py + norm.py; reference kernels operators/dropout_op.*,
+operators/layer_norm_op.*, batch_norm_op.*, lookup_table_v2_op.*,
+interpolate_v2_op.*, pad3d_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply, to_tensor
+from ...framework.random import split_key
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "zeropad2d", "interpolate", "upsample",
+    "batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm",
+    "normalize", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "unfold", "fold", "label_smooth", "class_center_sample",
+    "pairwise_distance",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Reference: operators/matmul_v2_op.* + elementwise_add
+    fused by XLA into one MXU call."""
+    if bias is not None:
+        return _apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                      op_name="linear")
+    return _apply(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0:
+        return x.clone() if isinstance(x, Tensor) else x
+    k = split_key()
+
+    def f(v):
+        if axis is None:
+            shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = tuple(v.shape[i] if i in [a % v.ndim for a in axes] else 1
+                          for i in range(v.ndim))
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+    return _apply(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    k = split_key()
+
+    def f(v):
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
+        return a * jnp.where(keep, v, jnp.full((), alpha_p, v.dtype)) + b
+    return _apply(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2_op.* — here a gather the TPU
+    executes natively; `sparse` grads become dense (XLA scatter-add)."""
+    idx = x._value.astype(jnp.int32) if isinstance(x, Tensor) else jnp.asarray(x, jnp.int32)
+
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return _apply(f, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(idx.astype(jnp.int32), num_classes,
+                                 dtype=jnp.float32))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy()]
+    pad = [int(p) for p in pad]
+    nd = x._value.ndim
+
+    if len(pad) == nd * 2:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        n_sp = len(pad) // 2
+        # paddle pads innermost spatial dims; map per data_format
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            sp = list(range(2, 2 + n_sp))
+        else:
+            sp = list(range(1, 1 + n_sp))
+        # paddle order is (left, right, top, bottom, front, back) over last
+        # spatial dim first
+        for i, axi in enumerate(reversed(sp)):
+            cfg[axi] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+    return _apply(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Reference: operators/interpolate_v2_op.* — jax.image.resize based."""
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    nd = x._value.ndim
+    n_sp = nd - 2
+    sp_axes = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x._value.shape[a] for a in sp_axes]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_sp
+        out_sizes = [int(in_sizes[i] * scale_factor[i]) for i in range(n_sp)]
+
+    method = {"nearest": "nearest", "bilinear": "bilinear",
+              "trilinear": "trilinear", "bicubic": "bicubic",
+              "linear": "linear", "area": "linear"}[mode]
+
+    def f(v):
+        shape = list(v.shape)
+        for i, a in enumerate(sp_axes):
+            shape[a] = out_sizes[i]
+        if method == "nearest" or not align_corners:
+            return jax.image.resize(v, shape, method=method)
+        # align_corners path: explicit coordinate map
+        out = v
+        for i, a in enumerate(sp_axes):
+            in_sz, out_sz = v.shape[a], out_sizes[i]
+            if out_sz == 1 or in_sz == 1:
+                idx = jnp.zeros(out_sz)
+            else:
+                idx = jnp.linspace(0, in_sz - 1, out_sz)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, in_sz - 1)
+            w = (idx - lo).astype(v.dtype)
+            shape_b = [1] * out.ndim
+            shape_b[a] = out_sz
+            w = w.reshape(shape_b)
+            out = (jnp.take(out, lo, axis=a) * (1 - w) +
+                   jnp.take(out, hi, axis=a) * w)
+        return out
+    return _apply(f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+# ---------------- normalisation application ----------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: operators/batch_norm_op.*. Running stats update is done
+    host-side on the Tensor (eager), matching the reference's in-place
+    mean/var variables."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NC")
+    nd = x._value.ndim
+    ch_axis = nd - 1 if channel_last and nd > 2 else 1
+    red_axes = tuple(i for i in range(nd) if i != ch_axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        mean = jnp.mean(x._value, axis=red_axes)
+        var = jnp.var(x._value, axis=red_axes)
+        # update running stats in place (eager side effect)
+        if running_mean is not None:
+            running_mean._value = (momentum * running_mean._value +
+                                   (1 - momentum) * mean)
+            running_var._value = (momentum * running_var._value +
+                                  (1 - momentum) * var)
+
+    def f(v, *params):
+        i = 0
+        if use_batch:
+            m = jnp.mean(v, axis=red_axes)
+            va = jnp.var(v, axis=red_axes)
+        else:
+            m, va = params[0], params[1]
+            i = 2
+
+        shape = [1] * nd
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(va.reshape(shape) + epsilon)
+        if len(params) > i:
+            out = out * params[i].reshape(shape)
+            out = out + params[i + 1].reshape(shape)
+        return out
+
+    args = [x]
+    if not use_batch:
+        args += [running_mean, running_var]
+    if weight is not None:
+        args += [weight, bias]
+    return _apply(f, *args, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    """Reference: operators/layer_norm_op.* — one fused XLA expression."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(list(normalized_shape))
+
+    def f(v, *params):
+        axes = tuple(range(v.ndim - n_norm, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        va = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(va + epsilon)
+        if params:
+            out = out * params[0] + params[1]
+        return out
+    if weight is not None:
+        return _apply(f, x, weight, bias, op_name="layer_norm")
+    return _apply(f, x, op_name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-5, data_format="NCHW", name=None):
+    nd = x._value.ndim
+    red_axes = tuple(range(2, nd))
+
+    def f(v, *params):
+        m = jnp.mean(v, axis=red_axes, keepdims=True)
+        va = jnp.var(v, axis=red_axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(va + epsilon)
+        if params:
+            shape = [1, v.shape[1]] + [1] * (nd - 2)
+            out = out * params[0].reshape(shape) + params[1].reshape(shape)
+        return out
+    if weight is not None:
+        return _apply(f, x, weight, bias, op_name="instance_norm")
+    return _apply(f, x, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    nd = x._value.ndim
+    ch_axis = nd - 1 if channel_last else 1
+
+    def f(v, *params):
+        c = v.shape[ch_axis]
+        g = num_groups
+        vm = jnp.moveaxis(v, ch_axis, 1)
+        shp = vm.shape
+        grouped = vm.reshape(shp[0], g, c // g, *shp[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        va = jnp.var(grouped, axis=axes, keepdims=True)
+        out = (grouped - m) * jax.lax.rsqrt(va + epsilon)
+        out = out.reshape(shp)
+        if params:
+            pshape = [1, c] + [1] * (out.ndim - 2)
+            out = out * params[0].reshape(pshape) + params[1].reshape(pshape)
+        return jnp.moveaxis(out, 1, ch_axis)
+    if weight is not None:
+        return _apply(f, x, weight, bias, op_name="group_norm")
+    return _apply(f, x, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(v):
+        sq = v * v
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        half = size // 2
+        c = v.shape[ch_axis]
+        sq_m = jnp.moveaxis(sq, ch_axis, -1)
+        padded = jnp.pad(sq_m, [(0, 0)] * (sq_m.ndim - 1) + [(half, size - 1 - half)])
+        win = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=-1)
+                  for i in range(size))
+        div = (k + alpha * win / size) ** beta
+        return v / jnp.moveaxis(div, -1, ch_axis)
+    return _apply(f, x, op_name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return _apply(f, x, op_name="normalize")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return _apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return _apply(f, x, y, op_name="pairwise_distance")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return _apply(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return _apply(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.swapaxes(3, 4).reshape(n, h, w, c)
+    return _apply(f, x, op_name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/math/im2col.*)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])])
+        out_h = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        out_w = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = v[:, :,
+                          i * dl[0]: i * dl[0] + out_h * st[0]: st[0],
+                          j * dl[1]: j * dl[1] + out_w * st[1]: st[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], out_h * out_w)
+    return _apply(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        H = os_[0] + 2 * pd[0]
+        W = os_[1] + 2 * pd[1]
+        out_h = (H - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        out_w = (W - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], out_h, out_w)
+        out = jnp.zeros((n, c, H, W), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :,
+                             i * dl[0]: i * dl[0] + out_h * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + out_w * st[1]: st[1]].add(
+                    v[:, :, i, j])
+        return out[:, :, pd[0]: H - pd[0], pd[1]: W - pd[1]]
+    return _apply(f, x, op_name="fold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(v, *pd):
+        k = v.shape[-1]
+        if pd:
+            return (1 - epsilon) * v + epsilon * pd[0]
+        return (1 - epsilon) * v + epsilon / k
+    if prior_dist is not None:
+        return _apply(f, label, prior_dist, op_name="label_smooth")
+    return _apply(f, label, op_name="label_smooth")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    lab = np.asarray(label._value)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.array([remap[v] for v in lab], np.int32)
+    return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled.astype(np.int32))))

@@ -1,0 +1,217 @@
+"""Convolution functionals.
+
+Parity: python/paddle/nn/functional/conv.py (reference kernels
+operators/conv_op.* with cuDNN algo search, conv_transpose_op.*).
+TPU-native design: one ``lax.conv_general_dilated`` call — XLA lowers it
+onto the MXU directly; there is no algo search/cache because the compiler
+picks the tiling (reference needed framework/conv_search_cache.h).
+NHWC is the TPU-preferred layout, but NCHW (paddle default) is accepted
+and handled by dimension_numbers without transposition cost.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        vv = list(v)
+        if len(vv) == 1:
+            vv = vv * n
+        return tuple(int(i) for i in vv)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride, kernel, dilation, in_sizes,
+             channel_last=False):
+    """Resolve paddle padding spec -> lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            pads = []
+            for i in range(n):
+                eff_k = (kernel[i] - 1) * dilation[i] + 1
+                out = -(-in_sizes[i] // stride[i])
+                total = max(0, (out - 1) * stride[i] + eff_k - in_sizes[i])
+                pads.append((total // 2, total - total // 2))
+            return pads
+        raise ValueError(f"bad padding {padding}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # per-dimension pair spec. Either n spatial pairs, or ndim pairs in
+        # data-format order (paddle allows [[0,0],[0,0],[ph,ph],[pw,pw]] for
+        # NCHW / [[0,0],[ph,ph],[pw,pw],[0,0]] for NHWC).
+        pairs = [tuple(int(v) for v in p) for p in padding]
+        if len(pairs) == n:
+            return pairs
+        if len(pairs) == n + 2:
+            if channel_last:
+                return pairs[1:-1]
+            return pairs[2:]
+        raise ValueError(f"bad padding {padding}")
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, op_name):
+    stride = _pair(stride, n)
+    dilation = _pair(dilation, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    sp_chars = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + sp_chars + "C") if channel_last else ("NC" + sp_chars)
+    # weight layout is always OIHW-style (paddle convention)
+    rhs_spec = "OI" + sp_chars
+    dn = jax.lax.conv_dimension_numbers(
+        x._value.shape, weight._value.shape, (lhs_spec, rhs_spec, lhs_spec))
+    in_sizes = [x._value.shape[lhs_spec.index(c)] for c in sp_chars]
+    kernel = [weight._value.shape[rhs_spec.index(c)] for c in sp_chars]
+    pads = _padding(padding, n, stride, kernel, dilation, in_sizes,
+                    channel_last)
+
+    def f(xv, wv, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            xv, wv, window_strides=stride, padding=pads,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    if bias is not None:
+        return _apply(f, x, weight, bias, op_name=op_name)
+    return _apply(f, x, weight, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NLC" if data_format == "NLC" else "NCL", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, op_name,
+                    output_size=None):
+    stride = _pair(stride, n)
+    dilation = _pair(dilation, n)
+    out_pad = _pair(output_padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    sp_chars = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + sp_chars + "C") if channel_last else ("NC" + sp_chars)
+    rhs_spec = "IO" + sp_chars  # paddle conv_transpose weight is (in, out//g, *k)
+    dn = jax.lax.conv_dimension_numbers(
+        x._value.shape, weight._value.shape, (lhs_spec, rhs_spec, lhs_spec))
+    in_sizes = [x._value.shape[lhs_spec.index(c)] for c in sp_chars]
+    kernel = [weight._value.shape[rhs_spec.index(c)] for c in sp_chars]
+    pads = _padding(padding, n, stride, kernel, dilation, in_sizes,
+                    channel_last)
+
+    # lax.conv_transpose padding semantics: we use the gradient-style
+    # transpose = insert (stride-1) zeros between inputs then VALID conv
+    # with flipped kernel; compute the equivalent lax padding.
+    t_pads = []
+    for i in range(n):
+        eff_k = (kernel[i] - 1) * dilation[i] + 1
+        lo = eff_k - 1 - pads[i][0]
+        hi = eff_k - 1 - pads[i][1] + out_pad[i]
+        t_pads.append((lo, hi))
+
+    # conv_transpose = insert (stride-1) zeros between inputs (lhs_dilation)
+    # then a VALID conv with the spatially-flipped kernel and swapped I/O.
+    # Weight comes in paddle layout (in, out//g, *k); flipping + treating it
+    # as OIHW-with-O=in gives the gradient-of-conv formulation.
+    fwd_rhs_spec = "OI" + sp_chars  # after explicit flip we use plain conv
+
+    def f(xv, wv, *maybe_bias):
+        wv = jnp.flip(wv, axis=tuple(range(2, 2 + n)))
+        # (in, out//g, *k) -> (out//g, in, *k) per group, contracting in
+        dn = jax.lax.conv_dimension_numbers(
+            xv.shape, (wv.shape[1] * groups, wv.shape[0] // groups,
+                       *wv.shape[2:]), (lhs_spec, fwd_rhs_spec, lhs_spec))
+        if groups == 1:
+            w_oihw = jnp.swapaxes(wv, 0, 1)
+            out = jax.lax.conv_general_dilated(
+                xv, w_oihw, window_strides=(1,) * n, padding=t_pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn)
+        else:
+            in_per_g = wv.shape[0] // groups
+            # split weight by input-channel groups and use one grouped conv:
+            # rearrange (g*inpg, out//g, *k) -> (g*out//g, inpg, *k)
+            wg = wv.reshape(groups, in_per_g, wv.shape[1], *wv.shape[2:])
+            wg = jnp.swapaxes(wg, 1, 2)  # g, out//g, inpg, *k
+            w_oihw = wg.reshape(groups * wv.shape[1], in_per_g,
+                                *wv.shape[2:])
+            out = jax.lax.conv_general_dilated(
+                xv, w_oihw, window_strides=(1,) * n, padding=t_pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if channel_last:
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    if bias is not None:
+        return _apply(f, x, weight, bias, op_name=op_name)
+    return _apply(f, x, weight, op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1,
+                           "NLC" if data_format == "NLC" else "NCL",
+                           "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose", output_size)

@@ -1,0 +1,320 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py; reference
+kernels operators/cross_entropy_op.*, softmax_with_cross_entropy_op.*,
+bce_loss_op.*, huber_loss_op.*, kldiv_loss_op.*, margin ops...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
+    "log_loss", "npair_loss", "sigmoid_focal_loss", "dice_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """Reference: operators/softmax_with_cross_entropy_op.* — fused
+    log_softmax + NLL in one XLA expression (numerically stable)."""
+    lab = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def f(v, *maybe_w):
+        logp = jax.nn.log_softmax(v, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(v, 1e-12, None))
+        if soft_label:
+            per = -jnp.sum(lab.astype(logp.dtype) * logp, axis=axis)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            per = -jnp.take_along_axis(
+                logp, jnp.expand_dims(li, axis), axis=axis).squeeze(axis)
+            mask = (li != ignore_index)
+            per = jnp.where(mask, per, jnp.zeros((), per.dtype))
+            if maybe_w:
+                w = maybe_w[0][li]
+                per = per * w
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(mask, w, jnp.zeros((), w.dtype)))
+                    return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                n_valid = jnp.maximum(jnp.sum(mask.astype(per.dtype)), 1.0)
+                return jnp.sum(per) / n_valid
+        return _reduce(per, reduction)
+    if weight is not None:
+        return _apply(f, input, weight, op_name="cross_entropy")
+    return _apply(f, input, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = _apply(lambda v: jnp.expand_dims(v, axis), loss, op_name="unsq")
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(v, lab, *maybe_w):
+        v = jnp.clip(v, 1e-12, 1 - 1e-12)
+        per = -(lab * jnp.log(v) + (1 - lab) * jnp.log(1 - v))
+        if maybe_w:
+            per = per * maybe_w[0]
+        return _reduce(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _apply(f, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(v, lab, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        max_val = jnp.clip(-v, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * lab + 1
+            per = (1 - lab) * v + log_w * (jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-v - max_val)) + max_val)
+        else:
+            per = (1 - lab) * v + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-v - max_val))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return _apply(f, *args, op_name="bce_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def f(v, *maybe_w):
+        li = lab.astype(jnp.int32)
+        per = -jnp.take_along_axis(v, jnp.expand_dims(li, 1), axis=1).squeeze(1)
+        mask = li != ignore_index
+        per = jnp.where(mask, per, jnp.zeros((), per.dtype))
+        if maybe_w:
+            wv = maybe_w[0][li]
+            per = per * wv
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(
+                    jnp.sum(jnp.where(mask, wv, jnp.zeros((), wv.dtype))), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(mask.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+    if weight is not None:
+        return _apply(f, input, weight, op_name="nll_loss")
+    return _apply(f, input, op_name="nll_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  input, label, op_name="l1_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _apply(lambda a, b: _reduce((a - b) ** 2, reduction),
+                  input, label, op_name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return _apply(lambda a, b: (a - b) ** 2, input, label,
+                  op_name="square_error_cost")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(per, reduction)
+    return _apply(f, input, label, op_name="smooth_l1")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, tgt):
+        per = tgt * (jnp.log(jnp.clip(tgt, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+    return _apply(f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, lab):
+        per = jnp.clip(-lab * (a - b) + margin, 0, None)
+        return _reduce(per, reduction)
+    return _apply(f, input, other, label, op_name="margin_ranking")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(a, lab):
+        per = jnp.where(lab == 1, a, jnp.clip(margin - a, 0, None))
+        return _reduce(per, reduction)
+    return _apply(f, input, label, op_name="hinge_embedding")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, lab):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(lab == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(per, reduction)
+    return _apply(f, input1, input2, label, op_name="cosine_embedding")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(x, y):
+            return jnp.sum(jnp.abs(x - y + epsilon) ** p, axis=-1) ** (1 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        per = jnp.clip(d_pos - d_neg + margin, 0, None)
+        return _reduce(per, reduction)
+    return _apply(f, input, positive, negative, op_name="triplet_margin")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(v, lab):
+        return -lab * jnp.log(v + epsilon) - (1 - lab) * jnp.log(
+            1 - v + epsilon)
+    return _apply(f, input, label, op_name="log_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, lab):
+        batch = a.shape[0]
+        sim = jnp.matmul(a, p.T)
+        tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) +
+                        jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return ce + reg
+    return _apply(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(v, lab, *maybe_norm):
+        p = jax.nn.sigmoid(v)
+        ce = jnp.clip(v, 0, None) - v * lab + jnp.log1p(jnp.exp(-jnp.abs(v)))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        per = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_norm:
+            per = per / maybe_norm[0]
+        return _reduce(per, reduction)
+    if normalizer is not None:
+        return _apply(f, logit, label, normalizer, op_name="focal")
+    return _apply(f, logit, label, op_name="focal")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(v, lab):
+        lab_oh = jax.nn.one_hot(lab.squeeze(-1).astype(jnp.int32),
+                                v.shape[-1], dtype=v.dtype)
+        inter = jnp.sum(v * lab_oh, axis=tuple(range(1, v.ndim)))
+        union = jnp.sum(v, axis=tuple(range(1, v.ndim))) + jnp.sum(
+            lab_oh, axis=tuple(range(1, lab_oh.ndim)))
+        return jnp.mean(1 - 2 * inter / (union + epsilon))
+    return _apply(f, input, label, op_name="dice_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward (reference: operators/warpctc_op.* wrapping warp-ctc).
+    TPU-native: dynamic-programming alpha recursion with lax.scan."""
+    ll = labels._value.astype(jnp.int32) if isinstance(labels, Tensor) else jnp.asarray(labels, jnp.int32)
+    il = input_lengths._value.astype(jnp.int32) if isinstance(input_lengths, Tensor) else jnp.asarray(input_lengths, jnp.int32)
+    tl = label_lengths._value.astype(jnp.int32) if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths, jnp.int32)
+
+    def f(lp):
+        # lp: (T, B, C) log-probs
+        if lp.ndim != 3:
+            raise ValueError("ctc_loss expects (T, B, C) log_probs")
+        T, B, C = lp.shape
+        S = ll.shape[1]
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(ll)
+        ext_len = 2 * tl + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = jnp.where(tl > 0, ll[:, 0], blank)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(tl > 0, lp[0, jnp.arange(B), first_lab], neg_inf))
+
+        can_skip = jnp.logical_and(
+            jnp.arange(2 * S + 1)[None, :] >= 2,
+            ext != jnp.roll(ext, 2, axis=1))
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf, lp.dtype), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf, lp.dtype), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(can_skip, a_shift2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(alpha, lp_t):
+            new, _ = step(alpha, lp_t)
+            return new, new
+
+        _, alphas = jax.lax.scan(scan_step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,B,2S+1)
+        # pick alpha at t = il-1, s in {ext_len-1, ext_len-2}
+        t_idx = jnp.clip(il - 1, 0, T - 1)
+        a_T = alphas[t_idx, jnp.arange(B)]  # (B, 2S+1)
+        lastA = jnp.take_along_axis(a_T, (ext_len - 1)[:, None], axis=1)[:, 0]
+        lastB = jnp.take_along_axis(a_T, jnp.clip(ext_len - 2, 0)[:, None],
+                                    axis=1)[:, 0]
+        nll = -jnp.logaddexp(lastA, lastB)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(tl.astype(nll.dtype), 1.0))
+        return _reduce(nll, reduction)
+    return _apply(f, log_probs, op_name="ctc_loss")

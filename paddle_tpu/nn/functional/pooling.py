@@ -1,0 +1,184 @@
+"""Pooling functionals (parity: python/paddle/nn/functional/pooling.py;
+reference kernels operators/pool_op.*, adaptive variants). Implemented with
+``lax.reduce_window`` — XLA's native windowed reduction on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        vv = list(v)
+        if len(vv) == 1:
+            vv = vv * n
+        return tuple(int(i) for i in vv)
+    return (int(v),) * n
+
+
+def _resolve_padding(padding, n, kernel, stride, sizes, ceil_mode):
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            return [(0, 0)] * n
+        pads = []
+        for i in range(n):
+            out = -(-sizes[i] // stride[i])
+            total = max(0, (out - 1) * stride[i] + kernel[i] - sizes[i])
+            pads.append((total // 2, total - total // 2))
+        return pads
+    p = _pair(padding, n) if not (isinstance(padding, (list, tuple)) and
+                                  isinstance(padding[0], (list, tuple))) else None
+    if p is not None:
+        pads = [(pp, pp) for pp in p]
+    else:
+        pads = [tuple(pp) for pp in padding]
+    if ceil_mode:
+        pads = [
+            (lo, hi + stride[i] - 1) for i, (lo, hi) in enumerate(pads)]
+    return pads
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, avg,
+          exclusive=True, ceil_mode=False, data_format="NCHW"):
+    kernel = _pair(kernel_size, n)
+    stride = _pair(stride if stride is not None else kernel_size, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    nd = x._value.ndim
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        sp_axes = list(range(1, nd - 1))
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        sp_axes = list(range(2, nd))
+    sizes = [x._value.shape[a] for a in sp_axes]
+    pads = _resolve_padding(padding, n, kernel, stride, sizes, ceil_mode)
+    full_pads = ([(0, 0)] + pads + [(0, 0)]) if channel_last else \
+        ([(0, 0), (0, 0)] + pads)
+
+    def f(v):
+        zero = jnp.zeros((), v.dtype)
+        if avg:
+            summed = jax.lax.reduce_window(
+                v, zero, jax.lax.add, window, strides, full_pads)
+            if exclusive and any(p != (0, 0) for p in pads):
+                ones = jnp.ones_like(v)
+                counts = jax.lax.reduce_window(
+                    ones, zero, jax.lax.add, window, strides, full_pads)
+                return summed / counts
+            return summed / np.prod(kernel)
+        neg_inf = jnp.full((), -jnp.inf, v.dtype)
+        return jax.lax.reduce_window(v, neg_inf, jax.lax.max, window,
+                                     strides, full_pads)
+    return _apply(f, x, op_name="pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf,
+                False, ceil_mode=ceil_mode,
+                data_format="NLC" if data_format == "NLC" else "NCL")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
+                 False, ceil_mode=ceil_mode, data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                 False, ceil_mode=ceil_mode, data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 data_format="NLC" if data_format == "NLC" else "NCL")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 data_format=data_format)
+
+
+def _adaptive_pool(x, output_size, n, avg, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sizes = _pair(output_size, n)
+    nd = x._value.ndim
+    sp_axes = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+
+    def f(v):
+        out = v
+        for i, ax in enumerate(sp_axes):
+            in_sz = v.shape[ax]
+            o = out_sizes[i]
+            if o is None:
+                continue
+            if in_sz % o == 0:
+                k = in_sz // o
+                # reshape trick: split the axis into (o, k) and reduce k
+                new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = r.mean(axis=ax + 1) if avg else r.max(axis=ax + 1)
+            else:
+                # general case: per-output-bin gather + reduce
+                starts = (np.arange(o) * in_sz) // o
+                ends = ((np.arange(o) + 1) * in_sz + o - 1) // o
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    seg = seg.mean(axis=ax, keepdims=True) if avg else \
+                        seg.max(axis=ax, keepdims=True)
+                    pieces.append(seg)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return _apply(f, x, op_name="adaptive_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, True, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, True, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, True, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "NCDHW")

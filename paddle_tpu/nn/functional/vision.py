@@ -1,0 +1,69 @@
+"""Vision functionals: affine_grid, grid_sample (parity:
+python/paddle/nn/functional/vision.py; reference kernels
+operators/affine_grid_op.*, grid_sampler_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, _apply
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(s) for s in out_shape.numpy()]
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).astype(th.dtype)  # h,w,3
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+    return _apply(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+            return v[jnp.arange(n)[:, None, None], :, iy, ix]  # n,ho,wo,c
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (fx - x0) * (y1 - fy)
+            wc = (x1 - fx) * (fy - y0)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None] +
+                   sample(x1, y0) * wb[..., None] +
+                   sample(x0, y1) * wc[..., None] +
+                   sample(x1, y1) * wd[..., None])
+        if padding_mode == "zeros":
+            inb = ((fx >= 0) & (fx <= w - 1) & (fy >= 0) & (fy <= h - 1))
+            out = out * inb[..., None].astype(out.dtype)
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return _apply(f, x, grid, op_name="grid_sample")

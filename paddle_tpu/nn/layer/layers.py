@@ -1,0 +1,372 @@
+"""Layer: the module base class.
+
+Parity target: reference python/paddle/fluid/dygraph/layers.py:76
+``class Layer`` (hooks at __call__:885, state_dict, sublayers,
+add_parameter/add_sublayer, train/eval, apply). Parameters are eager
+Tensors with ``stop_gradient=False``; for jit/pjit the layer exposes its
+parameter pytree so a whole model can be traced functionally
+(``functional_call``) — that's the TPU-native bridge eager->compiled.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework import dtype as dtypes
+from ...framework.core import Tensor
+from ...framework.random import split_key
+
+__all__ = ["Layer", "Parameter", "create_parameter"]
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (parity: framework.py ParamBase). Always
+    participates in autograd; ``trainable`` maps to stop_gradient."""
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter: " + super().__repr__()
+
+
+def create_parameter(shape, dtype="float32", initializer=None,
+                     is_bias=False, attr=None, default_initializer=None):
+    import jax.numpy as jnp
+    init = initializer or default_initializer
+    if init is None:
+        from ..initializer import Constant, XavierNormal
+        init = Constant(0.0) if is_bias else XavierNormal()
+    value = init(tuple(int(s) for s in shape), dtypes.to_jax(dtype))
+    return Parameter(value)
+
+
+class Layer:
+    """Base class of all NN modules."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = collections.OrderedDict()
+        self._hook_id = 0
+        self._name = name_scope or type(self).__name__
+
+    # ------------------------------------------------------------------
+    # attribute routing
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            subs.pop(name, None)
+            buffers.pop(name, None) if buffers else None
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ first")
+            subs[name] = value
+            params.pop(name, None)
+            object.__setattr__(self, name, value)
+            return
+        if params is not None and name in params and value is None:
+            del params[name]
+        if buffers is not None and name in buffers:
+            if isinstance(value, Tensor):
+                buffers[name] = value
+                object.__setattr__(self, name, value)
+                return
+            del buffers[name]
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called if normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+        if name in self.__dict__:
+            object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # registration API (parity: layers.py add_parameter/add_sublayer/
+    # register_buffer)
+    # ------------------------------------------------------------------
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter._value if isinstance(parameter, Tensor) else parameter)
+        setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        setattr(self, str(name), sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        return create_parameter(shape, dtype or self._dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    # ------------------------------------------------------------------
+    # iteration
+    # ------------------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ------------------------------------------------------------------
+    # hooks (parity: layers.py register_forward_pre_hook / post_hook)
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_post_hooks[hid] = hook
+        return _HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = []
+        for name, l in self._sub_layers.items():
+            mod_str = repr(l)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = f"{type(self).__name__}({self.extra_repr()}"
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    # ------------------------------------------------------------------
+    # state dict (parity: layers.py state_dict/set_state_dict)
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            short = name.rsplit(".", 1)[-1]
+            if short not in self._non_persistable_buffer_names:
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        import jax.numpy as jnp
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            val = src._value if isinstance(src, Tensor) else jnp.asarray(
+                np.asarray(src))
+            if tuple(val.shape) != tuple(target._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {val.shape} vs "
+                    f"{target._value.shape}")
+            target._value = val.astype(target._value.dtype)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+        import jax.numpy as jnp
+        for t in list(self.parameters()) + list(self.buffers()):
+            if dtype is not None and jnp.issubdtype(t._value.dtype, jnp.floating):
+                t._value = t._value.astype(dtypes.to_jax(dtype))
+            if device is not None:
+                from ...framework.place import set_device
+                place = set_device(device) if isinstance(device, str) else device
+                t._value = jax.device_put(t._value, place.jax_device())
+        if dtype is not None:
+            self._dtype = dtypes.convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.grad = None
+
+    # ------------------------------------------------------------------
+    # functional bridge for jit/pjit: run forward with an external
+    # parameter pytree (the TPU-native path; no reference analog — the
+    # reference serialises a ProgramDesc instead)
+    # ------------------------------------------------------------------
+    def raw_state(self) -> Dict[str, "jnp.ndarray"]:
+        return {k: v._value for k, v in self.state_dict().items()}
+
+    def functional_call(self, params: Dict[str, "jnp.ndarray"], *inputs,
+                        **kwargs):
+        """Run forward with parameter values taken from ``params``
+        (a flat dict name->array), restoring originals afterwards when
+        eager. Under jax tracing the swap is what makes the layer pure."""
+        state = self.state_dict()
+        old = {k: t._value for k, t in state.items()}
+        try:
+            for k, t in state.items():
+                if k in params:
+                    t._value = params[k]
+            return self(*inputs, **kwargs)
+        finally:
+            for k, t in state.items():
+                t._value = old[k]
+
+    def full_name(self):
+        return self._name
+
+
+class _HookRemoveHelper:
+    def __init__(self, d, hid):
+        self._d = d
+        self._hid = hid
+
+    def remove(self):
+        self._d.pop(self._hid, None)

@@ -1,0 +1,248 @@
+"""Normalisation layers (parity: python/paddle/nn/layer/norm.py; reference
+kernels operators/batch_norm_op.*, layer_norm_op.*, group_norm_op.*,
+instance_norm_op.*). BatchNorm keeps running stats as buffers updated
+eagerly — under jit the stats are part of the functional state pytree."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from .. import functional as F
+from ..initializer import Constant
+from .common import _resolve_init
+from .layers import Layer, Parameter
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "SpectralNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        w_init = _resolve_init(weight_attr, Constant(1.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0))
+        self.weight = Parameter(w_init((num_features,))) if w_init else None
+        self.bias = Parameter(b_init((num_features,))) if b_init else None
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}"
+
+
+class BatchNorm(_BatchNormBase):
+    """v1-style paddle.nn.BatchNorm(num_channels) (reference
+    fluid/dygraph/nn.py BatchNorm)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act == "relu":
+            out = F.relu(out)
+        elif self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN (reference: operators/sync_batch_norm_op.* — NCCL
+    allreduce of statistics). TPU-native: when running inside shard_map /
+    pjit with a data axis, the mean/var reduction happens with lax.pmean
+    over the axis; single-process eager falls back to local stats."""
+
+    AXIS_NAME = "dp"
+
+    def forward(self, x):
+        import jax
+        from ...framework.core import _apply
+        # under shard_map with a 'dp' axis, use pmean-reduced stats;
+        # outside any axis context the pmean raises and we fall back to
+        # plain BN (single-replica semantics are identical)
+        try:
+            def f(v, w, b, m, var):
+                ch_axis = 1 if self._data_format.startswith("NC") else v.ndim - 1
+                red = tuple(i for i in range(v.ndim) if i != ch_axis)
+                mean = jnp.mean(v, axis=red)
+                mean = jax.lax.pmean(mean, self.AXIS_NAME)
+                var_l = jnp.mean(jnp.square(v), axis=red)
+                var_l = jax.lax.pmean(var_l, self.AXIS_NAME) - jnp.square(mean)
+                shape = [1] * v.ndim
+                shape[ch_axis] = v.shape[ch_axis]
+                out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+                    var_l.reshape(shape) + self._epsilon)
+                return out * w.reshape(shape) + b.reshape(shape)
+            if self.training:
+                return _apply(f, x, self.weight, self.bias, self._mean,
+                              self._variance, op_name="sync_batch_norm")
+        except Exception:
+            pass
+        return super().forward(x)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively convert BatchNorm* sublayers to SyncBatchNorm
+        (parity: paddle.nn.SyncBatchNorm.convert_sync_batchnorm)."""
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            out = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight._value = layer.weight._value
+                out.bias._value = layer.bias._value
+            out._mean._value = layer._mean._value
+            out._variance._value = layer._variance._value
+        for name, sub in list(layer._sub_layers.items()):
+            setattr(out, name, cls.convert_sync_batchnorm(sub))
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        w_init = _resolve_init(weight_attr, Constant(1.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0))
+        shape = tuple(self._normalized_shape)
+        self.weight = Parameter(w_init(shape)) if w_init else None
+        self.bias = Parameter(b_init(shape)) if b_init else None
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        w_init = _resolve_init(weight_attr, Constant(1.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0))
+        self.weight = Parameter(w_init((num_channels,))) if w_init else None
+        self.bias = Parameter(b_init((num_channels,))) if b_init else None
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        w_init = _resolve_init(weight_attr, Constant(1.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0))
+        self.weight = Parameter(w_init((num_features,))) if w_init else None
+        self.bias = Parameter(b_init((num_features,))) if b_init else None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               epsilon=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral norm (reference: operators/spectral_norm_op.*)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        self._shape = list(weight_shape)
+        h = self._shape[dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != dim:
+                w *= s
+        from ..initializer import Normal
+        self.weight_u = Parameter(Normal(0, 1.0)((h,)), trainable=False)
+        self.weight_v = Parameter(Normal(0, 1.0)((w,)), trainable=False)
+
+    def forward(self, weight):
+        import jax
+        from ...framework.core import _apply
+
+        dim, eps, iters = self._dim, self._epsilon, self._power_iters
+
+        def f(w_mat, u0, v0):
+            wm = jnp.moveaxis(w_mat, dim, 0).reshape(w_mat.shape[dim], -1)
+            u, v = u0, v0
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w_mat / sigma
+        return _apply(f, weight, self.weight_u, self.weight_v,
+                      op_name="spectral_norm")

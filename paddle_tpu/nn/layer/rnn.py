@@ -1,0 +1,370 @@
+"""Recurrent layers.
+
+Parity: reference python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU +
+cells, RNN wrapper) whose CUDA kernels are operators/rnn_op / cudnn RNN.
+TPU-native design: the whole sequence loop is ONE ``lax.scan`` inside a
+single traced op — XLA unrolls nothing, keeps the loop on-device, and the
+MXU runs the per-step matmuls; autograd differentiates through the scan.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+from ...framework.random import split_key
+from .. import functional as F
+from ..initializer import Uniform
+from .layers import Layer, Parameter
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or [self.hidden_size]
+        return full([b] + list(shape), init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = Parameter(init((hidden_size, input_size)))
+        self.weight_hh = Parameter(init((hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((hidden_size,)))
+        self.bias_hh = Parameter(init((hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = _apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = Parameter(init((4 * hidden_size, input_size)))
+        self.weight_hh = Parameter(init((4 * hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((4 * hidden_size,)))
+        self.bias_hh = Parameter(init((4 * hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs),
+                      self.get_initial_states(inputs))
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = _apply(f, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, n_outputs=2,
+                      op_name="lstm_cell")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = Parameter(init((3 * hidden_size, input_size)))
+        self.weight_hh = Parameter(init((3 * hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((3 * hidden_size,)))
+        self.bias_hh = Parameter(init((3 * hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = _apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence loop (parity: nn/layer/rnn.py RNN).
+    Eager path loops in Python; under jit the loop body is traced per step
+    (use the fused SimpleRNN/LSTM/GRU layers for the scan-fused path)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        state = initial_states
+        outs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            xt = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, state = self.cell(xt, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        return stack(outs, axis=time_axis), state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _FusedRNNBase(Layer):
+    """Multi-layer (bi)directional RNN executed as stacked lax.scan —
+    one traced op for the whole network."""
+
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect",
+                                                 "bidirectional") else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        g = self.GATES
+        self._weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = (input_size if layer == 0
+                         else hidden_size * self.num_directions)
+                suffix = f"_reverse" if d == 1 else ""
+                wi = Parameter(init((g * hidden_size, in_sz)))
+                wh = Parameter(init((g * hidden_size, hidden_size)))
+                bi = Parameter(init((g * hidden_size,)))
+                bh = Parameter(init((g * hidden_size,)))
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._weights.append((wi, wh, bi, bh))
+
+    def _step(self, x, state, wi, wh, bi, bh):
+        raise NotImplementedError
+
+    def _zero_state(self):
+        return 1  # number of state tensors per direction-layer
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        """inputs: (B, T, C) or (T, B, C) if time_major."""
+        n_states = self._zero_state()
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        mode_lstm = n_states == 2
+        flat_w = [w for tup in self._weights for w in tup]
+
+        if initial_states is not None:
+            if mode_lstm:
+                init_h, init_c = initial_states
+                extra = [init_h, init_c]
+            else:
+                extra = [initial_states]
+        else:
+            extra = []
+
+        step = self._step
+        drop_p = self.dropout if (self.training and self.dropout > 0 and
+                                  nl > 1) else 0.0
+        drop_keys = (jax.random.split(split_key(), nl - 1)
+                     if drop_p > 0 else None)
+
+        def run(x, *args):
+            if initial_states is not None:
+                if mode_lstm:
+                    h_all, c_all = args[0], args[1]
+                    ws = args[2:]
+                else:
+                    h_all = args[0]
+                    ws = args[1:]
+            else:
+                ws = args
+                b = x.shape[1] if time_major else x.shape[0]
+                h_all = jnp.zeros((nl * nd, b, hs), x.dtype)
+                c_all = jnp.zeros((nl * nd, b, hs), x.dtype) if mode_lstm else None
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # (T, B, C)
+
+            out = x
+            final_h = []
+            final_c = []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    idx = layer * nd + d
+                    wi, wh, bi, bh = ws[4 * idx: 4 * idx + 4]
+                    h0 = h_all[idx]
+                    carry = (h0, c_all[idx]) if mode_lstm else h0
+                    seq = jnp.flip(out, 0) if d == 1 else out
+
+                    def scan_fn(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        new_carry, y = step(xt, carry, wi, wh, bi, bh)
+                        return new_carry, y
+
+                    last, ys = jax.lax.scan(scan_fn, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    if mode_lstm:
+                        final_h.append(last[0])
+                        final_c.append(last[1])
+                    else:
+                        final_h.append(last)
+                out = (jnp.concatenate(dir_outs, axis=-1)
+                       if nd == 2 else dir_outs[0])
+                # inter-layer dropout (parity: paddle RNN `dropout` arg —
+                # applied between stacked layers, not after the last)
+                if drop_p > 0 and layer < nl - 1:
+                    keep = jax.random.bernoulli(drop_keys[layer],
+                                                1.0 - drop_p, out.shape)
+                    out = jnp.where(keep, out / (1.0 - drop_p),
+                                    jnp.zeros((), out.dtype))
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            fh = jnp.stack(final_h, 0)
+            if mode_lstm:
+                return out, fh, jnp.stack(final_c, 0)
+            return out, fh
+
+        outs = _apply(lambda x, *a: tuple(run(x, *a)), inputs, *extra,
+                      *flat_w, op_name=self.MODE.lower())
+        if mode_lstm:
+            y, fh, fc = outs
+            return y, (fh, fc)
+        y, fh = outs
+        return y, fh
+
+
+class SimpleRNN(_FusedRNNBase):
+    MODE = "RNN"
+    GATES = 1
+
+    def _zero_state(self):
+        return 1
+
+    def _step(self, x, h, wi, wh, bi, bh):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h_new = act(x @ wi.T + bi + h @ wh.T + bh)
+        return h_new, h_new
+
+
+class LSTM(_FusedRNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+    def _zero_state(self):
+        return 2
+
+    def _step(self, x, carry, wi, wh, bi, bh):
+        h, c = carry
+        gates = x @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+
+class GRU(_FusedRNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+    def _zero_state(self):
+        return 1
+
+    def _step(self, x, h, wi, wh, bi, bh):
+        xg = x @ wi.T + bi
+        hg = h @ wh.T + bh
+        xr, xz, xn = jnp.split(xg, 3, axis=-1)
+        hr, hz, hn = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new

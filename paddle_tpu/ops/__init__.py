@@ -1,0 +1,8 @@
+"""paddle_tpu.ops — Pallas TPU kernels for the hot paths.
+
+The reference's analog is the hand-written CUDA fused op library
+(reference: paddle/fluid/operators/fused/, operators/jit/ runtime x86
+codegen). Here the compiler (XLA) covers most fusion; these kernels cover
+what it can't: blockwise attention and other manually-tiled patterns.
+"""
+from .flash_attention import flash_attention, flash_attention_bhsd  # noqa: F401

@@ -1,0 +1,168 @@
+"""Pallas flash attention for TPU.
+
+Greenfield per SURVEY.md §5.7 — the 2021-era reference has no fused
+attention (only the inference-side operators/fused/multihead_matmul_op.*);
+long-context capability is a requirement of this framework, not a port.
+
+Design: classic FlashAttention-style blockwise online softmax.
+- grid = (batch, heads, Q blocks); the K/V loop runs inside the kernel via
+  ``lax.fori_loop`` so K/V tiles stream HBM->VMEM block by block.
+- running max / denominator live in VMEM scratch (f32) for stability even
+  when inputs are bf16.
+- causal masking skips fully-masked K blocks (upper-triangular work is
+  never issued).
+- backward is a custom VJP that recomputes attention blockwise per Q chunk
+  (memory O(S·block) instead of O(S²)) in plain XLA — a fair trade for
+  round 1; a fused Pallas bwd kernel can replace it without API change.
+
+Layout convention here is (B, H, S, D); the public
+``nn.functional.scaled_dot_product_attention`` converts from paddle's
+(B, S, H, D).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention", "flash_attention_bhsd"]
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                scale: float, seq_k: int, block_q: int):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, d)
+
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    acc0 = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # K blocks beyond the diagonal of this Q block contribute nothing
+        num_kb_eff = jnp.minimum(num_kb,
+                                 (qi * block_q + block_q + block_k - 1)
+                                 // block_k)
+    else:
+        num_kb_eff = num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (b, h, sq // block_q)
+
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=scale, seq_k=sk, block_q=block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, q_: (b_, h_, q_, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, q_: (b_, h_, q_, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _ref_chunked(q, k, v, causal, scale, chunk=512):
+    """Blockwise-recompute attention in plain XLA (used for backward)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+
+    def one_chunk(qc, q0):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc * scale, k)
+        if causal:
+            q_pos = q0 + jnp.arange(qc.shape[2])[:, None]
+            k_pos = jnp.arange(sk)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    n = max(1, sq // chunk)
+    chunk = sq // n
+    outs = [one_chunk(jax.lax.dynamic_slice_in_dim(q, i * chunk, chunk, 2),
+                      i * chunk) for i in range(n)]
+    return jnp.concatenate(outs, axis=2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_bhsd(q, k, v, causal=False, scale=None, block_q=512,
+                         block_k=512, interpret=False):
+    """Flash attention on (B, H, S, D) tensors."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0:
+        return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return _ref_chunked(q, k, v, causal, scale)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention_bhsd(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(lambda q_, k_, v_: _ref_chunked(q_, k_, v_, causal, s),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512, interpret=False):
+    """Flash attention on paddle-layout (B, S, H, D) tensors."""
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhsd(qh, kh, vh, causal, scale, block_q, block_k,
+                               interpret)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,142 @@
+"""paddle_tpu.tensor — the op surface, mirrored onto Tensor as methods.
+
+The reference attaches ops to VarBase via monkey-patching
+(python/paddle/fluid/dygraph/varbase_patch_methods.py) plus build-time
+codegen'd C entry points (pybind/op_function_generator.cc). Here the same
+single Python table serves both eager and traced execution, so no codegen
+is needed: under ``jax.jit`` these same functions trace to XLA.
+"""
+from __future__ import annotations
+
+import operator as _operator
+
+from ..framework.core import Tensor, to_tensor
+
+from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import std, var, nanmean, nansum  # noqa: F401
+
+__all__ = (creation.__all__ + linalg.__all__ + logic.__all__ +
+           manipulation.__all__ + math.__all__ + random.__all__ +
+           search.__all__ + ["std", "var", "nanmean", "nansum", "einsum"])
+
+
+# ----------------------------------------------------------------------
+# attach methods to Tensor
+# ----------------------------------------------------------------------
+
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, floor_divide=math.floor_divide, mod=math.mod,
+    remainder=math.remainder, pow=math.pow, matmul=math.matmul,
+    maximum=math.maximum, minimum=math.minimum, abs=math.abs, neg=math.neg,
+    exp=math.exp, log=math.log, log2=math.log2, log10=math.log10,
+    log1p=math.log1p, sqrt=math.sqrt, rsqrt=math.rsqrt, square=math.square,
+    sign=math.sign, floor=math.floor, ceil=math.ceil, round=math.round,
+    reciprocal=math.reciprocal, sin=math.sin, cos=math.cos, tan=math.tan,
+    asin=math.asin, acos=math.acos, atan=math.atan, sinh=math.sinh,
+    cosh=math.cosh, tanh=math.tanh, erf=math.erf, sigmoid=math.sigmoid,
+    sum=math.sum, mean=math.mean, max=math.max, min=math.min,
+    prod=math.prod, cumsum=math.cumsum, cumprod=math.cumprod,
+    logsumexp=math.logsumexp, clip=math.clip, isnan=math.isnan,
+    isinf=math.isinf, isfinite=math.isfinite, scale=math.scale,
+    all=math.all, any=math.any, trace=math.trace, kron=math.kron,
+    inner=math.inner, outer=math.outer, lerp=math.lerp,
+    multiply_=math.multiply_,
+    # stat
+    std=std, var=var,
+    # manipulation
+    reshape=manipulation.reshape, reshape_=manipulation.reshape_,
+    flatten=manipulation.flatten, transpose=manipulation.transpose,
+    squeeze=manipulation.squeeze, squeeze_=manipulation.squeeze_,
+    unsqueeze=manipulation.unsqueeze, unsqueeze_=manipulation.unsqueeze_,
+    split=manipulation.split, chunk=manipulation.chunk,
+    tile=manipulation.tile, expand=manipulation.expand,
+    expand_as=manipulation.expand_as, broadcast_to=manipulation.broadcast_to,
+    flip=manipulation.flip, roll=manipulation.roll,
+    gather=manipulation.gather, gather_nd=manipulation.gather_nd,
+    scatter=manipulation.scatter, scatter_nd_add=manipulation.scatter_nd_add,
+    index_select=manipulation.index_select,
+    take_along_axis=manipulation.take_along_axis,
+    put_along_axis=manipulation.put_along_axis,
+    unique=manipulation.unique, unbind=manipulation.unbind,
+    repeat_interleave=manipulation.repeat_interleave,
+    tensordot=manipulation.tensordot,
+    # linalg
+    dot=linalg.dot, bmm=linalg.bmm, mm=linalg.mm, t=linalg.t,
+    norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
+    inverse=linalg.inv, matrix_power=linalg.matrix_power,
+    cross=linalg.cross, bincount=linalg.bincount,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal,
+    greater_than=logic.greater_than, greater_equal=logic.greater_equal,
+    less_than=logic.less_than, less_equal=logic.less_equal,
+    logical_and=logic.logical_and, logical_or=logic.logical_or,
+    logical_xor=logic.logical_xor, logical_not=logic.logical_not,
+    equal_all=logic.equal_all, allclose=logic.allclose,
+    isclose=logic.isclose,
+    bitwise_and=logic.bitwise_and, bitwise_or=logic.bitwise_or,
+    bitwise_xor=logic.bitwise_xor, bitwise_not=logic.bitwise_not,
+    # search
+    argmax=search.argmax, argmin=search.argmin, argsort=search.argsort,
+    sort=search.sort, topk=search.topk, where=search.where,
+    nonzero=search.nonzero, masked_select=search.masked_select,
+    kthvalue=search.kthvalue, mode=search.mode, median=search.median,
+    # creation-ish
+    fill_=None, tolist=creation.tolist,
+)
+
+
+def _install():
+    for name, fn in _METHODS.items():
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    def _binop(fn, reflected=False):
+        def op(self, other):
+            if reflected:
+                return fn(other if isinstance(other, Tensor) else to_tensor(other), self)
+            return fn(self, other)
+        return op
+
+    Tensor.__add__ = _binop(math.add)
+    Tensor.__radd__ = _binop(math.add, True)
+    Tensor.__sub__ = _binop(math.subtract)
+    Tensor.__rsub__ = _binop(math.subtract, True)
+    Tensor.__mul__ = _binop(math.multiply)
+    Tensor.__rmul__ = _binop(math.multiply, True)
+    Tensor.__truediv__ = _binop(math.divide)
+    Tensor.__rtruediv__ = _binop(math.divide, True)
+    Tensor.__floordiv__ = _binop(math.floor_divide)
+    Tensor.__rfloordiv__ = _binop(math.floor_divide, True)
+    Tensor.__mod__ = _binop(math.mod)
+    Tensor.__pow__ = _binop(math.pow)
+    Tensor.__rpow__ = _binop(math.pow, True)
+    Tensor.__matmul__ = _binop(math.matmul)
+    Tensor.__rmatmul__ = _binop(math.matmul, True)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__eq__ = lambda self, o: logic.equal(self, o)
+    Tensor.__ne__ = lambda self, o: logic.not_equal(self, o)
+    Tensor.__lt__ = lambda self, o: logic.less_than(self, o)
+    Tensor.__le__ = lambda self, o: logic.less_equal(self, o)
+    Tensor.__gt__ = lambda self, o: logic.greater_than(self, o)
+    Tensor.__ge__ = lambda self, o: logic.greater_equal(self, o)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__and__ = lambda self, o: logic.bitwise_and(self, o)
+    Tensor.__or__ = lambda self, o: logic.bitwise_or(self, o)
+    Tensor.__xor__ = lambda self, o: logic.bitwise_xor(self, o)
+    Tensor.__hash__ = object.__hash__
+
+
+_install()

@@ -1,0 +1,151 @@
+"""Tensor creation ops.
+
+Parity surface: python/paddle/tensor/creation.py in the reference, executed
+as XLA ops instead of per-device C++ kernels (reference kernels e.g.
+paddle/fluid/operators/fill_constant_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, _apply, to_tensor
+from ..framework.place import _default_place
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "arange", "linspace", "eye", "empty", "empty_like",
+    "meshgrid", "diag", "diagflat", "tril", "triu", "assign", "clone",
+    "numel", "tolist", "complex",
+]
+
+
+def _make(value, dtype):
+    dev = _default_place().jax_device()
+    return Tensor(jax.device_put(value, dev))
+
+
+def zeros(shape, dtype="float32", name=None):
+    return _make(jnp.zeros(_shape(shape), dtypes.to_jax(dtype)), dtype)
+
+
+def ones(shape, dtype="float32", name=None):
+    return _make(jnp.ones(_shape(shape), dtypes.to_jax(dtype)), dtype)
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _make(jnp.full(_shape(shape), fill_value, dtypes.to_jax(dtype)), dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros_like(x, dtype=None, name=None):
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return Tensor(jnp.zeros_like(x._value, dtype=jd))
+
+
+def ones_like(x, dtype=None, name=None):
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return Tensor(jnp.ones_like(x._value, dtype=jd))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=jd))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int32" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else "float32"
+    return _make(jnp.arange(start, end, step, dtype=dtypes.to_jax(dtype)), dtype)
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return _make(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                              dtype=dtypes.to_jax(dtype)), dtype)
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return _make(jnp.eye(num_rows, num_columns, dtype=dtypes.to_jax(dtype)), dtype)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._value for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v, offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), offset)
+        return jnp.diag(v, offset)
+    return _apply(f, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return _apply(lambda v: jnp.diagflat(v, offset), x, op_name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return _apply(lambda v: jnp.tril(v, diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return _apply(lambda v: jnp.triu(v, diagonal), x, op_name="triu")
+
+
+def assign(x, output=None):
+    src = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    res = _apply(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v,
+                 src, op_name="assign")
+    if output is not None:
+        output._value = res._value
+        output._node = res._node
+        output._out_idx = res._out_idx
+        return output
+    return res
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64 if False else jnp.int32))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def complex(real, imag, name=None):
+    return _apply(lambda r, i: jax.lax.complex(r, i), real, imag, op_name="complex")

@@ -1,0 +1,17 @@
+"""einsum (parity: python/paddle/tensor/einsum.py) — lowered straight to
+XLA dot_general chains by jnp.einsum, which the TPU MXU executes."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    ts = list(operands)
+    if len(ts) == 1 and isinstance(ts[0], (list, tuple)):
+        ts = list(ts[0])
+    return _apply(lambda *vs: jnp.einsum(equation, *vs), *ts,
+                  op_name="einsum")

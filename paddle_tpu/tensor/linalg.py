@@ -1,0 +1,217 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py; reference
+kernels operators/matmul_v2_op.*, operators/math/blas.h wrappers, svd/qr/
+eigh ops). On TPU these lower to MXU matmuls + XLA linalg."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+from .math import matmul  # re-export home
+
+__all__ = [
+    "matmul", "dot", "bmm", "mm", "t", "norm", "dist", "cond",
+    "cholesky", "inv", "pinv", "det", "slogdet", "matrix_power",
+    "matrix_rank", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "solve", "triangular_solve", "cholesky_solve", "lstsq", "lu", "mv",
+    "multi_dot", "cross", "histogram", "bincount", "corrcoef", "cov",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def dot(x, y, name=None):
+    return _apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y),
+                  op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return _apply(lambda a, b: jnp.matmul(a, b), _t(x), _t(vec), op_name="mv")
+
+
+def bmm(x, y, name=None):
+    return _apply(jnp.matmul, _t(x), _t(y), op_name="bmm")
+
+
+def mm(x, y, name=None):
+    return _apply(jnp.matmul, _t(x), _t(y), op_name="mm")
+
+
+def t(x, name=None):
+    return _apply(lambda v: v.T if v.ndim >= 2 else v, _t(x), op_name="t")
+
+
+def multi_dot(tensors, name=None):
+    return _apply(lambda *vs: jnp.linalg.multi_dot(vs),
+                  *[_t(v) for v in tensors], op_name="multi_dot")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(v * v))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return _apply(f, _t(x), op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_apply(jnp.subtract, _t(x), _t(y), op_name="sub"), p=float(p) if p not in ("fro",) else p)
+
+
+def cond(x, p=None, name=None):
+    v = _t(x)._value
+    return Tensor(jnp.asarray(np.linalg.cond(np.asarray(v, np.float64),
+                                             p=p), v.dtype))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return _apply(f, _t(x), op_name="cholesky")
+
+
+def inv(x, name=None):
+    return _apply(jnp.linalg.inv, _t(x), op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _apply(lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                            hermitian=hermitian),
+                  _t(x), op_name="pinv")
+
+
+def det(x, name=None):
+    return _apply(jnp.linalg.det, _t(x), op_name="det")
+
+
+def slogdet(x, name=None):
+    out = _apply(lambda v: tuple(jnp.linalg.slogdet(v)), _t(x),
+                 op_name="slogdet")
+    sign, logabs = out
+    return _apply(lambda s, l: jnp.stack([s, l]), sign, logabs,
+                  op_name="slogdet_pack")
+
+
+def matrix_power(x, n, name=None):
+    return _apply(lambda v: jnp.linalg.matrix_power(v, n), _t(x),
+                  op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    v = _t(x)._value
+    return Tensor(jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int32))
+
+
+def svd(x, full_matrices=False, name=None):
+    return tuple(_apply(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+        _t(x), op_name="svd"))
+
+
+def qr(x, mode="reduced", name=None):
+    out = _apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x),
+                 op_name="qr")
+    return tuple(out) if isinstance(out, (tuple, list)) else out
+
+
+def eig(x, name=None):
+    v = np.asarray(_t(x)._value)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    return tuple(_apply(lambda v: tuple(jnp.linalg.eigh(v,
+                                                        symmetrize_input=True)),
+                        _t(x), op_name="eigh"))
+
+
+def eigvals(x, name=None):
+    v = np.asarray(_t(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _apply(lambda v: jnp.linalg.eigvalsh(v), _t(x), op_name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return _apply(jnp.linalg.solve, _t(x), _t(y), op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return _apply(f, _t(x), _t(y), op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return _apply(f, _t(x), _t(y), op_name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    v, res, rank, sv = np.linalg.lstsq(np.asarray(_t(x)._value),
+                                       np.asarray(_t(y)._value), rcond=rcond)
+    return (Tensor(jnp.asarray(v)), Tensor(jnp.asarray(res)),
+            Tensor(jnp.asarray(np.int32(rank))), Tensor(jnp.asarray(sv)))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    v = _t(x)._value
+    lu_mat, piv = jsl.lu_factor(v)
+    if get_infos:
+        return Tensor(lu_mat), Tensor(piv.astype(jnp.int32)), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_mat), Tensor(piv.astype(jnp.int32))
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return _apply(f, _t(x), _t(y), op_name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = np.asarray(_t(input)._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+    hist, _ = np.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int32)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = _t(x)._value
+    w = _t(weights)._value if weights is not None else None
+    return Tensor(jnp.bincount(v.astype(jnp.int32), weights=w,
+                               minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x),
+                  op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _apply(lambda v: jnp.cov(v, rowvar=rowvar,
+                                    ddof=1 if ddof else 0),
+                  _t(x), op_name="cov")

@@ -1,0 +1,99 @@
+"""Comparison / logical ops (parity: python/paddle/tensor/logic.py;
+reference kernels operators/controlflow/compare_op.cc, logical_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "equal_all", "allclose", "isclose", "is_empty", "is_tensor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return np.asarray(x)
+
+
+def equal(x, y, name=None):
+    return Tensor(jnp.equal(_v(x), _v(y)))
+
+
+def not_equal(x, y, name=None):
+    return Tensor(jnp.not_equal(_v(x), _v(y)))
+
+
+def greater_than(x, y, name=None):
+    return Tensor(jnp.greater(_v(x), _v(y)))
+
+
+def greater_equal(x, y, name=None):
+    return Tensor(jnp.greater_equal(_v(x), _v(y)))
+
+
+def less_than(x, y, name=None):
+    return Tensor(jnp.less(_v(x), _v(y)))
+
+
+def less_equal(x, y, name=None):
+    return Tensor(jnp.less_equal(_v(x), _v(y)))
+
+
+def logical_and(x, y, out=None, name=None):
+    return Tensor(jnp.logical_and(_v(x), _v(y)))
+
+
+def logical_or(x, y, out=None, name=None):
+    return Tensor(jnp.logical_or(_v(x), _v(y)))
+
+
+def logical_xor(x, y, out=None, name=None):
+    return Tensor(jnp.logical_xor(_v(x), _v(y)))
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(_v(x)))
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_and(_v(x), _v(y)))
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_or(_v(x), _v(y)))
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_xor(_v(x), _v(y)))
+
+
+def bitwise_not(x, out=None, name=None):
+    return Tensor(jnp.bitwise_not(_v(x)))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_v(x), _v(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_v(x), _v(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_v(x), _v(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,414 @@
+"""Shape/layout manipulation ops.
+
+Parity surface: python/paddle/tensor/manipulation.py (reference kernels:
+operators/reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+operators/math/concat_and_split.*). All are metadata/copy ops XLA handles
+natively; gather/scatter lower to XLA gather/scatter which TPU executes
+on the vector unit.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "squeeze", "unsqueeze",
+    "concat", "stack", "split", "chunk", "unstack", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "roll", "gather", "gather_nd",
+    "scatter", "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "take_along_axis", "put_along_axis", "slice", "strided_slice", "crop",
+    "unique", "unique_consecutive", "unbind", "repeat_interleave",
+    "rot90", "moveaxis", "as_complex", "as_real", "view", "view_as",
+    "tensordot", "squeeze_", "unsqueeze_", "cast", "shard_index",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _static_shape(shape):
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    return _apply(lambda v: jnp.reshape(v, shape), _t(x), op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        if nd == 0:
+            return v.reshape(1)
+        s = start_axis % nd
+        e = stop_axis % nd
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return v.reshape(new_shape)
+    return _apply(f, _t(x), op_name="flatten")
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = [int(p) for p in perm]
+    return _apply(lambda v: jnp.transpose(v, perm), _t(x), op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return _apply(lambda v: jnp.moveaxis(v, source, destination), _t(x),
+                  op_name="moveaxis")
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+    return _apply(f, _t(x), op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    ax = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in ax]
+
+    def f(v):
+        out = v
+        for a in sorted([a % (v.ndim + len(ax)) if a >= 0 else a + v.ndim + len(ax) + 0 for a in ax]):
+            out = jnp.expand_dims(out, a)
+        return out
+    return _apply(f, _t(x), op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ts = [_t(v) for v in x]
+    return _apply(lambda *vs: jnp.concatenate(vs, axis=axis), *ts,
+                  op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    return _apply(lambda *vs: jnp.stack(vs, axis=axis), *ts, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    x = _t(x)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"paddle.split: axis {axis} size {dim} is not divisible by "
+                f"num {num_or_sections}; pass explicit section sizes")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            known = builtins_sum(s for s in sections if s >= 0)
+            sections[neg[0]] = dim - known
+    splits = np.cumsum(sections)[:-1].tolist()
+    outs = _apply(lambda v: tuple(jnp.split(v, splits, axis=axis)), x,
+                  op_name="split")
+    return list(outs)
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num or x.shape[axis]
+    outs = _apply(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+                  x, op_name="unstack")
+    return list(outs)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return _apply(lambda v: jnp.tile(v, reps), _t(x), op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _static_shape(shape)
+
+    def f(v):
+        tgt = list(shape)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - len(tgt) + v.ndim]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return _apply(f, _t(x), op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _apply(lambda v: jnp.flip(v, axis=tuple(ax)), _t(x), op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _t(x),
+                  op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _apply(lambda v: jnp.roll(v, shifts, axis=axis), _t(x),
+                  op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    idx = _t(index)._value.astype(jnp.int32)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return _apply(lambda v: jnp.take(v, idx, axis=axis), _t(x),
+                  op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = _t(index)._value.astype(jnp.int32)
+
+    def f(v):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+    return _apply(f, _t(x), op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = _t(index)._value.astype(jnp.int32).reshape(-1)
+
+    def f(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        # paddle semantics for overwrite=False: zero target rows then add
+        z = v.at[idx].set(jnp.zeros_like(u))
+        return z.at[idx].add(u)
+    return _apply(f, _t(x), _t(updates), op_name="scatter")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = _t(index)._value.astype(jnp.int32)
+    shape = _static_shape(shape)
+
+    def f(u):
+        z = jnp.zeros(shape, u.dtype)
+        k = idx.shape[-1]
+        return z.at[tuple(idx[..., i] for i in range(k))].add(u)
+    return _apply(f, _t(updates), op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _t(index)._value.astype(jnp.int32)
+
+    def f(v, u):
+        k = idx.shape[-1]
+        return v.at[tuple(idx[..., i] for i in range(k))].add(u)
+    return _apply(f, _t(x), _t(updates), op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    idx = _t(index)._value.astype(jnp.int32)
+    return _apply(lambda v: jnp.take_along_axis(v, idx, axis=1), _t(x),
+                  op_name="index_sample")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    idx = _t(indices)._value.astype(jnp.int32)
+    return _apply(lambda v: jnp.take_along_axis(v, idx, axis=axis), _t(arr),
+                  op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = _t(indices)._value.astype(jnp.int32)
+
+    def f(v, u):
+        u = jnp.broadcast_to(u, idx.shape).astype(v.dtype)
+        dims = []
+        for d in range(v.ndim):
+            if d == axis:
+                dims.append(idx)
+            else:
+                shape = [1] * v.ndim
+                shape[d] = v.shape[d]
+                dims.append(jnp.broadcast_to(
+                    jnp.arange(v.shape[d]).reshape(shape), idx.shape))
+        coords = tuple(dims)
+        if reduce == "assign":
+            return v.at[coords].set(u)
+        if reduce == "add":
+            return v.at[coords].add(u)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[coords].multiply(u)
+        raise ValueError(f"unknown reduce {reduce}")
+    return _apply(f, _t(arr), _t(values), op_name="put_along_axis")
+
+
+def slice(input, axes, starts, ends, name=None):
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+    axes = [int(a) for a in axes]
+    starts = [_v(s) for s in starts]
+    ends = [_v(e) for e in ends]
+
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return _apply(f, _t(input), op_name="slice")
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(a)] = builtins_slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return _apply(f, _t(x), op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shape = _static_shape(shape) if shape is not None else tuple(x.shape)
+    offsets = _static_shape(offsets) if offsets is not None else (0,) * x.ndim
+
+    def f(v):
+        idx = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+        return v[idx]
+    return _apply(f, x, op_name="crop")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = _t(x)._value
+    res = jnp.unique(np.asarray(v), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    v = np.asarray(_t(x)._value)
+    if axis is None:
+        v = v.reshape(-1)
+    keep = np.ones(v.shape[0], dtype=bool)
+    keep[1:] = np.any(v[1:] != v[:-1], axis=tuple(range(1, v.ndim))) if v.ndim > 1 else v[1:] != v[:-1]
+    out = v[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, v.shape[0]))
+        results.append(Tensor(jnp.asarray(counts.astype(np.int32))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.numpy() if isinstance(repeats, Tensor) else repeats
+    return _apply(lambda v: jnp.repeat(v, r, axis=axis), _t(x),
+                  op_name="repeat_interleave")
+
+
+def as_complex(x, name=None):
+    return _apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x),
+                  op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return _apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                  _t(x), op_name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return _apply(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y),
+                  op_name="tensordot")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Reference: operators/shard_index_op.* — maps global ids to per-shard
+    local ids (the PS sparse-table partition helper)."""
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(v):
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+    return _apply(f, _t(input), op_name="shard_index")

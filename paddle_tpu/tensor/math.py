@@ -1,0 +1,519 @@
+"""Math ops (elementwise, reductions, scans, matmul-adjacent scalars).
+
+Parity surface: python/paddle/tensor/math.py; reference kernels live in
+paddle/fluid/operators/elementwise/, operators/reduce_ops/,
+operators/activation_op.* — here each is one XLA op that the compiler
+fuses into neighbouring computations (no per-op kernel launches).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "matmul", "maximum", "minimum", "fmax", "fmin",
+    "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "sign", "floor", "ceil", "round", "trunc",
+    "reciprocal", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv",
+    "sigmoid", "logit", "sum", "mean", "max", "min", "prod", "cumsum",
+    "cumprod", "logsumexp", "logcumsumexp", "clip", "isnan", "isinf",
+    "isfinite", "nan_to_num", "add_n", "scale", "stanh", "multiplex",
+    "amax", "amin", "all", "any", "inner", "outer", "kron", "trace",
+    "diff", "angle", "conj", "real", "imag", "lerp", "rad2deg", "deg2rad",
+    "gcd", "lcm", "heaviside", "frac", "lgamma", "digamma", "multiply_",
+    "increment", "count_nonzero", "broadcast_shape",
+]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(np.asarray(x))
+
+
+def _binary(fn, x, y, name):
+    x = _t(x)
+    if isinstance(y, (int, float, bool, np.number)) and not isinstance(y, Tensor):
+        return _apply(lambda a: fn(a, y), x, op_name=name)
+    y = _t(y)
+    return _apply(fn, x, y, op_name=name)
+
+
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y, "multiply")
+
+
+def multiply_(x, y, name=None):
+    out = multiply(x, y)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def divide(x, y, name=None):
+    def f(a, b):
+        if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+            a = a.astype(jnp.float32)
+        return jnp.true_divide(a, b)
+    return _binary(f, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def mod(x, y, name=None):
+    return _binary(jnp.mod, x, y, "mod")
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return _binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return _binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return _binary(jnp.arctan2, x, y, "atan2")
+
+
+def gcd(x, y, name=None):
+    return _binary(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return _binary(jnp.lcm, x, y, "lcm")
+
+
+def heaviside(x, y, name=None):
+    return _binary(jnp.heaviside, x, y, "heaviside")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """MXU-bound matmul (reference: operators/matmul_v2_op.*). The transpose
+    flags fold into dot_general dimension numbers — no materialised transpose."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return _apply(f, _t(x), _t(y), op_name="matmul")
+
+
+# ---------------- unary ----------------
+
+def _unary(fn, x, name):
+    return _apply(fn, _t(x), op_name=name)
+
+
+def abs(x, name=None):
+    return _unary(jnp.abs, x, "abs")
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x, "neg")
+
+
+def exp(x, name=None):
+    return _unary(jnp.exp, x, "exp")
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x, "expm1")
+
+
+def log(x, name=None):
+    return _unary(jnp.log, x, "log")
+
+
+def log2(x, name=None):
+    return _unary(jnp.log2, x, "log2")
+
+
+def log10(x, name=None):
+    return _unary(jnp.log10, x, "log10")
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x, "log1p")
+
+
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x, "sqrt")
+
+
+def rsqrt(x, name=None):
+    return _unary(jax.lax.rsqrt, x, "rsqrt")
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x, "square")
+
+
+def sign(x, name=None):
+    return _unary(jnp.sign, x, "sign")
+
+
+def floor(x, name=None):
+    return _unary(jnp.floor, x, "floor")
+
+
+def ceil(x, name=None):
+    return _unary(jnp.ceil, x, "ceil")
+
+
+def round(x, name=None):
+    return _unary(jnp.round, x, "round")
+
+
+def trunc(x, name=None):
+    return _unary(jnp.trunc, x, "trunc")
+
+
+def frac(x, name=None):
+    return _unary(lambda v: v - jnp.trunc(v), x, "frac")
+
+
+def reciprocal(x, name=None):
+    return _unary(jnp.reciprocal, x, "reciprocal")
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x, "sin")
+
+
+def cos(x, name=None):
+    return _unary(jnp.cos, x, "cos")
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x, "tan")
+
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x, "asin")
+
+
+def acos(x, name=None):
+    return _unary(jnp.arccos, x, "acos")
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x, "atan")
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x, "sinh")
+
+
+def cosh(x, name=None):
+    return _unary(jnp.cosh, x, "cosh")
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x, "tanh")
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x, "asinh")
+
+
+def acosh(x, name=None):
+    return _unary(jnp.arccosh, x, "acosh")
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x, "atanh")
+
+
+def erf(x, name=None):
+    return _unary(jax.lax.erf, x, "erf")
+
+
+def erfinv(x, name=None):
+    return _unary(jax.lax.erf_inv, x, "erfinv")
+
+
+def lgamma(x, name=None):
+    return _unary(jax.lax.lgamma, x, "lgamma")
+
+
+def digamma(x, name=None):
+    return _unary(jax.lax.digamma, x, "digamma")
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return _unary(f, x, "logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda v: scale_b * jnp.tanh(scale_a * v), x, "stanh")
+
+
+def angle(x, name=None):
+    return _unary(jnp.angle, x, "angle")
+
+
+def conj(x, name=None):
+    return _unary(jnp.conj, x, "conj")
+
+
+def real(x, name=None):
+    return _unary(jnp.real, x, "real")
+
+
+def imag(x, name=None):
+    return _unary(jnp.imag, x, "imag")
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x, "rad2deg")
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x, "deg2rad")
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(_t(x)._value))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(_t(x)._value))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(_t(x)._value))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                           neginf=neginf), x, "nan_to_num")
+
+
+# ---------------- reductions ----------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return _apply(lambda v: jnp.sum(v, axis=axis, dtype=jd, keepdims=keepdim),
+                  _t(x), op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return _apply(lambda v: jnp.mean(v, axis=axis, keepdims=keepdim),
+                  _t(x), op_name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return _apply(lambda v: jnp.max(v, axis=axis, keepdims=keepdim),
+                  _t(x), op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return _apply(lambda v: jnp.min(v, axis=axis, keepdims=keepdim),
+                  _t(x), op_name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    axis = _norm_axis(axis)
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return _apply(lambda v: jnp.prod(v, axis=axis, dtype=jd, keepdims=keepdim),
+                  _t(x), op_name="prod")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return Tensor(jnp.all(_t(x)._value, axis=axis, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return Tensor(jnp.any(_t(x)._value, axis=axis, keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return Tensor(jnp.count_nonzero(_t(x)._value, axis=axis, keepdims=keepdim).astype(jnp.int32))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=jd)
+        return jnp.cumsum(v, axis=axis, dtype=jd)
+    return _apply(f, _t(x), op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return _apply(lambda v: jnp.cumprod(v, axis=dim, dtype=jd), _t(x),
+                  op_name="cumprod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return _apply(lambda v: jax.scipy.special.logsumexp(v, axis=axis,
+                                                        keepdims=keepdim),
+                  _t(x), op_name="logsumexp")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, v, axis=ax)
+    return _apply(f, _t(x), op_name="logcumsumexp")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return _apply(lambda v: jnp.clip(v, lo, hi), _t(x), op_name="clip")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _apply(lambda *vs: jax.tree_util.tree_reduce(jnp.add, list(vs)),
+                  *inputs, op_name="add_n")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+    out = _apply(f, _t(x), op_name="scale")
+    if act == "relu":
+        out = _apply(jax.nn.relu, out, op_name="relu")
+    elif act == "tanh":
+        out = _apply(jnp.tanh, out, op_name="tanh")
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = _apply(lambda v: v + value, x, op_name="increment")
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def multiplex(inputs, index, name=None):
+    idx_v = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(*vs):
+        stacked = jnp.stack(vs, axis=0)  # (n_candidates, batch, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx_v.reshape(-1).astype(jnp.int32), rows]
+    return _apply(f, *inputs, op_name="multiplex")
+
+
+def inner(x, y, name=None):
+    return _apply(lambda a, b: jnp.inner(a, b), _t(x), _t(y), op_name="inner")
+
+
+def outer(x, y, name=None):
+    return _apply(lambda a, b: jnp.outer(a, b), _t(x), _t(y), op_name="outer")
+
+
+def kron(x, y, name=None):
+    return _apply(jnp.kron, _t(x), _t(y), op_name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _apply(lambda v: jnp.trace(v, offset, axis1, axis2), _t(x),
+                  op_name="trace")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    pv = av = None
+    if prepend is not None:
+        args.append(_t(prepend))
+        pv = len(args) - 1
+    if append is not None:
+        args.append(_t(append))
+        av = len(args) - 1
+
+    def f(*vs):
+        kw = {}
+        if pv is not None:
+            kw["prepend"] = vs[pv]
+        if av is not None:
+            kw["append"] = vs[av]
+        return jnp.diff(vs[0], n=n, axis=axis, **kw)
+    return _apply(f, *args, op_name="diff")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return _apply(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight,
+                      op_name="lerp")
+    return _apply(lambda a, b: a + weight * (b - a), _t(x), _t(y),
+                  op_name="lerp")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

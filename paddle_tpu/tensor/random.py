@@ -1,0 +1,104 @@
+"""Random ops, drawing from the global stateful seed
+(parity: python/paddle/tensor/random.py; reference kernels
+operators/gaussian_random_op.*, uniform_random_op.*, dropout_op.*).
+
+Each call splits the global PRNG key (framework/random.py), so eager calls
+are stateful like the reference while staying functionally pure per call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, to_tensor
+from ..framework.random import split_key
+
+__all__ = [
+    "normal", "uniform", "randn", "rand", "randint", "randint_like",
+    "randperm", "multinomial", "standard_normal", "poisson", "bernoulli",
+    "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(split_key(), shp) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(split_key(), shp) * std + mean)
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return Tensor(jax.random.normal(split_key(), _shape(shape),
+                                    dtypes.to_jax(dtype)))
+
+
+def randn(shape, dtype="float32", name=None):
+    return standard_normal(shape, dtype)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else split_key()
+    return Tensor(jax.random.uniform(key, _shape(shape),
+                                     dtypes.to_jax(dtype), min, max))
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(split_key(), _shape(shape), low, high,
+                                     jnp.int32))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(split_key(), n).astype(jnp.int32))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x._value
+    logp = jnp.log(jnp.clip(v / jnp.sum(v, axis=-1, keepdims=True), 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(split_key(), logp,
+                                     shape=(*v.shape[:-1], num_samples) if v.ndim > 1 else (num_samples,))
+        if v.ndim > 1:
+            out = out.reshape(*v.shape[:-1], num_samples)
+    else:
+        key = split_key()
+        g = jax.random.gumbel(key, v.shape)
+        _, out = jax.lax.top_k(logp + g, num_samples)
+    return Tensor(out.astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(split_key(), x._value).astype(x._value.dtype))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(split_key(), x._value).astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jax.random.exponential(split_key(), x._value.shape,
+                                 x._value.dtype) / lam
+    x._value = out
+    return x

@@ -1,0 +1,151 @@
+"""Search / sort ops (parity: python/paddle/tensor/search.py; reference
+kernels operators/argsort_op.cc, arg_max_op.cc, top_k_v2_op.cc,
+where_op.cc, masked_select_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "masked_select", "index_sample", "searchsorted", "kthvalue", "mode",
+    "median", "nanmedian", "quantile",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = _t(x)._value
+    out = jnp.argmax(v, axis=axis, keepdims=keepdim) if axis is not None else jnp.argmax(v)
+    return Tensor(out.astype(jnp.int32))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = _t(x)._value
+    out = jnp.argmin(v, axis=axis, keepdims=keepdim) if axis is not None else jnp.argmin(v)
+    return Tensor(out.astype(jnp.int32))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    v = _t(x)._value
+    out = jnp.argsort(-v if descending else v, axis=axis, stable=True)
+    return Tensor(out.astype(jnp.int32))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return _apply(f, _t(x), op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    x = _t(x)
+    ax = -1 if axis is None else axis
+
+    # one top_k pass for indices; values come from a gather so the backward
+    # is a cheap scatter instead of re-running selection
+    vv = jnp.moveaxis(x._value, ax, -1)
+    idx = jax.lax.top_k(vv if largest else -vv, k)[1]
+
+    def f(v):
+        vm = jnp.moveaxis(v, ax, -1)
+        vals = jnp.take_along_axis(vm, idx, axis=-1)
+        return jnp.moveaxis(vals, -1, ax)
+    vals = _apply(f, x, op_name="topk")
+    return vals, Tensor(jnp.moveaxis(idx, -1, ax).astype(jnp.int32))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cond = _t(condition)._value
+
+    def f(a, b):
+        return jnp.where(cond, a, b)
+    return _apply(f, _t(x), _t(y), op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(_t(x)._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int32))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
+
+
+def masked_select(x, mask, name=None):
+    v = np.asarray(_t(x)._value)
+    m = np.asarray(_t(mask)._value).astype(bool)
+    return Tensor(jnp.asarray(v[m]))
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(_t(sorted_sequence)._value, _t(values)._value,
+                           side=side)
+    return Tensor(out.astype(jnp.int32))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+
+    def f(v):
+        s = jnp.sort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+    vals = _apply(f, x, op_name="kthvalue")
+    idx = jnp.take(jnp.argsort(x._value, axis=axis), k - 1, axis=axis)
+    if keepdim:
+        idx = jnp.expand_dims(idx, axis)
+    return vals, Tensor(idx.astype(jnp.int32))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(_t(x)._value)
+    vm = np.moveaxis(v, axis, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int32)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.nonzero(row == best)[0][-1]
+    shape = vm.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda v: jnp.median(v, axis=axis, keepdims=keepdim),
+                  _t(x), op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _apply(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                  _t(x), op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _apply(lambda v: jnp.quantile(v, jnp.asarray(q), axis=axis,
+                                         keepdims=keepdim),
+                  _t(x), op_name="quantile")

@@ -1,0 +1,49 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = ["mean", "std", "var", "numel", "median", "nanmean", "nansum"]
+
+from .math import mean  # noqa: F401 re-export
+from .search import median  # noqa: F401
+from .creation import numel  # noqa: F401
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _apply(lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), _t(x), op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _apply(lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), _t(x), op_name="var")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _apply(lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim),
+                  _t(x), op_name="nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return _apply(lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim),
+                  _t(x), op_name="nansum")

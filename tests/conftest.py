@@ -1,0 +1,24 @@
+"""Test config: force an 8-device virtual CPU mesh.
+
+The reference simulates multi-node with multi-process localhost
+(reference: python/paddle/fluid/tests/unittests/test_collective_base.py:162);
+on TPU we improve on that with XLA's host-platform device simulation —
+every test sees 8 virtual devices, so mesh/sharding tests run without
+real chips (SURVEY.md §4 lesson).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Tests compare against float64 NumPy references: force exact f32 matmuls.
+# (Production on TPU keeps the default fast MXU path.)
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+# The axon TPU plugin forces jax_platforms='axon,cpu' at import, overriding
+# the env var; pin it back so tests never touch the (single-tenant) TPU.
+jax.config.update("jax_platforms", "cpu")

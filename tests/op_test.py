@@ -1,0 +1,89 @@
+"""OpTest harness: NumPy-reference forward check + finite-difference grad
+check, the backbone of the reference's test strategy
+(reference: python/paddle/fluid/tests/unittests/op_test.py:238 OpTest,
+:101 get_numeric_gradient, :1262 check_output, :1335 check_grad).
+
+Usage:
+    check_op(paddle.tanh, [x_np], ref=np.tanh)        # fwd vs numpy
+    check_grad(paddle.tanh, [x_np])                   # analytic vs FD
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_op(fn, inputs, ref=None, ref_out=None, rtol=1e-4, atol=1e-4,
+             kwargs=None):
+    """Run ``fn`` on Tensors built from numpy ``inputs``; compare with the
+    numpy reference function ``ref`` (or precomputed ``ref_out``)."""
+    kwargs = kwargs or {}
+    ts = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i
+          for i in inputs]
+    out = fn(*ts, **kwargs)
+    if ref_out is None:
+        ref_out = ref(*[i for i in inputs], **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref_out if isinstance(ref_out, (tuple, list)) else [ref_out]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64)
+                                   if np.asarray(r).dtype.kind == "f"
+                                   else o.numpy(),
+                                   np.asarray(r), rtol=rtol, atol=atol)
+    return out
+
+
+def get_numeric_gradient(fn, inputs, wrt: int, out_grad=None, delta=1e-3,
+                         kwargs=None):
+    """Central finite differences of sum(fn*out_grad) w.r.t. inputs[wrt]
+    (parity: op_test.py:101 get_numeric_gradient)."""
+    kwargs = kwargs or {}
+
+    def scalar(xs):
+        ts = [paddle.to_tensor(x) for x in xs]
+        out = fn(*ts, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = 0.0
+        for i, o in enumerate(outs):
+            o_np = o.numpy().astype(np.float64)
+            g = np.ones_like(o_np) if out_grad is None else out_grad[i]
+            total += float((o_np * g).sum())
+        return total
+
+    x = inputs[wrt].astype(np.float64)
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = [i.copy() for i in inputs]
+        xm = [i.copy() for i in inputs]
+        xp[wrt] = xp[wrt].astype(np.float64)
+        xm[wrt] = xm[wrt].astype(np.float64)
+        xp[wrt][idx] += delta
+        xm[wrt][idx] -= delta
+        xp[wrt] = xp[wrt].astype(inputs[wrt].dtype)
+        xm[wrt] = xm[wrt].astype(inputs[wrt].dtype)
+        grad[idx] = (scalar(xp) - scalar(xm)) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def check_grad(fn, inputs, wrt=None, rtol=1e-2, atol=1e-3, delta=1e-3,
+               kwargs=None):
+    """Analytic (tape) gradient vs finite differences."""
+    kwargs = kwargs or {}
+    wrt = wrt if wrt is not None else list(range(len(inputs)))
+    ts = [paddle.to_tensor(i.astype(np.float64) if False else i,
+                           stop_gradient=False) for i in inputs]
+    out = fn(*ts, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    for o in outs[:-1]:
+        o.backward(retain_graph=True)
+    outs[-1].backward()
+    for w in wrt:
+        analytic = ts[w].grad.numpy().astype(np.float64)
+        numeric = get_numeric_gradient(fn, inputs, w, delta=delta,
+                                       kwargs=kwargs)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {w}")

@@ -1,0 +1,61 @@
+"""Pallas flash-attention kernel vs XLA reference (interpret mode on CPU,
+per pallas_guide debugging pattern)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.flash_attention import flash_attention_bhsd
+
+
+def _ref(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((sq, sk), bool), sk - sq)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 2, 256, 64).astype(np.float32)
+    k = rng.randn(2, 2, 256, 64).astype(np.float32)
+    v = rng.randn(2, 2, 256, 64).astype(np.float32)
+    scale = 1.0 / np.sqrt(64)
+    out = flash_attention_bhsd(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               causal, None, 128, 128, True)  # interpret
+    ref = _ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_gradients_match_reference():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return flash_attention_bhsd(q, k, v, True, None, 64, 64, True).sum()
+
+    def loss_ref(q, k, v):
+        return _ref(q, k, v, True, 1.0 / np.sqrt(64)).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-3)
+
+
+def test_non_divisible_seq_falls_back():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 1, 100, 32).astype(np.float32))
+    out = flash_attention_bhsd(q, q, q, False, None, 64, 64, True)
+    ref = _ref(q, q, q, False, 1.0 / np.sqrt(32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)

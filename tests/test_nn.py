@@ -1,0 +1,346 @@
+"""nn.Layer system + layer library tests (modelled on the reference's
+test_layers.py and per-layer unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(1)
+
+
+def _f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        sd = net.state_dict()
+        assert set(sd) == set(names)
+        net2 = Net()
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(net2.fc1.weight.numpy(),
+                                      net.fc1.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h1 = lin.register_forward_pre_hook(
+            lambda layer, inp: calls.append("pre"))
+        h2 = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append("post"))
+        lin(paddle.ones([1, 2]))
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        lin(paddle.ones([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_buffers(self):
+        class B(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.register_buffer("rm", paddle.zeros([3]))
+
+            def forward(self, x):
+                return x
+
+        b = B()
+        assert "rm" in b.state_dict()
+        assert len(b.parameters()) == 0
+
+    def test_sublayers_apply(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(net.sublayers()) == 3  # linear, sequential, inner linear
+        seen = []
+        net.apply(lambda l: seen.append(type(l).__name__))
+        assert "Sequential" in seen
+
+    def test_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        pl = nn.ParameterList([nn.Parameter(paddle.ones([2])._value)])
+        assert len(pl) == 1
+        d = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in d
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(3, 4)
+        x = _f32(5, 3)
+        out = lin(paddle.to_tensor(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_conv2d_matches_torch_style(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = _f32(1, 2, 5, 5)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [1, 3, 5, 5]
+        # VALID padding shape
+        conv2 = nn.Conv2D(2, 3, 3)
+        assert conv2(paddle.to_tensor(x)).shape == [1, 3, 3, 3]
+        # stride + groups
+        conv3 = nn.Conv2D(4, 4, 3, stride=2, groups=2, padding=1)
+        out3 = conv3(paddle.to_tensor(_f32(1, 4, 8, 8)))
+        assert out3.shape == [1, 4, 4, 4]
+
+    def test_conv2d_numeric(self):
+        # hand-check a 1x1x3x3 conv with known kernel
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        conv = nn.Conv2D(1, 1, 2, weight_attr=nn.initializer.Constant(1.0),
+                         bias_attr=nn.initializer.Constant(0.0))
+        out = conv(paddle.to_tensor(x)).numpy()
+        expected = np.array([[[[0+1+3+4, 1+2+4+5], [3+4+6+7, 4+5+7+8]]]],
+                            np.float32)
+        np.testing.assert_allclose(out, expected)
+
+    def test_conv_transpose_shape(self):
+        ct = nn.Conv2DTranspose(3, 2, 3, stride=2, padding=1)
+        out = ct(paddle.to_tensor(_f32(1, 3, 4, 4)))
+        assert out.shape == [1, 2, 7, 7]
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(_f32(4, 3, 5, 5))
+        out = bn(x)
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.to_tensor(_f32(2, 4, 8))
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)), atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_groupnorm_instancenorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.to_tensor(_f32(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+        inorm = nn.InstanceNorm2D(4)
+        assert inorm(paddle.to_tensor(_f32(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int32))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+    def test_dropout_modes(self):
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        out = d(x)
+        assert 0.5 < out.numpy().mean() < 1.5  # upscaled
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_pools(self):
+        x = paddle.to_tensor(_f32(1, 2, 4, 4))
+        assert nn.MaxPool2D(2)(x).shape == [1, 2, 2, 2]
+        assert nn.AvgPool2D(2)(x).shape == [1, 2, 2, 2]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        v = x.numpy()
+        np.testing.assert_allclose(
+            nn.MaxPool2D(2)(x).numpy(),
+            v.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5)), rtol=1e-6)
+
+    def test_activations(self):
+        x = paddle.to_tensor(_f32(3, 3))
+        for layer in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(),
+                      nn.LeakyReLU(), nn.Softmax(), nn.Silu(),
+                      nn.Hardswish(), nn.ELU()]:
+            assert layer(x).shape == [3, 3]
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(),
+                                   np.maximum(x.numpy(), 0))
+
+    def test_rnn_shapes_and_grad(self):
+        lstm = nn.LSTM(4, 6, num_layers=1)
+        x = paddle.randn([2, 5, 4])
+        y, (h, c) = lstm(x)
+        assert y.shape == [2, 5, 6] and h.shape == [1, 2, 6]
+        y.mean().backward()
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_lstm_matches_step_loop(self):
+        # fused scan == manual per-step cell
+        paddle.seed(3)
+        lstm = nn.LSTM(3, 4)
+        cell = nn.LSTMCell(3, 4)
+        cell.weight_ih._value = lstm.weight_ih_l0._value
+        cell.weight_hh._value = lstm.weight_hh_l0._value
+        cell.bias_ih._value = lstm.bias_ih_l0._value
+        cell.bias_hh._value = lstm.bias_hh_l0._value
+        x = paddle.to_tensor(_f32(2, 4, 3))
+        y_fused, (hN, cN) = lstm(x)
+        state = None
+        outs = []
+        for t in range(4):
+            o, state = cell(x[:, t], state)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(y_fused.numpy(),
+                                   np.stack(outs, axis=1), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        src = paddle.randn([2, 5, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_mha_cache_incremental_decode(self):
+        mha = nn.MultiHeadAttention(16, 2)
+        x = paddle.randn([1, 1, 16])
+        cache = mha.gen_cache(x)
+        out1, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 1
+        out2, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 2
+
+    def test_clip_grad_global_norm(self):
+        p = nn.Parameter(paddle.ones([4])._value)
+        g = paddle.full([4], 10.0)
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p, g)])
+        norm = np.linalg.norm(out[0][1].numpy())
+        np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+
+    def test_interpolate(self):
+        x = paddle.to_tensor(_f32(1, 1, 4, 4))
+        out = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert out.shape == [1, 1, 8, 8]
+        out2 = F.interpolate(x, size=[2, 2], mode="bilinear")
+        assert out2.shape == [1, 1, 2, 2]
+
+    def test_pad(self):
+        x = paddle.to_tensor(_f32(1, 1, 3, 3))
+        out = F.pad(x, [1, 1, 2, 2])
+        assert out.shape == [1, 1, 7, 5]
+
+
+class TestLosses:
+    def test_mse_l1(self):
+        a, b = _f32(4, 3), _f32(4, 3)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _f32(4, 5)
+        labels = np.array([1, 2, -100, 3])
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels.astype(np.int32)),
+                              ignore_index=-100)
+        from scipy.special import log_softmax
+        lp = log_softmax(logits, axis=-1)
+        ref = -(lp[0, 1] + lp[1, 2] + lp[3, 3]) / 3
+        np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+    def test_bce(self):
+        p = np.clip(np.abs(_f32(4)), 0.01, 0.99)
+        y = np.array([0, 1, 1, 0], np.float32)
+        out = F.binary_cross_entropy(paddle.to_tensor(p), paddle.to_tensor(y))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+    def test_ce_soft_label_and_grad(self):
+        logits = paddle.to_tensor(_f32(3, 4), stop_gradient=False)
+        soft = np.full((3, 4), 0.25, np.float32)
+        loss = F.cross_entropy(logits, paddle.to_tensor(soft),
+                               soft_label=True)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_kl_smooth_l1(self):
+        a = np.log(np.abs(_f32(3, 4)) + 0.5)
+        b = np.abs(_f32(3, 4)) + 0.5
+        b = b / b.sum(-1, keepdims=True)
+        out = F.kl_div(paddle.to_tensor(a), paddle.to_tensor(b),
+                       reduction="sum")
+        ref = (b * (np.log(b) - a)).sum()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+class TestInitializers:
+    def test_constant_assign(self):
+        from paddle_tpu.nn import initializer as I
+        assert I.Constant(3.0)((2, 2)).tolist() == [[3, 3], [3, 3]]
+        v = I.Assign(np.eye(2, dtype=np.float32))((2, 2))
+        np.testing.assert_array_equal(np.asarray(v), np.eye(2))
+
+    def test_xavier_stats(self):
+        from paddle_tpu.nn import initializer as I
+        paddle.seed(0)
+        w = np.asarray(I.XavierNormal()((200, 300)))
+        expected_std = (2.0 / 500) ** 0.5
+        assert abs(w.std() - expected_std) < expected_std * 0.1
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn import initializer as I
+        w = np.asarray(I.Orthogonal()((4, 4)))
+        np.testing.assert_allclose(w @ w.T, np.eye(4), atol=1e-5)
+
+
+class TestReviewRegressionsNN:
+    def test_conv_pairwise_padding_spec(self):
+        x = paddle.to_tensor(_f32(1, 2, 5, 5))
+        w = paddle.to_tensor(_f32(3, 2, 3, 3))
+        out = F.conv2d(x, w, padding=[[0, 0], [0, 0], [1, 1], [1, 1]])
+        ref = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_sdpa_dropout_applied_in_training(self):
+        import paddle_tpu.nn.functional as F2
+        q = paddle.randn([1, 8, 2, 16])
+        out_nodrop = F2.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+        out_drop = F2.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                                   training=True)
+        assert not np.allclose(out_nodrop.numpy(), out_drop.numpy())
+        out_eval = F2.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                                   training=False)
+        np.testing.assert_allclose(out_nodrop.numpy(), out_eval.numpy(),
+                                   rtol=1e-6)
+
+    def test_rnn_interlayer_dropout_active(self):
+        paddle.seed(0)
+        lstm = nn.LSTM(4, 8, num_layers=2, dropout=0.9)
+        x = paddle.randn([2, 6, 4])
+        y1, _ = lstm(x)
+        y2, _ = lstm(x)
+        assert not np.allclose(y1.numpy(), y2.numpy())  # stochastic in train
+        lstm.eval()
+        y3, _ = lstm(x)
+        y4, _ = lstm(x)
+        np.testing.assert_allclose(y3.numpy(), y4.numpy())

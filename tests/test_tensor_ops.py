@@ -1,0 +1,356 @@
+"""Op parity tests: forward vs NumPy, grads vs finite difference
+(modelled on the reference's per-op tests, e.g.
+python/paddle/fluid/tests/unittests/test_matmul_v2_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_op
+
+rng = np.random.RandomState(0)
+
+
+def _f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add(self):
+        a, b = _f32(3, 4), _f32(3, 4)
+        check_op(paddle.add, [a, b], ref=np.add)
+        check_grad(paddle.add, [a, b])
+
+    def test_broadcast_add(self):
+        a, b = _f32(3, 4), _f32(4)
+        check_op(paddle.add, [a, b], ref=np.add)
+        check_grad(paddle.add, [a, b])
+
+    def test_sub_mul_div(self):
+        a, b = _f32(2, 5), _f32(2, 5) + 2.0
+        check_op(paddle.subtract, [a, b], ref=np.subtract)
+        check_op(paddle.multiply, [a, b], ref=np.multiply)
+        check_op(paddle.divide, [a, b], ref=np.divide)
+        check_grad(paddle.divide, [a, b])
+
+    def test_pow_scalar(self):
+        a = np.abs(_f32(3, 3)) + 0.5
+        out = paddle.pow(paddle.to_tensor(a), 2.5)
+        np.testing.assert_allclose(out.numpy(), a ** 2.5, rtol=1e-5)
+
+    def test_maximum_minimum(self):
+        a, b = _f32(4, 4), _f32(4, 4)
+        check_op(paddle.maximum, [a, b], ref=np.maximum)
+        check_op(paddle.minimum, [a, b], ref=np.minimum)
+
+    def test_mod_floor_divide(self):
+        a = np.array([7, -7, 5], np.int32)
+        b = np.array([3, 3, 2], np.int32)
+        check_op(paddle.mod, [a, b], ref=np.mod)
+        check_op(paddle.floor_divide, [a, b], ref=np.floor_divide)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.exp, np.exp), (paddle.tanh, np.tanh),
+        (paddle.sin, np.sin), (paddle.cos, np.cos),
+        (paddle.abs, np.abs), (paddle.floor, np.floor),
+        (paddle.square, np.square), (paddle.sign, np.sign),
+    ])
+    def test_fwd(self, pfn, nfn):
+        a = _f32(3, 4)
+        check_op(pfn, [a], ref=nfn)
+
+    def test_log_sqrt_grad(self):
+        a = np.abs(_f32(3, 3)) + 0.5
+        check_op(paddle.log, [a], ref=np.log)
+        check_grad(paddle.log, [a])
+        check_grad(paddle.sqrt, [a])
+
+    def test_sigmoid(self):
+        a = _f32(4, 4)
+        check_op(paddle.sigmoid, [a], ref=lambda x: 1 / (1 + np.exp(-x)))
+        check_grad(paddle.sigmoid, [a])
+
+
+class TestReduce:
+    def test_sum_axes(self):
+        a = _f32(2, 3, 4)
+        check_op(paddle.sum, [a], ref_out=a.sum())
+        check_op(lambda x: paddle.sum(x, axis=1), [a], ref_out=a.sum(1))
+        check_op(lambda x: paddle.sum(x, axis=[0, 2], keepdim=True), [a],
+                 ref_out=a.sum((0, 2), keepdims=True))
+        check_grad(lambda x: paddle.sum(x, axis=1), [a])
+
+    def test_mean_max_min_prod(self):
+        a = _f32(3, 5)
+        check_op(paddle.mean, [a], ref_out=a.mean())
+        check_op(lambda x: paddle.max(x, axis=0), [a], ref_out=a.max(0))
+        check_op(lambda x: paddle.min(x, axis=1), [a], ref_out=a.min(1))
+        check_op(paddle.prod, [a], ref_out=a.prod(), rtol=1e-4)
+        check_grad(lambda x: paddle.max(x, axis=0), [a])
+
+    def test_cumsum_logsumexp(self):
+        a = _f32(3, 4)
+        check_op(lambda x: paddle.cumsum(x, axis=1), [a],
+                 ref_out=np.cumsum(a, 1))
+        from scipy.special import logsumexp as slse
+        check_op(lambda x: paddle.logsumexp(x, axis=1), [a],
+                 ref_out=slse(a, axis=1), rtol=1e-5)
+
+
+class TestMatmul:
+    def test_2d(self):
+        a, b = _f32(4, 3), _f32(3, 5)
+        check_op(paddle.matmul, [a, b], ref=np.matmul, rtol=1e-4)
+        check_grad(paddle.matmul, [a, b], rtol=2e-2)
+
+    def test_transpose_flags(self):
+        a, b = _f32(3, 4), _f32(5, 3)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-4)
+
+    def test_batched(self):
+        a, b = _f32(2, 4, 3), _f32(2, 3, 6)
+        check_op(paddle.matmul, [a, b], ref=np.matmul, rtol=1e-4)
+
+    def test_einsum(self):
+        a, b = _f32(2, 3), _f32(3, 4)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = _f32(2, 3, 4)
+        check_op(lambda x: paddle.reshape(x, [4, 6]), [a],
+                 ref_out=a.reshape(4, 6))
+        check_op(lambda x: paddle.transpose(x, [2, 0, 1]), [a],
+                 ref_out=a.transpose(2, 0, 1))
+        check_grad(lambda x: paddle.reshape(x, [-1]), [a])
+
+    def test_concat_stack_split(self):
+        a, b = _f32(2, 3), _f32(2, 3)
+        check_op(lambda x, y: paddle.concat([x, y], axis=1), [a, b],
+                 ref_out=np.concatenate([a, b], 1))
+        check_op(lambda x, y: paddle.stack([x, y]), [a, b],
+                 ref_out=np.stack([a, b]))
+        outs = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        np.testing.assert_allclose(outs[0].numpy(), a[:, :1])
+        np.testing.assert_allclose(outs[1].numpy(), a[:, 1:])
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = _f32(1, 3, 1)
+        assert paddle.squeeze(paddle.to_tensor(a)).shape == [3]
+        assert paddle.unsqueeze(paddle.to_tensor(a), 0).shape == [1, 1, 3, 1]
+        check_op(lambda x: paddle.tile(x, [2, 1, 2]), [a],
+                 ref_out=np.tile(a, (2, 1, 2)))
+
+    def test_gather_scatter(self):
+        a = _f32(5, 3)
+        idx = np.array([0, 2, 4], np.int32)
+        check_op(lambda x: paddle.gather(x, paddle.to_tensor(idx)), [a],
+                 ref_out=a[idx])
+        upd = _f32(3, 3)
+        out = paddle.scatter(paddle.to_tensor(a), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        exp = a.copy()
+        exp[idx] = upd
+        np.testing.assert_allclose(out.numpy(), exp)
+        check_grad(lambda x: paddle.gather(x, paddle.to_tensor(idx)), [a])
+
+    def test_gather_nd(self):
+        a = _f32(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        check_op(lambda x: paddle.gather_nd(x, paddle.to_tensor(idx)), [a],
+                 ref_out=a[idx[:, 0], idx[:, 1]])
+
+    def test_flip_roll_take_along(self):
+        a = _f32(3, 4)
+        check_op(lambda x: paddle.flip(x, [0]), [a], ref_out=a[::-1])
+        check_op(lambda x: paddle.roll(x, 1, 0), [a],
+                 ref_out=np.roll(a, 1, 0))
+        idx = np.array([[0, 1, 2, 0], [3, 2, 1, 0], [1, 1, 1, 1]], np.int32) % 3
+        check_op(lambda x: paddle.take_along_axis(x, paddle.to_tensor(idx), 0),
+                 [a], ref_out=np.take_along_axis(a, idx, 0))
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        a, b = _f32(3, 3), _f32(3, 3)
+        assert np.array_equal((paddle.to_tensor(a) > paddle.to_tensor(b)).numpy(), a > b)
+        assert np.array_equal(paddle.equal(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(), a == a)
+
+    def test_argmax_sort_topk(self):
+        a = _f32(4, 5)
+        assert np.array_equal(paddle.argmax(paddle.to_tensor(a), axis=1).numpy(), a.argmax(1))
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(a), axis=1).numpy(), np.sort(a, 1))
+        vals, idx = paddle.topk(paddle.to_tensor(a), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-a, 1)[:, :2])
+
+    def test_where_nonzero_masked(self):
+        a = _f32(3, 3)
+        cond = a > 0
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(a),
+                           paddle.to_tensor(-a))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, a, -a))
+        np.testing.assert_allclose(
+            paddle.masked_select(paddle.to_tensor(a), paddle.to_tensor(cond)).numpy(),
+            a[cond])
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        a = _f32(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.norm(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+    def test_solve_cholesky(self):
+        m = _f32(4, 4)
+        a = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        b = _f32(4, 2)
+        np.testing.assert_allclose(paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.cholesky(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.cholesky(a), rtol=1e-4, atol=1e-5)
+
+
+class TestCreationRandom:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], dtype='int32').numpy().dtype == np.int32
+        assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.eye(3).numpy().trace() == 3
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert x.min() >= 2.0 and x.max() < 3.0
+
+    def test_randperm_multinomial(self):
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+        probs = paddle.to_tensor(np.array([0.1, 0.0, 0.9], np.float32))
+        s = paddle.multinomial(probs, 100, replacement=True).numpy()
+        assert (s != 1).all()
+
+
+class TestAutogradEngine:
+    def test_chain(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x * x + 3 * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_accumulation_two_uses(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * x + x * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = x.detach() * 3
+        assert z.stop_gradient
+
+    def test_grad_api(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x ** 3
+        (g,) = paddle.framework.grad(y, [x])
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        assert x.grad is None
+
+    def test_hook(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 5).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0, 5.0])
+
+    def test_second_use_after_backward_raises_or_empty(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_grad_accumulate_across_backward(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_multi_output_op(self):
+        a = _f32(4, 4)
+        vals, idx = paddle.topk(paddle.to_tensor(a, stop_gradient=False), 2)
+        vals.sum().backward()
+
+
+class TestDtypePlace:
+    def test_astype(self):
+        x = paddle.ones([2], dtype='float32')
+        assert x.astype('int32').numpy().dtype == np.int32
+        assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+    def test_place(self):
+        x = paddle.ones([2])
+        assert x.place is not None
+        y = x.cpu()
+        assert y.place.is_cpu_place()
+
+    def test_item_float_int(self):
+        assert float(paddle.to_tensor([1.5]).sum()) == 1.5
+        assert int(paddle.to_tensor([3])) == 3
+
+
+class TestReviewRegressions:
+    """Regression tests for the round-1 code-review findings."""
+
+    def test_grad_api_does_not_pollute_other_leaves(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        w = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = (x * w).sum()
+        (g,) = paddle.framework.grad(y, [x])
+        np.testing.assert_allclose(g.numpy(), [3.0])
+        assert w.grad is None  # must not leak onto non-input leaves
+        assert x.grad is None
+
+    def test_logcumsumexp_correct(self):
+        x = np.array([0.0, 10.0, 5.0], np.float32)
+        out = paddle.logcumsumexp(paddle.to_tensor(x)).numpy()
+        ref = np.logaddexp.accumulate(x.astype(np.float64))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_split_non_divisible_raises(self):
+        with pytest.raises(Exception):
+            paddle.split(paddle.ones([5]), 2)
+
+    def test_topk_grad_routes_to_selected(self):
+        x = paddle.to_tensor(np.array([1.0, 5.0, 3.0], np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+        assert idx.numpy().tolist() == [1, 2]
