@@ -1,0 +1,186 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+Parity: reference dygraph autocast (paddle/fluid/imperative/amp_auto_cast.cc,
+python/paddle/amp/auto_cast.py:20) + GradScaler (amp/grad_scaler.py:20) +
+static rewrite (fluid/contrib/mixed_precision/).
+
+TPU-native difference: the native compute dtype is **bfloat16**, which has
+fp32-range exponent — loss scaling is therefore OPTIONAL (GradScaler is
+provided for API parity and fp16 use). Autocast routes the MXU-bound ops
+(matmul/conv/linear/einsum) through bf16 while keeping reductions and
+normalisations in fp32, mirroring the reference's white/black lists.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler",
+           "white_list", "black_list", "amp_state"]
+
+_state = threading.local()
+
+# parity naming with the reference's op lists
+# (fluid/contrib/mixed_precision/fp16_lists.py)
+white_list = {"matmul", "conv2d", "conv1d", "conv3d", "linear", "einsum",
+              "bmm", "mm", "mv"}
+black_list = {"softmax", "log_softmax", "layer_norm", "batch_norm", "mean",
+              "sum", "exp", "log", "cross_entropy"}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+class auto_cast(contextlib.ContextDecorator):
+    """with paddle.amp.auto_cast(): — bf16 compute for white-list ops."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+        self.custom_white = set(custom_white_list or [])
+        self.custom_black = set(custom_black_list or [])
+
+    def __enter__(self):
+        self._prev = amp_state()
+        _state.amp = self if self.enable else None
+        return self
+
+    def __exit__(self, *exc):
+        _state.amp = self._prev
+        return False
+
+    def should_cast(self, op_name: str) -> bool:
+        if op_name in self.custom_black or op_name in black_list:
+            return False
+        if self.level == "O2":
+            return True
+        return op_name in white_list or op_name in self.custom_white
+
+
+autocast = auto_cast
+
+
+def maybe_cast_inputs(op_name, *vals):
+    """Called by white-listed functional ops: cast float32 operands to the
+    autocast dtype (the reference does this inside Tracer::TraceOp,
+    imperative/tracer.cc:159)."""
+    st = amp_state()
+    if st is None or not st.should_cast(op_name):
+        return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and v.dtype == jnp.float32:
+            out.append(v.astype(st.dtype))
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, **kw):
+    """paddle.amp.decorate: O2 casts model params to the compute dtype
+    (master weights stay fp32 inside the optimizers, which already
+    accumulate in fp32)."""
+    if level == "O2" and models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: python/paddle/amp/grad_scaler.py:20;
+    reference state machine ops operators/amp/update_loss_scaling_op.*).
+
+    On TPU/bf16 scaling is typically unnecessary — ``enable=False`` makes
+    every method a passthrough, matching reference behavior."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = set()  # ids of optimizers already unscaled this step
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(~jnp.isfinite(g).all())
+                p.grad = Tensor(g)
+        self._found_inf = found
+        self._unscaled.add(id(optimizer))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)  # no-op if user already unscaled (clipping)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        self._unscaled.discard(id(optimizer))
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    is_use_dynamic_loss_scaling = is_enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]

@@ -1,0 +1,107 @@
+"""paddle_tpu.autograd (parity: python/paddle/autograd/ — backward, grad,
+PyLayer custom-op autograd; reference C++ engine imperative/basic_engine.cc)."""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..framework.core import (GradNode, Tensor, enable_grad, grad,  # noqa: F401
+                              is_grad_enabled, no_grad, run_backward,
+                              set_grad_enabled)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward over multiple roots."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """ctx object passed to PyLayer.forward/backward (parity:
+    python/paddle/autograd/py_layer.py)."""
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    saved_tensors = saved_tensor
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined forward/backward pair recorded on the eager tape.
+
+    class Tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * (1 - y * y)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not needs:
+            return out
+
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            gts = [Tensor(c) for c in cots]
+            with no_grad():
+                gin = cls.backward(ctx, *gts)
+            gin = gin if isinstance(gin, (tuple, list)) else [gin]
+            vals = []
+            for g in gin:
+                vals.append(g._value if isinstance(g, Tensor) else g)
+            return tuple(vals)
+
+        node = GradNode(vjp_fn, tensor_inputs,
+                        [(o._value.shape, o._value.dtype) for o in outs],
+                        name=cls.__name__)
+        for i, o in enumerate(outs):
+            o._node = node
+            o._out_idx = i
+            o.stop_gradient = False
+        return out if multi else outs[0]
+
+
+class PyLayerBackward:  # compat alias used by some scripts
+    pass

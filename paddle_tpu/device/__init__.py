@@ -1,0 +1,33 @@
+"""paddle_tpu.device (parity: python/paddle/device/)."""
+from ..framework.place import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+                               XPUPlace, device_count, get_device,
+                               is_compiled_with_cuda, is_compiled_with_tpu,
+                               is_compiled_with_xpu, set_device)
+
+__all__ = ["set_device", "get_device", "device_count", "is_compiled_with_cuda",
+           "is_compiled_with_xpu", "is_compiled_with_tpu", "TPUPlace",
+           "CPUPlace", "CUDAPlace", "XPUPlace", "Place", "cuda", "synchronize"]
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (reference:
+    platform device_context Wait). JAX: handled per-array; this flushes by
+    touching a trivial computation."""
+    import jax
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+
+
+class cuda:
+    """Compat namespace: paddle.device.cuda.* maps to the single accelerator."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        pass

@@ -5,6 +5,7 @@ framework/, imperative/ — see /root/reference/paddle/fluid/) with a thin
 TPU-native core: jax.Array storage, XLA memory, vjp-tape autograd.
 """
 from . import dtype  # noqa: F401  (the module; the class is dtype.dtype)
+from . import io  # noqa: F401
 from .core import (GradNode, Tensor, enable_grad, grad, is_grad_enabled,  # noqa: F401
                    no_grad, run_backward, set_grad_enabled, to_tensor)
 # NOTE: deliberately no `from .dtype import *` — it would shadow the

@@ -1,0 +1,68 @@
+"""Unified save/load (parity: python/paddle/framework/io.py:202 paddle.save,
+:292 paddle.load — pickled state_dict; the reference's per-variable
+save_combine_op path collapses into host-side numpy serialization since
+TPU tensors round-trip via host anyway).
+
+Checkpoints store numpy arrays; loading re-materialises on the current
+default place. Orbax-style sharded/async checkpointing for distributed
+training lives in distributed/checkpoint.py.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value))
+    if isinstance(obj, (jnp.ndarray, jax.Array)) and not isinstance(obj, np.ndarray):
+        return _TensorPayload(np.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj):
+    if isinstance(obj, _TensorPayload):
+        return Tensor(jnp.asarray(obj.array))
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save — state_dicts, Tensors, or arbitrary picklable nests."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_saveable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    """paddle.load."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_saveable(payload)

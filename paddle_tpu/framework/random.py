@@ -23,6 +23,28 @@ _lock = threading.Lock()
 # must not happen at import time (the TPU tunnel is single-tenant).
 _KEY = None
 
+# When a functional trace is active (jit/to_static), random ops split from
+# a *traced* key passed per call instead of the host-side global state, so
+# dropout/noise stay fresh across compiled steps (the reference's analog:
+# seed attrs on dropout ops + per-op curand states).
+_trace = threading.local()
+
+
+class use_key:
+    """Context: route split_key() to a traced key (functional RNG)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = getattr(_trace, "key", None)
+        _trace.key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        _trace.key = self._prev
+        return False
+
 
 def _key():
     global _KEY
@@ -40,7 +62,13 @@ def seed(s: int):
 
 
 def split_key(num: int = 1):
-    """Draw ``num`` fresh subkeys, advancing global state."""
+    """Draw ``num`` fresh subkeys, advancing global (or trace-local) state."""
+    tk = getattr(_trace, "key", None)
+    if tk is not None:
+        keys = jax.random.split(tk, num + 1)
+        _trace.key = keys[0]
+        subs = keys[1:]
+        return subs[0] if num == 1 else list(subs)
     global _KEY
     with _lock:
         keys = jax.random.split(_key(), num + 1)

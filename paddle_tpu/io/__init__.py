@@ -1,0 +1,25 @@
+"""paddle_tpu.io — Dataset / DataLoader / samplers.
+
+Parity: reference python/paddle/fluid/dataloader/ (Dataset, BatchSampler,
+dataloader_iter.py:265 single-process & :469 multi-process iterators with
+shared-memory tensor transport via memory/allocation/mmap_allocator.cc).
+
+TPU-native design: workers produce **host numpy** batches (multiprocessing
+with pickle/shm — no custom mmap allocator needed since the expensive hop
+is host->HBM, which happens once per batch via device_put, overlapped by a
+prefetch depth like the reference's buffered_reader
+(operators/reader/buffered_reader.cc)).
+"""
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa: F401
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "ConcatDataset", "Subset", "random_split",
+           "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "SubsetRandomSampler",
+           "WeightedRandomSampler", "DataLoader", "default_collate_fn",
+           "get_worker_info"]

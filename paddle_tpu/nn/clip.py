@@ -13,6 +13,13 @@ class ClipGradBase:
     def __call__(self, params_grads):
         raise NotImplementedError
 
+    def apply_values(self, grads):
+        """Pure-array variant used inside jitted train steps
+        (optimizer.functional_update): list of jax arrays -> clipped list."""
+        from ..framework.core import Tensor
+        pairs = [(None, Tensor(g)) for g in grads]
+        return [g._value for _, g in self(pairs)]
+
 
 class ClipGradByValue(ClipGradBase):
     def __init__(self, max, min=None):

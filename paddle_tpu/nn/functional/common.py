@@ -24,11 +24,18 @@ __all__ = [
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. Reference: operators/matmul_v2_op.* + elementwise_add
-    fused by XLA into one MXU call."""
+    fused by XLA into one MXU call. Under amp.auto_cast runs in bf16."""
+    from ...amp import maybe_cast_inputs
+
+    def f(v, w, *mb):
+        v, w = maybe_cast_inputs("linear", v, w)
+        out = jnp.matmul(v, w)
+        if mb:
+            out = out + mb[0].astype(out.dtype)
+        return out
     if bias is not None:
-        return _apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
-                      op_name="linear")
-    return _apply(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+        return _apply(f, x, weight, bias, op_name="linear")
+    return _apply(f, x, weight, op_name="linear")
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

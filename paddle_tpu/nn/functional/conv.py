@@ -87,12 +87,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
                     channel_last)
 
     def f(xv, wv, *maybe_bias):
+        from ...amp import maybe_cast_inputs
+        xv, wv = maybe_cast_inputs("conv2d", xv, wv)
         out = jax.lax.conv_general_dilated(
             xv, wv, window_strides=stride, padding=pads,
             rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=groups)
         if maybe_bias:
-            b = maybe_bias[0]
+            b = maybe_bias[0].astype(out.dtype)
             if channel_last:
                 out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
             else:

@@ -67,18 +67,19 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, avg,
         ([(0, 0), (0, 0)] + pads)
 
     def f(v):
-        zero = jnp.zeros((), v.dtype)
+        # NOTE: init values must be Python literals — jax recognises the
+        # (literal, add/max) monoid to derive the reverse-mode rule for
+        # reduce_window; traced-array inits break that pattern match.
         if avg:
             summed = jax.lax.reduce_window(
-                v, zero, jax.lax.add, window, strides, full_pads)
+                v, 0.0, jax.lax.add, window, strides, full_pads)
             if exclusive and any(p != (0, 0) for p in pads):
                 ones = jnp.ones_like(v)
                 counts = jax.lax.reduce_window(
-                    ones, zero, jax.lax.add, window, strides, full_pads)
+                    ones, 0.0, jax.lax.add, window, strides, full_pads)
                 return summed / counts
-            return summed / np.prod(kernel)
-        neg_inf = jnp.full((), -jnp.inf, v.dtype)
-        return jax.lax.reduce_window(v, neg_inf, jax.lax.max, window,
+            return (summed / np.prod(kernel)).astype(v.dtype)
+        return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window,
                                      strides, full_pads)
     return _apply(f, x, op_name="pool")
 

@@ -1,0 +1,8 @@
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
+                         Lars, Momentum, RMSProp)
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "lr"]

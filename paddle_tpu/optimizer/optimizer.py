@@ -1,0 +1,162 @@
+"""Optimizer base.
+
+Parity: reference python/paddle/optimizer/optimizer.py. The reference emits
+per-parameter *graph ops* (operators/optimizers/sgd_op.cc, adam_op.cc...);
+here each optimizer defines a pure ``_update(param, grad, *state) ->
+(new_param, *new_state)`` rule that runs as one jitted XLA call per
+parameter (fused muls/adds on the VPU), and the same rule is reusable
+inside a fully-jitted train step (jit/train_step.py) where XLA fuses the
+whole update sweep.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in the TPU-native build (no global "
+                "program to harvest them from)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)) and weight_decay is not None:
+            from ..regularizer import L2Decay
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        # state: param id -> dict of jnp arrays
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._global_step = 0
+        self._jit_update = jax.jit(self._update)
+
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when the lr is an LRScheduler; call "
+                "scheduler.step() instead (parity with the reference)")
+        self._learning_rate = value
+
+    # ------------------------------------------------------------------
+    def _create_state(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        """Per-parameter slot init (override)."""
+        return {}
+
+    def _update(self, p, g, lr, state: Dict[str, jnp.ndarray]):
+        """Pure update rule (override): returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            if self._weight_decay is not None:
+                gv = self._weight_decay.apply_gradient(p._value, gv)
+            sid = id(p)
+            if sid not in self._accumulators:
+                self._accumulators[sid] = self._create_state(p)
+            new_p, new_state = self._jit_update(p._value, gv, lr,
+                                               self._accumulators[sid])
+            p._value = new_p
+            self._accumulators[sid] = new_state
+        self._global_step += 1
+
+    minimize_step = step
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """paddle v1-style: backward + step in one call."""
+        loss.backward()
+        self.step()
+        return [], [(p, p.grad) for p in self._parameter_list]
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            st = self._accumulators.get(id(p))
+            if st:
+                key = p.name or f"param_{i}"
+                for k, v in st.items():
+                    out[f"{key}.{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        import numpy as np
+        self._global_step = int(state_dict.get("global_step", 0))
+        if (isinstance(self._learning_rate, LRScheduler)
+                and "LR_Scheduler" in state_dict):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = self._create_state(p)
+            found = False
+            for k in list(st):
+                sk = f"{key}.{k}"
+                if sk in state_dict:
+                    v = state_dict[sk]
+                    st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+
+    # functional view for jitted train steps -----------------------------
+    def opt_state(self):
+        """Pytree of all accumulator state, aligned with parameter list."""
+        states = []
+        for p in self._parameter_list:
+            if id(p) not in self._accumulators:
+                self._accumulators[id(p)] = self._create_state(p)
+            states.append(self._accumulators[id(p)])
+        return states
+
+    def functional_update(self, params: Sequence[jnp.ndarray],
+                          grads: Sequence[jnp.ndarray], states, lr=None):
+        """Pure batched update for use inside jit/pjit (no Tensor objects).
+        Applies grad_clip and weight_decay exactly like the eager step()."""
+        lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply_values(list(grads))
+        new_ps, new_ss = [], []
+        for p, g, s in zip(params, grads, states):
+            if self._weight_decay is not None:
+                g = self._weight_decay.apply_gradient(p, g)
+            np_, ns = self._update(p, g, lr, s)
+            new_ps.append(np_)
+            new_ss.append(ns)
+        return new_ps, new_ss
+
+    def load_opt_state(self, states):
+        for p, s in zip(self._parameter_list, states):
+            self._accumulators[id(p)] = s

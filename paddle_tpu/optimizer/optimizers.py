@@ -1,0 +1,282 @@
+"""The optimizer zoo.
+
+Parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,adamax,adagrad,
+adadelta,rmsprop,lamb}.py + incubate Lars. Reference executes these as
+per-parameter C++/CUDA graph ops (operators/optimizers/*.cc); here each is
+a pure jitted update rule (see optimizer.py) fused by XLA.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "Lars"]
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, lr, state):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, p, g, lr, state):
+        g = g.astype(p.dtype)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            step = g + self._momentum * v
+        else:
+            step = v
+        return p - lr.astype(p.dtype) * step, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        return {"m": jnp.zeros_like(p._value),
+                "v": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _adam_core(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["v"] + (1 - self._beta2) * (g32 * g32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        step = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        new_state = {"m": m, "v": v, "beta1_pow": b1p, "beta2_pow": b2p}
+        return step, new_state
+
+    def _update(self, p, g, lr, state):
+        step, new_state = self._adam_core(p, g, lr, state)
+        return (p.astype(jnp.float32) - step).astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (the reference implements AdamW as adam op +
+    pre-scaled param decay, python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        self._coeff = weight_decay if isinstance(weight_decay, (int, float)) \
+            else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._decay_flags = {
+            id(p): (apply_decay_param_fun is None or
+                    apply_decay_param_fun(p.name))
+            for p in self._parameter_list}
+
+    def _update(self, p, g, lr, state):
+        step, new_state = self._adam_core(p, g, lr, state)
+        p32 = p.astype(jnp.float32)
+        decay = state["decay"]
+        p32 = p32 * (1.0 - lr * self._coeff * decay)
+        new_state["decay"] = decay  # carry the flag through every step
+        return (p32 - step).astype(p.dtype), new_state
+
+    def _create_state(self, p):
+        st = super()._create_state(p)
+        st["decay"] = jnp.asarray(
+            1.0 if self._decay_flags.get(id(p), True) else 0.0, jnp.float32)
+        return st
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        return {"m": jnp.zeros_like(p._value),
+                "inf_norm": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        b1p = state["beta1_pow"] * self._beta1
+        step = lr * m / ((1 - b1p) * (u + self._eps))
+        return ((p.astype(jnp.float32) - step).astype(p.dtype),
+                {"m": m, "inf_norm": u, "beta1_pow": b1p})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        acc = state["moment"] + g32 * g32
+        step = lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return ((p.astype(jnp.float32) - step).astype(p.dtype),
+                {"moment": acc})
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._eps = epsilon
+        self._rho = rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p._value),
+                "avg_sq_update": jnp.zeros_like(p._value)}
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_sq_grad"] + (1 - self._rho) * g32 * g32
+        upd = (jnp.sqrt(state["avg_sq_update"] + self._eps) /
+               jnp.sqrt(asg + self._eps)) * g32
+        asu = self._rho * state["avg_sq_update"] + (1 - self._rho) * upd * upd
+        return ((p.astype(jnp.float32) - lr * upd).astype(p.dtype),
+                {"avg_sq_grad": asg, "avg_sq_update": asu})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._value),
+              "momentum": jnp.zeros_like(p._value)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._value)
+        return st
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new_state = {"mean_square": ms, "momentum": mom}
+        if self._centered:
+            new_state["mean_grad"] = mg
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large batch (reference:
+    operators/optimizers/lamb_op.*)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd_flags = {
+            id(p): 0.0 if (exclude_from_weight_decay_fn is not None and
+                           exclude_from_weight_decay_fn(p)) else 1.0
+            for p in self._parameter_list}
+
+    def _create_state(self, p):
+        return {"m": jnp.zeros_like(p._value),
+                "v": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32),
+                "wd": jnp.asarray(self._wd_flags.get(id(p), 1.0) *
+                                  self._lamb_wd, jnp.float32)}
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["v"] + (1 - self._beta2) * g32 * g32
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + state["wd"] * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return ((p32 - lr * trust * r).astype(p.dtype),
+                {"m": m, "v": v, "beta1_pow": b1p, "beta2_pow": b2p,
+                 "wd": state["wd"]})
+
+
+class Lars(Optimizer):
+    """Layer-wise adaptive rate scaling (reference:
+    operators/optimizers/lars_momentum_op.*)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._eps = epsilon
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, p, g, lr, state):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + self._wd * w_norm + self._eps),
+            1.0)
+        v = (self._momentum * state["velocity"] +
+             lr * local_lr * (g32 + self._wd * p32))
+        return (p32 - v).astype(p.dtype), {"velocity": v}

@@ -1,0 +1,28 @@
+"""Weight-decay regularizers (parity: python/paddle/regularizer.py —
+L1Decay/L2Decay appended to gradients before the update op)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def apply_gradient(self, p, g):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def apply_gradient(self, p, g):
+        return g + self.coeff * jnp.sign(p).astype(g.dtype)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+    def apply_gradient(self, p, g):
+        return g + self.coeff * p.astype(g.dtype)

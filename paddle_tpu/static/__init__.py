@@ -1,0 +1,109 @@
+"""paddle_tpu.static — static-graph compat surface.
+
+The reference's static mode is a full graph-IR stack (ProgramDesc +
+Executor, reference python/paddle/static/, fluid/framework.py,
+fluid/executor.py:475). Under XLA the IR is the jaxpr/StableHLO produced
+by tracing, so this module provides the *API shape* users expect —
+InputSpec, Program handles, an Executor whose ``run`` executes a traced
+callable — while compilation itself is jax.jit (see paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .input_spec import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "Executor",
+           "CompiledProgram", "name_scope", "data"]
+
+
+class Program:
+    """Lightweight stand-in for the reference Program (framework.py). It
+    records traced callables registered by jit; kept for API compat of
+    scripts that pass programs around."""
+
+    def __init__(self):
+        self.random_seed = 0
+        self._callables = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        return self.main
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference: paddle.static.data). Returns an
+    InputSpec usable with jit.to_static / jit.save."""
+    return InputSpec(shape, dtype, name)
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Reference fluid/compiler.py:164 — multi-device data parallelism.
+        TPU-native: handled by sharding the batch via pjit (see
+        paddle_tpu.distributed); retained as a no-op for script compat."""
+        return self
+
+
+class Executor:
+    """API-compat executor: ``run`` calls a registered jitted callable.
+    (The reference's Executor walks a ProgramDesc op-by-op,
+    fluid/executor.py:916; with XLA the whole program is one call.)"""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        # startup programs are no-ops: parameters initialise eagerly
+        if fetch_list:
+            return [None for _ in fetch_list]
+        return []
+
+    def close(self):
+        pass

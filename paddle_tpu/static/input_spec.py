@@ -1,0 +1,36 @@
+"""InputSpec (parity: python/paddle/static/input_spec.py)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        self.shape = [batch_size] + self.shape
+        return self
+
+    def unbatch(self):
+        self.shape = self.shape[1:]
+        return self

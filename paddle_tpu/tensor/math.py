@@ -120,8 +120,11 @@ def heaviside(x, y, name=None):
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """MXU-bound matmul (reference: operators/matmul_v2_op.*). The transpose
-    flags fold into dot_general dimension numbers — no materialised transpose."""
+    flags fold into dot_general dimension numbers — no materialised
+    transpose. Under amp.auto_cast the operands route through bf16."""
     def f(a, b):
+        from ..amp import maybe_cast_inputs
+        a, b = maybe_cast_inputs("matmul", a, b)
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:

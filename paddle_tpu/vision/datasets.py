@@ -1,0 +1,203 @@
+"""Built-in datasets (parity: python/paddle/vision/datasets/ + the
+download machinery of python/paddle/dataset/). This environment has zero
+egress, so datasets load from local files when present and raise a clear
+error otherwise; ``FakeData`` provides the synthetic stand-in used by
+tests and benchmarks (shape-compatible with CIFAR-10/MNIST/ImageNet)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "ImageFolder", "DatasetFolder"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        img = rng.randn(*self.image_shape).astype(np.float32)
+        label = np.int32(rng.randint(0, self.num_classes))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """MNIST from local IDX files (reference: paddle/dataset/mnist.py
+    downloads; here: point ``image_path``/``label_path`` at the files)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise RuntimeError(
+                "MNIST: zero-egress environment; pass image_path/label_path "
+                "to local idx files, or use vision.datasets.FakeData")
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") else \
+                open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8)
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") else \
+                open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, np.int32(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle tarball."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None:
+            raise RuntimeError(
+                "Cifar10: zero-egress environment; pass data_file pointing "
+                "at cifar-10-python.tar.gz, or use FakeData")
+        imgs, labels = [], []
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(d[b"data"])
+                    labels.extend(d[b"labels"])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None:
+            raise RuntimeError("Cifar100: pass local data_file or use FakeData")
+        name = "train" if mode == "train" else "test"
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if m.name.endswith(name):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    self.images = d[b"data"].reshape(-1, 3, 32, 32)
+                    self.labels = np.asarray(d[b"fine_labels"], np.int32)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (parity:
+    python/paddle/vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                p = os.path.join(cdir, fname)
+                if is_valid_file is not None:
+                    ok = is_valid_file(p)
+                else:
+                    ok = fname.lower().endswith(extensions)
+                if ok:
+                    self.samples.append((p, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError(
+                "loading image files needs PIL; use .npy files or pass a "
+                "custom loader") from e
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(target)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        self.samples = []
+        for fname in sorted(os.listdir(root)):
+            p = os.path.join(root, fname)
+            if fname.lower().endswith(extensions):
+                self.samples.append(p)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]

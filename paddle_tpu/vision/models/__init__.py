@@ -1,0 +1,14 @@
+"""Model zoo (parity: python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa: F401
+                        mobilenet_v2)
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152, wide_resnet50_2, wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .vit import VisionTransformer, vit_b_16, vit_b_32, vit_l_16  # noqa: F401
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2",
+           "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
+           "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+           "VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"]

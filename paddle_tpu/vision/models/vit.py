@@ -1,0 +1,61 @@
+"""Vision Transformer (north-star config[1] names ViT-B; absent from the
+2021 reference zoo — built TPU-first: patchify = one conv, encoder =
+paddle_tpu.nn.TransformerEncoder whose attention uses the Pallas flash
+kernel for long patch sequences)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...framework.core import Tensor
+from ...nn.initializer import TruncatedNormal
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"]
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, image_size=224, patch_size=16, embed_dim=768,
+                 depth=12, num_heads=12, mlp_ratio=4.0, num_classes=1000,
+                 dropout=0.1):
+        super().__init__()
+        self.patch_embed = nn.Conv2D(3, embed_dim, patch_size,
+                                     stride=patch_size)
+        num_patches = (image_size // patch_size) ** 2
+        init = TruncatedNormal(std=0.02)
+        self.cls_token = nn.Parameter(init((1, 1, embed_dim)))
+        self.pos_embed = nn.Parameter(init((1, num_patches + 1, embed_dim)))
+        self.pos_drop = nn.Dropout(dropout)
+        enc_layer = nn.TransformerEncoderLayer(
+            embed_dim, num_heads, int(embed_dim * mlp_ratio),
+            dropout=dropout, activation="gelu", normalize_before=True)
+        self.encoder = nn.TransformerEncoder(enc_layer, depth,
+                                             norm=nn.LayerNorm(embed_dim))
+        self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat, flatten, transpose
+        x = self.patch_embed(x)            # B, E, H/P, W/P
+        x = flatten(x, 2)                  # B, E, N
+        x = transpose(x, [0, 2, 1])        # B, N, E
+        b = x.shape[0]
+        from ...tensor.manipulation import expand
+        cls = expand(self.cls_token, [b, 1, self.cls_token.shape[2]])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        x = self.encoder(x)
+        return self.head(x[:, 0])
+
+
+def vit_b_16(num_classes=1000, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, num_classes=num_classes, **kwargs)
+
+
+def vit_b_32(num_classes=1000, **kwargs):
+    return VisionTransformer(patch_size=32, embed_dim=768, depth=12,
+                             num_heads=12, num_classes=num_classes, **kwargs)
+
+
+def vit_l_16(num_classes=1000, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, num_classes=num_classes, **kwargs)

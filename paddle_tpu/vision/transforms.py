@@ -1,0 +1,275 @@
+"""Image transforms (parity: python/paddle/vision/transforms/ — numpy/host
+implementations; batch-level device work belongs in the model, host-side
+per-sample transforms stay on CPU workers like the reference)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "Grayscale", "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "crop", "center_crop", "pad"]
+
+
+def _chw(img):
+    """HWC uint8/float -> CHW float32 [0,1]."""
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    return arr.transpose(2, 0, 1).astype(np.float32)
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _chw(img) if data_format == "CHW" else np.asarray(img, np.float32)
+    from ..framework.core import to_tensor as tt
+    return tt(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    out_h, out_w = size
+    # separable nearest/bilinear resize in numpy (host-side)
+    h, w = arr.shape[:2]
+    if interpolation == "nearest":
+        yi = np.clip(np.round(np.linspace(0, h - 1, out_h)).astype(int), 0, h - 1)
+        xi = np.clip(np.round(np.linspace(0, w - 1, out_w)).astype(int), 0, w - 1)
+        return arr[yi][:, xi]
+    ys = np.linspace(0, h - 1, out_h)
+    xs = np.linspace(0, w - 1, out_w)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    a = arr[y0][:, x0].astype(np.float32)
+    b = arr[y0][:, x1].astype(np.float32)
+    c = arr[y1][:, x0].astype(np.float32)
+    d = arr[y1][:, x1].astype(np.float32)
+    out = a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx + \
+        c * wy * (1 - wx) + d * wy * wx
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    return np.asarray(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = np.asarray(img).shape[:2]
+    th, tw = output_size
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    l, t, r, b = padding
+    cfg = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, cfg, constant_values=fill)
+    return np.pad(arr, cfg, mode={"reflect": "reflect", "edge": "edge",
+                                  "symmetric": "symmetric"}[padding_mode])
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return _chw(img) if self.data_format == "CHW" else np.asarray(
+            img, np.float32)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = np.asarray(img).shape[:2]
+        th, tw = self.size
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else np.asarray(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = 1 + random.uniform(-self.value, self.value)
+        arr = np.asarray(img).astype(np.float32) * factor
+        return np.clip(arr, 0, 255 if np.asarray(img).dtype == np.uint8 else None)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            aspect = random.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = random.randint(0, h - th)
+                left = random.randint(0, w - tw)
+                return resize(crop(arr, top, left, th, tw), self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        if arr.ndim == 2:
+            g = arr
+        else:
+            g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        if self.num_output_channels == 3:
+            return np.stack([g] * 3, -1)
+        return g[..., None]

@@ -1,0 +1,381 @@
+"""jit/to_static, save/load, DataLoader, amp, PyLayer tests
+(parity models: reference test_jit_save_load.py, test_dataloader*.py,
+test_amp*.py, dygraph_to_static suite)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        eager = net(x).numpy()
+        paddle.jit.to_static(net)
+        static = net(x).numpy()
+        np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+    def test_shape_cache(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(a):
+            calls.append(1)
+            return a * 2
+
+        f(paddle.ones([2]))
+        f(paddle.ones([2]))  # cached: no retrace
+        assert len(calls) == 1
+        f(paddle.ones([3]))  # new shape: retrace
+        assert len(calls) == 2
+
+    def test_control_flow_via_lax(self):
+        # data-independent python control flow works naturally
+        @paddle.jit.to_static
+        def f(a, flag=True):
+            if flag:  # static kwarg
+                return a + 1
+            return a - 1
+
+        out = f(paddle.zeros([2]))
+        np.testing.assert_allclose(out.numpy(), [1, 1])
+
+    def test_weights_not_baked(self):
+        net = nn.Linear(2, 2)
+        sf = paddle.jit.to_static(net)
+        x = paddle.ones([1, 2])
+        out1 = net(x).numpy()
+        # mutate weights; compiled fn must see the new values
+        net.weight._value = net.weight._value * 0
+        out2 = net(x).numpy()
+        np.testing.assert_allclose(out2, net.bias.numpy()[None], rtol=1e-6)
+        assert not np.allclose(out1, out2)
+
+    def test_train_step_matches_eager(self):
+        paddle.seed(1)
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        m2.set_state_dict(m1.state_dict())
+        xs = paddle.randn([16, 4])
+        ys = paddle.randn([16, 1])
+        o1 = paddle.optimizer.Adam(0.01, parameters=m1.parameters())
+        o2 = paddle.optimizer.Adam(0.01, parameters=m2.parameters())
+        step = paddle.jit.TrainStep(m2, lambda x, y: F.mse_loss(m2(x), y),
+                                    o2)
+        for _ in range(5):
+            l1 = F.mse_loss(m1(xs), ys)
+            l1.backward()
+            o1.step()
+            o1.clear_grad()
+            l2 = step(xs, ys)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+        np.testing.assert_allclose(m1[0].weight.numpy(),
+                                   m2[0].weight.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_train_step_updates_bn_stats(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda x: (m(x) ** 2).mean(), opt)
+        before = m[1]._mean.numpy().copy()
+        step(paddle.randn([8, 4]) + 3.0)
+        after = m[1]._mean.numpy()
+        assert not np.allclose(before, after)
+
+
+class TestSaveLoad:
+    def test_jit_save_load(self):
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "model")
+        paddle.jit.save(net, p,
+                        input_spec=[paddle.static.InputSpec([None, 4],
+                                                            "float32")])
+        assert os.path.exists(p + ".pdmodel")
+        loaded = paddle.jit.load(p)
+        x = paddle.randn([1, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_paddle_save_load_state_dict(self):
+        net = nn.Linear(3, 3)
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "m.pdparams")
+        paddle.save(net.state_dict(), path)
+        sd = paddle.load(path)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net.weight.numpy(),
+                                      net2.weight.numpy())
+
+    def test_save_optimizer_state(self):
+        net = nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(0.1, parameters=net.parameters())
+        (net(paddle.ones([1, 2])).sum()).backward()
+        opt.step()
+        d = tempfile.mkdtemp()
+        paddle.save(opt.state_dict(), os.path.join(d, "o.pdopt"))
+        st = paddle.load(os.path.join(d, "o.pdopt"))
+        assert st["global_step"] == 1
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+        ds = FakeData(num_samples=17, image_shape=(1, 8, 8), num_classes=3)
+        dl = DataLoader(ds, batch_size=5, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        assert batches[0][0].shape == [5, 1, 8, 8]
+        assert batches[-1][0].shape == [2, 1, 8, 8]
+        assert isinstance(batches[0][0], paddle.Tensor)
+
+    def test_workers_match_sync(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+        ds = FakeData(num_samples=12, image_shape=(2, 4, 4))
+        b_sync = [b[0].numpy() for b in DataLoader(ds, batch_size=4)]
+        b_par = [b[0].numpy() for b in DataLoader(ds, batch_size=4,
+                                                  num_workers=2)]
+        for a, b in zip(b_sync, b_par):
+            np.testing.assert_array_equal(a, b)
+
+    def test_shuffle_and_epoch_variation(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 100
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        dl = DataLoader(DS(), batch_size=100, shuffle=True)
+        a = next(iter(dl)).numpy()
+        assert sorted(a.tolist()) == list(range(100))
+
+    def test_distributed_batch_sampler_shards(self):
+        from paddle_tpu.io import DistributedBatchSampler
+        from paddle_tpu.vision.datasets import FakeData
+        ds = FakeData(num_samples=20, image_shape=(1, 2, 2))
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                     rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                     rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert not set(i0) & set(i1)
+        assert len(i0) == len(i1) == 10
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        with paddle.amp.auto_cast():
+            out = paddle.matmul(paddle.ones([2, 2]), paddle.ones([2, 2]))
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(paddle.ones([2, 2]), paddle.ones([2, 2]))
+        assert out2.dtype == paddle.float32
+
+    def test_autocast_blacklist(self):
+        with paddle.amp.auto_cast():
+            out = F.softmax(paddle.randn([2, 4]))
+        assert out.dtype == paddle.float32
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = nn.Parameter(paddle.ones([2])._value)
+        opt = paddle.optimizer.SGD(0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       decr_every_n_nan_or_inf=1)
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+        before = p.numpy().copy()
+        scaler.step(opt)
+        np.testing.assert_array_equal(p.numpy(), before)  # skipped
+        assert scaler.get_loss_scaling() == 2.0  # halved
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        class Square(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2 * x
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = Square.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+class TestVisionModels:
+    def test_lenet_forward_backward(self):
+        m = paddle.vision.models.LeNet()
+        x = paddle.randn([2, 1, 28, 28])
+        out = m(x)
+        assert out.shape == [2, 10]
+        F.cross_entropy(out, paddle.to_tensor(np.array([1, 2], np.int32))
+                        ).backward()
+        assert m.features[0].weight.grad is not None
+
+    def test_resnet18_tiny_forward(self):
+        m = paddle.vision.models.resnet18(num_classes=7)
+        out = m(paddle.randn([1, 3, 32, 32]))
+        assert out.shape == [1, 7]
+
+    def test_mobilenet_forward(self):
+        m = paddle.vision.models.mobilenet_v2(scale=0.25, num_classes=5)
+        out = m(paddle.randn([1, 3, 32, 32]))
+        assert out.shape == [1, 5]
+
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        pipe = T.Compose([T.Resize(16), T.CenterCrop(8), T.ToTensor(),
+                          T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(img)
+        assert out.shape == (3, 8, 8)
+
+    def test_metric_accuracy(self):
+        m = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                         np.float32))
+        lab = paddle.to_tensor(np.array([[1], [1]], np.int32))
+        correct = m.compute(pred, lab)
+        m.update(paddle.to_tensor(correct))
+        assert m.accumulate() == 0.5
+
+
+class TestE2ETraining:
+    def test_lenet_fakedata_train_loop(self):
+        """The SURVEY.md §7 step-4 'aha' slice: model + DataLoader + loss +
+        optimizer + train loop, fully jitted."""
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+        paddle.seed(0)
+        model = paddle.vision.models.LeNet()
+        ds = FakeData(num_samples=64, image_shape=(1, 28, 28),
+                      num_classes=10)
+        loader = DataLoader(ds, batch_size=16, shuffle=True)
+        opt = paddle.optimizer.Adam(0.002, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model,
+            lambda x, y: F.cross_entropy(model(x), y), opt)
+        losses = []
+        for epoch in range(4):
+            for x, y in loader:
+                losses.append(float(step(x, y)))
+        assert losses[-1] < losses[0]
+
+
+class TestReviewRegressionsJit:
+    def test_to_static_trainable(self):
+        # training THROUGH to_static must produce grads on parameters
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        sf = paddle.jit.to_static(net)
+        x = paddle.randn([3, 4])
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        assert net.weight.grad is not None
+        # and eager-equivalent gradients
+        net2 = nn.Linear(4, 2)
+        net2.set_state_dict(net.state_dict())
+        loss2 = (net2(x) ** 2).mean()
+        loss2.backward()
+        np.testing.assert_allclose(net.weight.grad.numpy(),
+                                   net2.weight.grad.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_to_static_updates_bn_stats(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+        paddle.jit.to_static(m)
+        before = m[1]._mean.numpy().copy()
+        with paddle.no_grad():
+            m(paddle.randn([8, 4]) + 5.0)
+        assert not np.allclose(before, m[1]._mean.numpy())
+
+    def test_to_static_static_python_args(self):
+        @paddle.jit.to_static
+        def f(x, flag, mode):
+            if flag and mode == "double":
+                return x * 2
+            return x
+
+        a = f(paddle.ones([2]), True, "double")
+        b = f(paddle.ones([2]), False, "double")
+        np.testing.assert_allclose(a.numpy(), [2, 2])
+        np.testing.assert_allclose(b.numpy(), [1, 1])
+
+    def test_to_static_amp_in_cache_key(self):
+        net = nn.Linear(2, 2)
+        sf = paddle.jit.to_static(net)
+        out1 = net(paddle.ones([1, 2]))
+        with paddle.amp.auto_cast():
+            out2 = net(paddle.ones([1, 2]))
+        assert out1.dtype == paddle.float32
+        assert out2.dtype == paddle.bfloat16
+
+    def test_adamw_exclusion_persists_across_steps(self):
+        lin = nn.Linear(2, 2)
+        lin.bias.name = "linear.bias"
+        lin.weight.name = "linear.weight"
+        opt = paddle.optimizer.AdamW(
+            0.1, parameters=lin.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        # two steps with zero grads: only decay acts; bias must not move
+        for _ in range(2):
+            for p in lin.parameters():
+                p.grad = paddle.zeros(p.shape)
+            opt.step()
+        np.testing.assert_allclose(lin.bias.numpy(), [0.0, 0.0], atol=1e-7)
+
+    def test_scaler_unscale_then_step_no_double_unscale(self):
+        p = nn.Parameter(paddle.ones([2])._value)
+        opt = paddle.optimizer.SGD(1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p.grad = paddle.to_tensor(np.array([4.0, 4.0], np.float32))
+        scaler.unscale_(opt)  # user unscales for clipping
+        scaler.step(opt)      # must NOT unscale again
+        # grad was 4/4 = 1.0 -> p = 1 - 1 = 0
+        np.testing.assert_allclose(p.numpy(), [0.0, 0.0], atol=1e-7)
+
+    def test_train_step_applies_grad_clip(self):
+        m = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(
+            1.0, parameters=m.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(1e-4))
+        step = paddle.jit.TrainStep(
+            m, lambda x: (m(x) * 100).mean(), opt)
+        before = m.weight.numpy().copy()
+        step(paddle.ones([4, 2]))
+        assert np.abs(m.weight.numpy() - before).sum() < 1e-3
+
+    def test_dataloader_early_break_no_leak(self):
+        import threading
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+        n0 = threading.active_count()
+        for _ in range(5):
+            dl = DataLoader(FakeData(num_samples=64, image_shape=(1, 4, 4)),
+                            batch_size=4, num_workers=2)
+            for batch in dl:
+                break  # abandon mid-epoch
+        import time
+        time.sleep(1.0)
+        assert threading.active_count() <= n0 + 2

@@ -1,0 +1,222 @@
+"""Optimizer + LR scheduler tests (modelled on the reference's
+test_adam_op.py / test_momentum_op.py / test_lr_scheduler.py — here
+validated against torch (cpu) as an independent reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+
+
+def _toy_problem():
+    paddle.seed(5)
+    net = nn.Linear(4, 1)
+    x = paddle.to_tensor(rng.randn(32, 4).astype(np.float32))
+    y = paddle.matmul(x, paddle.ones([4, 1])) * 0.5
+    return net, x, y
+
+
+def _run(opt_factory, steps=40, thresh=0.5):
+    net, x, y = _toy_problem()
+    opt = opt_factory(net.parameters())
+    l0 = None
+    for _ in range(steps):
+        loss = F.mse_loss(net(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0 * thresh, (l0, float(loss))
+
+
+class TestOptimizersConverge:
+    def test_sgd(self):
+        _run(lambda ps: paddle.optimizer.SGD(0.1, parameters=ps))
+
+    def test_momentum(self):
+        _run(lambda ps: paddle.optimizer.Momentum(0.02, parameters=ps))
+
+    def test_momentum_nesterov(self):
+        _run(lambda ps: paddle.optimizer.Momentum(0.02, parameters=ps,
+                                                  use_nesterov=True))
+
+    def test_adam(self):
+        _run(lambda ps: paddle.optimizer.Adam(0.05, parameters=ps))
+
+    def test_adamw(self):
+        _run(lambda ps: paddle.optimizer.AdamW(0.05, parameters=ps))
+
+    def test_rmsprop(self):
+        _run(lambda ps: paddle.optimizer.RMSProp(0.01, parameters=ps))
+
+    def test_adagrad(self):
+        _run(lambda ps: paddle.optimizer.Adagrad(0.1, parameters=ps))
+
+    def test_adadelta(self):
+        _run(lambda ps: paddle.optimizer.Adadelta(2.0, parameters=ps),
+             steps=100, thresh=0.8)
+
+    def test_adamax(self):
+        _run(lambda ps: paddle.optimizer.Adamax(0.05, parameters=ps))
+
+    def test_lamb(self):
+        _run(lambda ps: paddle.optimizer.Lamb(0.05, parameters=ps))
+
+    def test_lars_update_rule(self):
+        # LARS is a large-batch optimizer; on a toy problem we check the
+        # update math against a manual NumPy step instead of convergence.
+        p0 = np.array([3.0, 4.0], np.float32)  # |w| = 5
+        g = np.array([0.6, 0.8], np.float32)   # |g| = 1
+        p = nn.Parameter(paddle.to_tensor(p0)._value)
+        opt = paddle.optimizer.Lars(0.1, momentum=0.9, lars_coeff=0.001,
+                                    lars_weight_decay=0.0005,
+                                    parameters=[p])
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        local_lr = 0.001 * 5.0 / (1.0 + 0.0005 * 5.0)
+        v = 0.1 * local_lr * (g + 0.0005 * p0)
+        np.testing.assert_allclose(p.numpy(), p0 - v, rtol=1e-5)
+
+
+class TestAgainstTorch:
+    def _compare(self, make_ours, make_torch, steps=5, rtol=1e-4, atol=1e-5):
+        import torch
+        p0 = rng.randn(6).astype(np.float32)
+        gs = [rng.randn(6).astype(np.float32) for _ in range(steps)]
+        tp = torch.tensor(p0, requires_grad=True)
+        topt = make_torch([tp])
+        our_p = nn.Parameter(paddle.to_tensor(p0)._value)
+        oopt = make_ours([our_p])
+        for g in gs:
+            tp.grad = torch.tensor(g)
+            topt.step()
+            our_p.grad = paddle.to_tensor(g)
+            oopt.step()
+        np.testing.assert_allclose(our_p.numpy(), tp.detach().numpy(),
+                                   rtol=rtol, atol=atol)
+
+    def test_sgd(self):
+        import torch
+        self._compare(lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+                      lambda ps: torch.optim.SGD(ps, lr=0.1))
+
+    def test_adam(self):
+        import torch
+        self._compare(lambda ps: paddle.optimizer.Adam(0.1, parameters=ps),
+                      lambda ps: torch.optim.Adam(ps, lr=0.1))
+
+    def test_adamw(self):
+        import torch
+        self._compare(
+            lambda ps: paddle.optimizer.AdamW(0.1, parameters=ps,
+                                              weight_decay=0.05),
+            lambda ps: torch.optim.AdamW(ps, lr=0.1, weight_decay=0.05))
+
+    def test_momentum(self):
+        import torch
+        self._compare(
+            lambda ps: paddle.optimizer.Momentum(0.1, 0.9, parameters=ps),
+            lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9))
+
+
+class TestOptimizerMechanics:
+    def test_grad_clip_integration(self):
+        net, x, y = _toy_problem()
+        opt = paddle.optimizer.SGD(
+            0.1, parameters=net.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        before = net.weight.numpy().copy()
+        F.mse_loss(net(x), y).backward()
+        opt.step()
+        delta = np.abs(net.weight.numpy() - before).sum()
+        assert delta < 0.001  # tiny because clipped
+
+    def test_weight_decay_regularizer(self):
+        p = nn.Parameter(paddle.ones([3])._value)
+        opt = paddle.optimizer.SGD(0.1, parameters=[p], weight_decay=0.5)
+        p.grad = paddle.zeros([3])
+        opt.step()
+        # grad 0 + l2 0.5*p -> p = 1 - 0.1*0.5 = 0.95
+        np.testing.assert_allclose(p.numpy(), [0.95] * 3, rtol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        net, x, y = _toy_problem()
+        opt = paddle.optimizer.Adam(0.05, parameters=net.parameters())
+        F.mse_loss(net(x), y).backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(0.05, parameters=net.parameters())
+        opt2.set_state_dict(sd)
+        s1 = opt.opt_state()
+        s2 = opt2.opt_state()
+        np.testing.assert_allclose(np.asarray(s1[0]["m"]),
+                                   np.asarray(s2[0]["m"]))
+
+    def test_minimize_api(self):
+        net, x, y = _toy_problem()
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        loss = F.mse_loss(net(x), y)
+        before = float(loss)
+        opt.minimize(loss)
+        opt.clear_grad()
+        assert float(F.mse_loss(net(x), y)) < before
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_multistep(self):
+        s = paddle.optimizer.lr.MultiStepDecay(1.0, [2, 4], gamma=0.1)
+        lrs = [s() for _ in range(1)]
+        for _ in range(4):
+            s.step()
+            lrs.append(s())
+        np.testing.assert_allclose(lrs, [1.0, 1.0, 0.1, 0.1, 0.01])
+
+    def test_cosine(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        s.step(10)
+        assert abs(s() - 0.0) < 1e-6
+
+    def test_linear_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.5, warmup_steps=5,
+                                             start_lr=0.0, end_lr=0.5)
+        assert s() == 0.0
+        for _ in range(5):
+            s.step()
+        assert abs(s() - 0.5) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = paddle.optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.1)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)  # no improvement beyond patience
+        assert s() == pytest.approx(0.1)
+
+    def test_scheduler_with_optimizer(self):
+        net, x, y = _toy_problem()
+        sched = paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=net.parameters())
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_noam_warmup_shape(self):
+        s = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        lrs = []
+        for _ in range(20):
+            lrs.append(s())
+            s.step()
+        peak = int(np.argmax(lrs))
+        assert 8 <= peak <= 11  # peaks at warmup boundary
