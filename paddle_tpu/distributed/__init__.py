@@ -1,0 +1,37 @@
+"""paddle_tpu.distributed — SPMD distributed training over TPU meshes.
+
+Capability parity with python/paddle/distributed/ (reference), redesigned:
+NCCL rings/comm-init/graph-rewrite meta-optimizers are replaced by a named
+``jax.sharding.Mesh`` (mesh.py), in-graph XLA collectives
+(communication.py), sharding-annotated parallel layers (meta_parallel.py)
+and a strategy surface (fleet/) that maps DistributedStrategy toggles to
+mesh axes + pjit shardings instead of program rewrites.
+"""
+from __future__ import annotations
+
+from . import communication  # noqa: F401
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    get_group, new_group, recv, reduce, reduce_scatter, scatter, send, split,
+    wait,
+)
+from .mesh import get_mesh, init_mesh, set_mesh  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    get_rng_state_tracker, mark_sharding, shard_parameter,
+)
+from .parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+)
+from . import fleet  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "DataParallel", "ReduceOp", "Group", "new_group", "get_group",
+    "all_reduce", "all_gather", "reduce", "reduce_scatter", "broadcast",
+    "scatter", "alltoall", "send", "recv", "barrier", "wait", "split",
+    "init_mesh", "get_mesh", "set_mesh", "communication", "fleet", "spawn",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "mark_sharding", "shard_parameter", "get_rng_state_tracker",
+]

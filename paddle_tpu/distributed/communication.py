@@ -1,0 +1,100 @@
+"""In-graph collectives over named mesh axes.
+
+TPU-native replacement for the reference's collective *graph ops*
+(reference: paddle/fluid/operators/collective/ — ``c_allreduce_sum_op``,
+``c_allgather_op``, ``c_reducescatter_op``, ``c_broadcast_op``,
+``send_v2_op``/``recv_v2_op``), which are NCCL kernels keyed by ``ring_id``
+with explicit stream-sync ops.  Here each collective is a pure function of
+(array, axis-name) usable inside ``shard_map``/``pjit``; XLA schedules and
+overlaps them on ICI — no ring table, no comm streams, no sync ops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "psum", "pmean", "pmax", "pmin", "pprod", "all_gather", "reduce_scatter",
+    "ppermute", "all_to_all", "axis_index", "axis_size", "broadcast_from",
+    "ring_shift",
+]
+
+
+def psum(x, axis: str):
+    """allreduce-sum (reference: operators/collective/c_allreduce_sum_op)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis)
+
+
+def pmin(x, axis: str):
+    return lax.pmin(x, axis)
+
+
+def pprod(x, axis: str):
+    return jnp.exp(lax.psum(jnp.log(x), axis))
+
+
+def all_gather(x, axis: str, *, tiled: bool = False, gather_dim: int = 0):
+    """allgather (reference: operators/collective/c_allgather_op.cc).
+
+    ``tiled=True`` concatenates along ``gather_dim`` instead of stacking a
+    new leading axis.
+    """
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dim: int = 0):
+    """reduce+scatter (reference: operators/collective/c_reducescatter_op.cc)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                            tiled=True)
+
+
+def ppermute(x, axis: str, perm: Sequence):
+    """P2P send/recv ring (reference: operators/collective/send_v2_op.cc,
+    recv_v2_op.cc used for pipeline stage boundaries)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Rotate values around the ``axis`` ring by ``shift`` (ring attention's
+    KV rotation primitive)."""
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
+               tiled: bool = True):
+    """alltoall (reference: operators/collective/c_alltoall — absent in the
+    reference snapshot; required for Ulysses sequence parallelism)."""
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=tiled)
+
+
+def axis_index(axis: str):
+    """This shard's coordinate on ``axis`` (reference analog: ring rank)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.psum(1, axis)
+
+
+def broadcast_from(x, axis: str, root: int = 0):
+    """broadcast from ``root`` (reference: operators/collective/c_broadcast_op.cc).
+
+    Implemented as masked psum — XLA lowers this to an ICI broadcast.
+    """
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)

@@ -1,0 +1,30 @@
+"""paddle_tpu.distributed.fleet — the distributed strategy surface.
+
+Parity: python/paddle/distributed/fleet/ (reference).  The meta-optimizer
+graph-rewrite pipeline (fleet/meta_optimizers/) collapses into strategy ->
+mesh axes + pjit shardings; see strategy.py and dist_step.py.
+"""
+from __future__ import annotations
+
+from .fleet_base import (  # noqa: F401
+    DistributedStrategy, Fleet, barrier_worker, distributed_model,
+    distributed_optimizer, distributed_train_step, init, init_server,
+    init_worker, is_first_worker, is_server, is_worker, run_server,
+    server_endpoints, server_index, server_num, stop_worker, worker_endpoints,
+    worker_index, worker_num,
+)
+from .dist_step import DistributedTrainStep  # noqa: F401
+from .ps import PSRuntime, SparseTable  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
+from .. import meta_parallel  # noqa: F401
+
+__all__ = [
+    "init", "is_first_worker", "worker_index", "worker_num", "is_worker",
+    "worker_endpoints", "server_num", "server_index", "server_endpoints",
+    "is_server", "barrier_worker", "init_worker", "init_server",
+    "run_server", "stop_worker", "distributed_optimizer",
+    "distributed_model", "distributed_train_step", "DistributedStrategy",
+    "DistributedTrainStep", "Fleet", "PSRuntime", "SparseTable", "utils",
+    "recompute", "meta_parallel",
+]

@@ -1,0 +1,307 @@
+"""DistributedTrainStep — one pjit'd hybrid-parallel training step.
+
+This is the TPU-native collapse of the reference's whole meta-optimizer
+stack: where the reference rewrites the Program graph per strategy
+(sharding_optimizer.py:33 partitions vars and converts allreduce ops,
+recompute via backward.py:725, gradient merge via
+gradient_merge_optimizer.py, AMP via mixed_precision/decorator.py) and then
+executes it with SSA executors + NCCL ops, here ONE compiled XLA program
+carries the entire step — forward, backward, optimizer — with shardings:
+
+- batch dim0 sharded over ('dp','fsdp')      -> data parallelism; XLA
+  emits the gradient reduction (fused, overlapped) — no Reducer, no
+  c_allreduce ops
+- ZeRO stage1: optimizer state sharded over 'fsdp'
+       stage2: + gradients materialised sharded (reduce_scatter)
+       stage3: + parameters sharded (all_gather inside fwd/bwd)
+- tensor-parallel params keep their layer-annotated 'tp' specs
+- recompute -> jax.checkpoint; gradient merge -> in-graph k-step
+  accumulation with lax.cond; buffers (BN stats) thread functionally
+
+Buffers are donated (params/opt-state/accumulators), so peak HBM matches
+an in-place executor.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.core import Tensor, no_grad
+from ...framework.random import split_key, use_key
+from .. import mesh as mesh_mod
+
+__all__ = ["DistributedTrainStep", "param_partition_spec"]
+
+
+def _tree_to_tensors(obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensors(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensors(v) for k, v in obj.items()}
+    return Tensor(obj) if hasattr(obj, "dtype") else obj
+
+
+def _tree_to_values(obj):
+    if isinstance(obj, Tensor):
+        return obj._value
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_values(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_values(v) for k, v in obj.items()}
+    return obj
+
+
+def param_partition_spec(value, mesh, annotated: Optional[P],
+                         zero3: bool) -> P:
+    """Final PartitionSpec for one parameter.
+
+    Layer annotation ('tp' etc.) wins per-dim; ZeRO-3 additionally shards
+    the largest remaining dim that the 'fsdp' axis divides (the reference's
+    sharding_optimizer partitions whole params by numel round-robin,
+    sharding/shard.py — per-dim sharding is the XLA-friendly equivalent).
+    """
+    ndim = len(value.shape)
+    spec = list(annotated) if annotated is not None else [None] * ndim
+    spec += [None] * (ndim - len(spec))
+    fsdp = mesh.shape.get("fsdp", 1)
+    if zero3 and fsdp > 1:
+        dims = sorted(range(ndim), key=lambda d: -value.shape[d])
+        for d in dims:
+            if spec[d] is None and value.shape[d] % fsdp == 0 \
+                    and value.shape[d] >= fsdp:
+                spec[d] = "fsdp"
+                break
+    return P(*spec)
+
+
+class DistributedTrainStep:
+    """Compile (model, loss_fn, optimizer, strategy) into one sharded step.
+
+    Usage::
+        step = DistributedTrainStep(model, loss_fn, opt, strategy)
+        for x, y in loader:
+            loss = step(x, y)
+    """
+
+    def __init__(self, model, loss_fn, optimizer, strategy=None, mesh=None):
+        from .strategy import DistributedStrategy
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._strategy = strategy or DistributedStrategy()
+        if mesh is None:
+            degrees = self._strategy.mesh_degrees()
+            cur = mesh_mod.get_mesh(create=False)
+            want = {k: v for k, v in degrees.items() if v not in (1, -1)}
+            if cur is None or any(cur.shape.get(k, 1) != v
+                                  for k, v in want.items()):
+                mesh = mesh_mod.init_mesh(degrees)
+            else:
+                mesh = cur
+        self._mesh = mesh
+        self._param_names = [n for n, _ in model.named_parameters()]
+        self._params = dict(model.named_parameters())
+        self._buffers = {n: b for n, b in model.state_dict().items()
+                         if n not in self._params}
+        sh = self._strategy.sharding_configs
+        self._zero_stage = sh["stage"] if self._strategy.sharding else 0
+        gm = self._strategy.gradient_merge_configs
+        self._k_steps = gm["k_steps"] if self._strategy.gradient_merge else 1
+        self._gm_avg = gm["avg"]
+        self._compiled = None
+        self._accum = None  # gradient-merge accumulators
+        self._step_i = np.int64(0)
+
+    # sharding derivation ---------------------------------------------
+    def _param_specs(self) -> Dict[str, P]:
+        mesh = self._mesh
+        zero3 = self._zero_stage >= 3
+        specs = {}
+        for n, p in self._params.items():
+            ann = getattr(p, "dist_spec", None)
+            specs[n] = param_partition_spec(p._value, mesh, ann, zero3)
+        return specs
+
+    def _opt_state_specs(self, opt_state, pspecs):
+        """Moment tensors follow their parameter's spec; under ZeRO-1/2
+        (params replicated) moments still shard over 'fsdp'."""
+        mesh = self._mesh
+        zero = self._zero_stage >= 1
+        out = []
+        for name, st in zip(self._param_names, opt_state):
+            p = self._params[name]
+            d = {}
+            for k, v in st.items():
+                if hasattr(v, "shape") and v.shape == p._value.shape:
+                    d[k] = pspecs[name] if self._zero_stage >= 3 else \
+                        (param_partition_spec(v, mesh,
+                                              getattr(p, "dist_spec", None),
+                                              zero3=True) if zero
+                         else pspecs[name])
+                else:
+                    d[k] = P()
+            out.append(d)
+        return out
+
+    def _batch_spec_tree(self, vals):
+        data_axes = mesh_mod.data_axes(self._mesh)
+        nshard = int(np.prod([self._mesh.shape[a] for a in data_axes]))
+
+        def spec(v):
+            if hasattr(v, "ndim") and v.ndim >= 1 \
+                    and v.shape[0] % nshard == 0:
+                return P(data_axes, *([None] * (v.ndim - 1)))
+            return P()
+        return jax.tree_util.tree_map(spec, vals)
+
+    def _shardings(self, tree_of_specs):
+        mesh = self._mesh
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tree_of_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    # compile ----------------------------------------------------------
+    def _build(self, batch_vals, opt_state):
+        model, loss_fn, opt = self._model, self._loss_fn, self._opt
+        names = self._param_names
+        strategy = self._strategy
+        k_steps, gm_avg = self._k_steps, self._gm_avg
+        use_remat = strategy.recompute
+
+        def loss_of(pvals, buffer_vals, key, args):
+            targs = _tree_to_tensors(args)
+            with use_key(key):
+                st = model.state_dict()
+                old = {k: t._value for k, t in st.items()}
+                try:
+                    for k, t in st.items():
+                        if k in pvals:
+                            t._value = pvals[k]
+                        elif k in buffer_vals:
+                            t._value = buffer_vals[k]
+                    out = loss_fn(*targs)
+                    new_bufs = {k: st[k]._value for k in buffer_vals}
+                finally:
+                    for k, t in st.items():
+                        t._value = old[k]
+            lv = out._value if isinstance(out, Tensor) else out
+            return lv, new_bufs
+
+        if use_remat:
+            # whole-step rematerialisation: residuals are not saved, the
+            # forward is recomputed during backward (reference analog:
+            # RecomputeOptimizer re-executes checkpointed segments,
+            # fluid/backward.py:725).  Models can additionally scope finer
+            # remat blocks via fleet.utils.recompute.
+            loss_of = jax.checkpoint(loss_of)
+
+        def grads_of(pvals, buffer_vals, key, args):
+            (loss, bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pvals, buffer_vals, key, args)
+            return loss, bufs, grads
+
+        def apply_opt(pvals, grads, opt_state, lr):
+            plist = [pvals[n] for n in names]
+            glist = [grads[n] for n in names]
+            # lr is a traced scalar so schedulers work without retracing
+            new_ps, new_ss = opt.functional_update(plist, glist, opt_state,
+                                                   lr=lr)
+            return dict(zip(names, new_ps)), new_ss
+
+        if k_steps <= 1:
+            def step(pvals, bufs, opt_state, lr, key, args):
+                loss, nbufs, grads = grads_of(pvals, bufs, key, args)
+                new_p, new_s = apply_opt(pvals, grads, opt_state, lr)
+                return loss, new_p, nbufs, new_s
+            donate = (0, 1, 2)
+        else:
+            def step(pvals, bufs, opt_state, accum, i, lr, key, args):
+                loss, nbufs, grads = grads_of(pvals, bufs, key, args)
+                accum = jax.tree_util.tree_map(jnp.add, accum, grads)
+                do_apply = (i + 1) % k_steps == 0
+
+                def apply_branch(op):
+                    pv, acc, st = op
+                    g = jax.tree_util.tree_map(
+                        (lambda a: a / k_steps) if gm_avg else (lambda a: a),
+                        acc)
+                    np_, ns = apply_opt(pv, g, st, lr)
+                    zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                    return np_, zeros, ns
+
+                def skip_branch(op):
+                    pv, acc, st = op
+                    return dict(pv), acc, st
+
+                new_p, accum, new_s = jax.lax.cond(
+                    do_apply, apply_branch, skip_branch,
+                    (pvals, accum, opt_state))
+                return loss, new_p, nbufs, new_s, accum
+            donate = (0, 1, 2, 3)
+
+        # shardings ----------------------------------------------------
+        pspecs = self._param_specs()
+        sspecs = self._opt_state_specs(opt_state, pspecs)
+        bspec = self._batch_spec_tree(batch_vals)
+        bufspec = {k: P() for k in self._buffers}
+        in_specs = [pspecs, bufspec, sspecs]
+        out_specs = [P(), pspecs, bufspec, sspecs]
+        if k_steps > 1:
+            gspecs = pspecs  # accumulators shard like their params
+            in_specs += [gspecs, P(), P(), P(), bspec]
+            out_specs += [gspecs]
+        else:
+            in_specs += [P(), P(), bspec]
+        sh = self._shardings
+        return jax.jit(step, donate_argnums=donate,
+                       in_shardings=sh(tuple(in_specs)),
+                       out_shardings=sh(tuple(out_specs)))
+
+    # run --------------------------------------------------------------
+    def __call__(self, *args):
+        arg_vals = _tree_to_values(list(args))
+        param_vals = {n: p._value for n, p in self._params.items()}
+        buffer_vals = {n: b._value for n, b in self._buffers.items()}
+        opt_state = self._opt.opt_state()
+        if self._compiled is None:
+            self._compiled = self._build(arg_vals, opt_state)
+            # lay params/opt-state out on their final shardings once (ZeRO-3
+            # may add 'fsdp' dims on top of layer-annotated 'tp' specs);
+            # afterwards every step's args already match the jit shardings
+            pspecs = self._param_specs()
+            for n, p in self._params.items():
+                p._value = jax.device_put(
+                    p._value, NamedSharding(self._mesh, pspecs[n]))
+                param_vals[n] = p._value
+            sspecs = self._opt_state_specs(opt_state, pspecs)
+            opt_state = [
+                {k: jax.device_put(v, NamedSharding(self._mesh, d[k]))
+                 if hasattr(v, "shape") else v for k, v in st.items()}
+                for st, d in zip(opt_state, sspecs)]
+            self._opt.load_opt_state(opt_state)
+            if self._k_steps > 1 and self._accum is None:
+                self._accum = {
+                    n: jnp.zeros_like(
+                        v, device=NamedSharding(self._mesh, pspecs[n]))
+                    for n, v in param_vals.items()}
+        key = split_key()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        with no_grad():
+            if self._k_steps > 1:
+                loss, new_p, new_b, new_s, self._accum = self._compiled(
+                    param_vals, buffer_vals, opt_state, self._accum,
+                    jnp.asarray(self._step_i, jnp.int32), lr, key, arg_vals)
+            else:
+                loss, new_p, new_b, new_s = self._compiled(
+                    param_vals, buffer_vals, opt_state, lr, key, arg_vals)
+        self._step_i += 1
+        for n, p in self._params.items():
+            p._value = new_p[n]
+        for n, b in self._buffers.items():
+            b._value = new_b[n]
+        self._opt.load_opt_state(new_s)
+        return Tensor(loss)

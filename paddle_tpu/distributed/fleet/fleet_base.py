@@ -1,0 +1,289 @@
+"""Fleet — the unified distributed-training API surface.
+
+Parity: reference python/paddle/distributed/fleet/base/fleet_base.py
+(``fleet.init:130``, ``distributed_optimizer:598``, ``minimize:1070``).
+There, ``minimize`` runs a ranked pipeline of graph-rewriting meta
+optimizers (fleet_base.py:1150-1186 -> fleet/meta_optimizers/*) over the
+Program.  Here the strategy configures mesh axes + jit shardings; dygraph
+training needs no rewriting at all, and the compiled path is
+``fleet.distributed_train_step`` (one pjit'd program, dist_step.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..parallel import init_parallel_env
+from .. import mesh as mesh_mod
+from .strategy import DistributedStrategy
+
+__all__ = [
+    "Fleet", "init", "is_first_worker", "worker_index", "worker_num",
+    "is_worker", "worker_endpoints", "server_num", "server_index",
+    "server_endpoints", "is_server", "barrier_worker", "init_worker",
+    "init_server", "run_server", "stop_worker", "distributed_optimizer",
+    "distributed_model", "distributed_train_step", "DistributedStrategy",
+]
+
+
+class _RoleMaker:
+    """Parity: fleet/base/role_maker.py PaddleCloudRoleMaker — reads the
+    PADDLE_* env the launcher exports."""
+
+    def __init__(self, is_collective=True):
+        self.is_collective = is_collective
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return max(jax.process_count(),
+                   int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+    def is_worker(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") == "TRAINER"
+
+    def is_server(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") == "PSERVER"
+
+    def worker_endpoints(self):
+        return [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+
+    def server_endpoints(self):
+        return [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+
+
+class Fleet:
+    """Singleton façade (parity: fleet_base.py:63 class Fleet)."""
+
+    def __init__(self):
+        self._role_maker: Optional[_RoleMaker] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._ps_runtime = None
+
+    # -- lifecycle -----------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._role_maker = role_maker or _RoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        if is_collective or getattr(role_maker, "is_collective", False):
+            init_parallel_env()
+            degrees = self._strategy.mesh_degrees()
+            if any(v not in (1, -1) for v in degrees.values()):
+                mesh_mod.init_mesh(degrees)
+        return self
+
+    # -- role info (parity fleet_base.py:214-420) ----------------------
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return self._rm().worker_index()
+
+    def worker_num(self):
+        return self._rm().worker_num()
+
+    def is_worker(self):
+        return self._rm().is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._rm().worker_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return len(self._rm().server_endpoints())
+
+    def server_index(self):
+        return int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+    def server_endpoints(self, to_string=False):
+        eps = self._rm().server_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._rm().is_server()
+
+    def _rm(self):
+        if self._role_maker is None:
+            self.init(is_collective=True)
+        return self._role_maker
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # -- PS lifecycle (wired to the host embedding service, fleet/ps) --
+    def init_worker(self):
+        if self._ps_runtime is not None:
+            self._ps_runtime.init_worker()
+
+    def init_server(self, *args, **kwargs):
+        from .ps import PSRuntime
+        self._ps_runtime = PSRuntime(self._strategy)
+        self._ps_runtime.init_server(*args, **kwargs)
+
+    def run_server(self):
+        if self._ps_runtime is not None:
+            self._ps_runtime.run_server()
+
+    def stop_worker(self):
+        if self._ps_runtime is not None:
+            self._ps_runtime.stop()
+
+    # -- the core API --------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        return DistributedOptimizer(optimizer,
+                                    self._strategy or DistributedStrategy(),
+                                    self)
+
+    def distributed_model(self, model):
+        """Parity: fleet_base.py distributed_model — wraps for DP; TP/fsdp
+        layers already carry shardings."""
+        from ..parallel import DataParallel
+        return DataParallel(model)
+
+    def distributed_train_step(self, model, loss_fn, optimizer,
+                               strategy=None):
+        from .dist_step import DistributedTrainStep
+        opt = optimizer.inner_opt if isinstance(optimizer,
+                                                DistributedOptimizer) \
+            else optimizer
+        return DistributedTrainStep(model, loss_fn, opt,
+                                    strategy or self._strategy)
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+
+class DistributedOptimizer:
+    """Wrapper returned by ``fleet.distributed_optimizer`` (parity:
+    fleet_base.py:598).  Applies optimizer-level strategy toggles (LAMB /
+    LARS swap — the reference's lamb_optimizer.py / lars_optimizer.py meta
+    optimizers) and delegates; graph-level strategies live in the compiled
+    step (dist_step.py)."""
+
+    def __init__(self, optimizer, strategy, fleet_obj):
+        self.user_defined_strategy = strategy
+        self._fleet = fleet_obj
+        self.inner_opt = self._maybe_swap(optimizer, strategy)
+
+    @staticmethod
+    def _maybe_swap(opt, strategy):
+        from ...optimizer import Lamb, Momentum
+        if strategy.lamb:
+            cfg = strategy.lamb_configs
+            return Lamb(learning_rate=opt._learning_rate,
+                        lamb_weight_decay=cfg["lamb_weight_decay"],
+                        parameters=opt._parameter_list)
+        if strategy.lars:
+            from ...optimizer import Lars
+            cfg = strategy.lars_configs
+            return Lars(learning_rate=opt._learning_rate,
+                        lars_coeff=cfg["lars_coeff"],
+                        lars_weight_decay=cfg["lars_weight_decay"],
+                        epsilon=cfg["epsilon"],
+                        parameters=opt._parameter_list)
+        return opt
+
+    def step(self):
+        return self.inner_opt.step()
+
+    def clear_grad(self):
+        return self.inner_opt.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.inner_opt.step()
+        self.inner_opt.clear_grad()
+        return [], []
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, d):
+        return self.inner_opt.set_state_dict(d)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_opt"], name)
+
+
+_fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return _fleet.init(role_maker, is_collective, strategy)
+
+
+def is_first_worker():
+    return _fleet.is_first_worker()
+
+
+def worker_index():
+    return _fleet.worker_index()
+
+
+def worker_num():
+    return _fleet.worker_num()
+
+
+def is_worker():
+    return _fleet.is_worker()
+
+
+def worker_endpoints(to_string=False):
+    return _fleet.worker_endpoints(to_string)
+
+
+def server_num():
+    return _fleet.server_num()
+
+
+def server_index():
+    return _fleet.server_index()
+
+
+def server_endpoints(to_string=False):
+    return _fleet.server_endpoints(to_string)
+
+
+def is_server():
+    return _fleet.is_server()
+
+
+def barrier_worker():
+    return _fleet.barrier_worker()
+
+
+def init_worker():
+    return _fleet.init_worker()
+
+
+def init_server(*a, **k):
+    return _fleet.init_server(*a, **k)
+
+
+def run_server():
+    return _fleet.run_server()
+
+
+def stop_worker():
+    return _fleet.stop_worker()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_train_step(model, loss_fn, optimizer, strategy=None):
+    return _fleet.distributed_train_step(model, loss_fn, optimizer, strategy)

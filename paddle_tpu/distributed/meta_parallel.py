@@ -1,0 +1,157 @@
+"""Tensor-/sequence-parallel layers (fleet.meta_parallel parity).
+
+The reference's model parallelism is embryonic: only
+``paddle.distributed.split`` with three cases — parallel embedding,
+row-parallel and column-parallel linear — built from per-rank weight shards
+plus explicit ``c_allreduce_sum``/``c_concat`` graph ops
+(reference: python/paddle/distributed/collective.py:492,526,566).
+
+TPU-native design: a parallel layer is an ordinary Layer whose parameters
+carry a ``dist_spec`` — a PartitionSpec over mesh axes.  Under global-view
+execution (eager sharded arrays or pjit) XLA's SPMD partitioner derives the
+collectives: a row-parallel matmul's contraction over the 'tp'-sharded
+dimension becomes a psum over ICI, a column-parallel output stays sharded
+until a sharding constraint gathers it.  No hand-inserted comm ops, and the
+same layer code runs unsharded when the mesh has tp=1.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn.functional as F
+from ..nn.initializer import Constant, Normal, XavierNormal
+from ..nn.layer.layers import Layer, Parameter
+from . import mesh as mesh_mod
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "mark_sharding", "shard_parameter", "get_rng_state_tracker",
+]
+
+
+def mark_sharding(param: Parameter, spec: P) -> Parameter:
+    """Attach a PartitionSpec to a parameter and, when a mesh is live,
+    immediately lay the value out accordingly (eager ops then run SPMD)."""
+    param.dist_spec = spec
+    mesh = mesh_mod.get_mesh(create=False)
+    if mesh is not None and any(s is not None for s in spec):
+        try:
+            param._value = jax.device_put(
+                param._value, mesh_mod.named_sharding(spec, mesh))
+        except ValueError:
+            pass  # axis size does not divide the dim: keep replicated
+    return param
+
+
+shard_parameter = mark_sharding
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the output dim sharded over 'tp'
+    (parity: reference collective.py:492 ``_parallel_linear`` axis=1).
+
+    y = x @ W[:, shard] — each tp rank computes a column block.  With
+    ``gather_output`` the result is constrained back to replicated (XLA
+    inserts the all-gather, the reference inserts ``c_concat``).
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = True, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        init = weight_attr if callable(weight_attr) else XavierNormal()
+        self.weight = mark_sharding(
+            Parameter(init((in_features, out_features))), P(None, "tp"))
+        self.bias = (mark_sharding(Parameter(Constant(0.0)((out_features,))),
+                                   P("tp"))
+                     if has_bias else None)
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        from ..framework.core import _apply
+        spec = (P(*([None] * (len(y.shape) - 1)), None) if self.gather_output
+                else P(*([None] * (len(y.shape) - 1)), "tp"))
+        return _apply(lambda v: mesh_mod.maybe_constrain(v, spec), y)
+
+
+class RowParallelLinear(Layer):
+    """Linear with the input (contraction) dim sharded over 'tp'
+    (parity: reference collective.py:492 ``_parallel_linear`` axis=0).
+
+    Each rank holds W[shard, :]; the matmul's partial products are psummed
+    by XLA (the reference appends an explicit ``c_allreduce_sum``).
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = False, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        init = weight_attr if callable(weight_attr) else XavierNormal()
+        self.weight = mark_sharding(
+            Parameter(init((in_features, out_features))), P("tp", None))
+        self.bias = Parameter(Constant(0.0)((out_features,))) \
+            if has_bias else None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'tp'
+    (parity: reference collective.py:526 ``_parallel_embedding``).
+
+    The reference masks out-of-shard ids, looks up locally and allreduces;
+    XLA SPMD derives exactly that program from the table's sharding.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        init = weight_attr if callable(weight_attr) else Normal(0.0, 0.02)
+        self.weight = mark_sharding(
+            Parameter(init((num_embeddings, embedding_dim))), P("tp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class _RNGStateTracker:
+    """Per-region PRNG isolation for TP dropout (parity:
+    fleet.meta_parallel get_rng_state_tracker in later reference versions;
+    here: fold the tp coordinate into the key so 'local' regions decorrelate
+    across tp ranks while 'global' regions stay identical)."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        self._states[name] = seed
+
+    def rng_state(self, name="local"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            from ..framework import random as rnd
+            seed = self._states.get(name, 0)
+            key = jax.random.fold_in(rnd._key(), seed)
+            with rnd.use_key(key):
+                yield
+        return cm()
+
+
+_tracker = _RNGStateTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

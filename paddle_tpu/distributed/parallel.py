@@ -1,0 +1,154 @@
+"""Process/parallel environment + DataParallel.
+
+Reference equivalents:
+- ``init_parallel_env``  <- python/paddle/distributed/parallel.py:57 —
+  there it gloo-rendezvouses a TCP store and creates an
+  ``NCCLParallelContext`` (reference: paddle/fluid/imperative/nccl_context.cc)
+  per process.  Here multi-host bootstrap is ``jax.distributed.initialize``
+  (coordinator rendezvous replaces the ncclUniqueId TCP broadcast of
+  reference platform/gen_comm_id_helper.cc:284) and intra-host parallelism
+  needs no processes at all: one controller drives every local chip.
+- ``DataParallel``       <- python/paddle/fluid/dygraph/parallel.py:322 +
+  the C++ bucketed-allreduce ``Reducer``
+  (reference: paddle/fluid/imperative/reducer.h:129).  On TPU the Reducer
+  vanishes: inputs are sharded on the batch axis of the global mesh, every
+  eager op then executes SPMD under XLA's global-view semantics, and the
+  gradient cross-replica sum is inserted by XLA — overlapped with compute
+  without any bucketing machinery.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from . import mesh as mesh_mod
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "DataParallel"]
+
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv (reads PADDLE_* env in the
+    reference; here rank/world come from the JAX process view)."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def device_id(self) -> int:
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+
+    # reference aliases
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env() -> ParallelEnv:
+    """Initialise the distributed runtime and the global device mesh.
+
+    Single host: no-op bootstrap, mesh over local chips.  Multi-host (the
+    reference's multi-node NCCL case): ``PADDLE_COORDINATOR`` /
+    ``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ID`` select the
+    ``jax.distributed`` coordinator — DCN-level rendezvous, after which the
+    mesh spans every chip in the slice.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_COORDINATOR")
+    if coord and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    mesh_mod.get_mesh()  # builds the default all-dp mesh
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+class DataParallel:
+    """Data-parallel model wrapper (parity:
+    reference python/paddle/fluid/dygraph/parallel.py:322, forward at :496).
+
+    Wraps a Layer so that batches entering ``forward`` are sharded over the
+    mesh data axes.  Parameters stay replicated; XLA's global-view autodiff
+    produces already-summed gradients, so the reference's Reducer
+    (imperative/reducer.h:129 — bucketing, MarkVarReady, fused NCCL
+    allreduce) has no equivalent here: ``scale_loss`` and
+    ``apply_collective_grads`` are identity, kept for API parity.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters=False):
+        init_parallel_env()
+        self._layers = layers
+
+    def _shard_batch(self, t):
+        from ..framework.core import Tensor
+        if not isinstance(t, Tensor):
+            return t
+        v = t._value
+        if not hasattr(v, "ndim") or v.ndim == 0:
+            return t
+        m = mesh_mod.get_mesh()
+        nshard = int(np.prod([m.shape[a] for a in mesh_mod.data_axes(m)]))
+        if v.shape[0] % nshard:
+            return t  # ragged tail batch: leave replicated
+        sharding = mesh_mod.named_sharding(mesh_mod.batch_spec(v.ndim, m), m)
+        out = Tensor(jax.device_put(v, sharding),
+                     stop_gradient=t.stop_gradient)
+        out._node, out._out_idx = t._node, t._out_idx
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(x) for x in inputs)
+        kwargs = {k: self._shard_batch(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    # delegate everything else to the wrapped layer (state_dict, parameters,
+    # train/eval, attribute access) — parity with the reference wrapper.
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

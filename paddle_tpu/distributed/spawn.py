@@ -1,0 +1,28 @@
+"""paddle.distributed.spawn parity.
+
+Reference: python/paddle/distributed/spawn.py — forks one worker process
+per GPU, each binding one device.  The TPU programming model is
+single-controller-per-host: one process drives every local chip, so
+``spawn(nprocs=k)`` does not fork k device workers; it runs ``func`` once
+with the mesh spanning the chips (``nprocs`` validated against the device
+count).  Multi-host spawning is the launcher's job (launch.py), matching
+how TPU pods schedule one process per host.
+"""
+from __future__ import annotations
+
+import jax
+
+from .parallel import init_parallel_env
+
+__all__ = ["spawn"]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    n = jax.local_device_count()
+    if nprocs not in (-1, None) and nprocs > n:
+        raise ValueError(
+            f"nprocs={nprocs} exceeds the {n} local TPU chips; on TPU one "
+            "process drives all local chips (use paddle_tpu.distributed."
+            "launch for multi-host)")
+    init_parallel_env()
+    return func(*args)

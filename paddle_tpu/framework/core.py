@@ -540,6 +540,13 @@ def to_tensor(data, dtype=None, place: Optional[Place] = None,
         v = jnp.asarray(v, dtype=jd)
     if isinstance(v, jax.core.Tracer):
         return Tensor(v, stop_gradient=stop_gradient)
-    dev = (place or _default_place()).jax_device()
-    arr = jax.device_put(v, dev)
+    if place is None:
+        from .place import _explicitly_set
+        if not _explicitly_set():
+            # uncommitted: lets the value co-locate with sharded/mesh
+            # arrays it later combines with (an explicit place or
+            # set_device commits, like the reference's Place-keyed tensors)
+            return Tensor(jnp.asarray(v), stop_gradient=stop_gradient)
+        place = _default_place()
+    arr = jax.device_put(v, place.jax_device())
     return Tensor(arr, stop_gradient=stop_gradient)

@@ -116,9 +116,19 @@ def _default_place() -> Place:
     return _expected_place
 
 
+_user_set_device = False
+
+
+def _explicitly_set() -> bool:
+    """True once the user called set_device — then new tensors commit to
+    that place instead of staying uncommitted."""
+    return _user_set_device
+
+
 def set_device(device) -> Place:
     """paddle.set_device('tpu:0' | 'cpu' | 'gpu:0' | Place)."""
-    global _expected_place
+    global _expected_place, _user_set_device
+    _user_set_device = True
     if isinstance(device, Place):
         _expected_place = device
         return device

@@ -12,7 +12,6 @@ in-flight device batches (the analog of the reference's double-buffered
 """
 from __future__ import annotations
 
-import atexit
 import itertools
 import queue
 import threading
@@ -78,21 +77,6 @@ def _to_device(batch, places=None):
     return conv(batch)
 
 
-def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
-                 num_workers):
-    _worker_info.info = WorkerInfo(wid, num_workers, dataset)
-    while True:
-        item = index_queue.get()
-        if item is None:
-            break
-        seq, idxs = item
-        try:
-            samples = [dataset[i] for i in idxs]
-            out_queue.put((seq, collate_fn(samples), None))
-        except Exception as e:  # propagate worker errors
-            out_queue.put((seq, None, e))
-
-
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -150,7 +134,17 @@ class DataLoader:
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        wid_counter = itertools.count()
+
+        def init_worker():
+            wid = next(wid_counter)
+            _worker_info.info = WorkerInfo(wid, self.num_workers,
+                                           self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+
+        pool = ThreadPoolExecutor(max_workers=self.num_workers,
+                                  initializer=init_worker)
         try:
             def make(idxs):
                 return self.collate_fn([self.dataset[i] for i in idxs])

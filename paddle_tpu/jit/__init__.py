@@ -94,6 +94,32 @@ def _split_args(obj, leaves):
     return obj
 
 
+def _static_key(obj):
+    """Stable hashable key for a static (non-Tensor) argument skeleton.
+
+    repr() is unsafe here: numpy truncates large-array reprs (two different
+    masks could collide on '...'), and default object reprs embed id()s
+    inconsistently.  Arrays key by content digest; plain objects by
+    identity (baked into the trace as constants, so identity semantics are
+    the safe choice)."""
+    if isinstance(obj, _TensorLeaf):
+        return ("leaf", obj.idx)
+    if obj is None or isinstance(obj, (bool, int, float, complex, str,
+                                       bytes)):
+        return obj
+    if isinstance(obj, np.ndarray):
+        import hashlib
+        return ("nd", obj.shape, str(obj.dtype),
+                hashlib.sha1(np.ascontiguousarray(obj).tobytes())
+                .hexdigest())
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__,) + tuple(_static_key(o) for o in obj)
+    if isinstance(obj, dict):
+        return ("dict",) + tuple(sorted(
+            (k, _static_key(v)) for k, v in obj.items()))
+    return ("obj", type(obj).__qualname__, id(obj))
+
+
 def _fill_args(skeleton, leaf_vals, stop_gradient=True):
     if isinstance(skeleton, _TensorLeaf):
         return Tensor(leaf_vals[skeleton.idx], stop_gradient=stop_gradient)
@@ -188,7 +214,7 @@ class StaticFunction:
         layer = self._layer_obj()
         amp = amp_state()
         key_cache = (
-            repr(skeleton), repr(kw_skeleton),
+            _static_key(skeleton), _static_key(kw_skeleton),
             tuple((v.shape, str(v.dtype)) for v in leaf_vals),
             None if amp is None else (amp.level, str(amp.dtype)),
             None if layer is None else layer.training,
@@ -432,7 +458,7 @@ class TrainStep:
         opt = self._opt
         names = self._param_names
 
-        def step(param_vals, buffer_vals, opt_state, key, args):
+        def step(param_vals, buffer_vals, opt_state, lr, key, args):
             def loss_of(pvals):
                 targs = _tree_to_tensors(args)
                 with use_key(key):
@@ -458,7 +484,11 @@ class TrainStep:
                 loss_of, has_aux=True)(param_vals)
             plist = [param_vals[n] for n in names]
             glist = [grads[n] for n in names]
-            new_ps, new_ss = opt.functional_update(plist, glist, opt_state)
+            # lr enters as a traced scalar so LR schedulers take effect
+            # without retracing (they would otherwise be baked in as a
+            # compile-time constant)
+            new_ps, new_ss = opt.functional_update(plist, glist, opt_state,
+                                                   lr=lr)
             return loss, dict(zip(names, new_ps)), new_bufs, new_ss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -476,9 +506,10 @@ class TrainStep:
         buffer_vals = {n: b._value for n, b in self._buffers.items()}
         opt_state = self._opt.opt_state()
         key = split_key()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with no_grad():
             loss, new_params, new_bufs, new_state = self._compiled(
-                param_vals, buffer_vals, opt_state, key, arg_vals)
+                param_vals, buffer_vals, opt_state, lr, key, arg_vals)
         for n, p in self._params.items():
             p._value = new_params[n]
         for n, b in self._buffers.items():

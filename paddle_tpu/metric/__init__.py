@@ -151,11 +151,11 @@ class Auc(Metric):
         l = l.reshape(-1)
         idx = np.clip((p * self.num_thresholds).astype(int), 0,
                       self.num_thresholds)
-        for i, lab in zip(idx, l):
-            if lab:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        mask = l.astype(bool)
+        self._stat_pos += np.bincount(idx[mask],
+                                      minlength=self.num_thresholds + 1)
+        self._stat_neg += np.bincount(idx[~mask],
+                                      minlength=self.num_thresholds + 1)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1)

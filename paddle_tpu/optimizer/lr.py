@@ -290,6 +290,16 @@ class OneCycleLR(LRScheduler):
         if step <= up_steps or up_steps == 0:
             pct = step / max(up_steps, 1)
             return self._interp(self.initial_lr, self.max_lr, pct)
+        if self.three_phase:
+            # phase 2: symmetric descent max_lr -> initial_lr, then
+            # phase 3: annihilation initial_lr -> end_lr
+            down_steps = up_steps
+            if step <= up_steps + down_steps:
+                pct = (step - up_steps) / max(down_steps, 1)
+                return self._interp(self.max_lr, self.initial_lr, pct)
+            rest = max(self.total_steps - up_steps - down_steps, 1)
+            pct = (step - up_steps - down_steps) / rest
+            return self._interp(self.initial_lr, self.end_lr, pct)
         pct = (step - up_steps) / max(self.total_steps - up_steps, 1)
         return self._interp(self.max_lr, self.end_lr, pct)
 

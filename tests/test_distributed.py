@@ -1,0 +1,221 @@
+"""Distributed stack tests on the 8-device virtual CPU mesh.
+
+Replaces the reference's multi-process localhost harness
+(reference: python/paddle/fluid/tests/unittests/test_collective_base.py:162
+spawns 2 subprocesses) with XLA host-platform device simulation — every
+collective/sharding test runs in-process over 8 virtual devices
+(SURVEY.md §4 lesson).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import communication as comm
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def test_mesh_init_degrees():
+    m = dist.init_mesh({"dp": 2, "tp": 2, "pp": 2})
+    assert m.shape["dp"] == 2 and m.shape["tp"] == 2 and m.shape["pp"] == 2
+    assert m.shape["fsdp"] == 1
+    m2 = dist.init_mesh({"fsdp": -1, "tp": 2})
+    assert m2.shape["fsdp"] == 4 and m2.shape["tp"] == 2
+
+
+def test_mesh_default_absorbs_dp():
+    m = dist.init_mesh({"tp": 2})
+    assert m.shape["dp"] == 4 and m.shape["tp"] == 2
+
+
+def test_eager_all_reduce_replicated_semantics():
+    # eager tensor == this process's value on every rank; sum over 8 ranks
+    t = paddle.to_tensor(np.ones((4,), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(np.asarray(t._value), 8 * np.ones(4), rtol=0)
+
+
+def test_eager_all_reduce_max_group():
+    g = dist.new_group(list(range(4)))
+    t = paddle.to_tensor(np.full((2, 2), 3.0, np.float32))
+    dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g)
+    np.testing.assert_allclose(np.asarray(t._value), 3.0)
+
+
+def test_eager_all_gather():
+    out = []
+    t = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    dist.all_gather(out, t)
+    assert len(out) == 8
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o._value),
+                                   np.arange(3, dtype=np.float32))
+
+
+def test_eager_broadcast_and_barrier():
+    t = paddle.to_tensor(np.full((3,), 7.0, np.float32))
+    dist.broadcast(t, src=2)
+    np.testing.assert_allclose(np.asarray(t._value), 7.0)
+    dist.barrier()
+
+
+def test_in_graph_collectives_shard_map():
+    from paddle_tpu.distributed.collective import shard_map
+    mesh = dist.init_mesh({"dp": 8})
+
+    def f(x):
+        s = comm.psum(x, "dp")
+        g = comm.all_gather(x, "dp", tiled=True)
+        idx = comm.axis_index("dp")
+        shifted = comm.ring_shift(x, "dp", 1)
+        return s, g, idx[None], shifted
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    s, g, idx, shifted = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P("dp"),),
+        out_specs=(P("dp"), P("dp"), P("dp"), P("dp"))))(x)
+    np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
+    # all_gather tiled: every shard holds the full 8 values -> global (64,1)
+    assert g.shape == (64, 1)
+    np.testing.assert_allclose(np.asarray(idx).ravel(), np.arange(8))
+    # ring shift by 1: shard i receives shard (i-1)'s value
+    np.testing.assert_allclose(np.asarray(shifted).ravel(),
+                               np.roll(np.arange(8.0), 1))
+
+
+def test_broadcast_from_in_graph():
+    from paddle_tpu.distributed.collective import shard_map
+    mesh = dist.init_mesh({"dp": 8})
+    x = jnp.arange(8.0).reshape(8)
+    out = jax.jit(shard_map(lambda v: comm.broadcast_from(v, "dp", root=3),
+                            mesh=mesh, in_specs=(P("dp"),),
+                            out_specs=P("dp")))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+
+def test_data_parallel_training_matches_single():
+    """DP over 8 devices must match single-device numerics (the reference
+    asserts the same closeness in test_dist_base.py check_with_place)."""
+    import paddle_tpu.nn as nn
+
+    def build():
+        paddle.seed(42)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        return m, opt
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(4, 16, 16)).astype(np.float32)
+    ys = rng.normal(size=(4, 16, 4)).astype(np.float32)
+
+    # single-device
+    m1, o1 = build()
+    for x, y in zip(xs, ys):
+        loss = ((m1(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+
+    # data-parallel
+    dist.init_mesh({"dp": 8})
+    m2, o2 = build()
+    dp = dist.DataParallel(m2)
+    for x, y in zip(xs, ys):
+        loss = ((dp(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_allclose(np.asarray(p1._value),
+                                   np.asarray(p2._value),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_tensor_parallel_linear_matches_serial():
+    dist.init_mesh({"tp": 8})
+    paddle.seed(7)
+    col = dist.ColumnParallelLinear(16, 64, gather_output=True)
+    row = dist.RowParallelLinear(64, 16)
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .normal(size=(4, 16)).astype(np.float32))
+    y = row(col(x))
+    # serial reference with identical weights
+    import paddle_tpu.nn.functional as F
+    ref = F.linear(F.linear(x, col.weight, col.bias), row.weight, row.bias)
+    np.testing.assert_allclose(np.asarray(y._value), np.asarray(ref._value),
+                               rtol=1e-4, atol=1e-4)
+    # grads flow through sharded params
+    y.sum().backward()
+    assert col.weight.grad is not None and row.weight.grad is not None
+
+
+def test_vocab_parallel_embedding():
+    dist.init_mesh({"tp": 8})
+    paddle.seed(3)
+    emb = dist.VocabParallelEmbedding(64, 8)
+    ids = paddle.to_tensor(np.array([[0, 5, 63], [7, 8, 9]], np.int32))
+    out = emb(ids)
+    assert tuple(out.shape) == (2, 3, 8)
+    ref = np.asarray(emb.weight._value)[np.asarray(ids._value)]
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+
+def test_split_api_parity():
+    dist.init_mesh({"tp": 8})
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    y = dist.split(x, size=(8, 16), operation="linear", axis=1,
+                   num_partitions=8)
+    assert tuple(y.shape) == (2, 16)
+
+
+def test_parallel_env_and_fleet_roles():
+    env = dist.init_parallel_env()
+    assert env.rank == 0 and env.world_size == 1
+    from paddle_tpu.distributed import fleet
+    fleet.init(is_collective=True)
+    assert fleet.is_first_worker()
+    assert fleet.worker_num() == 1
+    fleet.barrier_worker()
+
+
+def test_strategy_serialization(tmp_path):
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    s = DistributedStrategy()
+    s.sharding = True
+    s.sharding_configs = {"stage": 3, "sharding_degree": 4}
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4}
+    p = str(tmp_path / "strategy.json")
+    s.save_to_prototxt(p)
+    s2 = DistributedStrategy()
+    s2.load_from_prototxt(p)
+    assert s2.sharding and s2.sharding_configs["stage"] == 3
+    assert s2.mesh_degrees()["fsdp"] == 4
+    with pytest.raises(ValueError):
+        s.sharding_configs = {"bogus_key": 1}
+
+
+def test_strategy_lamb_swap():
+    from paddle_tpu.distributed import fleet
+    import paddle_tpu.nn as nn
+    m = nn.Linear(4, 4)
+    s = fleet.DistributedStrategy()
+    s.lamb = True
+    fleet.init(is_collective=True, strategy=s)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    dopt = fleet.distributed_optimizer(opt, s)
+    from paddle_tpu.optimizer import Lamb
+    assert isinstance(dopt.inner_opt, Lamb)
