@@ -132,7 +132,8 @@ def _compiled(kind, gid, shape, dtype, extra=None):
         red = {"0": lambda x: lax.psum(x, "world"),
                "1": lambda x: lax.pmax(x, "world"),
                "2": lambda x: lax.pmin(x, "world"),
-               "3": lambda x: jnp.exp(lax.psum(jnp.log(x), "world"))}[op]
+               "3": lambda x: jnp.prod(lax.all_gather(x, "world"),
+                                       axis=0)}[op]
         return run(lambda x: red(x))
     if kind == "all_gather":
         sm = shard_map(lambda x: lax.all_gather(x, "world"),

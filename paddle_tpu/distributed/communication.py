@@ -41,7 +41,8 @@ def pmin(x, axis: str):
 
 
 def pprod(x, axis: str):
-    return jnp.exp(lax.psum(jnp.log(x), axis))
+    # NOT exp(psum(log)): that breaks on zero/negative elements
+    return jnp.prod(lax.all_gather(x, axis), axis=0)
 
 
 def all_gather(x, axis: str, *, tiled: bool = False, gather_dim: int = 0):

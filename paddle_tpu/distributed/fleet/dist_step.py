@@ -32,27 +32,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...framework.core import Tensor, no_grad
 from ...framework.random import split_key, use_key
+from ...jit import _tree_to_values
 from .. import mesh as mesh_mod
 
 __all__ = ["DistributedTrainStep", "param_partition_spec"]
 
 
 def _tree_to_tensors(obj):
+    # jit's helper wraps jax arrays only; batch elements may be numpy too
     if isinstance(obj, (list, tuple)):
         return type(obj)(_tree_to_tensors(o) for o in obj)
     if isinstance(obj, dict):
         return {k: _tree_to_tensors(v) for k, v in obj.items()}
     return Tensor(obj) if hasattr(obj, "dtype") else obj
-
-
-def _tree_to_values(obj):
-    if isinstance(obj, Tensor):
-        return obj._value
-    if isinstance(obj, (list, tuple)):
-        return type(obj)(_tree_to_values(o) for o in obj)
-    if isinstance(obj, dict):
-        return {k: _tree_to_values(v) for k, v in obj.items()}
-    return obj
 
 
 def param_partition_spec(value, mesh, annotated: Optional[P],
@@ -103,8 +95,24 @@ class DistributedTrainStep:
             else:
                 mesh = cur
         self._mesh = mesh
-        self._param_names = [n for n, _ in model.named_parameters()]
-        self._params = dict(model.named_parameters())
+        # Align with the OPTIMIZER's parameter list (opt_state order), not
+        # the model's: fine-tuning may optimize a subset; frozen params ride
+        # along as (non-differentiated) buffers.
+        all_named = dict(model.named_parameters())
+        opt_plist = list(getattr(optimizer, "_parameter_list", None) or [])
+        if opt_plist:
+            id2name = {id(p): n for n, p in all_named.items()}
+            self._param_names = []
+            for p in opt_plist:
+                n = id2name.get(id(p))
+                if n is None:
+                    raise ValueError(
+                        "optimizer holds a parameter that is not part of "
+                        "the model passed to DistributedTrainStep")
+                self._param_names.append(n)
+        else:
+            self._param_names = list(all_named)
+        self._params = {n: all_named[n] for n in self._param_names}
         self._buffers = {n: b for n, b in model.state_dict().items()
                          if n not in self._params}
         sh = self._strategy.sharding_configs

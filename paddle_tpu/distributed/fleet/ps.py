@@ -69,6 +69,18 @@ class SparseTable:
                 else:  # sgd
                     row -= self._lr * g
 
+    def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
+        """Geo-async raw delta add (reference: GeoCommunicator delta-push,
+        distributed/service/communicator.h:495) — no optimizer applied."""
+        ids = np.asarray(ids).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(ids.size, self.dim)
+        with self._lock:
+            for k, d in zip(ids.tolist(), deltas):
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._rows[k] = self._init()
+                row += d
+
     def __len__(self):
         return len(self._rows)
 

@@ -107,13 +107,7 @@ class PSServer:
                         _send_msg(conn, {"ok": True})
                 elif op == "push_delta":  # geo mode: raw delta add
                     t = self._tables[msg["table"]]
-                    ids, deltas = msg["ids"], msg["deltas"]
-                    with t._lock:
-                        for k, d in zip(np.asarray(ids).tolist(), deltas):
-                            row = t._rows.get(k)
-                            if row is None:
-                                row = t._rows[k] = t._init()
-                            row += d
+                    t.push_delta(msg["ids"], msg["deltas"])
                     if msg.get("sync"):
                         _send_msg(conn, {"ok": True})
                 elif op == "barrier":
@@ -149,6 +143,7 @@ class PSClient:
         self._lock = [threading.Lock() for _ in self._socks]
         self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
         self._stop = threading.Event()
+        self._push_err: "Exception | None" = None
         if mode in ("async", "half_async"):
             self._drainer = threading.Thread(target=self._drain, daemon=True)
             self._drainer.start()
@@ -158,11 +153,11 @@ class PSClient:
 
     def pull(self, table: str, ids) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
-        if len(self._socks) == 1:
+        if len(self._socks) == 1 or ids.size == 0:
+            # empty pulls still round-trip so the (0, dim) shape comes back
             return self._rpc(0, {"op": "pull", "table": table, "ids": ids},
                              reply=True)["vals"]
         shard = self._shard(ids)
-        out = np.empty((ids.size,), object)
         vals = None
         for r in range(len(self._socks)):
             m = shard == r
@@ -201,13 +196,21 @@ class PSClient:
                 table, ids, grads = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._push_now(table, ids, grads, sync=False)
+            try:
+                self._push_now(table, ids, grads, sync=False)
+            except Exception as e:  # keep draining; surface at barrier()
+                self._push_err = e
+            finally:
+                self._q.task_done()
 
     def barrier(self):
-        # flush the async queue then round-trip every server
-        while not self._q.empty():
-            import time
-            time.sleep(0.01)
+        # flush the async queue (join waits for task_done, so in-flight
+        # pushes count — q.empty() would race the drainer) then round-trip
+        # every server
+        self._q.join()
+        if self._push_err is not None:
+            err, self._push_err = self._push_err, None
+            raise RuntimeError("async push failed before barrier") from err
         for r in range(len(self._socks)):
             self._rpc(r, {"op": "barrier"}, reply=True)
 

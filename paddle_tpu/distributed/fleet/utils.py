@@ -34,14 +34,19 @@ def recompute(function, *args, **kwargs):
         return function(*args, **kwargs)
 
     vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    _is_t = lambda o: isinstance(o, Tensor)  # noqa: E731
 
     def fn(*vs):
         ts = [Tensor(v) if hasattr(v, "dtype") else v for v in vs]
         out = function(*ts, **kwargs)
-        return out._value if isinstance(out, Tensor) else out
+        # multi-output segments return tuples/lists/dicts of Tensors
+        return jax.tree_util.tree_map(
+            lambda o: o._value if _is_t(o) else o, out, is_leaf=_is_t)
 
     out = jax.checkpoint(fn)(*vals)
-    return Tensor(out, stop_gradient=False) if hasattr(out, "dtype") else out
+    return jax.tree_util.tree_map(
+        lambda o: Tensor(o, stop_gradient=False)
+        if hasattr(o, "dtype") else o, out)
 
 
 class LocalSGDStepper:

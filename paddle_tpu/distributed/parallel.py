@@ -77,11 +77,22 @@ def init_parallel_env() -> ParallelEnv:
     if _initialized:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_COORDINATOR")
-    if coord and jax.process_count() == 1:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    if coord:
+        # Must run before anything touches the XLA backend (even
+        # jax.process_count() would initialise it).  Only skip when a
+        # launcher already did the rendezvous — a real connect failure must
+        # propagate, or every host would silently train independently.
+        already = False
+        try:
+            from jax._src.distributed import global_state as _gs
+            already = getattr(_gs, "client", None) is not None
+        except ImportError:
+            pass
+        if not already:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     mesh_mod.get_mesh()  # builds the default all-dp mesh
     _initialized = True
     return ParallelEnv()

@@ -94,7 +94,7 @@ def _split_args(obj, leaves):
     return obj
 
 
-def _static_key(obj):
+def _static_key(obj, pins=None):
     """Stable hashable key for a static (non-Tensor) argument skeleton.
 
     repr() is unsafe here: numpy truncates large-array reprs (two different
@@ -104,19 +104,30 @@ def _static_key(obj):
     the safe choice)."""
     if isinstance(obj, _TensorLeaf):
         return ("leaf", obj.idx)
-    if obj is None or isinstance(obj, (bool, int, float, complex, str,
-                                       bytes)):
+    if obj is None or isinstance(obj, (str, bytes)):
         return obj
+    if isinstance(obj, (bool, int, float, complex)):
+        # type goes into the key: 1, 1.0 and True hash equal but must not
+        # share a trace (dtype promotion differs)
+        return ("scalar", type(obj).__name__, obj)
     if isinstance(obj, np.ndarray):
         import hashlib
         return ("nd", obj.shape, str(obj.dtype),
                 hashlib.sha1(np.ascontiguousarray(obj).tobytes())
                 .hexdigest())
+    if isinstance(obj, np.generic):  # numpy scalar: key by value
+        return ("nps", str(obj.dtype), obj.item())
     if isinstance(obj, (list, tuple)):
-        return (type(obj).__name__,) + tuple(_static_key(o) for o in obj)
+        return (type(obj).__name__,) + tuple(_static_key(o, pins)
+                                             for o in obj)
     if isinstance(obj, dict):
         return ("dict",) + tuple(sorted(
-            (k, _static_key(v)) for k, v in obj.items()))
+            (k, _static_key(v, pins)) for k, v in obj.items()))
+    # identity-keyed: pin the object on the owning StaticFunction so its id
+    # can't be recycled onto a different live object while that trace cache
+    # still references it (pins die with the StaticFunction, not process)
+    if pins is not None:
+        pins[id(obj)] = obj
     return ("obj", type(obj).__qualname__, id(obj))
 
 
@@ -151,6 +162,7 @@ class StaticFunction:
         self._layer = layer if layer is not None else getattr(fn, "__self__",
                                                               None)
         self._compiled: Dict[Any, Callable] = {}
+        self._pins: Dict[int, Any] = {}  # keep identity-keyed statics alive
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__",
                                            "__qualname__"), updated=())
@@ -214,7 +226,8 @@ class StaticFunction:
         layer = self._layer_obj()
         amp = amp_state()
         key_cache = (
-            _static_key(skeleton), _static_key(kw_skeleton),
+            _static_key(skeleton, self._pins),
+            _static_key(kw_skeleton, self._pins),
             tuple((v.shape, str(v.dtype)) for v in leaf_vals),
             None if amp is None else (amp.level, str(amp.dtype)),
             None if layer is None else layer.training,

@@ -1,0 +1,9 @@
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, RMSNorm,
+    llama_tiny, llama_7b, llama_13b,
+)
+
+__all__ = [
+    "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "RMSNorm",
+    "llama_tiny", "llama_7b", "llama_13b",
+]

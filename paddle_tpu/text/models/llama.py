@@ -1,0 +1,328 @@
+"""Llama-family decoder-only LM — the flagship long-context model.
+
+The 2021-era reference has no Llama; its largest NLP target is the ERNIE/
+BERT encoder family trained with Fleet collective (reference:
+python/paddle/distributed/fleet/, python/paddle/nn/layer/transformer.py).
+This model is the greenfield long-context capability SURVEY.md §5.7 calls
+for, designed TPU-first:
+
+- every projection is a tensor-parallel layer (``ColumnParallelLinear`` /
+  ``RowParallelLinear`` / ``VocabParallelEmbedding``) whose parameters
+  carry PartitionSpecs over the 'tp' mesh axis — XLA SPMD derives the
+  collectives, no ``c_allreduce`` ops;
+- attention dispatches to the Pallas flash-attention kernel for long
+  sequences (ops/flash_attention.py), and under a 'sp' mesh axis the
+  sequence dimension is sharded (ring/all-to-all handled by XLA SPMD +
+  sharding constraints, see distributed/sequence_parallel.py);
+- bf16-first: matmul-heavy compute runs in ``bfloat16`` on the MXU while
+  params/norms stay fp32 (the reference's AMP white/black lists,
+  python/paddle/fluid/contrib/mixed_precision/fp16_lists.py, collapse into
+  this dtype policy);
+- rematerialisation boundaries per decoder layer via ``remat=True`` map to
+  ``jax.checkpoint`` (reference: RecomputeOptimizer,
+  python/paddle/fluid/backward.py:725).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn.functional as F
+from ...distributed import mesh as mesh_mod
+from ...distributed.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ...framework.core import Tensor, _apply
+from ...nn.initializer import Constant, Normal
+from ...nn.layer.layers import Layer, Parameter
+
+__all__ = [
+    "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "RMSNorm",
+    "llama_tiny", "llama_7b", "llama_13b",
+]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # GQA; None -> MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    remat: bool = True            # per-layer activation checkpointing
+    compute_dtype: str = "bfloat16"
+    sequence_parallel: bool = False  # shard activations' seq dim over 'sp'
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Small config for tests / compile checks."""
+    d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+             num_hidden_layers=2, num_attention_heads=4,
+             num_key_value_heads=2, max_position_embeddings=128,
+             remat=False)
+    d.update(kw)
+    return LlamaConfig(**d)
+
+
+def llama_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama_13b(**kw) -> LlamaConfig:
+    d = dict(hidden_size=5120, intermediate_size=13824,
+             num_hidden_layers=40, num_attention_heads=40)
+    d.update(kw)
+    return LlamaConfig(**d)
+
+
+class RMSNorm(Layer):
+    """y = x / rms(x) * w — computed in fp32 regardless of input dtype."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5):
+        super().__init__()
+        self.eps = eps
+        self.weight = Parameter(Constant(1.0)((hidden_size,)))
+
+    def forward(self, x):
+        eps = self.eps
+
+        def f(v, w):
+            h = v.astype(jnp.float32)
+            var = jnp.mean(h * h, axis=-1, keepdims=True)
+            h = h * jax.lax.rsqrt(var + eps)
+            return (h * w).astype(v.dtype)
+        return _apply(f, x, self.weight, op_name="rms_norm")
+
+
+def _rope(x, positions, theta: float):
+    """Rotary position embedding on (B, S, H, D)."""
+    d = x.shape[-1]
+    freq = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = positions[:, :, None].astype(jnp.float32) * freq  # B,S,D/2
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    out = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        init = Normal(0.0, c.initializer_range)
+        self.q_proj = ColumnParallelLinear(
+            c.hidden_size, c.num_attention_heads * c.head_dim,
+            weight_attr=init, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            c.hidden_size, c.kv_heads * c.head_dim,
+            weight_attr=init, has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            c.hidden_size, c.kv_heads * c.head_dim,
+            weight_attr=init, has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(
+            c.num_attention_heads * c.head_dim, c.hidden_size,
+            weight_attr=init, has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden, positions, cache=None):
+        c = self.config
+        q = self.q_proj(hidden)
+        k = self.k_proj(hidden)
+        v = self.v_proj(hidden)
+
+        def attn(qv, kv, vv, pos):
+            B, S = qv.shape[0], qv.shape[1]
+            qh = qv.reshape(B, S, c.num_attention_heads, c.head_dim)
+            kh = kv.reshape(B, S, c.kv_heads, c.head_dim)
+            vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
+            qh = _rope(qh, pos, c.rope_theta)
+            kh = _rope(kh, pos, c.rope_theta)
+            # heads stay sharded over 'tp' through the attention
+            qh = mesh_mod.maybe_constrain(qh, P(None, None, "tp", None))
+            if c.kv_heads != c.num_attention_heads:
+                rep = c.num_attention_heads // c.kv_heads
+                kh = jnp.repeat(kh, rep, axis=2)
+                vh = jnp.repeat(vh, rep, axis=2)
+            from ...nn.functional.attention import _sdpa_ref
+            from ...ops.flash_attention import flash_attention as _fa_t
+            use_flash = (jax.default_backend() == "tpu" and S >= 1024
+                         and c.head_dim in (64, 128, 256))
+            if use_flash:
+                o = _fa_t(qh, kh, vh, causal=True)
+            else:
+                o = _sdpa_ref(qh, kh, vh, None, 0.0, True, None)
+            return o.reshape(B, S, c.num_attention_heads * c.head_dim)
+
+        ctx = _apply(attn, q, k, v, positions, op_name="llama_attention")
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        self.gate_proj = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(
+            c.intermediate_size, c.hidden_size, weight_attr=init,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden, positions):
+        h = hidden + self.self_attn(self.input_layernorm(hidden), positions)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, positions=None):
+        c = self.config
+        if positions is None:
+            S = input_ids.shape[1]
+            positions = _apply(
+                lambda ids: jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32)[None, :], ids.shape),
+                input_ids, op_name="positions")
+        hidden = self.embed_tokens(input_ids)
+        if c.compute_dtype:
+            hidden = hidden.astype(c.compute_dtype)
+        sp_spec = (P(None, "sp", None) if c.sequence_parallel else None)
+        if sp_spec is not None:
+            hidden = _apply(lambda v: mesh_mod.maybe_constrain(v, sp_spec),
+                            hidden)
+        for layer in self.layers:
+            if c.remat:
+                hidden = _remat_layer(layer, hidden, positions)
+            else:
+                hidden = layer(hidden, positions)
+        return self.norm(hidden)
+
+
+def _remat_layer(layer: LlamaDecoderLayer, hidden: Tensor, positions):
+    """Run one decoder layer under jax.checkpoint via functional_call.
+
+    The eager tape sees a single fused op whose vjp recomputes the layer
+    forward — activation-checkpointing parity with the reference's
+    RecomputeOptimizer (fluid/optimizer.py RecomputeOptimizer) done the
+    XLA way.
+    """
+    names = [n for n, _ in layer.named_parameters()]
+    params = dict(layer.named_parameters())
+
+    @functools.partial(jax.checkpoint, static_argnums=())
+    def run(pvals, h, pos):
+        st = dict(layer.named_parameters())
+        old = {k: t._value for k, t in st.items()}
+        try:
+            for k, t in st.items():
+                t._value = pvals[k]
+            out = layer(Tensor(h), Tensor(pos))
+        finally:
+            for k, t in st.items():
+                t._value = old[k]
+        return out._value
+
+    tensors = [params[n] for n in names]
+
+    def f(h, pos, *pv):
+        return run(dict(zip(names, pv)), h, pos)
+    return _apply(f, hidden, positions, *tensors, op_name="remat_layer")
+
+
+class LlamaForCausalLM(Layer):
+    """Causal LM head on LlamaModel.
+
+    ``forward(input_ids, labels=None)`` returns logits, or (loss, logits)
+    when labels are given (next-token shift done internally, label -100 =
+    ignore, matching the common pretrain convention).
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=Normal(0.0, config.initializer_range),
+                has_bias=False, gather_output=True)
+
+    def _logits(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden.astype("float32"))
+        emb = self.model.embed_tokens.weight
+
+        def f(h, w):
+            return h.astype(jnp.float32) @ w.T.astype(jnp.float32)
+        return _apply(f, hidden, emb, op_name="tied_lm_head")
+
+    def forward(self, input_ids, labels=None, positions=None):
+        hidden = self.model(input_ids, positions)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        loss = _apply(_causal_lm_loss, logits, labels, op_name="lm_loss")
+        return loss, logits
+
+
+def _causal_lm_loss(logits, labels):
+    lg = logits[:, :-1, :]
+    lb = labels[:, 1:]
+    valid = lb >= 0
+    lb = jnp.where(valid, lb, 0)
+    logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,115 @@
+"""Llama family: eager forward, grads, remat parity, distributed step.
+
+Model-level consistency testing follows the reference's pattern of
+whole-model dygraph-vs-static comparisons
+(reference: python/paddle/fluid/tests/unittests/dygraph_to_static/test_bert.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+
+def _batch(cfg, b=2, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, size=(b, s)).astype("int32")
+    return paddle.to_tensor(ids)
+
+
+def test_forward_shapes():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = _batch(cfg)
+    logits = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    assert str(logits.dtype).endswith("float32")
+
+
+def test_loss_and_grads():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = _batch(cfg)
+    loss, _ = model(ids, labels=ids)
+    assert np.isfinite(float(loss))
+    loss.backward()
+    for n, p in model.named_parameters():
+        assert p.grad is not None, f"no grad for {n}"
+        assert np.all(np.isfinite(np.asarray(p.grad._value))), n
+
+
+def test_remat_matches_no_remat():
+    cfg = llama_tiny(remat=False)
+    cfg2 = llama_tiny(remat=True)
+    m1 = LlamaForCausalLM(cfg)
+    m2 = LlamaForCausalLM(cfg2)
+    m2.set_state_dict(m1.state_dict())
+    ids = _batch(cfg)
+    l1, _ = m1(ids, labels=ids)
+    l2, _ = m2(ids, labels=ids)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    l1.backward()
+    l2.backward()
+    g1 = dict(m1.named_parameters())
+    for n, p2 in m2.named_parameters():
+        np.testing.assert_allclose(
+            np.asarray(g1[n].grad._value), np.asarray(p2.grad._value),
+            rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_gqa_tiling():
+    cfg = llama_tiny(num_key_value_heads=1)
+    model = LlamaForCausalLM(cfg)
+    logits = model(_batch(cfg))
+    assert logits.shape[-1] == cfg.vocab_size
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = np.asarray(_batch(cfg, b=1)._value).copy()
+    l1 = np.asarray(model(paddle.to_tensor(ids))._value)
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    l2 = np.asarray(model(paddle.to_tensor(ids2))._value)
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], rtol=1e-4, atol=1e-5)
+    assert not np.allclose(l1[0, -1], l2[0, -1])
+
+
+def test_distributed_tp_fsdp_step():
+    """One DistributedTrainStep over a tp=2 x fsdp=2 x dp=2 mesh must run
+    and match the single-device loss on identical weights/batch."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
+
+    cfg = llama_tiny(compute_dtype="float32")
+    ref = LlamaForCausalLM(cfg)
+    ids = _batch(cfg, b=4)
+    ref_loss, _ = ref(ids, labels=ids)
+
+    mesh_mod.set_mesh(None)
+    mesh_mod.init_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    try:
+        model = LlamaForCausalLM(cfg)
+        model.set_state_dict(ref.state_dict())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        strategy = fleet.DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
+
+        def loss_fn(ids_, labels_):
+            loss, _ = model(ids_, labels=labels_)
+            return loss
+
+        step = DistributedTrainStep(model, loss_fn, opt, strategy,
+                                    mesh=mesh_mod.get_mesh())
+        loss = step(ids, ids)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-3, atol=2e-4)
+        loss2 = step(ids, ids)
+        assert float(loss2) < float(loss)  # optimizer actually stepped
+    finally:
+        mesh_mod.set_mesh(None)
