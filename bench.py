@@ -1,0 +1,81 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Metric definition follows BASELINE.md (the reference publishes no numbers,
+so ``vs_baseline`` is null).  The whole training step — forward, backward,
+SGD-momentum update — is ONE donated XLA program via
+``DistributedTrainStep`` on a single-chip mesh, i.e. the same path a user
+gets from the fleet API.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": null}
+
+Env knobs: BENCH_SMOKE=1 (tiny shapes on CPU), BENCH_BATCH, BENCH_STEPS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
+    hw = 32 if smoke else 224
+
+    paddle.seed(0)
+    model = resnet50(num_classes=10 if smoke else 1000)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+
+    def loss_fn(img, label):
+        logits = model(img)
+        return F.cross_entropy(logits, label).mean()
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((batch, 3, hw, hw)).astype("float32"))
+    label = paddle.to_tensor(
+        rng.randint(0, 10 if smoke else 1000, (batch,)).astype("int64"))
+
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        loss = step(img, label)
+    import jax
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(img, label)
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

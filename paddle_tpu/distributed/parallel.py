@@ -83,16 +83,25 @@ def init_parallel_env() -> ParallelEnv:
         # launcher already did the rendezvous — a real connect failure must
         # propagate, or every host would silently train independently.
         already = False
+        probe_worked = True
         try:
             from jax._src.distributed import global_state as _gs
             already = getattr(_gs, "client", None) is not None
-        except ImportError:
-            pass
+        except ImportError:  # private path moved: fall back to msg check
+            probe_worked = False
         if not already:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
-                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(
+                        os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                    process_id=int(
+                        os.environ.get("PADDLE_TRAINER_ID", "0")))
+            except RuntimeError as e:
+                # only tolerate the double-init case, and only when we
+                # could not probe it; real connect failures must propagate
+                if probe_worked or "already" not in str(e).lower():
+                    raise
     mesh_mod.get_mesh()  # builds the default all-dp mesh
     _initialized = True
     return ParallelEnv()

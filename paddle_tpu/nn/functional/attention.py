@@ -51,11 +51,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     drop = dropout_p if training else 0.0
     use_flash = False
     try:
+        from ...ops.flash_attention import flash_eligible
         qv = query._value
-        if (qv.ndim == 4 and qv.shape[1] >= 1024 and
-                qv.shape[3] in (64, 128, 256) and
-                jax.default_backend() == "tpu"):
-            use_flash = attn_mask is None and drop == 0.0
+        if qv.ndim == 4:
+            use_flash = flash_eligible(qv.shape[1], qv.shape[3],
+                                       has_mask=attn_mask is not None,
+                                       dropout=drop)
     except Exception:
         use_flash = False
 

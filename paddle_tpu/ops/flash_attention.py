@@ -157,6 +157,17 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
 flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 
 
+def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
+                   dropout: float = 0.0) -> bool:
+    """Single source of truth for Pallas flash-attention dispatch: long
+    sequences with MXU-friendly head dims on TPU, no additive mask or
+    dropout (those go through the XLA softmax composition)."""
+    import jax
+    return (jax.default_backend() == "tpu" and seq_len >= 1024
+            and head_dim in (64, 128, 256) and not has_mask
+            and dropout == 0.0)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
                     block_k=512, interpret=False):
     """Flash attention on paddle-layout (B, S, H, D) tensors."""

@@ -166,9 +166,8 @@ class LlamaAttention(Layer):
                 vh = jnp.repeat(vh, rep, axis=2)
             from ...nn.functional.attention import _sdpa_ref
             from ...ops.flash_attention import flash_attention as _fa_t
-            use_flash = (jax.default_backend() == "tpu" and S >= 1024
-                         and c.head_dim in (64, 128, 256))
-            if use_flash:
+            from ...ops.flash_attention import flash_eligible
+            if flash_eligible(S, c.head_dim):
                 o = _fa_t(qh, kh, vh, causal=True)
             else:
                 o = _sdpa_ref(qh, kh, vh, None, 0.0, True, None)
