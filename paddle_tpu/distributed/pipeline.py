@@ -1,0 +1,146 @@
+"""Pipeline parallelism — GPipe schedule inside one compiled program.
+
+The reference implements pipeline parallelism as a graph transform plus a
+threaded runtime: ``PipelineOptimizer`` cuts the Program at
+``device_guard`` boundaries and inserts ``send_v2``/``recv_v2`` P2P ops
+(reference: python/paddle/fluid/optimizer.py:3718 ``_split_program``,
+``_insert_sendrecv_ops_for_boundaries``), and a ``SectionWorker`` thread
+per stage streams ``num_microbatches`` through NCCL P2P
+(reference: paddle/fluid/framework/trainer.h:328, device_worker.h:641,
+section_worker.cc).
+
+TPU-native design: the schedule lives INSIDE one XLA program.
+``shard_map`` manual over the 'pp' mesh axis gives each stage its shard of
+a layer-stacked parameter tree; a ``lax.scan`` over ``M + S - 1`` ticks
+runs the classic GPipe wavefront, rotating activations to the next stage
+with ``lax.ppermute`` (the ICI-native send/recv).  Because ``ppermute``
+and the masks are differentiable, ``jax.grad`` of this forward IS the
+backward pipeline — no SectionWorker threads, no stream-sync ops.  All
+other mesh axes (dp/fsdp/tp/sp) stay in XLA's automatic SPMD via
+``axis_names={'pp'}``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+__all__ = ["gpipe_spmd", "pipeline_apply", "num_stages"]
+
+
+def num_stages(mesh=None) -> int:
+    mesh = mesh or mesh_mod.get_mesh(create=False)
+    return int(mesh.shape.get("pp", 1)) if mesh is not None else 1
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def gpipe_spmd(stage_fn: Callable, local_params: Any, payload_mb,
+               *, num_stages: int, axis: str = "pp"):
+    """GPipe wavefront — call INSIDE a shard_map manual over ``axis``.
+
+    ``stage_fn(local_params, payload) -> payload`` applies this rank's
+    stage (it must preserve the payload pytree structure/shapes so the
+    rotation is well-typed; ride-along leaves like positions pass through
+    unchanged).  ``payload_mb`` is a pytree whose leaves have leading dim
+    M (microbatches), identical on every pp rank.  Returns the payload
+    pytree with the LAST stage's results broadcast to every rank.
+    """
+    S = num_stages
+    s = lax.axis_index(axis)
+    leaves = jax.tree_util.tree_leaves(payload_mb)
+    M = leaves[0].shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (bubble ticks read a don't-care)
+        tm = jnp.minimum(t, M - 1)
+        inp = _tmap(lambda x, st: jnp.where(s == 0, x[tm], st),
+                    payload_mb, state)
+        out = stage_fn(local_params, inp)
+        # last stage emits microbatch t-(S-1) once the wave reaches it
+        valid = jnp.logical_and(s == S - 1, t >= S - 1)
+        idx = jnp.clip(t - (S - 1), 0, M - 1)
+        outputs = _tmap(
+            lambda obuf, o: obuf.at[idx].set(
+                jnp.where(valid, o, obuf[idx])),
+            outputs, out)
+        state = _tmap(lambda o: lax.ppermute(o, axis, perm), out)
+        return (state, outputs), None
+
+    state0 = _tmap(lambda x: jnp.zeros_like(x[0]), payload_mb)
+    out0 = _tmap(jnp.zeros_like, payload_mb)
+    (_, outputs), _ = lax.scan(tick, (state0, out0),
+                               jnp.arange(M + S - 1))
+    # broadcast the last stage's result to every pp rank so downstream
+    # (final norm / lm head / loss) runs replicated over 'pp'
+    return _tmap(
+        lambda o: lax.psum(jnp.where(s == S - 1, o, jnp.zeros_like(o)),
+                           axis), outputs)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params: Any, hidden,
+                   extras=None, *, num_microbatches: int = 1, mesh=None):
+    """Run a layer-stacked block as a pipeline over the 'pp' mesh axis.
+
+    ``stacked_params``: pytree whose leaves have a leading layer dim,
+    sharded ``P('pp', ...)`` — each stage owns a contiguous chunk of
+    layers.  ``stage_fn(local_params, h, extras) -> h`` consumes that
+    chunk (e.g. scans its local layers).  ``hidden`` is (B, ...); dim 0 is
+    cut into ``num_microbatches``.  ``extras`` leaves with a matching
+    batch dim are microbatched and travel with their microbatch through
+    the rotation; scalar/static extras are closed over.  dp/fsdp/tp/sp
+    shardings of activations remain automatic (XLA SPMD).
+    """
+    mesh = mesh or mesh_mod.get_mesh()
+    S = num_stages(mesh)
+    if S <= 1:
+        return stage_fn(stacked_params, hidden, extras)
+    M = int(num_microbatches)
+    B = hidden.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    def split(v):
+        return v.reshape((M, B // M) + tuple(v.shape[1:]))
+
+    is_batched = (lambda v: hasattr(v, "shape") and getattr(v, "ndim", 0)
+                  >= 1 and v.shape[0] == B)
+    x_mb = split(hidden)
+    e_leaves, e_treedef = jax.tree_util.tree_flatten(extras)
+    batched_idx = [i for i, v in enumerate(e_leaves) if is_batched(v)]
+    batched_mb = [split(e_leaves[i]) for i in batched_idx]
+
+    payload = (x_mb, batched_mb)
+
+    def sf(local_params, pl):
+        h, bat = pl
+        cur = list(e_leaves)
+        for i, v in zip(batched_idx, bat):
+            cur[i] = v
+        e = jax.tree_util.tree_unflatten(e_treedef, cur)
+        return (stage_fn(local_params, h, e), bat)
+
+    def mapped(params, pl):
+        return gpipe_spmd(sf, params, pl, num_stages=S)
+
+    p_spec = _tmap(lambda v: P(*(("pp",) + (None,) * (v.ndim - 1))),
+                   stacked_params)
+    rep = _tmap(lambda v: P(), payload)
+    sm = jax.shard_map(mapped, mesh=mesh, axis_names={"pp"},
+                       in_specs=(p_spec, rep), out_specs=rep,
+                       check_vma=False)
+    # partial-manual shard_map only has a jit lowering path (the eager
+    # impl raises on auto axes in jax 0.9); under an outer jit this
+    # inlines, eagerly it dispatches a compiled program
+    out = jax.jit(sm)(stacked_params, payload)
+    hidden_out = out[0]
+    return hidden_out.reshape((B,) + tuple(hidden_out.shape[2:]))

@@ -62,6 +62,8 @@ class LlamaConfig:
     remat: bool = True            # per-layer activation checkpointing
     compute_dtype: str = "bfloat16"
     sequence_parallel: bool = False  # shard activations' seq dim over 'sp'
+    scan_layers: bool = False     # stack layer params, lax.scan the depth
+    pp_num_microbatches: int = 1  # GPipe microbatches when mesh has pp>1
 
     @property
     def head_dim(self) -> int:
@@ -211,6 +213,86 @@ class LlamaDecoderLayer(Layer):
         return h + self.mlp(self.post_attention_layernorm(h))
 
 
+class StackedLlamaDecoder(Layer):
+    """The decoder stack with layer-STACKED parameters.
+
+    Every parameter has a leading layer dim scanned by ``lax.scan`` —
+    the standard JAX LLM idiom (one compiled layer body instead of L
+    inlined copies), and the exact layout pipeline parallelism needs: the
+    leading dim carries ``P('pp', ...)`` so each pipeline stage owns a
+    contiguous chunk of layers (distributed/pipeline.py).  The reference
+    has no analog — its PipelineOptimizer cuts a flat Program per device
+    (fluid/optimizer.py:3718); here the cut is a sharding annotation.
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        L = config.num_hidden_layers
+        layers = [LlamaDecoderLayer(config) for _ in range(L)]
+        proto = layers[0]
+        object.__setattr__(self, "_proto", proto)  # not a registered child
+        self._names = [n for n, _ in proto.named_parameters()]
+        from ...distributed.meta_parallel import mark_sharding
+        for n in self._names:
+            vals = [dict(l.named_parameters())[n]._value for l in layers]
+            stacked = Parameter(jnp.stack(vals))
+            ann = getattr(dict(proto.named_parameters())[n], "dist_spec",
+                          None)
+            spec = P("pp", *(tuple(ann) if ann is not None
+                             else (None,) * (stacked._value.ndim - 1)))
+            mark_sharding(stacked, spec)
+            self.add_parameter(n.replace(".", "__"), stacked)
+
+    def _stacked_values(self):
+        return {n: getattr(self, n.replace(".", "__"))._value
+                for n in self._names}
+
+    def _apply_one_layer(self, per_layer_vals, h, positions):
+        """Functionally run the proto layer with one layer's params."""
+        proto = self._proto
+        st = dict(proto.named_parameters())
+        old = {k: t._value for k, t in st.items()}
+        try:
+            for k in self._names:
+                st[k]._value = per_layer_vals[k]
+            out = proto(Tensor(h), Tensor(positions))
+        finally:
+            for k, t in st.items():
+                t._value = old[k]
+        return out._value
+
+    def forward(self, hidden, positions):
+        from ...distributed.pipeline import num_stages, pipeline_apply
+        cfg = self.config
+        names = self._names
+        remat = cfg.remat
+
+        def body_fn(h, per_layer, pos):
+            return self._apply_one_layer(per_layer, h, pos)
+        if remat:
+            body_fn = jax.checkpoint(body_fn)
+
+        def stage_fn(local_stacked, h, pos):
+            def body(hh, per_layer):
+                return body_fn(hh, per_layer, pos), None
+            h2, _ = jax.lax.scan(body, h, local_stacked)
+            return h2
+
+        def f(hval, pval, *stacked_vals):
+            stacked = dict(zip(names, stacked_vals))
+            S = num_stages()
+            if S > 1:
+                return pipeline_apply(
+                    stage_fn, stacked, hval, pval,
+                    num_microbatches=max(cfg.pp_num_microbatches, 1))
+            return stage_fn(stacked, hval, pval)
+
+        tensors = [getattr(self, n.replace(".", "__")) for n in names]
+        return _apply(f, hidden, positions, *tensors,
+                      op_name="stacked_decoder")
+
+
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -219,9 +301,14 @@ class LlamaModel(Layer):
         self.embed_tokens = VocabParallelEmbedding(
             config.vocab_size, config.hidden_size,
             weight_attr=Normal(0.0, config.initializer_range))
-        self.layers = LayerList(
-            [LlamaDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+        if config.scan_layers:
+            self.decoder = StackedLlamaDecoder(config)
+            self.layers = LayerList([])
+        else:
+            self.decoder = None
+            self.layers = LayerList(
+                [LlamaDecoderLayer(config)
+                 for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, input_ids, positions=None):
@@ -239,11 +326,14 @@ class LlamaModel(Layer):
         if sp_spec is not None:
             hidden = _apply(lambda v: mesh_mod.maybe_constrain(v, sp_spec),
                             hidden)
-        for layer in self.layers:
-            if c.remat:
-                hidden = _remat_layer(layer, hidden, positions)
-            else:
-                hidden = layer(hidden, positions)
+        if self.decoder is not None:
+            hidden = self.decoder(hidden, positions)
+        else:
+            for layer in self.layers:
+                if c.remat:
+                    hidden = _remat_layer(layer, hidden, positions)
+                else:
+                    hidden = layer(hidden, positions)
         return self.norm(hidden)
 
 
