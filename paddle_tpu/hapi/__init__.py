@@ -1,0 +1,8 @@
+"""High-level API (parity: reference python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import Callback  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+
+__all__ = ["Model", "summary", "flops", "callbacks", "Callback"]

@@ -1,0 +1,472 @@
+"""hapi callbacks (parity: reference python/paddle/hapi/callbacks.py).
+
+The reference dispatches a fixed event vocabulary
+(on_{train,eval,predict}_{begin,end}, on_epoch_{begin,end},
+on_{train,eval,predict}_batch_{begin,end}) from Model.fit; the config
+dict gives callbacks epochs/steps/metrics context.  Same contract here.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+import warnings
+
+__all__ = [
+    "Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+    "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+]
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None, mode="train"):
+    cbks = callbacks or []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(k, ProgBarLogger) for k in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(k, ModelCheckpoint) for k in cbks):
+        cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(k, LRScheduler) for k in cbks):
+        cbks = list(cbks) + [LRScheduler()]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    metrics = metrics or []
+    params = {
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": metrics,
+    }
+    cbk_list.set_params(params)
+    return cbk_list
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            func = getattr(c, name, None)
+            if func:
+                func(*args)
+
+    def _check_mode(self, mode):
+        assert mode in ["train", "eval", "predict"], \
+            "mode should be train, eval or predict"
+
+    def on_begin(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call("on_{}_begin".format(mode), logs)
+
+    def on_end(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call("on_{}_end".format(mode), logs)
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call("on_{}_batch_begin".format(mode), step, logs)
+
+    def on_batch_end(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call("on_{}_batch_end".format(mode), step, logs)
+
+
+class Callback:
+    """Base class (reference hapi/callbacks.py `class Callback`)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """Prints loss/metrics every ``log_freq`` steps (reference ProgBarLogger,
+    without the terminal progress-bar widget — plain line logging keeps the
+    output sane in notebooks and log files)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def _is_print(self):
+        return self.verbose and _local_rank() == 0
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+        self.train_metrics = self.params.get("metrics") or []
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch
+        self.train_step = 0
+        self._t0 = time.time()
+        if self._is_print() and self.epochs:
+            print("Epoch %d/%d" % ((epoch or 0) + 1, self.epochs))
+
+    def _print_logs(self, prefix, step, logs, steps=None):
+        logs = logs or {}
+        parts = []
+        for k, v in logs.items():
+            if k == "batch_size":
+                continue
+            if isinstance(v, numbers.Number):
+                parts.append("%s: %.4f" % (k, v))
+            elif hasattr(v, "__len__") and len(v) == 1:
+                parts.append("%s: %.4f" % (k, float(v[0])))
+            else:
+                try:
+                    parts.append("%s: %.4f" % (k, float(v)))
+                except (TypeError, ValueError):
+                    parts.append("%s: %s" % (k, v))
+        total = "/%s" % steps if steps else ""
+        print("%s step %d%s - %s" % (prefix, step, total, ", ".join(parts)))
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step = step + 1
+        if self._is_print() and self.train_step % self.log_freq == 0:
+            self._print_logs("train", self.train_step, logs, self.steps)
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        if self._is_print():
+            self._print_logs("epoch %d end" % ((epoch or 0) + 1),
+                             self.train_step, logs)
+
+    def on_eval_begin(self, logs=None):
+        self.eval_step = 0
+        if self._is_print():
+            print("Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_step = step + 1
+        if self._is_print() and self.eval_step % self.log_freq == 0:
+            self._print_logs("eval", self.eval_step, logs)
+
+    def on_eval_end(self, logs=None):
+        if self._is_print():
+            self._print_logs("eval end", getattr(self, "eval_step", 0), logs)
+
+
+class ModelCheckpoint(Callback):
+    """Saves weights+optimizer every ``save_freq`` epochs and at train end
+    (reference ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch or 0
+
+    def _is_save(self):
+        return self.model and self.save_dir and _local_rank() == 0
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        if self._is_save() and (self.epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(self.epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self._is_save():
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (reference LRScheduler callback:
+    by default per epoch; ``by_step`` for per-batch schedules)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError(
+                "by_step option is mutually exclusive with by_epoch")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop training when ``monitor`` stops improving (reference
+    EarlyStopping; evaluated at on_eval_end so fit() must get eval_data)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ["auto", "min", "max"]:
+            warnings.warn("EarlyStopping mode %s is unknown, fallback to "
+                          "auto mode." % mode)
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+        elif mode == "max":
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+        elif "acc" in self.monitor or "auc" in self.monitor:
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+        else:
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = (float("inf")
+                               if self.monitor_op(0, 1) else -float("inf"))
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch or 0
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn("Monitor of EarlyStopping should be loss or "
+                          "metric name.")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.monitor_op(current, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None \
+                    and getattr(self.model, "_save_dir", None):
+                self.model.save(
+                    os.path.join(self.model._save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            self.stopped_epoch = self.epoch
+            if self.verbose and _local_rank() == 0:
+                print("Epoch %d: Early stopping." % (self.stopped_epoch + 1))
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a metric has stopped improving (reference
+    ReduceLROnPlateau callback of later hapi versions; semantics match
+    optimizer.lr.ReduceOnPlateau but driven by eval logs)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a factor "
+                             ">= 1.0.")
+        self.factor = factor
+        self.min_lr = min_lr
+        self.min_delta = min_delta
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.cooldown_counter = 0
+        self.wait = 0
+        self.best = 0
+        self.mode = mode
+        self._reset()
+
+    def _reset(self):
+        if self.mode not in ["auto", "min", "max"]:
+            warnings.warn("Learning rate reduction mode %s is unknown, "
+                          "fallback to auto mode." % self.mode)
+            self.mode = "auto"
+        if self.mode == "min" or (self.mode == "auto"
+                                  and "acc" not in self.monitor):
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+            self.best = float("inf")
+        else:
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+            self.best = -float("inf")
+        self.cooldown_counter = 0
+        self.wait = 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn("Monitor of ReduceLROnPlateau should be loss or "
+                          "metric name.")
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                old_lr = float(opt.get_lr())
+                if old_lr > float(self.min_lr):
+                    new_lr = max(old_lr * self.factor, self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose and _local_rank() == 0:
+                        print("Epoch: ReduceLROnPlateau reducing learning "
+                              "rate to %s." % new_lr)
+                    self.cooldown_counter = self.cooldown
+                    self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback.  The reference wraps the external VisualDL
+    writer; here scalars are appended to a JSONL file under ``log_dir`` —
+    readable by anything, no extra dependency (zero-egress environment)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+        self._gstep = 0
+        self._fh = None
+
+    def _write(self, mode, step, logs):
+        import json
+        if _local_rank() != 0:
+            return
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        rec = {"mode": mode, "step": int(step)}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                pass
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch or 0
+
+    def on_train_batch_end(self, step, logs=None):
+        # own monotonic counter: loaders without __len__ give steps=None,
+        # and epoch*steps would collapse records across epochs
+        self._write("train", self._gstep, logs)
+        self._gstep += 1
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", self.epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def _local_rank():
+    """Process rank for rank-0-only printing/saving.  Delegates to the
+    distributed package (the owner of the launch env scheme); falls back to
+    the env var when jax.distributed was never initialised."""
+    try:
+        from ..distributed import get_rank
+        return get_rank()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))

@@ -1,0 +1,136 @@
+"""FLOPs estimation (parity: reference python/paddle/hapi/dynamic_flops.py
+``paddle.flops``).
+
+Same design as the reference: per-layer-type count functions attached via
+forward hooks, one real forward pass, results summed (and optionally
+printed per layer).  Counts are multiply-accumulate-based like the
+reference's (conv: kernel_ops * out_elems; linear: in*out; norm/act:
+elementwise).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from .. import nn
+from ..nn.layer.layers import Layer
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    return int(np.prod([d for d in shape if d is not None])) if shape else 1
+
+
+def _count_conv(layer, inp, out):
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    kernel_ops = _numel(layer.weight.shape[1:])  # cin/g * kh * kw
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    layer._flops += _numel(out.shape) * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, inp, out):
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    in_f = layer.weight.shape[0]
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    layer._flops += _numel(out.shape) * (in_f + bias_ops)
+
+
+def _count_norm(layer, inp, out):
+    x = inp[0] if isinstance(inp, (list, tuple)) else inp
+    layer._flops += 2 * _numel(x.shape)
+
+
+def _count_act(layer, inp, out):
+    x = inp[0] if isinstance(inp, (list, tuple)) else inp
+    layer._flops += _numel(x.shape)
+
+
+def _count_pool(layer, inp, out):
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    layer._flops += _numel(out.shape)
+
+
+def _count_embedding(layer, inp, out):
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    layer._flops += _numel(out.shape)
+
+
+_COUNTERS = []
+
+
+def _build_counters():
+    if _COUNTERS:
+        return _COUNTERS
+    table = [
+        ((nn.Conv1D, nn.Conv2D, nn.Conv3D, nn.Conv2DTranspose), _count_conv),
+        ((nn.Linear,), _count_linear),
+        ((nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D,
+          nn.LayerNorm, nn.GroupNorm, nn.InstanceNorm2D), _count_norm),
+        ((nn.ReLU, nn.ReLU6, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Softmax,
+          nn.LeakyReLU, nn.Hardswish, nn.Hardsigmoid, nn.Swish),
+         _count_act),
+        ((nn.MaxPool1D, nn.MaxPool2D, nn.MaxPool3D, nn.AvgPool1D,
+          nn.AvgPool2D, nn.AvgPool3D, nn.AdaptiveAvgPool1D,
+          nn.AdaptiveAvgPool2D, nn.AdaptiveAvgPool3D), _count_pool),
+        ((nn.Embedding,), _count_embedding),
+    ]
+    for classes, fn in table:
+        classes = tuple(c for c in classes if c is not None)
+        if classes:
+            _COUNTERS.append((classes, fn))
+    return _COUNTERS
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False,
+          inputs=None):
+    """Total multiply-accumulate count of one forward pass.
+
+    ``custom_ops``: dict mapping layer class -> fn(layer, inputs, output)
+    that adds into ``layer._flops`` (reference signature).
+    """
+    counters = _build_counters()
+    custom_ops = custom_ops or {}
+    hooks, counted = [], []
+
+    for layer in net.sublayers(include_self=True):
+        if list(layer.children()):
+            continue
+        fn = None
+        for cls, f in custom_ops.items():
+            if isinstance(layer, cls):
+                fn = f
+                break
+        if fn is None:
+            for classes, f in counters:
+                if isinstance(layer, classes):
+                    fn = f
+                    break
+        if fn is None:
+            continue
+        layer._flops = 0
+        counted.append(layer)
+        hooks.append(layer.register_forward_post_hook(fn))
+
+    if inputs is None:
+        sizes = input_size if isinstance(input_size[0], (list, tuple)) \
+            else [input_size]
+        inputs = [Tensor(np.zeros(s, dtype="float32")) for s in sizes]
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = int(sum(layer._flops for layer in counted))
+    if print_detail:
+        for layer in counted:
+            print("%-40s FLOPs: %s" % (type(layer).__name__,
+                                       "{:,}".format(layer._flops)))
+        print("Total FLOPs: {:,}".format(total))
+    return total

@@ -1,0 +1,359 @@
+"""hapi.Model — Keras-like train/eval/predict loop.
+
+Parity: reference python/paddle/hapi/model.py (class Model at :810,
+fit at :1299, evaluate :1515, predict :1609).  The reference maintains two
+adapter backends (DynamicGraphAdapter / StaticGraphAdapter) because its two
+execution modes need different plumbing; here eager ops already run through
+XLA and ``to_static`` is just jit, so one code path serves both — the
+adapter split disappears.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_numpy(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Model:
+    """An object trainable/testable with high-level APIs.
+
+    Usage matches the reference::
+
+        model = hapi.Model(net)
+        model.prepare(optimizer, loss, metrics)
+        model.fit(train_dataset, eval_dataset, epochs=2, batch_size=64)
+    """
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._amp_level = "O0"
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """Configure the model (reference model.py ``prepare``)."""
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, Layer) \
+                and not callable(loss):
+            raise TypeError(
+                "'loss' must be sub classes of `paddle.nn.Layer` or any "
+                "callable function.")
+        self._loss = loss
+        metrics = metrics or []
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise AssertionError(
+                    "{} is not sub class of Metric".format(m.__class__))
+        self._metrics = _to_list(metrics)
+        if amp_configs is None:
+            self._amp_level = "O0"
+        elif isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        else:
+            self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outputs, labels = _to_list(outputs), _to_list(labels)
+        if self._loss is None:
+            # network computes its own loss (reference allows loss=None
+            # when the network returns the loss directly)
+            out = outputs[0]
+            return out
+        return self._loss(*(outputs + labels))
+
+    def _run_forward(self, inputs):
+        if self._amp_level in ("O1", "O2"):
+            from ..amp import auto_cast
+            with auto_cast(enable=True, level=self._amp_level):
+                return self.network(*inputs)
+        return self.network(*inputs)
+
+    def _train_batch_impl(self, inputs, labels, update=True):
+        """Returns (losses, metrics) — always a pair."""
+        assert self._optimizer is not None, \
+            "model not ready, please call `model.prepare()` first"
+        self.network.train()
+        inputs = [Tensor(x) if not isinstance(x, Tensor) else x
+                  for x in _to_list(inputs)]
+        labels = [Tensor(y) if not isinstance(y, Tensor) else y
+                  for y in _to_list(labels)]
+        outputs = self._run_forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        with no_grad():
+            for metric in self._metrics:
+                res = metric.compute(*(_to_list(outputs) + labels))
+                metric.update(*_to_list(res))
+                metrics.append(metric.accumulate())
+        return [_to_numpy(loss)], metrics
+
+    def _eval_batch_impl(self, inputs, labels):
+        """Returns (losses, metrics); losses is [] when loss=None."""
+        self.network.eval()
+        inputs = [Tensor(x) if not isinstance(x, Tensor) else x
+                  for x in _to_list(inputs)]
+        labels = [Tensor(y) if not isinstance(y, Tensor) else y
+                  for y in _to_list(labels)]
+        with no_grad():
+            outputs = self._run_forward(inputs)
+            metrics = []
+            losses = []
+            if self._loss is not None:
+                loss = self._compute_loss(outputs, labels)
+                losses = [_to_numpy(loss)]
+            for metric in self._metrics:
+                res = metric.compute(*(_to_list(outputs) + labels))
+                metric.update(*_to_list(res))
+                metrics.append(metric.accumulate())
+        return losses, metrics
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimizer step; returns loss list (+ metric results)
+        (reference model.py ``train_batch`` return convention)."""
+        out, metrics = self._train_batch_impl(inputs, labels, update)
+        return (out, metrics) if metrics else out
+
+    def eval_batch(self, inputs, labels=None):
+        losses, metrics = self._eval_batch_impl(inputs, labels)
+        if losses:
+            return (losses, metrics) if metrics else losses
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [Tensor(x) if not isinstance(x, Tensor) else x
+                  for x in _to_list(inputs)]
+        with no_grad():
+            outputs = self._run_forward(inputs)
+        return [_to_numpy(o) for o in _to_list(outputs)]
+
+    # ------------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last):
+        from ..io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__getitem__") or isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """Train the model (reference model.py:1299 ``fit``)."""
+        assert train_data is not None, "train_data must be given!"
+        self._save_dir = save_dir
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = (self._make_loader(eval_data, batch_size, False,
+                                         num_workers, False)
+                       if eval_data is not None else None)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_begin("train")
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(loader, cbks, "train",
+                                       accumulate_grad_batches,
+                                       num_iters=num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                cbks.on_begin("eval")
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_end("eval", eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def _run_one_epoch(self, loader, cbks, mode,
+                       accumulate_grad_batches=1, num_iters=None):
+        logs = {}
+        for m in self._metrics:
+            m.reset()
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            cbks.on_batch_begin(mode, step, logs)
+            if mode == "train":
+                # force the tail update so end-of-epoch gradients are
+                # never dropped (reference fit: `or step+1 == steps`)
+                update = ((step + 1) % accumulate_grad_batches == 0
+                          or (steps is not None and step + 1 == steps)
+                          or (num_iters is not None
+                              and step + 1 >= num_iters))
+                losses, metrics = self._train_batch_impl(
+                    inputs, labels, update=update)
+            else:
+                losses, metrics = self._eval_batch_impl(inputs, labels)
+            if losses:
+                logs["loss"] = float(np.asarray(losses[0]).reshape(-1)[0])
+            for m, res in zip(self._metrics, metrics):
+                for n, v in zip(_to_list(m.name()), _to_list(res)):
+                    logs[n] = v
+            bsz = None
+            for x in inputs:
+                shape = getattr(x, "shape", None)
+                if shape:
+                    bsz = shape[0]
+                    break
+            logs["batch_size"] = bsz or 1
+            cbks.on_batch_end(mode, step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        """Evaluate; returns dict of loss + metrics (reference :1515)."""
+        loader = self._make_loader(eval_data, batch_size, False,
+                                   num_workers, False)
+        cbks = config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval",
+                                   num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        return {k: v for k, v in logs.items() if k != "batch_size"}
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """Inference over a dataset; returns per-output lists
+        (reference :1609)."""
+        loader = self._make_loader(test_data, batch_size, False,
+                                   num_workers, False)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[])
+        cbks.on_begin("predict")
+        outputs = None
+        for step, batch in enumerate(loader):
+            inputs, _ = self._split_batch(batch)
+            cbks.on_batch_begin("predict", step, None)
+            outs = self.predict_batch(inputs)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+            cbks.on_batch_end("predict", step, {"batch_size": len(outs[0])})
+        cbks.on_end("predict", None)
+        outputs = outputs or [[]]
+        if stack_outputs:
+            outputs = [np.concatenate(o, axis=0) if o else np.empty((0,))
+                       for o in outputs]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """Save weights (+ optimizer) to ``path + '.pdparams'/'.pdopt'``,
+        or an inference artifact when ``training=False`` via jit.save
+        (reference model.py ``save``)."""
+        if _local_rank() != 0:
+            return
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        if not training:
+            from .. import jit
+            input_spec = self._inputs if self._inputs else None
+            jit.save(self.network, path, input_spec=input_spec)
+            return
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """Load weights saved by ``save`` (reference model.py ``load``)."""
+        from ..framework.io import load as fload
+        param_path = path if path.endswith(".pdparams") else \
+            path + ".pdparams"
+        if not os.path.exists(param_path):
+            raise ValueError(
+                "Loading weights file failed: no file at {}".format(
+                    param_path))
+        state = fload(param_path)
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(np.shape(v)) ==
+                     tuple(own[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = (param_path[:-len(".pdparams")]) + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+        return self
+
+    # ------------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """Print and return a layer-by-layer summary (reference
+        model.py ``summary`` → hapi/model_summary.py)."""
+        from .model_summary import summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(s.shape) for s in _to_list(self._inputs)]
+        assert input_size is not None, \
+            "'input_size' or 'self._inputs' must be set"
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _local_rank():
+    from .callbacks import _local_rank as rank
+    return rank()

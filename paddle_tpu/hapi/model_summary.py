@@ -1,0 +1,104 @@
+"""Layer-by-layer model summary (parity: reference
+python/paddle/hapi/model_summary.py ``summary``).
+
+Implemented with forward hooks on every leaf layer — same mechanism as the
+reference; runs one real forward pass on zero inputs.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def _normalize_sizes(input_size):
+    # accept: tuple, [tuple, ...], InputSpec, [InputSpec, ...]
+    def one(sz):
+        if hasattr(sz, "shape"):  # InputSpec / Tensor
+            return tuple(int(d) if d and d > 0 else 1 for d in sz.shape), \
+                getattr(sz, "dtype", None)
+        if isinstance(sz, numbers.Number):
+            return (int(sz),), None
+        return tuple(int(d) if d and d > 0 else 1 for d in sz), None
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], (list, tuple)) or (
+                isinstance(input_size, list) and input_size
+                and hasattr(input_size[0], "shape")):
+        return [one(s) for s in input_size]
+    return [one(input_size)]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a table of (layer, output shape, #params) and return
+    ``{'total_params': N, 'trainable_params': M}``."""
+    if input is not None:
+        inputs = [x if isinstance(x, Tensor) else Tensor(x)
+                  for x in (input if isinstance(input, (list, tuple))
+                            else [input])]
+    else:
+        sizes = _normalize_sizes(input_size)
+        if dtypes is None:
+            dtypes = ["float32"] * len(sizes)
+        elif isinstance(dtypes, str):
+            dtypes = [dtypes] * len(sizes)
+        inputs = []
+        for (shape, spec_dtype), dt in zip(sizes, dtypes):
+            dt = spec_dtype or dt
+            dt = str(dt).replace("paddle.", "").replace("jax.numpy.", "")
+            inputs.append(Tensor(np.zeros(shape, dtype=dt)))
+
+    entries = []
+    hooks = []
+
+    def register(layer, prefix):
+        children = list(layer.named_children())
+        if not children:
+            def hook(lyr, inp, out, name=prefix or
+                     type(layer).__name__):
+                shape = getattr(out[0] if isinstance(out, (list, tuple))
+                                else out, "shape", None)
+                n_params = int(sum(np.prod(p.shape or (1,))
+                                   for p in lyr.parameters(
+                                       include_sublayers=False)))
+                entries.append((name + " (%s)" % type(lyr).__name__,
+                                list(shape) if shape is not None else "-",
+                                n_params))
+            hooks.append(layer.register_forward_post_hook(hook))
+        for cname, child in children:
+            register(child, (prefix + "." + cname) if prefix else cname)
+
+    register(net, "")
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = int(sum(np.prod(p.shape or (1,)) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape or (1,)) for p in net.parameters()
+                        if getattr(p, "trainable", True)))
+
+    name_w = max([len(e[0]) for e in entries] + [20])
+    line = "-" * (name_w + 40)
+    print(line)
+    print("%-*s %-20s %12s" % (name_w, "Layer (type)", "Output Shape",
+                               "Param #"))
+    print(line)
+    for name, shape, n in entries:
+        print("%-*s %-20s %12s" % (name_w, name, str(shape), "{:,}".format(n)))
+    print(line)
+    print("Total params: {:,}".format(total))
+    print("Trainable params: {:,}".format(trainable))
+    print("Non-trainable params: {:,}".format(total - trainable))
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,172 @@
+"""hapi.Model tests (parity model: reference python/paddle/tests/test_model.py
+— fit/evaluate/predict on a small net, save/load round-trip, callbacks,
+summary and flops)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import hapi, nn
+from paddle_tpu.hapi.callbacks import EarlyStopping, VisualDL
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.optimizer import Adam
+
+
+class TinyDataset(Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype("float32")
+        w = rng.randn(8, 3).astype("float32")
+        self.y = np.argmax(self.x @ w, axis=1).astype("int64")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def make_model():
+    net = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    model = hapi.Model(net)
+    model.prepare(Adam(learning_rate=0.01,
+                       parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_reduces_loss_and_evaluate():
+    model = make_model()
+    ds = TinyDataset()
+    first = model.evaluate(ds, batch_size=32, verbose=0)
+    model.fit(ds, batch_size=16, epochs=8, verbose=0)
+    last = model.evaluate(ds, batch_size=32, verbose=0)
+    assert last["loss"] < first["loss"]
+    assert last["acc"] > 0.8
+    assert set(last) >= {"loss", "acc"}
+
+
+def test_predict_shapes_and_stack():
+    model = make_model()
+    ds = TinyDataset(n=20)
+    outs = model.predict(ds, batch_size=8, verbose=0)
+    assert len(outs) == 1 and len(outs[0]) == 3  # 3 batches of logits
+    stacked = model.predict(ds, batch_size=8, stack_outputs=True, verbose=0)
+    assert stacked[0].shape == (20, 3)
+
+
+def test_train_batch_and_eval_batch():
+    model = make_model()
+    x = np.random.randn(4, 8).astype("float32")
+    y = np.array([0, 1, 2, 0], dtype="int64")
+    loss, metrics = model.train_batch([x], [y])
+    assert np.isfinite(loss[0])
+    out = model.eval_batch([x], [y])
+    assert np.isfinite(out[0][0])
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = make_model()
+    ds = TinyDataset(n=32)
+    model.fit(ds, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = make_model()
+    model2.load(path)
+    x = np.random.randn(2, 8).astype("float32")
+    np.testing.assert_allclose(model.predict_batch([x])[0],
+                               model2.predict_batch([x])[0], rtol=1e-6)
+
+
+def test_fit_with_save_dir_checkpoints(tmp_path):
+    model = make_model()
+    save_dir = str(tmp_path / "ckpts")
+    model.fit(TinyDataset(n=32), batch_size=16, epochs=2, verbose=0,
+              save_dir=save_dir)
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+
+def test_early_stopping_stops():
+    model = make_model()
+    ds = TinyDataset(n=32)
+    stopper = EarlyStopping(monitor="loss", patience=0, verbose=0,
+                            save_best_model=False)
+    # monitor improvement is impossible with lr=0 → stops after patience
+    model._optimizer.set_lr(0.0)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[stopper])
+    assert model.stop_training
+    assert stopper.stopped_epoch < 10
+
+
+def test_visualdl_writes_scalars(tmp_path):
+    model = make_model()
+    log_dir = str(tmp_path / "vdl")
+    model.fit(TinyDataset(n=32), batch_size=16, epochs=1, verbose=0,
+              callbacks=[VisualDL(log_dir)])
+    path = os.path.join(log_dir, "scalars.jsonl")
+    assert os.path.exists(path)
+    assert len(open(path).read().strip().splitlines()) >= 2
+
+
+def test_summary_counts_params(capsys):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    info = hapi.summary(net, (1, 8))
+    # 8*16+16 + 16*3+3 = 195
+    assert info["total_params"] == 195
+    assert info["trainable_params"] == 195
+    assert "Linear" in capsys.readouterr().out
+
+
+def test_flops_linear():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    n = hapi.flops(net, (1, 8))
+    # (8+1)*16 + 16 + (16+1)*3 = 211
+    assert n == 211
+
+
+def test_model_summary_via_model():
+    model = make_model()
+    info = model.summary(input_size=(1, 8))
+    assert info["total_params"] == 195
+
+
+def test_evaluate_metrics_only_no_loss():
+    # loss=None + metrics: metric must be reported under its own name
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    model = hapi.Model(net)
+    model.prepare(metrics=Accuracy())
+    res = model.evaluate(TinyDataset(n=32), batch_size=16, verbose=0)
+    assert "acc" in res
+    assert "loss" not in res
+
+
+def test_fit_zero_epochs_noop():
+    model = make_model()
+    model.fit(TinyDataset(n=16), batch_size=8, epochs=0, verbose=0)
+
+
+def test_grad_accumulation_tail_update():
+    model = make_model()
+    ds = TinyDataset(n=48)  # 3 batches of 16 with accumulate=2 → tail batch
+    before = [np.array(p.numpy()) for p in model.parameters()]
+    model.fit(ds, batch_size=16, epochs=1, verbose=0,
+              accumulate_grad_batches=2)
+    after = [p.numpy() for p in model.parameters()]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    # grads from the tail batch must have been consumed, not leaked
+    assert all(p.grad is None or np.allclose(p.grad.numpy(), 0)
+               for p in model.parameters())
+
+
+def test_top_level_exports():
+    assert paddle.Model is hapi.Model
+    assert paddle.summary is hapi.summary
+    assert paddle.flops is hapi.flops
