@@ -241,10 +241,11 @@ class ModelCheckpoint(Callback):
 
 
 class LRScheduler(Callback):
-    """Steps the optimizer's LRScheduler (reference LRScheduler callback:
-    by default per epoch; ``by_step`` for per-batch schedules)."""
+    """Steps the optimizer's LRScheduler — per batch by default, matching
+    the reference LRScheduler callback (``by_epoch`` for epoch-grained
+    schedules)."""
 
-    def __init__(self, by_step=False, by_epoch=True):
+    def __init__(self, by_step=True, by_epoch=False):
         super().__init__()
         if by_step and by_epoch:
             raise ValueError(

@@ -97,8 +97,12 @@ class Model:
                 return self.network(*inputs)
         return self.network(*inputs)
 
-    def _train_batch_impl(self, inputs, labels, update=True):
-        """Returns (losses, metrics) — always a pair."""
+    def _train_batch_impl(self, inputs, labels, update=True,
+                          loss_scale=1.0):
+        """Returns (losses, metrics) — always a pair.  ``loss_scale``
+        (1/accumulate_grad_batches) keeps accumulated updates a MEAN over
+        microbatches, like the reference hapi fit; the reported loss stays
+        unscaled."""
         assert self._optimizer is not None, \
             "model not ready, please call `model.prepare()` first"
         self.network.train()
@@ -108,7 +112,7 @@ class Model:
                   for y in _to_list(labels)]
         outputs = self._run_forward(inputs)
         loss = self._compute_loss(outputs, labels)
-        loss.backward()
+        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -226,6 +230,7 @@ class Model:
             steps = len(loader)
         except TypeError:
             steps = None
+        pending_update = False
         for step, batch in enumerate(loader):
             inputs, labels = self._split_batch(batch)
             cbks.on_batch_begin(mode, step, logs)
@@ -237,7 +242,9 @@ class Model:
                           or (num_iters is not None
                               and step + 1 >= num_iters))
                 losses, metrics = self._train_batch_impl(
-                    inputs, labels, update=update)
+                    inputs, labels, update=update,
+                    loss_scale=1.0 / accumulate_grad_batches)
+                pending_update = not update
             else:
                 losses, metrics = self._eval_batch_impl(inputs, labels)
             if losses:
@@ -255,6 +262,10 @@ class Model:
             cbks.on_batch_end(mode, step, logs)
             if num_iters is not None and step + 1 >= num_iters:
                 break
+        if pending_update:
+            # length-less loader: epoch end reached with grads pending
+            self._optimizer.step()
+            self._optimizer.clear_grad()
         return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -1,0 +1,219 @@
+"""Context-parallel attention: ring attention + Ulysses (all-to-all).
+
+Greenfield per SURVEY.md §5.7 — the 2021-era reference has NO sequence /
+context parallelism (its longest-sequence tools are recompute
+reference: python/paddle/fluid/backward.py:725 and pipeline
+reference: python/paddle/fluid/optimizer.py:3718).  On TPU these are
+first-class: a 'sp' mesh axis shards the sequence dimension and the
+attention ops below exchange K/V (ring) or heads (Ulysses) over ICI.
+
+Both take paddle-layout (B, S, H, D) *global-view* arrays — under jit with
+a live mesh the arrays are sharded on S and ``shard_map`` gives each
+device its local block.
+
+Ring attention (Liu et al. 2023 pattern, built from scratch here):
+  each device keeps its Q shard and passes its K/V shard around the ring
+  with ``lax.ppermute``; an online-softmax accumulator (running max m,
+  denominator l, weighted sum acc — exactly the flash-attention recurrence
+  in ops/flash_attention.py) merges each arriving block, so the full
+  S×S score matrix never materialises and ICI transfers overlap compute.
+  Per-step work is wrapped in ``jax.checkpoint`` so backward recomputes
+  scores instead of storing O(S_local · S_global) residuals.
+
+Ulysses (all-to-all head scatter):
+  all_to_all converts seq-sharded (S/n, H) activations into head-sharded
+  (S, H/n), runs ordinary full/flash attention per head group, and
+  converts back.  Requires num_heads % sp == 0; ring has no such
+  constraint, Ulysses moves activations once instead of n times.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "ring_attention_bhsd"]
+
+NEG_INF = -1e30
+
+
+def _block_accumulate(q, k, v, m, l, acc, q0, k0, causal, scale):
+    """One online-softmax step: fold K/V block (k0 offset) into the
+    accumulator of the Q block at global offset q0.  Shapes (B,H,S,D)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        q_pos = q0 + jnp.arange(q.shape[2])[:, None]
+        k_pos = k0 + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + p.sum(axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    """Runs inside shard_map: q/k/v are the local (B,H,S/n,D) shards."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    q0 = idx * s_local
+
+    b, h, _, d = q.shape
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    acc = jnp.zeros((b, h, s_local, v.shape[-1]), jnp.float32)
+
+    step = jax.checkpoint(functools.partial(
+        _block_accumulate, causal=causal, scale=scale))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for t in range(n):
+        # after t rotations this device holds the shard of rank (idx - t)
+        src = (idx - t) % n
+        k0 = src * s_local
+        m, l, acc = step(q, k, v, m, l, acc, q0, k0)
+        if t != n - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _resolve_mesh(mesh):
+    if mesh is None:
+        from ..distributed import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh(create=False)
+    if mesh is None:
+        raise ValueError(
+            "ring/ulysses attention needs a live mesh with the sequence "
+            "axis; call paddle.distributed.init_mesh({'sp': n, ...}) first")
+    return mesh
+
+
+def _head_axis(mesh, tp_axis, num_heads):
+    """Shard the head dim over tp when the mesh has a non-trivial tp axis
+    that divides the head count (ring/ulysses compose with tensor
+    parallelism: heads are embarrassingly parallel)."""
+    if (tp_axis and tp_axis in mesh.shape and mesh.shape[tp_axis] > 1
+            and num_heads % mesh.shape[tp_axis] == 0):
+        return tp_axis
+    return None
+
+
+def _batch_axes(mesh, batch):
+    """Data-parallel axes to keep the batch dim sharded over inside the
+    shard_map — without this, a dp/fsdp-sharded batch would be all-gathered
+    at every attention layer."""
+    from ..distributed.mesh import data_axes
+    axes = tuple(ax for ax in data_axes(mesh) if mesh.shape.get(ax, 1) > 1)
+    size = math.prod(mesh.shape[ax] for ax in axes) if axes else 1
+    if axes and batch % size == 0:
+        return axes
+    return None
+
+
+def _chunked_attention(q, k, v, q0, causal, scale, chunk=1024):
+    """Online-softmax attention over K/V chunks — O(S·chunk) score memory
+    instead of O(S²); per-chunk work checkpointed so backward recomputes.
+    Shapes (B,H,Sq,D) x (B,H,Sk,D); q0 = global offset of the Q block."""
+    b, h, sq, _ = q.shape
+    sk = k.shape[2]
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, h, sq, v.shape[-1]), jnp.float32)
+    step = jax.checkpoint(functools.partial(
+        _block_accumulate, causal=causal, scale=scale))
+    n = max(1, -(-sk // chunk))
+    chunk = -(-sk // n)
+    for i in range(n):
+        lo = i * chunk
+        kc = jax.lax.slice_in_dim(k, lo, min(lo + chunk, sk), axis=2)
+        vc = jax.lax.slice_in_dim(v, lo, min(lo + chunk, sk), axis=2)
+        m, l, acc = step(q, kc, vc, m, l, acc, q0, lo)
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention_bhsd(q, k, v, causal=False, scale=None,
+                        axis_name: str = "sp", mesh=None,
+                        tp_axis: Optional[str] = "tp"):
+    """Ring attention on (B, H, S, D) global arrays, S sharded over
+    ``axis_name`` (and heads over ``tp_axis`` when the mesh has one)."""
+    mesh = _resolve_mesh(mesh)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[2] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            "sequence length %d not divisible by %s=%d" %
+            (q.shape[2], axis_name, mesh.shape[axis_name]))
+    h_ax = _head_axis(mesh, tp_axis, q.shape[1])
+    spec = P(_batch_axes(mesh, q.shape[0]), h_ax, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, causal=False, scale=None, axis_name: str = "sp",
+                   mesh=None, tp_axis: Optional[str] = "tp"):
+    """Ring attention on paddle-layout (B, S, H, D) global arrays."""
+    out = ring_attention_bhsd(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        causal=causal, scale=scale, axis_name=axis_name, mesh=mesh,
+        tp_axis=tp_axis)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+    """Inside shard_map: (B,H,S/n,D) seq shards -> all_to_all ->
+    (B,H/n,S,D) head shards -> full attention -> back."""
+    # split heads over the axis, gather sequence
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = _chunked_attention(qh, kh, vh, 0, causal, scale)
+    return gather_heads(out)
+
+
+def ulysses_attention(q, k, v, causal=False, scale=None,
+                      axis_name: str = "sp", mesh=None,
+                      tp_axis: Optional[str] = "tp"):
+    """Ulysses sequence-parallel attention on paddle-layout (B, S, H, D)
+    global arrays (S sharded over ``axis_name``); heads must divide."""
+    mesh = _resolve_mesh(mesh)
+    n = mesh.shape[axis_name]
+    num_heads = q.shape[2]
+    h_ax = _head_axis(mesh, tp_axis, num_heads)
+    local_heads = num_heads // (mesh.shape[h_ax] if h_ax else 1)
+    if local_heads % n != 0:
+        raise ValueError("heads-per-device %d %% %s=%d != 0 — use "
+                         "ring_attention" % (local_heads, axis_name, n))
+    if q.shape[1] % n != 0:
+        raise ValueError("sequence length %d not divisible by %s=%d" %
+                         (q.shape[1], axis_name, n))
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    sspec = P(_batch_axes(mesh, q.shape[0]), h_ax, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(sspec, sspec, sspec), out_specs=sspec,
+        check_vma=False)
+    out = fn(qh, kh, vh)
+    return jnp.swapaxes(out, 1, 2)

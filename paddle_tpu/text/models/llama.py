@@ -62,6 +62,10 @@ class LlamaConfig:
     remat: bool = True            # per-layer activation checkpointing
     compute_dtype: str = "bfloat16"
     sequence_parallel: bool = False  # shard activations' seq dim over 'sp'
+    # context-parallel attention over 'sp': None -> XLA-derived from the
+    # activation sharding; "ring" -> ring attention (ppermute KV rotation,
+    # ops/ring_attention.py); "ulysses" -> all-to-all head scatter
+    context_parallel: Optional[str] = None
     scan_layers: bool = False     # stack layer params, lax.scan the depth
     pp_num_microbatches: int = 1  # GPipe microbatches when mesh has pp>1
 
@@ -169,7 +173,19 @@ class LlamaAttention(Layer):
             from ...nn.functional.attention import _sdpa_ref
             from ...ops.flash_attention import flash_attention as _fa_t
             from ...ops.flash_attention import flash_eligible
-            if flash_eligible(S, c.head_dim):
+            if c.context_parallel and mesh_mod.mesh_axis_size("sp") > 1:
+                from ...ops.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+                if c.context_parallel == "ring":
+                    cp = ring_attention
+                elif c.context_parallel == "ulysses":
+                    cp = ulysses_attention
+                else:
+                    raise ValueError(
+                        "context_parallel must be 'ring' or 'ulysses', "
+                        "got %r" % (c.context_parallel,))
+                o = cp(qh, kh, vh, causal=True)
+            elif flash_eligible(S, c.head_dim):
                 o = _fa_t(qh, kh, vh, causal=True)
             else:
                 o = _sdpa_ref(qh, kh, vh, None, 0.0, True, None)
