@@ -349,39 +349,93 @@ def save(layer, path, input_spec=None, **config):
     if spec is None:
         raise ValueError("jit.save needs input_spec (list of InputSpec or "
                          "example Tensors) to trace the export")
-    example = []
-    for s in spec:
-        if isinstance(s, InputSpec):
-            shape = [1 if d is None or d < 0 else int(d) for d in s.shape]
-            from ..framework.dtype import to_jax
-            example.append(jax.ShapeDtypeStruct(tuple(shape),
-                                                to_jax(s.dtype)))
-        elif isinstance(s, Tensor):
-            example.append(jax.ShapeDtypeStruct(s._value.shape,
-                                                s._value.dtype))
-        else:
-            example.append(jax.ShapeDtypeStruct(np.asarray(s).shape,
-                                                np.asarray(s).dtype))
+    from jax import export as jexport
+    from ..framework.dtype import to_jax
 
-    skeleton = [_TensorLeaf(i) for i in range(len(example))]
+    def _specs(mode):
+        # Unknown dims (None/-1) become export symbols so the artifact is
+        # shape-polymorphic. mode="independent": every unknown dim is its
+        # own symbol (paddle's -1 semantics). mode="shared-batch": dim 0
+        # shares one "batch" symbol across inputs, for programs that
+        # require equal leading dims. mode="static": concrete 1s.
+        symbolic = mode != "static"
+        scope = jexport.SymbolicScope() if symbolic else None
+        out, names, uniq = [], [], [0]
+
+        def _dims(shape, dtype):
+            parts = []
+            for j, d in enumerate(shape):
+                if d is None or (isinstance(d, int) and d < 0):
+                    if not symbolic:
+                        parts.append("1")
+                    elif j == 0 and mode == "shared-batch":
+                        parts.append("batch")
+                    else:
+                        uniq[0] += 1
+                        parts.append(f"dyn{uniq[0]}")
+                else:
+                    parts.append(str(int(d)))
+            if symbolic:
+                dims = jexport.symbolic_shape(",".join(parts) or "",
+                                              scope=scope)
+                return jax.ShapeDtypeStruct(tuple(dims), dtype)
+            return jax.ShapeDtypeStruct(tuple(int(p) for p in parts), dtype)
+
+        for i, s in enumerate(spec):
+            if isinstance(s, InputSpec):
+                out.append(_dims(s.shape, to_jax(s.dtype)))
+                names.append(s.name or f"x{i}")
+            elif isinstance(s, Tensor):
+                out.append(jax.ShapeDtypeStruct(s._value.shape,
+                                                s._value.dtype))
+                names.append(f"x{i}")
+            else:
+                a = np.asarray(s)
+                out.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+                names.append(f"x{i}")
+        return out, names
+
+    skeleton = [_TensorLeaf(i) for i in range(len(spec))]
     compiled = sf._make_compiled(skeleton, {})
     rng = jax.random.PRNGKey(0)
-    from jax import export as jexport
     p_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in param_vals.items()}
     b_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                for k, v in buf_vals.items()}
-    exp = jexport.export(compiled)(p_specs, b_specs, rng, example)
-    state = {**param_vals, **buf_vals}
+    # Portable across host-test and TPU deploy.
+    platforms = config.get("platforms", ("cpu", "tpu"))
+    exp = None
+    for mode in ("independent", "shared-batch", "static"):
+        example, in_names = _specs(mode)
+        try:
+            exp = jexport.export(compiled, platforms=platforms)(
+                p_specs, b_specs, rng, example)
+            break
+        except Exception as e:
+            if mode == "static":
+                raise
+            import warnings
+            warnings.warn(
+                f"jit.save: shape-polymorphic export ({mode} dims) failed "
+                f"({type(e).__name__}: {e}); retrying with a more "
+                "constrained shape mode. The artifact may only accept the "
+                "traced shapes.", stacklevel=2)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exp.serialize())
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({"params": {k: np.asarray(v) for k, v in param_vals.items()},
                      "buffers": {k: np.asarray(v) for k, v in buf_vals.items()}},
                     f, protocol=4)
+    # out_avals = ((outputs...), new_buffers) per the compiled signature;
+    # record the user-visible output count for load_inference_model.
+    out_tree = jax.tree_util.tree_unflatten(exp.out_tree, exp.out_avals)
+    n_outputs = len(jax.tree_util.tree_leaves(out_tree[0]))
     meta = {"n_inputs": len(example),
-            "input_shapes": [list(e.shape) for e in example],
-            "input_dtypes": [str(np.dtype(e.dtype)) for e in example]}
+            "input_names": in_names,
+            "input_shapes": [[d if isinstance(d, int) else -1 for d in e.shape]
+                             for e in example],
+            "input_dtypes": [str(np.dtype(e.dtype)) for e in example],
+            "n_outputs": n_outputs}
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

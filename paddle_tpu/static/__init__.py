@@ -15,7 +15,8 @@ from .input_spec import InputSpec  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "Executor",
-           "CompiledProgram", "name_scope", "data"]
+           "CompiledProgram", "name_scope", "data",
+           "save_inference_model", "load_inference_model"]
 
 
 class Program:
@@ -107,3 +108,52 @@ class Executor:
 
     def close(self):
         pass
+
+
+def save_inference_model(path_prefix, feed_vars_or_layer, fetch_vars=None,
+                         executor=None, input_spec=None, **kwargs):
+    """Export a deployable model (parity: paddle.static.save_inference_model,
+    reference fluid/io.py:1199 — prunes the Program to the inference
+    subgraph and serializes ProgramDesc + params).
+
+    TPU-native: the deployable artifact is StableHLO. Accepts either the
+    v2 signature ``(path, feed_vars, fetch_vars, exe)`` where feed_vars
+    are InputSpecs from :func:`data` and ``fetch_vars`` is a traced
+    layer/callable, or simply ``(path, layer, input_spec=[...])``.
+    Writes ``<prefix>.pdmodel`` (StableHLO) + ``<prefix>.pdiparams``.
+    """
+    from .. import jit as _jit
+    from ..nn.layer.layers import Layer
+
+    if isinstance(feed_vars_or_layer, Layer) or (
+            callable(feed_vars_or_layer) and not isinstance(
+                feed_vars_or_layer, (list, tuple))):
+        layer = feed_vars_or_layer
+        spec = input_spec
+    else:
+        spec = list(feed_vars_or_layer)
+        layer = fetch_vars
+        if layer is None:
+            raise ValueError("save_inference_model needs the model as "
+                             "fetch_vars (a Layer or traced callable)")
+    _jit.save(layer, path_prefix, input_spec=spec)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Parity: paddle.static.load_inference_model (fluid/io.py). Returns
+    ``(program, feed_names, fetch_names)`` shaped like the reference —
+    ``program`` is a callable TranslatedLayer."""
+    import os
+    import pickle
+
+    from .. import jit as _jit
+
+    layer = _jit.load(path_prefix)
+    meta = {}
+    if os.path.exists(path_prefix + ".pdmeta"):
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    feed_names = meta.get("input_names",
+                          [f"x{i}" for i in range(meta.get("n_inputs", 1))])
+    fetch_names = [f"out{i}" for i in range(meta.get("n_outputs", 1))]
+    return layer, feed_names, fetch_names
