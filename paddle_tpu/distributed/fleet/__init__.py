@@ -15,6 +15,9 @@ from .fleet_base import (  # noqa: F401
 )
 from .dist_step import DistributedTrainStep  # noqa: F401
 from .ps import PSRuntime, SparseTable  # noqa: F401
+from .dataset import (  # noqa: F401
+    DatasetBase, InMemoryDataset, QueueDataset,
+)
 from . import utils  # noqa: F401
 from .utils import recompute  # noqa: F401
 from .. import meta_parallel  # noqa: F401

@@ -24,26 +24,75 @@ import numpy as np
 __all__ = ["SparseTable", "PSRuntime"]
 
 
+_OPT_CODES = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
 class SparseTable:
     """Host-RAM unbounded sparse table (reference:
     operators/distributed/large_scale_kv.h, distributed/table/
-    common_sparse_table.cc).  Rows materialise on first touch."""
+    common_sparse_table.cc).  Rows materialise on first touch.
+
+    Backed by the native C++ sharded core (paddle_tpu/native/ps_core.cc)
+    when a toolchain is present and no custom Python initializer is
+    given; the native core gives lock-sharded concurrent pull/push and
+    deterministic per-id row init (model independent of insertion order
+    and shard count). Pure-Python dict fallback otherwise.
+    """
 
     def __init__(self, dim: int, initializer=None, optimizer: str = "sgd",
-                 lr: float = 0.01, seed: int = 0):
+                 lr: float = 0.01, seed: int = 0, init_std: float = 0.01,
+                 backend: str = "auto", n_shards: int = 32,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-10):
         self.dim = dim
-        self._rows: Dict[int, np.ndarray] = {}
-        self._moments: Dict[int, np.ndarray] = {}
-        self._rng = np.random.default_rng(seed)
-        self._init = initializer or (
-            lambda: self._rng.normal(0, 0.01, size=(dim,)).astype(np.float32))
         self._opt = optimizer
         self._lr = lr
+        self._native = None
+        self._lib = None
+        if backend != "python" and initializer is None \
+                and optimizer in _OPT_CODES:
+            from ...native import ps_core
+            try:
+                lib = ps_core()
+            except Exception:
+                lib = None
+            if lib is not None:
+                self._lib = lib
+                self._native = lib.pts_create(
+                    dim, _OPT_CODES[optimizer], lr, beta1, beta2, epsilon,
+                    init_std, seed, n_shards)
+        # python fallback state
+        self._rows: Dict[int, np.ndarray] = {}
+        self._moments: Dict[int, np.ndarray] = {}
+        self._moments2: Dict[int, np.ndarray] = {}
+        self._steps: Dict[int, int] = {}
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: self._rng.normal(0, init_std,
+                                     size=(dim,)).astype(np.float32))
         self._lock = threading.Lock()
 
+    def __del__(self):
+        if getattr(self, "_native", None) is not None and self._lib:
+            try:
+                self._lib.pts_free(self._native)
+            except Exception:
+                pass
+            self._native = None
+
+    def _c(self, arr, ctype):
+        import ctypes
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
     def pull(self, ids: np.ndarray) -> np.ndarray:
-        ids = np.asarray(ids).reshape(-1)
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         out = np.empty((ids.size, self.dim), np.float32)
+        if self._native is not None:
+            self._lib.pts_pull(self._native, self._c(ids, ctypes.c_int64),
+                               ids.size, self._c(out, ctypes.c_float))
+            return out
         with self._lock:
             for i, k in enumerate(ids.tolist()):
                 row = self._rows.get(k)
@@ -53,8 +102,14 @@ class SparseTable:
         return out
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
-        ids = np.asarray(ids).reshape(-1)
-        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, self.dim))
+        if self._native is not None:
+            self._lib.pts_push(self._native, self._c(ids, ctypes.c_int64),
+                               ids.size, self._c(grads, ctypes.c_float))
+            return
         with self._lock:
             for k, g in zip(ids.tolist(), grads):
                 row = self._rows.get(k)
@@ -65,15 +120,33 @@ class SparseTable:
                     if m is None:
                         m = self._moments[k] = np.zeros(self.dim, np.float32)
                     m += g * g
-                    row -= self._lr * g / (np.sqrt(m) + 1e-10)
+                    row -= self._lr * g / (np.sqrt(m) + self._eps)
+                elif self._opt == "adam":
+                    m = self._moments.setdefault(
+                        k, np.zeros(self.dim, np.float32))
+                    v = self._moments2.setdefault(
+                        k, np.zeros(self.dim, np.float32))
+                    t = self._steps[k] = self._steps.get(k, 0) + 1
+                    m[:] = self._beta1 * m + (1 - self._beta1) * g
+                    v[:] = self._beta2 * v + (1 - self._beta2) * g * g
+                    mh = m / (1 - self._beta1 ** t)
+                    vh = v / (1 - self._beta2 ** t)
+                    row -= self._lr * mh / (np.sqrt(vh) + self._eps)
                 else:  # sgd
                     row -= self._lr * g
 
     def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
         """Geo-async raw delta add (reference: GeoCommunicator delta-push,
         distributed/service/communicator.h:495) — no optimizer applied."""
-        ids = np.asarray(ids).reshape(-1)
-        deltas = np.asarray(deltas, np.float32).reshape(ids.size, self.dim)
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(ids.size, self.dim))
+        if self._native is not None:
+            self._lib.pts_push_delta(
+                self._native, self._c(ids, ctypes.c_int64), ids.size,
+                self._c(deltas, ctypes.c_float))
+            return
         with self._lock:
             for k, d in zip(ids.tolist(), deltas):
                 row = self._rows.get(k)
@@ -82,21 +155,49 @@ class SparseTable:
                 row += d
 
     def __len__(self):
+        if self._native is not None:
+            return int(self._lib.pts_size(self._native))
         return len(self._rows)
 
     # checkpoint (reference: servers persist their shard,
     # the_one_ps.py:758 warm-start)
     def save(self, path: str):
+        import ctypes
+        if self._native is not None:
+            n = int(self._lib.pts_size(self._native))
+            ids = np.empty(n, np.int64)
+            vals = np.empty((n, self.dim), np.float32)
+            if n:
+                # cap=n: the table may grow concurrently; export writes at
+                # most n rows (the snapshot is whatever fit)
+                w = self._lib.pts_export(self._native,
+                                         self._c(ids, ctypes.c_int64),
+                                         self._c(vals, ctypes.c_float), n)
+                ids, vals = ids[:w], vals[:w]
+            np.savez(path, ids=ids, vals=vals)
+            return
         ids = np.fromiter(self._rows, np.int64, len(self._rows))
         vals = np.stack([self._rows[int(i)] for i in ids]) \
             if len(ids) else np.zeros((0, self.dim), np.float32)
         np.savez(path, ids=ids, vals=vals)
 
     def load(self, path: str):
+        import ctypes
         d = np.load(path if path.endswith(".npz") else path + ".npz")
+        ids = np.ascontiguousarray(d["ids"], np.int64)
+        vals = np.ascontiguousarray(d["vals"], np.float32)
+        if self._native is not None:
+            # restore REPLACES (reference warm-start semantics,
+            # the_one_ps.py:758) — never merges into existing rows
+            self._lib.pts_clear(self._native)
+            self._lib.pts_import(self._native, self._c(ids, ctypes.c_int64),
+                                 ids.size, self._c(vals, ctypes.c_float))
+            return
         with self._lock:
-            self._rows = {int(i): v.copy()
-                          for i, v in zip(d["ids"], d["vals"])}
+            self._rows = {int(i): v.copy() for i, v in zip(ids, vals)}
+            self._moments.clear()
+            self._moments2.clear()
+            self._steps.clear()
 
 
 class PSRuntime:

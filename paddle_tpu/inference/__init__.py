@@ -107,11 +107,23 @@ class Config:
         if p.endswith(".pdmodel"):
             return p[:-len(".pdmodel")]
         if os.path.isdir(p):
-            cands = [f for f in os.listdir(p) if f.endswith(".pdmodel")]
+            cands = sorted(f for f in os.listdir(p)
+                           if f.endswith(".pdmodel"))
             if not cands:
                 raise FileNotFoundError(f"no .pdmodel under {p}")
+            if len(cands) > 1:
+                raise ValueError(
+                    f"ambiguous model dir {p}: {cands}; pass the .pdmodel "
+                    "path explicitly")
             return os.path.join(p, cands[0][:-len(".pdmodel")])
         return p
+
+    def _params_path(self):
+        """Params file: the explicit Config(prog, params) path wins,
+        else <prefix>.pdiparams."""
+        if self._params_file is not None:
+            return self._params_file
+        return self._path_prefix() + ".pdiparams"
 
     # -- device ------------------------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -237,7 +249,7 @@ class Predictor:
         prefix = config._path_prefix()
         with open(prefix + ".pdmodel", "rb") as f:
             self._exported = jexport.deserialize(bytearray(f.read()))
-        with open(prefix + ".pdiparams", "rb") as f:
+        with open(config._params_path(), "rb") as f:
             blob = pickle.load(f)
         meta = {}
         if os.path.exists(prefix + ".pdmeta"):

@@ -1,0 +1,299 @@
+// Native MultiSlot data ingest for recommendation workloads.
+//
+// TPU-native equivalent of the reference's C++ DataFeed/Dataset stack
+// (reference: paddle/fluid/framework/data_feed.h MultiSlotDataFeed — text
+// records "slot:feasign" parsed by trainer threads; framework/data_set.h:157
+// DatasetImpl with LoadIntoMemory/LocalShuffle/GlobalShuffle:200-211 —
+// multi-threaded file readers filling an in-memory record store that
+// feeds training threads).
+//
+// Record text format (the reference's MultiSlot format,
+// framework/data_feed.cc CheckFile): per line, for each slot in order:
+//   <n> v1 v2 ... vn
+// where values are uint64 feasign ids for sparse slots and floats for
+// dense slots.
+//
+// Design (not a port):
+//  - columnar in-memory store: per slot one growing value array + per
+//    record (offset,len); records identified by dense index, so shuffle
+//    is a permutation of an index vector — values never move.
+//  - parallel load: files split across worker threads, each parses into
+//    a thread-local store; stores are stitched (no locks in the parse
+//    hot loop).
+//  - batches materialise as (values, lod) pairs per sparse slot — the
+//    CSR/ragged layout JAX embedding lookups consume directly — and as
+//    dense [batch, dim] matrices for float slots.
+//
+// C ABI via ctypes (pybind11 not in image).
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotStore {
+  // sparse: u64 ids; dense: floats. One of the two vectors is used.
+  std::vector<uint64_t> ids;
+  std::vector<float> vals;
+  std::vector<uint64_t> offs;  // per record start offset
+  std::vector<uint32_t> lens;  // per record length
+};
+
+struct Feed {
+  int n_slots;
+  std::vector<uint8_t> is_dense;  // per slot
+  std::vector<SlotStore> slots;
+  std::vector<uint64_t> order;  // record permutation / partitioned view
+  bool order_init = false;
+  uint64_t n_records = 0;
+
+  void ensure_order() {
+    if (!order_init) {
+      order.resize(n_records);
+      for (uint64_t i = 0; i < n_records; ++i) order[i] = i;
+      order_init = true;
+    }
+  }
+};
+
+// Parse one file into a private Feed (no locking).
+bool parse_file(const char* path, int n_slots, const uint8_t* is_dense,
+                Feed* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> buf((size_t)sz + 1);
+  if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
+    std::fclose(f);
+    return false;
+  }
+  std::fclose(f);
+  buf[(size_t)sz] = '\0';
+
+  char* p = buf.data();
+  char* end = buf.data() + sz;
+  while (p < end) {
+    // skip blank lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    // NUL-terminate this line so strtol/strtof can never consume tokens
+    // from the next record when a line is truncated (they treat '\n' as
+    // plain whitespace otherwise).
+    char* eol = p;
+    while (eol < end && *eol != '\n') ++eol;
+    char saved = *eol;
+    *eol = '\0';
+    bool ok = true;
+    for (int s = 0; s < n_slots && ok; ++s) {
+      char* next = nullptr;
+      long n = std::strtol(p, &next, 10);
+      if (next == p || n < 0) { ok = false; break; }
+      p = next;
+      SlotStore& st = out->slots[s];
+      st.offs.push_back(is_dense[s] ? st.vals.size() : st.ids.size());
+      st.lens.push_back((uint32_t)n);
+      for (long i = 0; i < n; ++i) {
+        if (is_dense[s]) {
+          float v = std::strtof(p, &next);
+          if (next == p) { ok = false; break; }
+          st.vals.push_back(v);
+        } else {
+          uint64_t v = std::strtoull(p, &next, 10);
+          if (next == p) { ok = false; break; }
+          st.ids.push_back(v);
+        }
+        p = next;
+      }
+    }
+    if (ok) {
+      ++out->n_records;
+    } else {
+      // drop malformed tail of line; resync offsets
+      for (int s = 0; s < n_slots; ++s) {
+        SlotStore& st = out->slots[s];
+        while (st.offs.size() > out->n_records) {
+          if (is_dense[s]) st.vals.resize(st.offs.back());
+          else st.ids.resize(st.offs.back());
+          st.offs.pop_back();
+          st.lens.pop_back();
+        }
+      }
+    }
+    *eol = saved;
+    p = eol;  // next iteration skips the newline
+  }
+  return true;
+}
+
+void append_store(Feed* dst, const Feed& src) {
+  for (int s = 0; s < dst->n_slots; ++s) {
+    SlotStore& a = dst->slots[s];
+    const SlotStore& b = src.slots[s];
+    uint64_t base = dst->is_dense[s] ? a.vals.size() : a.ids.size();
+    a.ids.insert(a.ids.end(), b.ids.begin(), b.ids.end());
+    a.vals.insert(a.vals.end(), b.vals.begin(), b.vals.end());
+    for (size_t i = 0; i < b.offs.size(); ++i)
+      a.offs.push_back(b.offs[i] + base);
+    a.lens.insert(a.lens.end(), b.lens.begin(), b.lens.end());
+  }
+  dst->n_records += src.n_records;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dfd_create(int n_slots, const uint8_t* is_dense) {
+  Feed* f = new Feed();
+  f->n_slots = n_slots;
+  f->is_dense.assign(is_dense, is_dense + n_slots);
+  f->slots.resize(n_slots);
+  return f;
+}
+
+void dfd_free(void* h) { delete (Feed*)h; }
+
+// Load files in parallel (n_threads<=0: hardware concurrency, capped 16).
+// Returns number of records loaded, or -1 if any file failed to open.
+int64_t dfd_load(void* h, const char** paths, int n_files, int n_threads) {
+  Feed* f = (Feed*)h;
+  if (n_threads <= 0)
+    n_threads = (int)std::thread::hardware_concurrency();
+  n_threads = std::max(1, std::min({n_threads, n_files, 16}));
+  std::vector<Feed> parts(n_files);
+  std::vector<uint8_t> okv(n_files, 0);
+  std::atomic<int> next{0};
+  auto work = [&]() {
+    int i;
+    while ((i = next.fetch_add(1)) < n_files) {
+      parts[i].n_slots = f->n_slots;
+      parts[i].is_dense = f->is_dense;
+      parts[i].slots.resize(f->n_slots);
+      okv[i] = parse_file(paths[i], f->n_slots, f->is_dense.data(),
+                          &parts[i]);
+    }
+  };
+  std::vector<std::thread> th;
+  for (int w = 0; w < n_threads; ++w) th.emplace_back(work);
+  for (auto& t : th) t.join();
+  bool all_ok = true;
+  for (int i = 0; i < n_files; ++i) {
+    if (!okv[i]) { all_ok = false; continue; }
+    append_store(f, parts[i]);
+  }
+  f->order.clear();
+  f->order_init = false;
+  return all_ok ? (int64_t)f->n_records : -1;
+}
+
+int64_t dfd_size(void* h) { return (int64_t)((Feed*)h)->n_records; }
+
+void dfd_shuffle(void* h, uint64_t seed) {
+  Feed* f = (Feed*)h;
+  // Always rebuild the FULL view first: shuffle is called once per epoch
+  // and must undo any previous rank partition, otherwise repeated
+  // global_shuffle calls would shrink each worker's data by 1/nranks per
+  // epoch.
+  f->order_init = false;
+  f->ensure_order();
+  std::mt19937_64 rng(seed);
+  std::shuffle(f->order.begin(), f->order.end(), rng);
+}
+
+// Keep only records whose (index % n_ranks) == rank — the degenerate
+// "global shuffle" partition used for multi-worker reading; the real
+// cross-host exchange rides the collective layer in Python.
+void dfd_partition(void* h, int rank, int n_ranks) {
+  Feed* f = (Feed*)h;
+  f->ensure_order();
+  std::vector<uint64_t> kept;
+  kept.reserve(f->order.size() / n_ranks + 1);
+  for (uint64_t i = 0; i < f->order.size(); ++i)
+    if ((int)(i % (uint64_t)n_ranks) == rank) kept.push_back(f->order[i]);
+  f->order.swap(kept);
+  // n_records tracks the store, order tracks the view; iteration uses
+  // order.size()
+}
+
+int64_t dfd_view_size(void* h) {
+  Feed* f = (Feed*)h;
+  f->ensure_order();
+  return (int64_t)f->order.size();
+}
+
+// Batch extraction, two-phase.
+// Phase 1: dfd_batch_sizes(start, bs, sizes_out[n_slots]) -> actual batch
+//   rows; sizes_out[s] = total values of slot s in the batch.
+// Phase 2 per slot: dfd_batch_sparse / dfd_batch_dense fill caller
+//   buffers (ids + lod offsets of size rows+1, or row-major floats).
+int dfd_batch_sizes(void* h, int64_t start, int batch,
+                    int64_t* sizes_out) {
+  Feed* f = (Feed*)h;
+  f->ensure_order();
+  int64_t n = (int64_t)f->order.size();
+  if (start >= n) return 0;
+  int rows = (int)std::min<int64_t>(batch, n - start);
+  for (int s = 0; s < f->n_slots; ++s) {
+    int64_t tot = 0;
+    for (int r = 0; r < rows; ++r)
+      tot += f->slots[s].lens[f->order[start + r]];
+    sizes_out[s] = tot;
+  }
+  return rows;
+}
+
+void dfd_batch_sparse(void* h, int64_t start, int rows, int slot,
+                      uint64_t* ids_out, int64_t* lod_out) {
+  Feed* f = (Feed*)h;
+  SlotStore& st = f->slots[slot];
+  int64_t w = 0;
+  lod_out[0] = 0;
+  for (int r = 0; r < rows; ++r) {
+    uint64_t rec = f->order[start + r];
+    uint64_t off = st.offs[rec];
+    uint32_t len = st.lens[rec];
+    std::memcpy(ids_out + w, st.ids.data() + off, sizeof(uint64_t) * len);
+    w += len;
+    lod_out[r + 1] = w;
+  }
+}
+
+void dfd_batch_dense(void* h, int64_t start, int rows, int slot, int dim,
+                     float* out) {
+  Feed* f = (Feed*)h;
+  SlotStore& st = f->slots[slot];
+  for (int r = 0; r < rows; ++r) {
+    uint64_t rec = f->order[start + r];
+    uint64_t off = st.offs[rec];
+    int len = (int)st.lens[rec];
+    int n = std::min(len, dim);
+    std::memcpy(out + (size_t)r * dim, st.vals.data() + off,
+                sizeof(float) * n);
+    for (int j = n; j < dim; ++j) out[(size_t)r * dim + j] = 0.0f;
+  }
+}
+
+void dfd_release(void* h) {
+  Feed* f = (Feed*)h;
+  for (auto& s : f->slots) {
+    s.ids.clear(); s.ids.shrink_to_fit();
+    s.vals.clear(); s.vals.shrink_to_fit();
+    s.offs.clear(); s.offs.shrink_to_fit();
+    s.lens.clear(); s.lens.shrink_to_fit();
+  }
+  f->order.clear();
+  f->order.shrink_to_fit();
+  f->order_init = false;
+  f->n_records = 0;
+}
+
+}  // extern "C"

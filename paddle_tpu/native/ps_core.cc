@@ -1,0 +1,277 @@
+// Native sparse-table core for the parameter-server path.
+//
+// TPU-native equivalent of the reference's C++ sparse table stack
+// (reference: paddle/fluid/distributed/table/common_sparse_table.cc,
+// operators/distributed/large_scale_kv.h — unbounded id->row storage with
+// per-row optimizer state, lazily initialised, sharded + locked for
+// concurrent trainer threads; framework/fleet/fleet_wrapper.h:66
+// PullSparseVarsSync / PushSparseVarsWithLabelAsync semantics).
+//
+// Design (not a port):
+//  - N shards, each an open unordered_map id -> row index into a chunked
+//    slab (16k rows/chunk) so rows never move and pointers stay stable.
+//  - Row stride = dim * (1 value + optimizer-state slots) + 1 step slot;
+//    SGD:0 extra, AdaGrad:1 (accumulator), Adam:2 (m, v).
+//  - Per-id deterministic init: splitmix64(seed ^ id) -> Box-Muller
+//    normal(0, init_std). Pull/push order and shard count thus never
+//    change the model — the reference's RNG-per-server cannot say that.
+//  - pull/push fan out over worker threads, grouped by shard so each
+//    shard lock is taken once per call, not once per id.
+//
+// C ABI only (loaded via ctypes; pybind11 is not in this image).
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kRowsPerChunk = 1 << 14;
+
+enum Opt { kSGD = 0, kAdaGrad = 1, kAdam = 2 };
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Shard {
+  std::unordered_map<int64_t, uint64_t> index;
+  std::vector<float*> chunks;
+  uint64_t used = 0;  // rows in use
+  std::mutex mu;
+
+  ~Shard() {
+    for (float* c : chunks) delete[] c;
+  }
+};
+
+struct Table {
+  int dim;
+  int opt;
+  float lr, beta1, beta2, eps, init_std;
+  uint64_t seed;
+  int n_shards;
+  int stride;  // floats per row incl. optimizer state + step counter
+  std::vector<Shard> shards;
+
+  Table(int dim_, int opt_, float lr_, float b1, float b2, float eps_,
+        float std_, uint64_t seed_, int n_shards_)
+      : dim(dim_), opt(opt_), lr(lr_), beta1(b1), beta2(b2), eps(eps_),
+        init_std(std_), seed(seed_), n_shards(n_shards_),
+        shards(n_shards_) {
+    int state_slots = opt == kAdam ? 2 : (opt == kAdaGrad ? 1 : 0);
+    stride = dim * (1 + state_slots) + 1;  // +1: per-row step counter
+  }
+
+  int shard_of(int64_t id) const {
+    return (int)(splitmix64((uint64_t)id) % (uint64_t)n_shards);
+  }
+
+  // caller holds s.mu
+  float* row_locked(Shard& s, int64_t id, bool create) {
+    auto it = s.index.find(id);
+    if (it == s.index.end()) {
+      if (!create) return nullptr;
+      uint64_t idx = s.used++;
+      if (idx / kRowsPerChunk >= s.chunks.size())
+        s.chunks.push_back(new float[(size_t)kRowsPerChunk * stride]);
+      s.index.emplace(id, idx);
+      float* r = s.chunks[idx / kRowsPerChunk] +
+                 (size_t)(idx % kRowsPerChunk) * stride;
+      init_row(r, id);
+      return r;
+    }
+    uint64_t idx = it->second;
+    return s.chunks[idx / kRowsPerChunk] +
+           (size_t)(idx % kRowsPerChunk) * stride;
+  }
+
+  void init_row(float* r, int64_t id) {
+    uint64_t st = splitmix64(seed ^ (uint64_t)id);
+    for (int j = 0; j < dim; j += 2) {
+      // Box-Muller from two splitmix64 draws
+      st = splitmix64(st);
+      double u1 = ((st >> 11) + 1.0) * (1.0 / 9007199254740993.0);
+      st = splitmix64(st);
+      double u2 = (st >> 11) * (1.0 / 9007199254740992.0);
+      double m = std::sqrt(-2.0 * std::log(u1)) * init_std;
+      r[j] = (float)(m * std::cos(6.283185307179586 * u2));
+      if (j + 1 < dim) r[j + 1] = (float)(m * std::sin(6.283185307179586 * u2));
+    }
+    std::memset(r + dim, 0, sizeof(float) * (stride - dim));
+  }
+
+  void apply(float* r, const float* g) {
+    float* v = r;
+    float* step = r + stride - 1;
+    *step += 1.0f;
+    switch (opt) {
+      case kSGD:
+        for (int j = 0; j < dim; ++j) v[j] -= lr * g[j];
+        break;
+      case kAdaGrad: {
+        float* acc = r + dim;
+        for (int j = 0; j < dim; ++j) {
+          acc[j] += g[j] * g[j];
+          v[j] -= lr * g[j] / (std::sqrt(acc[j]) + eps);
+        }
+        break;
+      }
+      case kAdam: {
+        float* m = r + dim;
+        float* vv = r + 2 * dim;
+        float t = *step;
+        float bc1 = 1.0f - std::pow(beta1, t);
+        float bc2 = 1.0f - std::pow(beta2, t);
+        for (int j = 0; j < dim; ++j) {
+          m[j] = beta1 * m[j] + (1.0f - beta1) * g[j];
+          vv[j] = beta2 * vv[j] + (1.0f - beta2) * g[j] * g[j];
+          v[j] -= lr * (m[j] / bc1) / (std::sqrt(vv[j] / bc2) + eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+// Group positions by shard once, then each worker thread owns a disjoint
+// set of shards — one lock acquisition per (call, shard), no contention.
+template <typename Fn>
+void for_each_shard_group(Table* t, const int64_t* ids, int64_t n, Fn fn) {
+  std::vector<std::vector<int64_t>> by_shard(t->n_shards);
+  for (int64_t i = 0; i < n; ++i)
+    by_shard[t->shard_of(ids[i])].push_back(i);
+  int hw = (int)std::thread::hardware_concurrency();
+  int workers = std::min(t->n_shards, std::max(1, std::min(hw, 16)));
+  if (n < 4096) workers = 1;  // small batches: thread spawn dominates
+  std::atomic<int> next{0};
+  auto run = [&]() {
+    int s;
+    while ((s = next.fetch_add(1)) < t->n_shards) {
+      if (by_shard[s].empty()) continue;
+      Shard& sh = t->shards[s];
+      std::lock_guard<std::mutex> lk(sh.mu);
+      for (int64_t pos : by_shard[s]) fn(sh, pos);
+    }
+  };
+  if (workers == 1) {
+    run();
+  } else {
+    std::vector<std::thread> th;
+    for (int w = 0; w < workers; ++w) th.emplace_back(run);
+    for (auto& x : th) x.join();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pts_create(int dim, int opt, float lr, float beta1, float beta2,
+                 float eps, float init_std, uint64_t seed, int n_shards) {
+  if (n_shards <= 0) n_shards = 32;
+  return new Table(dim, opt, lr, beta1, beta2, eps, init_std, seed,
+                   n_shards);
+}
+
+void pts_free(void* h) { delete (Table*)h; }
+
+void pts_set_lr(void* h, float lr) { ((Table*)h)->lr = lr; }
+
+// gather rows (lazy init) into out[n, dim]
+void pts_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = (Table*)h;
+  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
+    float* r = t->row_locked(sh, ids[i], true);
+    std::memcpy(out + (size_t)i * t->dim, r, sizeof(float) * t->dim);
+  });
+}
+
+// apply optimizer update per (id, grad) pair; duplicates apply in order
+void pts_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
+  Table* t = (Table*)h;
+  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
+    float* r = t->row_locked(sh, ids[i], true);
+    t->apply(r, grads + (size_t)i * t->dim);
+  });
+}
+
+// geo-mode raw delta add (no optimizer)
+void pts_push_delta(void* h, const int64_t* ids, int64_t n,
+                    const float* deltas) {
+  Table* t = (Table*)h;
+  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
+    float* r = t->row_locked(sh, ids[i], true);
+    const float* d = deltas + (size_t)i * t->dim;
+    for (int j = 0; j < t->dim; ++j) r[j] += d[j];
+  });
+}
+
+int64_t pts_size(void* h) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += (int64_t)s.index.size();
+  }
+  return n;
+}
+
+// two-phase export: ids/vals may be null to query count. vals gets the
+// value part only (dim floats per row) — optimizer state stays server-side,
+// matching the reference's save format (values persisted, state rebuilt).
+// cap bounds the rows written so a table growing concurrently (trainer
+// threads pull-initialise rows during checkpoint) can never overflow the
+// caller's buffers; returns rows written (or total count when querying).
+int64_t pts_export(void* h, int64_t* ids_out, float* vals_out,
+                   int64_t cap) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kv : s.index) {
+      if ((ids_out || vals_out) && n >= cap) return n;
+      if (ids_out) ids_out[n] = kv.first;
+      if (vals_out) {
+        float* r = s.chunks[kv.second / kRowsPerChunk] +
+                   (size_t)(kv.second % kRowsPerChunk) * t->stride;
+        std::memcpy(vals_out + (size_t)n * t->dim, r,
+                    sizeof(float) * t->dim);
+      }
+      ++n;
+    }
+  }
+  return n;
+}
+
+// drop every row (used by load(): restore replaces, never merges)
+void pts_clear(void* h) {
+  Table* t = (Table*)h;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.index.clear();
+    for (float* c : s.chunks) delete[] c;
+    s.chunks.clear();
+    s.used = 0;
+  }
+}
+
+// bulk load values (fresh optimizer state)
+void pts_import(void* h, const int64_t* ids, int64_t n, const float* vals) {
+  Table* t = (Table*)h;
+  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
+    float* r = t->row_locked(sh, ids[i], true);
+    std::memcpy(r, vals + (size_t)i * t->dim, sizeof(float) * t->dim);
+    std::memset(r + t->dim, 0, sizeof(float) * (t->stride - t->dim));
+  });
+}
+
+}  // extern "C"

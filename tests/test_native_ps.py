@@ -1,0 +1,194 @@
+"""Native C++ sparse-table core tests (paddle_tpu/native/ps_core.cc).
+
+Parity model: reference distributed/table/common_sparse_table tests —
+lazy row init, optimizer update semantics vs a numpy oracle, geo delta
+push, save/load, concurrency.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.native import ps_core
+
+
+requires_native = pytest.mark.skipif(ps_core() is None,
+                                     reason="no C++ toolchain")
+
+
+@requires_native
+def test_native_backend_selected():
+    t = SparseTable(8)
+    assert t._native is not None
+
+
+@requires_native
+def test_pull_deterministic_and_lazy():
+    t = SparseTable(16, seed=42)
+    ids = np.array([5, 99, 5, 12345678901], np.int64)
+    out = t.pull(ids)
+    assert out.shape == (4, 16)
+    # same id -> same row, regardless of position
+    np.testing.assert_array_equal(out[0], out[2])
+    assert len(t) == 3
+    # re-pull is stable
+    np.testing.assert_array_equal(t.pull(ids), out)
+    # a fresh table with the same seed materialises identical rows even
+    # when ids arrive in a different order (deterministic per-id init)
+    t2 = SparseTable(16, seed=42)
+    out2 = t2.pull(ids[::-1].copy())
+    np.testing.assert_array_equal(out2[::-1], out)
+    # init is ~ normal(0, 0.01)
+    big = t.pull(np.arange(4096, dtype=np.int64))
+    assert abs(float(big.mean())) < 1e-3
+    assert 0.008 < float(big.std()) < 0.012
+
+
+@requires_native
+def test_sgd_push_matches_oracle():
+    t = SparseTable(4, optimizer="sgd", lr=0.1)
+    ids = np.array([1, 2], np.int64)
+    before = t.pull(ids).copy()
+    g = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.float32)
+    t.push(ids, g)
+    np.testing.assert_allclose(t.pull(ids), before - 0.1 * g, rtol=1e-6)
+
+
+@requires_native
+def test_adagrad_push_matches_python_fallback():
+    ids = np.array([7, 8, 7], np.int64)
+    g = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    tn = SparseTable(6, optimizer="adagrad", lr=0.05)
+    tp = SparseTable(6, optimizer="adagrad", lr=0.05, backend="python",
+                     initializer=lambda: np.zeros(6, np.float32))
+    # align initial rows: zero them via import
+    zeros = np.zeros((2, 6), np.float32)
+    uniq = np.array([7, 8], np.int64)
+    tn.load_from_arrays = None  # no-op guard
+    import ctypes
+    tn._lib.pts_import(tn._native, tn._c(uniq, ctypes.c_int64), 2,
+                       tn._c(zeros, ctypes.c_float))
+    for _ in range(3):
+        tn.push(ids, g)
+        tp.push(ids, g)
+    np.testing.assert_allclose(tn.pull(uniq), tp.pull(uniq),
+                               rtol=1e-5, atol=1e-6)
+
+
+@requires_native
+def test_adam_push_matches_python_fallback():
+    ids = np.array([3, 4], np.int64)
+    g = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+    tn = SparseTable(5, optimizer="adam", lr=0.01)
+    tp = SparseTable(5, optimizer="adam", lr=0.01, backend="python",
+                     initializer=lambda: np.zeros(5, np.float32))
+    import ctypes
+    zeros = np.zeros((2, 5), np.float32)
+    tn._lib.pts_import(tn._native, tn._c(ids, ctypes.c_int64), 2,
+                       tn._c(zeros, ctypes.c_float))
+    for _ in range(5):
+        tn.push(ids, g)
+        tp.push(ids, g)
+    np.testing.assert_allclose(tn.pull(ids), tp.pull(ids),
+                               rtol=1e-4, atol=1e-6)
+
+
+@requires_native
+def test_push_delta_and_len():
+    t = SparseTable(3)
+    ids = np.array([10, 11], np.int64)
+    base = t.pull(ids).copy()
+    d = np.ones((2, 3), np.float32)
+    t.push_delta(ids, d)
+    np.testing.assert_allclose(t.pull(ids), base + 1.0, rtol=1e-6)
+    assert len(t) == 2
+
+
+@requires_native
+def test_save_load_roundtrip(tmp_path):
+    t = SparseTable(4, seed=1)
+    ids = np.array([100, 200, 300], np.int64)
+    t.push(ids, np.ones((3, 4), np.float32))
+    vals = t.pull(ids).copy()
+    p = str(tmp_path / "table")
+    t.save(p)
+    t2 = SparseTable(4, seed=999)   # different seed: rows must come from file
+    t2.load(p)
+    assert len(t2) == 3
+    np.testing.assert_array_equal(t2.pull(ids), vals)
+    # python-backend can read the same file (shared format)
+    t3 = SparseTable(4, backend="python")
+    t3.load(p + ".npz")
+    np.testing.assert_allclose(t3.pull(ids), vals, rtol=1e-6)
+
+
+@requires_native
+def test_concurrent_push_pull():
+    t = SparseTable(8, optimizer="sgd", lr=0.001)
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.RandomState(seed)
+            for _ in range(50):
+                ids = rng.randint(0, 1000, size=64).astype(np.int64)
+                t.pull(ids)
+                t.push(ids, rng.randn(64, 8).astype(np.float32))
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert len(t) <= 1000
+    out = t.pull(np.arange(1000, dtype=np.int64))
+    assert np.isfinite(out).all()
+
+
+@requires_native
+def test_large_batch_threads():
+    """Exercise the multi-threaded shard fan-out path (n >= 4096)."""
+    t = SparseTable(16)
+    ids = np.random.RandomState(3).randint(0, 10**12, size=20000)
+    ids = ids.astype(np.int64)
+    out = t.pull(ids)
+    assert out.shape == (20000, 16)
+    t.push(ids, np.ones((20000, 16), np.float32))
+    assert np.isfinite(t.pull(ids)).all()
+
+
+def test_python_fallback_still_works():
+    t = SparseTable(4, backend="python", optimizer="adam", lr=0.01)
+    ids = np.array([1, 2], np.int64)
+    t.push(ids, np.ones((2, 4), np.float32))
+    assert len(t) == 2
+    assert np.isfinite(t.pull(ids)).all()
+
+
+@requires_native
+def test_load_replaces_not_merges(tmp_path):
+    t = SparseTable(4, seed=1)
+    t.pull(np.array([1, 2], np.int64))
+    p = str(tmp_path / "snap")
+    t.save(p)
+    t2 = SparseTable(4, seed=2)
+    t2.pull(np.array([7, 8, 9], np.int64))   # pre-existing rows
+    t2.load(p)
+    assert len(t2) == 2                      # replaced, not merged
+
+
+def test_load_replaces_python_backend(tmp_path):
+    t = SparseTable(4, backend="python", seed=1)
+    t.pull(np.array([1, 2], np.int64))
+    p = str(tmp_path / "snap")
+    t.save(p)
+    t2 = SparseTable(4, backend="python", optimizer="adam")
+    t2.push(np.array([7], np.int64), np.ones((1, 4), np.float32))
+    t2.load(p + ".npz")
+    assert len(t2) == 2
+    assert not t2._moments                   # optimizer state reset
