@@ -123,6 +123,12 @@ class InMemoryDataset(DatasetBase):
             lib = datafeed()
         except Exception:
             lib = None
+        # re-load: free the previous native store (QueueDataset re-loads
+        # every epoch; without this each load leaks the prior records)
+        if self._h is not None and self._lib is not None:
+            self._lib.dfd_free(self._h)
+            self._h = None
+        self._py_records = None
         if lib is not None:
             dense = np.array([s.is_dense for s in self._slots], np.uint8)
             self._lib = lib
@@ -147,17 +153,26 @@ class InMemoryDataset(DatasetBase):
                     if not toks:
                         continue
                     rec, i, ok = [], 0, True
-                    for s in self._slots:
-                        if i >= len(toks):
-                            ok = False
-                            break
-                        n = int(toks[i]); i += 1
-                        vals = toks[i:i + n]; i += n
-                        if len(vals) != n:
-                            ok = False
-                            break
-                        rec.append(np.array(
-                            vals, np.float32 if s.is_dense else np.uint64))
+                    # malformed lines are DROPPED, matching the native
+                    # parser (parse_file skips bad records, never aborts)
+                    try:
+                        for s in self._slots:
+                            if i >= len(toks):
+                                ok = False
+                                break
+                            n = int(toks[i]); i += 1
+                            if n < 0:
+                                ok = False
+                                break
+                            vals = toks[i:i + n]; i += n
+                            if len(vals) != n:
+                                ok = False
+                                break
+                            rec.append(np.array(
+                                vals,
+                                np.float32 if s.is_dense else np.uint64))
+                    except ValueError:
+                        ok = False
                     if ok:
                         recs.append(rec)
         self._py_records = recs
@@ -168,7 +183,12 @@ class InMemoryDataset(DatasetBase):
     def local_shuffle(self, seed: Optional[int] = None):
         """Shuffle the FULL record set (also undoing any previous rank
         partition) — re-callable once per epoch."""
-        seed = self._seed if seed is None else seed
+        if seed is None:
+            # fresh permutation per call (the reference shuffles with a new
+            # random state each epoch); deterministic from _seed so every
+            # worker calling in lockstep still agrees
+            seed = self._seed
+            self._seed += 1
         if self._h is not None:
             self._lib.dfd_shuffle(self._h, seed)
         elif self._py_records is not None:
@@ -185,7 +205,9 @@ class InMemoryDataset(DatasetBase):
         rank = _par.get_rank() if fleet is None else fleet.worker_index()
         nranks = (_par.get_world_size() if fleet is None
                   else fleet.worker_num())
-        seed = self._seed if seed is None else seed
+        if seed is None:
+            seed = self._seed
+            self._seed += 1          # varies per epoch, same on all ranks
         self.local_shuffle(seed=seed)   # identical permutation everywhere
         if nranks > 1:
             if self._h is not None:

@@ -186,6 +186,12 @@ class SparseTable:
         d = np.load(path if path.endswith(".npz") else path + ".npz")
         ids = np.ascontiguousarray(d["ids"], np.int64)
         vals = np.ascontiguousarray(d["vals"], np.float32)
+        if vals.ndim != 2 or vals.shape[0] != ids.size or (
+                ids.size and vals.shape[1] != self.dim):
+            raise ValueError(
+                f"checkpoint layout {vals.shape} does not match table "
+                f"(rows={ids.size}, dim={self.dim}); was it saved from a "
+                f"table with a different embedding dim?")
         if self._native is not None:
             # restore REPLACES (reference warm-start semantics,
             # the_one_ps.py:758) — never merges into existing rows

@@ -13,6 +13,8 @@ from .core import (GradNode, Tensor, enable_grad, grad, is_grad_enabled,  # noqa
 from .dtype import (bfloat16, complex64, complex128, convert_dtype, finfo,  # noqa: F401
                     float16, float32, float64, iinfo, int8, int16, int32,
                     int64, is_floating_point, is_integer, uint8)
+from .debug import (check_numerics, disable_check_nan_inf,  # noqa: F401
+                    enable_check_nan_inf)
 from .errors import *  # noqa: F401,F403
 from .flags import FLAGS, define_flag, get_flags, set_flags  # noqa: F401
 from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401

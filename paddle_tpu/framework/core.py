@@ -313,8 +313,36 @@ def _unwrap_index(idx):
 # dispatch: run an op eagerly, record vjp for backward
 # ----------------------------------------------------------------------
 
+_dispatch_wrapper: Optional[Callable] = None
+_backward_event: Optional[Callable] = None  # profiler RecordEvent factory
+
+
+def _set_dispatch_wrapper(w: Optional[Callable]):
+    """Install/remove an instrumentation wrapper around eager dispatch.
+
+    Used by the profiler (per-op host timing, FLAGS_benchmark sync) and the
+    nan/inf checker — the analog of the RecordEvent + CheckOpHasNanOrInf
+    instrumentation inside OperatorWithKernel::RunImpl (reference
+    framework/operator.cc:1108,1195). ``w`` is called as
+    ``w(impl, fn, args, kwargs, op_name)`` and must return impl's result.
+    """
+    global _dispatch_wrapper
+    _dispatch_wrapper = w
+
+
 def _apply(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
            **kwargs) -> Any:
+    """Single eager-dispatch choke point (Tracer::TraceOp analog); forwards
+    to ``_apply_impl``, via the installed instrumentation wrapper if any."""
+    w = _dispatch_wrapper
+    if w is not None:
+        return w(_apply_impl, fn, args, kwargs, op_name)
+    return _apply_impl(fn, *args, op_name=op_name, n_outputs=n_outputs,
+                       **kwargs)
+
+
+def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
+                **kwargs) -> Any:
     """Execute ``fn`` over the jax values of ``args``; record a GradNode.
 
     This is the single choke point every op goes through — the analog of
@@ -418,7 +446,14 @@ def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
         for i, (shape, dt) in enumerate(node.out_avals):
             full.append(buf[i] if buf[i] is not None else jnp.zeros(shape, dt))
         arg = tuple(full) if len(full) > 1 else full[0]
-        in_grads = node.vjp_fn(arg)
+        ev = _backward_event
+        if ev is not None:
+            # per-grad-op host event, the analog of the reference profiling
+            # each backward op in BasicEngine (RecordEvent in RunImpl)
+            with ev(f"{node.name}_grad"):
+                in_grads = node.vjp_fn(arg)
+        else:
+            in_grads = node.vjp_fn(arg)
         if not retain_graph:
             node.vjp_fn = None  # free residuals
         for parent, g in zip(node.parents, in_grads):

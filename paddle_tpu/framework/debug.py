@@ -1,0 +1,109 @@
+"""Numeric debugging: nan/inf scanning of eager op outputs.
+
+TPU-native analog of the reference's numeric sanitizer (SURVEY §5.2):
+``FLAGS_check_nan_inf`` (reference platform/flags.cc:44) makes the runtime
+scan every op's outputs after execution (reference framework/operator.cc:
+1195-1197 CheckOpHasNanOrInf, impl framework/details/nan_inf_utils_detail.cc)
+and abort with the op name on the first nan/inf. Per-op and per-var skip
+lists come from env vars like the reference
+(PADDLE_INF_NAN_SKIP_OP / PADDLE_INF_NAN_SKIP_VAR).
+
+Under ``jax.jit`` tracing there is no per-op host hook; for compiled code
+``enable_check_nan_inf`` also flips ``jax_debug_nans`` so XLA-compiled
+programs re-raise on nan production — together the two cover both execution
+modes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Set
+
+import jax
+import jax.numpy as jnp
+
+from . import core as _core
+from . import flags as _flags
+
+__all__ = ["enable_check_nan_inf", "disable_check_nan_inf",
+           "nan_inf_enabled", "check_numerics"]
+
+
+def _skip_set(env: str) -> Set[str]:
+    v = os.environ.get(env, "")
+    return {s.strip() for s in v.split(",") if s.strip()}
+
+
+def nan_inf_enabled() -> bool:
+    return bool(_flags.FLAGS.check_nan_inf)
+
+
+def enable_check_nan_inf(debug_jit: bool = True):
+    """Turn on post-op nan/inf scanning for eager mode; with ``debug_jit``
+    also arm jax_debug_nans for compiled programs."""
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+    if debug_jit:
+        jax.config.update("jax_debug_nans", True)
+    _reinstall()
+
+
+def disable_check_nan_inf():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+    try:
+        jax.config.update("jax_debug_nans", False)
+    except Exception:
+        pass
+    _reinstall()
+
+
+def _reinstall():
+    from ..utils import profiler as _prof
+    _prof._install()
+
+
+def check_numerics(value, name: str = "tensor"):
+    """Raise FloatingPointError if ``value`` holds nan/inf (parity:
+    the CheckVarHasNanOrInf entry, framework/details/nan_inf_utils.h)."""
+    v = getattr(value, "_value", value)
+    if isinstance(v, jax.core.Tracer):
+        return value  # under jit: jax_debug_nans covers compiled programs
+    try:
+        arr = jnp.asarray(v)
+    except Exception:
+        return value  # non-numeric
+    if not (jnp.issubdtype(arr.dtype, jnp.floating)
+            or jnp.issubdtype(arr.dtype, jnp.complexfloating)):
+        return value
+    if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
+        return value  # cross-host sharded: skip host scan
+    finite = bool(jnp.all(jnp.isfinite(arr)))
+    if not finite:
+        n_nan = int(jnp.isnan(arr).sum())
+        n_inf = int(jnp.isinf(arr).sum())
+        raise FloatingPointError(
+            f"Operator output '{name}' contains NaN/Inf "
+            f"(nan={n_nan}, inf={n_inf}, shape={list(arr.shape)}, "
+            f"dtype={arr.dtype}). Set PADDLE_INF_NAN_SKIP_OP to skip ops.")
+    return value
+
+
+def _maybe_check_nan_inf(op_name: str, out):
+    """Post-dispatch hook body shared with the profiler wrapper."""
+    if not nan_inf_enabled():
+        return
+    if op_name in _skip_set("PADDLE_INF_NAN_SKIP_OP"):
+        return
+    ts = out if isinstance(out, (tuple, list)) else (out,)
+    for t in ts:
+        v = getattr(t, "_value", t)
+        if isinstance(v, jax.core.Tracer):
+            continue  # under jit: jax_debug_nans covers it
+        check_numerics(v, op_name)
+
+
+def _checked_dispatch(impl, fn, args, kwargs, op_name):
+    """Dispatch wrapper installed when nan/inf checking is on but the
+    profiler is off (the profiler wrapper calls _maybe_check_nan_inf
+    itself so the two compose)."""
+    out = impl(fn, *args, op_name=op_name, **kwargs)
+    _maybe_check_nan_inf(op_name or getattr(fn, "__name__", "op"), out)
+    return out

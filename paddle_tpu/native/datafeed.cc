@@ -185,14 +185,14 @@ int64_t dfd_load(void* h, const char** paths, int n_files, int n_threads) {
   std::vector<std::thread> th;
   for (int w = 0; w < n_threads; ++w) th.emplace_back(work);
   for (auto& t : th) t.join();
-  bool all_ok = true;
-  for (int i = 0; i < n_files; ++i) {
-    if (!okv[i]) { all_ok = false; continue; }
-    append_store(f, parts[i]);
-  }
+  // all-or-nothing: appending the good files before reporting failure
+  // would leave partial data behind the IOError the caller raises
+  for (int i = 0; i < n_files; ++i)
+    if (!okv[i]) return -1;
+  for (int i = 0; i < n_files; ++i) append_store(f, parts[i]);
   f->order.clear();
   f->order_init = false;
-  return all_ok ? (int64_t)f->n_records : -1;
+  return (int64_t)f->n_records;
 }
 
 int64_t dfd_size(void* h) { return (int64_t)((Feed*)h)->n_records; }

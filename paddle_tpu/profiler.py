@@ -1,0 +1,18 @@
+"""paddle_tpu.profiler — top-level profiler namespace.
+
+Re-exports the host-event profiler + XLA trace API from
+``paddle_tpu.utils.profiler`` (reference exposes the profiler as
+python/paddle/fluid/profiler.py, re-exported as paddle.utils.profiler in
+the v2.0 namespace; later versions add paddle.profiler — both map here).
+"""
+from .utils.profiler import (  # noqa: F401
+    RecordEvent, export_chrome_tracing, profiler, profiler_summary,
+    reset_profiler, start_profiler, start_trace, stop_profiler, stop_trace,
+    trace,
+)
+
+__all__ = [
+    "RecordEvent", "start_profiler", "stop_profiler", "profiler",
+    "reset_profiler", "profiler_summary", "export_chrome_tracing",
+    "start_trace", "stop_trace", "trace",
+]

@@ -1,0 +1,74 @@
+"""paddle_tpu.utils — utilities (profiler, cpp extensions, misc helpers).
+
+Parity target: python/paddle/utils/ in the reference (deprecated decorator,
+download, install_check, cpp_extension) plus the profiler entry point
+(reference python/paddle/fluid/profiler.py re-exported as
+paddle.utils.profiler in the v2.0 API).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import profiler  # noqa: F401
+
+__all__ = ["cpp_extension", "profiler", "deprecated", "run_check",
+           "try_import"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator marking an API deprecated (parity:
+    python/paddle/utils/deprecated.py)."""
+
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str):
+    """Import a soft dependency with a clear error (parity:
+    python/paddle/utils/lazy_import.py)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"Optional dependency '{module_name}' is required for this "
+            f"feature but is not installed") from e
+
+
+def run_check():
+    """Sanity-check the installation: run one fused train-ish step through
+    XLA on the default device (parity: python/paddle/utils/install_check.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(w, x):
+        y = jnp.tanh(x @ w)
+        return y.sum()
+
+    x = jnp.ones((8, 16), jnp.float32)
+    w = jnp.ones((16, 4), jnp.float32)
+    out = step(w, x)
+    out.block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! "
+          f"(checked one jit step on {dev.platform}:{dev.id})")
+    return True

@@ -1,0 +1,268 @@
+"""Runtime-compiled C++ custom operators.
+
+TPU-native re-design of the reference custom-op extension mechanism
+(SURVEY §2.1): the reference ships a header-only C++ op ABI
+(paddle/extension.h, registered through framework/custom_operator.cc) and a
+Python JIT builder (python/paddle/utils/cpp_extension/) that compiles user
+.cc/.cu files and registers them as first-class operators.
+
+On TPU, user native code cannot run *on the device* — device-side custom
+kernels are written in Pallas (see paddle_tpu/ops/flash_attention.py for
+the exemplar). What this module provides is the host-side half, which is
+what the reference's CPU custom ops are:
+
+- ``load(name, sources)`` compiles C++ sources with the system toolchain
+  into a shared library (content-hash cached, like the reference's
+  versioned build dir) and returns a :class:`CustomOpLibrary`.
+- ``CustomOpLibrary.elementwise_op`` / ``def_op`` wrap an exported
+  ``extern "C"`` symbol as a paddle_tpu eager op. Eagerly the kernel runs
+  directly over numpy buffers via ctypes; under ``jax.jit`` the same op is
+  staged through ``jax.pure_callback`` so compiled programs keep working
+  (the host round-trip is the TPU analog of the reference's CPU-kernel
+  fallback + data transform, framework/data_device_transform.cc).
+- a backward can be attached with ``op.def_grad`` — registered as a
+  ``jax.custom_vjp`` so autograd (eager tape and jit) both see it, the
+  analog of the reference's grad-op maker for custom ops
+  (framework/custom_operator.cc RegisterOperatorWithMetaInfo).
+
+C symbol convention (the "extension ABI"): rank-erased flat buffers,
+
+    extern "C" void op(const void** ins, void* out, const int64_t* n_elems);
+
+for ``def_op``; or the simpler unary/binary elementwise forms
+
+    extern "C" void op(const float* x, float* y, int64_t n);
+    extern "C" void op(const float* x, const float* b, float* y, int64_t n);
+
+for ``elementwise_op``.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "get_build_directory", "CppExtension", "CUDAExtension",
+           "BuildExtension", "setup", "CustomOpLibrary"]
+
+_LOCK = threading.Lock()
+_LIB_CACHE = {}
+
+
+def get_build_directory() -> str:
+    """Build cache dir (parity: utils/cpp_extension/extension_utils.py
+    get_build_directory; env override like PADDLE_EXTENSION_DIR)."""
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"paddle_tpu_extensions_{os.getuid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(name: str, sources: Sequence[str], extra_cxx_flags=(),
+             extra_ldflags=(), verbose=False) -> str:
+    blobs = []
+    for s in sources:
+        with open(s, "rb") as f:
+            blobs.append(f.read())
+    digest = hashlib.sha256(b"\0".join(blobs)).hexdigest()[:16]
+    out = os.path.join(get_build_directory(), f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *extra_cxx_flags, *sources, "-o", f"{out}.{os.getpid()}.tmp",
+           *extra_ldflags]
+    if verbose:
+        print("cpp_extension:", " ".join(cmd), file=sys.stderr)
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"compiling extension '{name}' failed:\n{r.stderr[-4000:]}")
+    os.replace(f"{out}.{os.getpid()}.tmp", out)
+    return out
+
+
+class CustomOp:
+    """One registered custom operator, callable on paddle_tpu Tensors."""
+
+    def __init__(self, lib: "CustomOpLibrary", symbol: str,
+                 fwd: Callable, name: str):
+        self._lib = lib
+        self.name = name
+        self._grad_fn: Optional[Callable] = None
+        self._build(fwd)
+
+    def _build(self, host_fn):
+        import jax
+
+        def _callback_op(*arrs):
+            # staged path: identical host kernel through pure_callback
+            shape_dtype = jax.ShapeDtypeStruct(arrs[0].shape, arrs[0].dtype)
+            return jax.pure_callback(
+                lambda *a: host_fn(*[np.asarray(x) for x in a]),
+                shape_dtype, *arrs, vmap_method="sequential")
+
+        fwd = jax.custom_vjp(_callback_op)
+
+        def _fwd(*arrs):
+            return _callback_op(*arrs), arrs
+
+        def _bwd(res, g):
+            if self._grad_fn is None:
+                raise NotImplementedError(
+                    f"custom op '{self.name}' has no backward; call "
+                    f"def_grad(fn) to register one")
+            grads = self._grad_fn(*res, g)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            return tuple(grads)
+
+        fwd.defvjp(_fwd, _bwd)
+        self._jax_fn = fwd
+        self._host_fn = host_fn
+
+    def def_grad(self, grad_fn: Callable):
+        """Register backward: ``grad_fn(*inputs, cotangent) -> grads``
+        written in jax-traceable Python (or another custom op)."""
+        self._grad_fn = grad_fn
+        return self
+
+    # __call__ installed below (needs framework.core; late import keeps
+    # this module importable before the package finishes initialising)
+
+
+def _customop_call(self, *tensors):
+    from ..framework.core import Tensor, _apply
+    import jax
+
+    args = [t for t in tensors]
+    vals = [t._value if isinstance(t, Tensor) else np.asarray(t)
+            for t in args]
+    eager = not any(isinstance(v, jax.core.Tracer) for v in vals)
+    if eager and self._grad_fn is None:
+        # fast path: run the C kernel directly on host buffers
+        needs_grad = any(isinstance(t, Tensor) and not t.stop_gradient
+                         for t in args)
+        if not needs_grad:
+            out = self._host_fn(*[np.asarray(v) for v in vals])
+            return Tensor(jax.numpy.asarray(out))
+    return _apply(self._jax_fn, *args, op_name=self.name)
+
+
+CustomOp.__call__ = _customop_call
+
+
+class CustomOpLibrary:
+    """A loaded extension .so with op-wrapping helpers."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self._cdll = ctypes.CDLL(path)
+        self._ops = {}
+
+    def elementwise_op(self, symbol: str, dtype=np.float32,
+                       arity: int = 1, op_name: Optional[str] = None):
+        """Wrap ``extern "C" void sym(const T* x[, const T* y], T* out,
+        int64_t n)`` as an op producing output shaped like input 0."""
+        cfn = getattr(self._cdll, symbol)
+        ctype = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+        cfn.restype = None
+        cfn.argtypes = [ctype] * arity + [ctype, ctypes.c_int64]
+
+        def host_fn(*arrs):
+            arrs = [np.ascontiguousarray(a, dtype=dtype) for a in arrs]
+            out = np.empty_like(arrs[0])
+            cfn(*arrs, out, arrs[0].size)
+            return out
+
+        op = CustomOp(self, symbol, host_fn, op_name or symbol)
+        self._ops[op.name] = op
+        setattr(self, op.name, op)
+        return op
+
+    def def_op(self, symbol: str, out_shape_fn: Callable,
+               out_dtype=np.float32, op_name: Optional[str] = None):
+        """Wrap the general ABI ``void sym(const void** ins, void* out,
+        const int64_t* n_elems)``; ``out_shape_fn(*in_shapes)`` gives the
+        output shape (the InferShapeFn of the reference custom-op ABI)."""
+        cfn = getattr(self._cdll, symbol)
+        cfn.restype = None
+        cfn.argtypes = [ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+                        ctypes.POINTER(ctypes.c_int64)]
+
+        def host_fn(*arrs):
+            arrs = [np.ascontiguousarray(a) for a in arrs]
+            shape = out_shape_fn(*[a.shape for a in arrs])
+            out = np.empty(shape, dtype=out_dtype)
+            ins = (ctypes.c_void_p * len(arrs))(
+                *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+            nel = (ctypes.c_int64 * (len(arrs) + 1))(
+                *[a.size for a in arrs], out.size)
+            cfn(ins, out.ctypes.data_as(ctypes.c_void_p), nel)
+            return out
+
+        op = CustomOp(self, symbol, host_fn, op_name or symbol)
+        self._ops[op.name] = op
+        setattr(self, op.name, op)
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=(),
+         extra_ldflags=(), verbose: bool = False,
+         build_directory: Optional[str] = None) -> CustomOpLibrary:
+    """Compile + load a custom-op extension (parity:
+    python/paddle/utils/cpp_extension/cpp_extension.py load())."""
+    if build_directory:
+        os.environ["PADDLE_TPU_EXTENSION_DIR"] = build_directory
+    key = (name, tuple(sources))
+    with _LOCK:
+        if key in _LIB_CACHE:
+            return _LIB_CACHE[key]
+        path = _compile(name, sources, extra_cxx_flags, extra_ldflags,
+                        verbose)
+        lib = CustomOpLibrary(name, path)
+        _LIB_CACHE[key] = lib
+        return lib
+
+
+# ----------------------------------------------------------------------
+# setuptools-style API (parity surface; build-time install path)
+# ----------------------------------------------------------------------
+
+def CppExtension(sources: List[str], *args, **kwargs):
+    """setuptools.Extension factory (parity: cpp_extension.CppExtension)."""
+    from setuptools import Extension
+    name = kwargs.pop("name", "paddle_tpu_custom_ops")
+    return Extension(name, sources, *args, **kwargs)
+
+
+def CUDAExtension(sources: List[str], *args, **kwargs):
+    """Accepted for porting convenience; CUDA sources cannot target TPU —
+    .cu files are rejected, plain C++ ones build as CppExtension."""
+    cu = [s for s in sources if s.endswith(".cu")]
+    if cu:
+        raise RuntimeError(
+            f"CUDAExtension: CUDA sources {cu} cannot run on TPU; port the "
+            f"kernel to Pallas (device) or C++ (host) instead")
+    return CppExtension(sources, *args, **kwargs)
+
+
+def BuildExtension(*args, **kwargs):
+    from setuptools.command.build_ext import build_ext
+    return build_ext
+
+
+def setup(**attrs):
+    """Thin re-export of setuptools.setup (parity: cpp_extension.setup)."""
+    import setuptools
+    return setuptools.setup(**attrs)

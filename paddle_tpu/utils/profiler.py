@@ -1,0 +1,244 @@
+"""Host-side op profiler + XLA device trace hooks.
+
+TPU-native re-design of the reference profiler stack (SURVEY §5.1):
+
+- ``RecordEvent``      <- RAII host event (reference platform/profiler.h:127),
+  nested events form a stack per thread, aggregated into per-name tables.
+- eager-op instrumentation <- the RecordEvent calls inside
+  OperatorWithKernel::RunImpl (reference framework/operator.cc:1108,1124,1137)
+  and Tracer::TraceOp (imperative/tracer.cc:136): every eager op dispatched
+  through ``framework.core._apply`` is timed while profiling is on.
+- ``start_profiler/stop_profiler/profiler()`` <- EnableProfiler /
+  DisableProfiler + the fluid.profiler context manager
+  (reference platform/profiler.h:210-213, python/paddle/fluid/profiler.py);
+  ``stop_profiler`` prints a per-op table sorted by total/max/ave/calls.
+- chrome-tracing export <- DeviceTracer timeline + tools/timeline.py:
+  ``export_chrome_tracing`` writes chrome://tracing JSON directly (no
+  separate conversion tool needed).
+- device-side tracing: instead of CUPTI (reference platform/device_tracer.cc)
+  the XLA/TPU trace comes from ``jax.profiler`` — ``start_trace/stop_trace``
+  wrap it so one API yields a TensorBoard-viewable device timeline.
+- ``FLAGS_benchmark``   <- per-op device sync for accurate timing
+  (reference framework/operator.cc:1164, platform/flags.cc FLAGS_benchmark).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from ..framework import flags as _flags
+from ..framework import core as _core
+
+__all__ = [
+    "RecordEvent", "start_profiler", "stop_profiler", "profiler",
+    "reset_profiler", "profiler_summary", "export_chrome_tracing",
+    "start_trace", "stop_trace", "trace",
+]
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+_enabled = False
+_trace_events: List[dict] = []     # chrome-tracing "X" events
+_stats: Dict[str, List[float]] = {}  # name -> [calls, total_s, max_s, min_s]
+_t0 = 0.0
+
+
+def _record(name: str, start: float, end: float):
+    dur = end - start
+    with _lock:
+        s = _stats.get(name)
+        if s is None:
+            _stats[name] = [1, dur, dur, dur]
+        else:
+            s[0] += 1
+            s[1] += dur
+            s[2] = max(s[2], dur)
+            s[3] = min(s[3], dur)
+        _trace_events.append({
+            "name": name, "ph": "X", "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "ts": (start - _t0) * 1e6, "dur": dur * 1e6,
+        })
+
+
+class RecordEvent:
+    """Named host-side event; context manager or explicit begin/end.
+
+    Parity: platform/profiler.h:127 RecordEvent (RAII) — events recorded
+    only while the profiler is enabled, and nest naturally.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+
+    def begin(self):
+        if _enabled:
+            self._start = time.perf_counter()
+        return self
+
+    def end(self):
+        if self._start is not None:
+            _record(self.name, self._start, time.perf_counter())
+            self._start = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def _profiled_dispatch(impl, fn, args, kwargs, op_name):
+    """Instrumentation installed around framework.core._apply.
+
+    Times each eager op; under FLAGS_benchmark also blocks on the outputs so
+    the host clock covers device execution (reference operator.cc:1164).
+    Composes with the nan/inf checker (framework.debug) which installs its
+    own wrapper when profiling is off.
+    """
+    name = op_name or getattr(fn, "__name__", "op")
+    t0 = time.perf_counter()
+    out = impl(fn, *args, op_name=op_name, **kwargs)
+    if _flags.FLAGS.benchmark:
+        _block_on(out)
+    _record(name, t0, time.perf_counter())
+    from ..framework.debug import _maybe_check_nan_inf
+    _maybe_check_nan_inf(name, out)
+    return out
+
+
+def _block_on(out):
+    ts = out if isinstance(out, (tuple, list)) else (out,)
+    for t in ts:
+        v = getattr(t, "_value", t)
+        if hasattr(v, "block_until_ready"):
+            try:
+                v.block_until_ready()
+            except Exception:
+                pass  # tracers under jit have no device buffer
+
+
+def reset_profiler():
+    """Drop all recorded events/stats (parity: fluid.profiler.reset_profiler)."""
+    global _t0
+    with _lock:
+        _stats.clear()
+        _trace_events.clear()
+    _t0 = time.perf_counter()
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """Begin collecting host events (parity: fluid.profiler.start_profiler;
+    EnableProfiler reference platform/profiler.h:210). ``state``/
+    ``tracer_option`` accepted for API parity; host events are always
+    collected, device timelines come from start_trace()."""
+    global _enabled
+    reset_profiler()
+    _enabled = True
+    _install()
+
+
+def _install():
+    from ..framework import debug as _debug
+    if _enabled:
+        _core._set_dispatch_wrapper(_profiled_dispatch)
+        _core._backward_event = RecordEvent
+    elif _debug.nan_inf_enabled():
+        _core._set_dispatch_wrapper(_debug._checked_dispatch)
+        _core._backward_event = None
+    else:
+        _core._set_dispatch_wrapper(None)
+        _core._backward_event = None
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None):
+    """Stop collecting and print the per-op summary table; optionally dump
+    chrome-tracing JSON to ``profile_path`` (parity:
+    fluid.profiler.stop_profiler + tools/timeline.py output)."""
+    global _enabled
+    _enabled = False
+    _install()
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    print(profiler_summary(sorted_key=sorted_key))
+
+
+def profiler_summary(sorted_key: Optional[str] = "total") -> str:
+    """Per-op event table sorted by total/max/min/ave/calls time — the
+    analog of the reference's printed profiler report."""
+    with _lock:
+        rows = [(name, int(s[0]), s[1], s[2], s[3], s[1] / s[0])
+                for name, s in _stats.items()]
+    keyidx = {"calls": 1, "total": 2, "max": 3, "min": 4, "ave": 5}.get(
+        sorted_key or "total", 2)
+    rows.sort(key=lambda r: r[keyidx], reverse=True)
+    head = (f"{'Event':<32}{'Calls':>8}{'Total(ms)':>12}{'Max(ms)':>10}"
+            f"{'Min(ms)':>10}{'Ave(ms)':>10}")
+    lines = ["------------------------- Profiling Report "
+             "-------------------------", head]
+    for name, calls, total, mx, mn, ave in rows:
+        lines.append(f"{name[:31]:<32}{calls:>8}{total * 1e3:>12.3f}"
+                     f"{mx * 1e3:>10.3f}{mn * 1e3:>10.3f}{ave * 1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str):
+    """Write recorded host events as chrome://tracing JSON."""
+    with _lock:
+        data = {"traceEvents": list(_trace_events),
+                "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None, tracer_option: str = "Default"):
+    """``with profiler.profiler(): ...`` context (parity:
+    python/paddle/fluid/profiler.py profiler())."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)
+
+
+# ----------------------------------------------------------------------
+# device-side (XLA) tracing — replaces the CUPTI DeviceTracer
+# ----------------------------------------------------------------------
+
+def start_trace(logdir: str):
+    """Start a jax/XLA device trace viewable in TensorBoard (replaces the
+    reference's CUPTI device tracer, platform/device_tracer.cc:57)."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace():
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+# honour FLAGS_check_nan_inf set from the environment at import
+# (reference parses FLAGS_* env at import, python/paddle/fluid/__init__.py)
+_install()

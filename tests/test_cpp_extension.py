@@ -1,0 +1,94 @@
+"""Custom C++ op extension tests (SURVEY §2.1 custom_operator.cc,
+python/paddle/utils/cpp_extension/).
+
+Behavior modeled on the reference's custom-op test flow
+(python/paddle/fluid/tests/custom_op/): compile a .cc at test time with
+the system toolchain, register forward (+ backward), check eager call,
+autograd, and jit-staged execution.
+"""
+import os
+import shutil
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+pytestmark = pytest.mark.skipif(
+    shutil.which(os.environ.get("CXX", "g++")) is None,
+    reason="no C++ toolchain")
+
+_SRC = textwrap.dedent("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" void custom_relu_f32(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+    }
+    extern "C" void custom_addmul_f32(const float* x, const float* b,
+                                      float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) y[i] = x[i] * 2.f + b[i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def lib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "custom_ops.cc"
+    src.write_text(_SRC)
+    return cpp_extension.load("custom_ops_test", [str(src)],
+                              build_directory=str(d / "build"))
+
+
+def test_eager_forward(lib):
+    relu = lib.elementwise_op("custom_relu_f32", op_name="custom_relu")
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], dtype="float32"))
+    out = relu(x)
+    np.testing.assert_allclose(out.numpy(), [0.0, 2.0, 0.0, 4.0])
+
+
+def test_binary_op(lib):
+    addmul = lib.elementwise_op("custom_addmul_f32", arity=2)
+    x = paddle.to_tensor(np.ones(4, dtype="float32"))
+    b = paddle.to_tensor(np.arange(4, dtype="float32"))
+    np.testing.assert_allclose(addmul(x, b).numpy(), [2.0, 3.0, 4.0, 5.0])
+
+
+def test_backward_via_def_grad(lib):
+    relu = lib.elementwise_op("custom_relu_f32", op_name="custom_relu_g")
+    relu.def_grad(lambda x, g: g * (x > 0).astype(g.dtype))
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], dtype="float32"),
+                         stop_gradient=False)
+    y = relu(x)
+    y.backward(paddle.to_tensor(np.ones(4, dtype="float32")))
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0, 1.0])
+
+
+def test_under_jit(lib):
+    import jax
+    import jax.numpy as jnp
+    relu = lib.elementwise_op("custom_relu_f32", op_name="custom_relu_jit")
+    relu.def_grad(lambda x, g: g * (x > 0).astype(g.dtype))
+
+    @jax.jit
+    def f(a):
+        return relu._jax_fn(a) * 3.0
+
+    out = f(jnp.asarray([-2.0, 5.0], dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 15.0])
+
+
+def test_missing_grad_raises(lib):
+    relu = lib.elementwise_op("custom_relu_f32", op_name="custom_relu_ng")
+    x = paddle.to_tensor(np.array([1.0, -1.0], dtype="float32"),
+                         stop_gradient=False)
+    y = relu(x)
+    with pytest.raises(NotImplementedError, match="no backward"):
+        y.backward()
+
+
+def test_cuda_extension_rejected():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp_extension.CUDAExtension(["kernel.cu"])
