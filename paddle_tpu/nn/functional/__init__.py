@@ -6,9 +6,12 @@ from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
 
-from . import activation, attention, common, conv, loss, pooling, vision  # noqa: F401
+from . import (activation, attention, common, conv, loss, pooling,  # noqa: F401
+               sequence, vision)
 
 __all__ = (activation.__all__ + attention.__all__ + common.__all__ +
-           conv.__all__ + loss.__all__ + pooling.__all__ + vision.__all__)
+           conv.__all__ + loss.__all__ + pooling.__all__ +
+           sequence.__all__ + vision.__all__)

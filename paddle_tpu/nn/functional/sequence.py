@@ -1,0 +1,181 @@
+"""Sequence ops — TPU-native replacement for the reference's LoD machinery.
+
+The reference represents variable-length batches as LoDTensor (ragged
+offsets, framework/lod_tensor.h:114) with ~30 LoD kernels under
+operators/sequence_ops/ (sequence_pool_op.cc, sequence_pad_op.cc,
+sequence_mask_op.cc, sequence_softmax_op.cc, sequence_expand_op.cc …).
+Ragged offsets force dynamic shapes, which XLA cannot tile onto the MXU —
+so the TPU-native representation is **dense padded [batch, max_len, ...] +
+a lengths vector**, and every op is a masked dense computation with static
+shapes. Autograd flows through the standard eager tape (and the same code
+traces under jit).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply, to_tensor
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_pool", "sequence_softmax", "sequence_expand",
+           "sequence_first_step", "sequence_last_step"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _len_val(lengths):
+    return lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="int64",
+                  name=None) -> Tensor:
+    """[batch] lengths -> [batch, maxlen] 0/1 mask (parity:
+    operators/sequence_ops/sequence_mask_op.cc, fluid.layers.sequence_mask).
+    """
+    lv = _len_val(lengths)
+    if maxlen is None:
+        maxlen = int(np.asarray(lv).max()) if lv.size else 0
+    from ...framework import dtype as dtypes
+    jd = dtypes.to_jax(dtype)
+
+    def fn(l):
+        return (jnp.arange(maxlen)[None, :] < l[..., None]).astype(jd)
+
+    return _apply(fn, _t(lengths), op_name="sequence_mask")
+
+
+def sequence_pad(x, lengths, pad_value=0.0, maxlen: Optional[int] = None,
+                 name=None):
+    """Packed [total, ...] rows + lengths -> (padded [batch, maxlen, ...],
+    lengths) (parity: operators/sequence_ops/sequence_pad_op.cc; the
+    LoDTensor input becomes the packed-rows + lengths pair)."""
+    x = _t(x)
+    lv = np.asarray(_len_val(lengths)).astype(np.int64)
+    if maxlen is None:
+        maxlen = int(lv.max()) if lv.size else 0
+    batch = lv.shape[0]
+    # gather indices computed on host: shapes are static given lengths
+    idx = np.zeros((batch, maxlen), np.int32)
+    valid = np.zeros((batch, maxlen), bool)
+    off = 0
+    for b, n in enumerate(lv.tolist()):
+        n = int(n)
+        keep = min(n, maxlen)  # truncate the copy, NOT the packed offset
+        idx[b, :keep] = np.arange(off, off + keep)
+        valid[b, :keep] = True
+        off += n
+
+    def fn(xv):
+        g = xv[idx.reshape(-1)].reshape((batch, maxlen) + xv.shape[1:])
+        m = jnp.asarray(valid).reshape((batch, maxlen) + (1,) * (xv.ndim - 1))
+        return jnp.where(m, g, jnp.asarray(pad_value, xv.dtype))
+
+    out = _apply(fn, x, op_name="sequence_pad")
+    return out, to_tensor(lv)
+
+
+def sequence_unpad(x, lengths, name=None) -> Tensor:
+    """Padded [batch, maxlen, ...] -> packed [total, ...] (parity:
+    operators/sequence_ops/sequence_unpad_op.cc). Output row count depends
+    on lengths, so this runs with concrete lengths (eager / host)."""
+    x = _t(x)
+    lv = np.asarray(_len_val(lengths)).astype(np.int64)
+    rows = []
+    for b, n in enumerate(lv.tolist()):
+        rows.append(np.arange(b * x.shape[1], b * x.shape[1] + int(n)))
+    flat_idx = np.concatenate(rows) if rows else np.zeros((0,), np.int64)
+
+    def fn(xv):
+        f = xv.reshape((-1,) + xv.shape[2:])
+        return f[flat_idx]
+
+    return _apply(fn, x, op_name="sequence_unpad")
+
+
+def sequence_pool(x, lengths, pool_type: str = "sum", name=None) -> Tensor:
+    """Masked pooling over the time axis of padded [batch, maxlen, ...]
+    (parity: operators/sequence_ops/sequence_pool_op.cc — SUM/MEAN/MAX/
+    SQRT/FIRST/LAST variants)."""
+    x = _t(x)
+    pool = pool_type.lower()
+    maxlen = x.shape[1]
+    ln = _t(lengths)
+
+    def fn(xv, lv):
+        m = (jnp.arange(maxlen)[None, :] < lv[:, None])
+        mf = m.reshape(m.shape + (1,) * (xv.ndim - 2)).astype(xv.dtype)
+        if pool == "sum":
+            return (xv * mf).sum(axis=1)
+        if pool == "average" or pool == "mean":
+            d = jnp.maximum(lv, 1).astype(xv.dtype)
+            return (xv * mf).sum(axis=1) / d.reshape(
+                (-1,) + (1,) * (xv.ndim - 2))
+        if pool == "sqrt":
+            d = jnp.sqrt(jnp.maximum(lv, 1).astype(xv.dtype))
+            return (xv * mf).sum(axis=1) / d.reshape(
+                (-1,) + (1,) * (xv.ndim - 2))
+        if pool == "max":
+            neg = jnp.asarray(jnp.finfo(xv.dtype).min
+                              if jnp.issubdtype(xv.dtype, jnp.floating)
+                              else jnp.iinfo(xv.dtype).min, xv.dtype)
+            return jnp.where(mf.astype(bool), xv, neg).max(axis=1)
+        if pool == "first":
+            return xv[:, 0]
+        if pool == "last":
+            i = jnp.maximum(lv - 1, 0)
+            return jnp.take_along_axis(
+                xv, i.reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
+            ).squeeze(1)
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return _apply(fn, x, ln, op_name=f"sequence_pool_{pool}")
+
+
+def sequence_softmax(x, lengths, name=None) -> Tensor:
+    """Masked softmax along axis 1 of padded [batch, maxlen, ...] (parity:
+    operators/sequence_ops/sequence_softmax_op.cc); padding positions get
+    probability 0."""
+    import jax
+
+    x = _t(x)
+    maxlen = x.shape[1]
+
+    def fn(xv, lv):
+        m = (jnp.arange(maxlen)[None, :] < lv[:, None])
+        m = m.reshape(m.shape + (1,) * (xv.ndim - 2))
+        neg = jnp.asarray(jnp.finfo(xv.dtype).min, xv.dtype)
+        z = jnp.where(m, xv, neg)
+        z = z - jax.lax.stop_gradient(z.max(axis=1, keepdims=True))
+        e = jnp.where(m, jnp.exp(z), 0.0)
+        return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+
+    return _apply(fn, x, _t(lengths), op_name="sequence_softmax")
+
+
+def sequence_expand(x, ref_lengths, name=None) -> Tensor:
+    """Repeat row b of ``x`` ref_lengths[b] times (parity:
+    operators/sequence_ops/sequence_expand_op.cc in its common
+    one-level-LoD use). Concrete lengths required (dynamic output rows)."""
+    x = _t(x)
+    lv = np.asarray(_len_val(ref_lengths)).astype(np.int64)
+    idx = np.repeat(np.arange(lv.shape[0]), lv)
+
+    def fn(xv):
+        return xv[idx]
+
+    return _apply(fn, x, op_name="sequence_expand")
+
+
+def sequence_first_step(x, lengths=None, name=None) -> Tensor:
+    n = lengths if lengths is not None else np.full((_t(x).shape[0],),
+                                                    _t(x).shape[1])
+    return sequence_pool(x, n, "first")
+
+
+def sequence_last_step(x, lengths, name=None) -> Tensor:
+    return sequence_pool(x, lengths, "last")

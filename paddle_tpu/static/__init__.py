@@ -12,10 +12,11 @@ from __future__ import annotations
 from typing import Optional
 
 from .input_spec import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "Executor",
-           "CompiledProgram", "name_scope", "data",
+           "CompiledProgram", "name_scope", "data", "nn",
            "save_inference_model", "load_inference_model"]
 
 

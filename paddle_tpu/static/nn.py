@@ -1,0 +1,217 @@
+"""paddle_tpu.static.nn — control flow + static-graph layer helpers.
+
+TPU-native control flow (SURVEY §2.3 "Control flow"): the reference
+implements cond/while as *nested-block ops* executed by a sub-Executor
+(operators/controlflow/conditional_block_op.cc, while_op.cc,
+fluid/layers/control_flow.py). Under XLA, data-dependent control flow
+inside a compiled program must be ``lax.cond/while_loop/switch`` — Python
+``if`` on a traced value cannot trace. These wrappers behave like plain
+Python in eager mode (so the autograd tape records the taken branch) and
+lower to the XLA constructs when tracing under jit/to_static.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "fc",
+           "sequence_pool", "sequence_mask", "sequence_pad",
+           "sequence_unpad", "sequence_softmax", "sequence_expand",
+           "sequence_first_step", "sequence_last_step"]
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(x, (jnp.ndarray, jax.Array)) or
+        isinstance(x, jax.core.Tracer) else x, tree)
+
+
+def _pred_value(pred):
+    v = pred._value if isinstance(pred, Tensor) else pred
+    if isinstance(v, (bool, int)):
+        return bool(v), False
+    if isinstance(v, jax.core.Tracer):
+        return v, True
+    return bool(v), False  # concrete jax array -> python bool
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """Run ``true_fn()`` or ``false_fn()`` on ``pred`` (parity:
+    fluid/layers/control_flow.py cond -> conditional_block_op.cc).
+
+    Eager: Python branch, tape records the taken side. Traced: lax.cond —
+    both branches staged, XLA picks at runtime (compiler-friendly, no
+    recompile per value).
+    """
+    v, traced = _pred_value(pred)
+    if not traced:
+        return true_fn() if v else false_fn()
+    out = jax.lax.cond(
+        jnp.asarray(v, jnp.bool_),
+        lambda _: _unwrap(true_fn()),
+        lambda _: _unwrap(false_fn()),
+        operand=None)
+    return _wrap(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None):
+    """Parity: fluid/layers/control_flow.py while_loop -> while_op.cc.
+
+    Eager: a Python while (differentiable through the tape). Traced:
+    lax.while_loop — single compiled body, no unrolling (the XLA-native
+    scheme; note reverse-mode through a traced while is not defined, same
+    restriction as the reference's while grad in inference/test graphs —
+    use lax.scan-style fixed trip counts for differentiable loops).
+    """
+    loop_vars = list(loop_vars)
+    probe = cond_fn(*loop_vars)
+    v, traced = _pred_value(probe)
+    if not traced:
+        # fully eager python loop
+        keep = v
+        while keep:
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (tuple, list)) else [out]
+            keep, t2 = _pred_value(cond_fn(*loop_vars))
+            if t2:
+                raise ValueError(
+                    "while_loop predicate became traced mid-loop; run the "
+                    "whole loop under jit instead")
+        return loop_vars
+
+    def c(vals):
+        out = cond_fn(*_wrap(list(vals)))
+        return jnp.asarray(out._value if isinstance(out, Tensor) else out,
+                           jnp.bool_)
+
+    def b(vals):
+        out = body_fn(*_wrap(list(vals)))
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        return tuple(_unwrap(out))
+
+    res = jax.lax.while_loop(c, b, tuple(_unwrap(loop_vars)))
+    return [_wrap(r) for r in res]
+
+
+def case(pred_fn_pairs, default: Callable = None, name=None):
+    """First pair whose pred is true wins (parity:
+    fluid/layers/control_flow.py case)."""
+    pairs = list(pred_fn_pairs)
+    traced = any(_pred_value(p)[1] for p, _ in pairs)
+    if not traced:
+        for p, fn in pairs:
+            if _pred_value(p)[0]:
+                return fn()
+        # no default: the LAST pair's fn is the fallback (reference
+        # semantics, fluid/layers/control_flow.py case) — matches the
+        # traced lowering below
+        return default() if default is not None else pairs[-1][1]()
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    # lower as nested lax.cond
+    out = _unwrap(default())
+    for p, fn in reversed(pairs):
+        pv = jnp.asarray(_pred_value(p)[0], jnp.bool_)
+        out = jax.lax.cond(pv, lambda _, f=fn: _unwrap(f()),
+                           lambda _, o=out: o, operand=None)
+    return _wrap(out)
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """Integer-indexed dispatch (parity: fluid/layers/control_flow.py
+    switch_case). Traced form is one lax.switch."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        keys = list(range(len(branch_fns)))
+        fns = list(branch_fns)
+    iv_raw = branch_index._value if isinstance(branch_index, Tensor) \
+        else branch_index
+    traced = isinstance(iv_raw, jax.core.Tracer)
+    if not traced:
+        i = int(jnp.asarray(iv_raw))  # integer index, NOT a bool predicate
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is None:
+            raise ValueError(f"branch index {i} not found, no default")
+        return default()
+    if default is None:
+        default = fns[-1]
+    # map arbitrary keys onto a dense switch table
+    iv = branch_index._value if isinstance(branch_index, Tensor) \
+        else branch_index
+    table = [lambda _, f=default: _unwrap(f())]
+    sel = jnp.zeros((), jnp.int32)
+    for j, (k, fn) in enumerate(zip(keys, fns), start=1):
+        table.append(lambda _, f=fn: _unwrap(f()))
+        sel = jnp.where(jnp.asarray(iv) == k, j, sel)
+    return _wrap(jax.lax.switch(sel, table, None))
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation=None, name=None):
+    """Static-graph fully-connected helper (parity: paddle.static.nn.fc,
+    fluid/layers/nn.py fc). Stateless-by-trace: creates the layer once per
+    call site via the default Layer machinery is not needed here — static
+    users pass explicit sizes; we keep a module-level cache keyed by name.
+    """
+    import sys
+
+    from ..framework.core import _apply
+    from ..nn import Linear
+    import numpy as np
+
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    in_feat = int(np.prod(x.shape[num_flatten_dims:]))
+    # parameter reuse is per CALL SITE (like the reference, where each
+    # static fc() in the program text owns its parameters but the program
+    # is built once and re-run): unnamed calls key on caller file:line so
+    # a training loop re-invoking the same line reuses the same weights
+    # while two different fc lines stay independent.
+    if name is None:
+        fr = sys._getframe(1)
+        name = f"{fr.f_code.co_filename}:{fr.f_lineno}"
+    key = (name, in_feat, size)
+    layer = _FC_CACHE.get(key)
+    if layer is None:
+        layer = Linear(in_feat, size)
+        _FC_CACHE[key] = layer
+    lead = tuple(x.shape[:num_flatten_dims])
+    n_lead = int(np.prod(lead)) if lead else 1
+    # all reshapes/activations go through _apply so grads reach x and the
+    # cached Linear's parameters
+    flat = _apply(lambda v: v.reshape((n_lead, in_feat)), x,
+                  op_name="reshape")
+    out = layer(flat)
+    out = _apply(lambda v: v.reshape(lead + (size,)), out,
+                 op_name="reshape")
+    if activation == "relu":
+        out = _apply(lambda v: jnp.maximum(v, 0), out, op_name="relu")
+    elif activation == "tanh":
+        out = _apply(jnp.tanh, out, op_name="tanh")
+    elif activation is not None:
+        raise ValueError(f"unsupported fc activation {activation!r}")
+    return out
+
+
+_FC_CACHE = {}
+
+# sequence ops re-exported from functional (reference exposes them under
+# fluid.layers.sequence_* / paddle.static.nn.sequence_*)
+from ..nn.functional.sequence import (  # noqa: E402,F401
+    sequence_expand, sequence_first_step, sequence_last_step, sequence_mask,
+    sequence_pad, sequence_pool, sequence_softmax, sequence_unpad)

@@ -18,6 +18,9 @@ __all__ = [
 def _v(x):
     if isinstance(x, Tensor):
         return x._value
+    import jax
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return x  # tracers must not be concretised (jit-traced operands)
     return np.asarray(x)
 
 

@@ -68,16 +68,20 @@ def get_build_directory() -> str:
 
 
 def _compile(name: str, sources: Sequence[str], extra_cxx_flags=(),
-             extra_ldflags=(), verbose=False) -> str:
-    blobs = []
+             extra_ldflags=(), verbose=False,
+             build_directory: Optional[str] = None) -> str:
+    cxx = os.environ.get("CXX", "g++")
+    blobs = [cxx.encode(), repr(tuple(extra_cxx_flags)).encode(),
+             repr(tuple(extra_ldflags)).encode()]
     for s in sources:
         with open(s, "rb") as f:
             blobs.append(f.read())
     digest = hashlib.sha256(b"\0".join(blobs)).hexdigest()[:16]
-    out = os.path.join(get_build_directory(), f"{name}-{digest}.so")
+    out = os.path.join(build_directory or get_build_directory(),
+                       f"{name}-{digest}.so")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
     if os.path.exists(out):
         return out
-    cxx = os.environ.get("CXX", "g++")
     cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            *extra_cxx_flags, *sources, "-o", f"{out}.{os.getpid()}.tmp",
            *extra_ldflags]
@@ -95,10 +99,13 @@ class CustomOp:
     """One registered custom operator, callable on paddle_tpu Tensors."""
 
     def __init__(self, lib: "CustomOpLibrary", symbol: str,
-                 fwd: Callable, name: str):
+                 fwd: Callable, name: str, out_spec_fn: Callable = None):
         self._lib = lib
         self.name = name
         self._grad_fn: Optional[Callable] = None
+        # out_spec_fn(*avals) -> ShapeDtypeStruct: the InferShape/InferDtype
+        # of the reference custom-op ABI; defaults to "like input 0"
+        self._out_spec_fn = out_spec_fn
         self._build(fwd)
 
     def _build(self, host_fn):
@@ -106,7 +113,11 @@ class CustomOp:
 
         def _callback_op(*arrs):
             # staged path: identical host kernel through pure_callback
-            shape_dtype = jax.ShapeDtypeStruct(arrs[0].shape, arrs[0].dtype)
+            if self._out_spec_fn is not None:
+                shape_dtype = self._out_spec_fn(*arrs)
+            else:
+                shape_dtype = jax.ShapeDtypeStruct(arrs[0].shape,
+                                                   arrs[0].dtype)
             return jax.pure_callback(
                 lambda *a: host_fn(*[np.asarray(x) for x in a]),
                 shape_dtype, *arrs, vmap_method="sequential")
@@ -211,7 +222,14 @@ class CustomOpLibrary:
             cfn(ins, out.ctypes.data_as(ctypes.c_void_p), nel)
             return out
 
-        op = CustomOp(self, symbol, host_fn, op_name or symbol)
+        def out_spec_fn(*avals):
+            import jax
+            return jax.ShapeDtypeStruct(
+                out_shape_fn(*[a.shape for a in avals]),
+                np.dtype(out_dtype))
+
+        op = CustomOp(self, symbol, host_fn, op_name or symbol,
+                      out_spec_fn=out_spec_fn)
         self._ops[op.name] = op
         setattr(self, op.name, op)
         return op
@@ -221,15 +239,15 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=(),
          extra_ldflags=(), verbose: bool = False,
          build_directory: Optional[str] = None) -> CustomOpLibrary:
     """Compile + load a custom-op extension (parity:
-    python/paddle/utils/cpp_extension/cpp_extension.py load())."""
-    if build_directory:
-        os.environ["PADDLE_TPU_EXTENSION_DIR"] = build_directory
-    key = (name, tuple(sources))
+    python/paddle/utils/cpp_extension/cpp_extension.py load()).
+    ``build_directory`` applies to this load only (no global state)."""
+    key = (name, tuple(sources), tuple(extra_cxx_flags),
+           tuple(extra_ldflags), build_directory)
     with _LOCK:
         if key in _LIB_CACHE:
             return _LIB_CACHE[key]
         path = _compile(name, sources, extra_cxx_flags, extra_ldflags,
-                        verbose)
+                        verbose, build_directory=build_directory)
         lib = CustomOpLibrary(name, path)
         _LIB_CACHE[key] = lib
         return lib

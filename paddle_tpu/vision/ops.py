@@ -1,0 +1,341 @@
+"""Detection / vision ops (SURVEY §2.3 "Detection/vision ops",
+reference operators/detection/ — ~60 CUDA/CPU kernels).
+
+TPU-native design: every op is a dense, statically-shaped jax computation
+(vectorized gather + where-masking instead of per-box CUDA loops) dispatched
+through the eager tape so it is differentiable where the reference's is and
+traces under jit. Greedy NMS — inherently sequential — is a
+``lax.fori_loop`` over score-sorted boxes, which XLA compiles without
+host round-trips.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = ["box_iou", "iou_similarity", "nms", "box_coder", "yolo_box",
+           "roi_align", "roi_pool", "prior_box"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _iou_matrix(a, b):
+    # a [N,4], b [M,4] in xyxy
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def box_iou(boxes1, boxes2) -> Tensor:
+    """Pairwise IoU [N,M] of xyxy boxes (parity:
+    operators/detection/iou_similarity_op.cc)."""
+    return _apply(_iou_matrix, _t(boxes1), _t(boxes2), op_name="box_iou")
+
+
+iou_similarity = box_iou
+
+
+def nms(boxes, scores=None, iou_threshold: float = 0.3,
+        score_threshold: Optional[float] = None,
+        top_k: Optional[int] = None, category_idxs=None, categories=None,
+        name=None) -> Tensor:
+    """Greedy hard NMS -> kept indices, score-descending (parity:
+    operators/detection/nms_op / multiclass_nms helpers; API shape of
+    paddle.vision.ops.nms).
+
+    The greedy sweep is a lax.fori_loop over sorted candidates — compiled,
+    no data-dependent shapes inside; the final dynamic-size index pick
+    happens on the host (eager API, like the reference's CPU epilogue).
+    With ``category_idxs`` boxes only suppress within the same category
+    (multiclass NMS): implemented by offsetting each category's boxes to a
+    disjoint coordinate island, one sweep, zero IoU across categories.
+    """
+    bt, n = _t(boxes), _t(boxes).shape[0]
+    if n == 0:
+        return to_tensor(np.zeros((0,), np.int64))
+    sv = None if scores is None else _t(scores)._value
+    bv = bt._value
+    if category_idxs is not None:
+        cv = _t(category_idxs)._value.astype(bv.dtype)
+        span = jnp.max(bv) - jnp.min(bv) + 1.0
+        bv = bv + (cv * span)[:, None]
+
+    order = (jnp.argsort(-sv) if sv is not None
+             else jnp.arange(n))
+    sorted_boxes = bv[order]
+    iou = _iou_matrix(sorted_boxes, sorted_boxes)
+
+    def body(i, keep):
+        # suppressed iff any higher-scored KEPT box overlaps too much
+        ok = ~jnp.any(jnp.where(jnp.arange(n) < i,
+                                (iou[i] > iou_threshold) & keep,
+                                False))
+        return keep.at[i].set(ok)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    keep = np.asarray(keep)
+    idx = np.asarray(order)[keep]
+    if sv is not None and score_threshold is not None:
+        s = np.asarray(sv)[idx]
+        idx = idx[s > score_threshold]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return to_tensor(idx.astype(np.int64))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0, name=None):
+    """Encode/decode boxes against priors (parity:
+    operators/detection/box_coder_op.cc)."""
+    pb, tb = _t(prior_box), _t(target_box)
+    pbv = None if prior_box_var is None else _t(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+
+    def enc(p, t, var=None):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        tw = t[:, None, 2] - t[:, None, 0] + norm
+        th = t[:, None, 3] - t[:, None, 1] + norm
+        tcx = t[:, None, 0] + tw * 0.5
+        tcy = t[:, None, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx[None]) / pw[None],
+                         (tcy - pcy[None]) / ph[None],
+                         jnp.log(tw / pw[None]),
+                         jnp.log(th / ph[None])], axis=-1)
+        if var is not None:
+            out = out / var.reshape((1, -1, 4) if var.ndim == 2
+                                    else (1, 1, 4))
+        return out
+
+    def dec(p, t, var=None):
+        # t: [N, M, 4] offsets against priors broadcast on `axis`
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        o = t if var is None else t * (
+            var.reshape((-1, 4) if var.ndim == 2 else (1, 4))
+            if axis == 0 else var)
+        shape = (1, -1) if axis == 1 else (-1, 1)
+        pw, ph = pw.reshape(shape), ph.reshape(shape)
+        pcx, pcy = pcx.reshape(shape), pcy.reshape(shape)
+        cx = o[..., 0] * pw + pcx
+        cy = o[..., 1] * ph + pcy
+        w = jnp.exp(o[..., 2]) * pw
+        h = jnp.exp(o[..., 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                         axis=-1)
+
+    fn = enc if code_type.startswith("encode") else dec
+    args = [pb, tb] if pbv is None else [pb, tb, pbv]
+    return _apply(fn, *args, op_name=f"box_coder_{code_type[:6]}")
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float = 0.01, downsample_ratio: int = 32,
+             clip_bbox: bool = True, scale_x_y: float = 1.0, name=None
+             ) -> Tuple[Tensor, Tensor]:
+    """Decode a YOLOv3 head [N, na*(5+C), H, W] into (boxes [N,H*W*na,4],
+    scores [N,H*W*na,C]) (parity: operators/detection/yolo_box_op.cc)."""
+    xt = _t(x)
+    n, _, h, w = xt.shape
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def fn(xv, img):
+        v = xv.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=xv.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xv.dtype)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (sig(v[:, :, 0]) * scale_x_y - bias + gx) / w
+        cy = (sig(v[:, :, 1]) * scale_x_y - bias + gy) / h
+        aw = jnp.asarray(anc[:, 0]).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anc[:, 1]).reshape(1, na, 1, 1)
+        bw = jnp.exp(v[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = sig(v[:, :, 4])
+        probs = sig(v[:, :, 5:]) * conf[:, :, None]
+        # below conf_thresh: zeroed scores (reference zeroes the box too)
+        mask = (conf > conf_thresh)[:, :, None]
+        probs = jnp.where(mask, probs, 0.0)
+        imh = img[:, 0].reshape(n, 1, 1, 1).astype(xv.dtype)
+        imw = img[:, 1].reshape(n, 1, 1, 1).astype(xv.dtype)
+        x0 = (cx - bw * 0.5) * imw
+        y0 = (cy - bh * 0.5) * imh
+        x1 = (cx + bw * 0.5) * imw
+        y1 = (cy + bh * 0.5) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+        return boxes, scores
+
+    return _apply(fn, xt, _t(img_size), op_name="yolo_box")
+
+
+def _roi_sample(xv, rois, roi_batch, out_h, out_w, spatial_scale,
+                sampling_ratio, mode):
+    """Shared bilinear ROI sampler. xv [N,C,H,W], rois [K,4] xyxy."""
+    k = rois.shape[0]
+    H, W = xv.shape[2], xv.shape[3]
+    r = rois * spatial_scale
+    w0, h0 = r[:, 0], r[:, 1]
+    rw = jnp.maximum(r[:, 2] - r[:, 0], 1.0)
+    rh = jnp.maximum(r[:, 3] - r[:, 1], 1.0)
+    bin_h = rh / out_h
+    bin_w = rw / out_w
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [K, out_h*s] y coords, [K, out_w*s] x coords.
+    # avg (RoIAlign): bin midpoints, the reference's sampling scheme.
+    # max (RoIPool): bin ENDPOINTS inclusive, so pixels on bin corners
+    # (e.g. (0,0) of a corner RoI) are hit exactly — the reference's
+    # integer-partition max visits them too.
+    if mode == "max":
+        frac = jnp.arange(s) / max(s - 1, 1)
+    else:
+        frac = (jnp.arange(s) + 0.5) / s
+    iy = (jnp.arange(out_h)[:, None] + frac[None, :]).reshape(-1)
+    ix = (jnp.arange(out_w)[:, None] + frac[None, :]).reshape(-1)
+    ys = h0[:, None] + bin_h[:, None] * iy[None, :]
+    xs = w0[:, None] + bin_w[:, None] * ix[None, :]
+
+    def bilinear(img, yy, xx):
+        # img [C,H,W]; yy [P], xx [Q] -> [C,P,Q]
+        y0 = jnp.clip(jnp.floor(yy), 0, H - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xx), 0, W - 1).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy, 0, H - 1) - y0
+        wx = jnp.clip(xx, 0, W - 1) - x0
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1]
+        v10 = img[:, y1][:, :, x0]
+        v11 = img[:, y1][:, :, x1]
+        return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + v11 * wy[None, :, None] * wx[None, None, :])
+
+    def per_roi(i):
+        img = xv[roi_batch[i]]
+        samp = bilinear(img, ys[i], xs[i])  # [C, out_h*s, out_w*s]
+        c = samp.shape[0]
+        samp = samp.reshape(c, out_h, s, out_w, s)
+        if mode == "max":
+            return samp.max(axis=(2, 4))
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(k))
+
+
+def _rois_with_batch(boxes, boxes_num, n_imgs):
+    bn = np.asarray(boxes_num if not isinstance(boxes_num, Tensor)
+                    else boxes_num.numpy()).astype(np.int64)
+    roi_batch = np.repeat(np.arange(bn.shape[0]), bn)
+    return jnp.asarray(roi_batch)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None
+              ) -> Tensor:
+    """RoIAlign with bilinear sampling (parity:
+    operators/detection/roi_align_op.cc; API of paddle.vision.ops.roi_align).
+    ``boxes`` [K,4] xyxy concatenated over images, ``boxes_num`` per image.
+    """
+    xt, bt = _t(x), _t(boxes)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    roi_batch = _rois_with_batch(bt, boxes_num, xt.shape[0])
+    off = 0.5 if aligned else 0.0
+
+    def fn(xv, rv):
+        rv = rv - off / spatial_scale
+        return _roi_sample(xv, rv, roi_batch, oh, ow, spatial_scale,
+                           sampling_ratio, "avg")
+
+    return _apply(fn, xt, bt, op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None) -> Tensor:
+    """RoI max-pooling (parity: operators/detection/roi_pool_op.cc) —
+    implemented as dense max over a fixed bilinear sample grid (TPU wants
+    static shapes; 2x2 samples/bin approximates the reference's integer
+    bin partition)."""
+    xt, bt = _t(x), _t(boxes)
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    roi_batch = _rois_with_batch(bt, boxes_num, xt.shape[0])
+
+    def fn(xv, rv):
+        return _roi_sample(xv, rv, roi_batch, oh, ow, spatial_scale, 2,
+                           "max")
+
+    return _apply(fn, xt, bt, op_name="roi_pool")
+
+
+def prior_box(input, image, min_sizes: Sequence[float],
+              max_sizes: Optional[Sequence[float]] = None,
+              aspect_ratios: Sequence[float] = (1.0,),
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              flip: bool = False, clip: bool = False,
+              steps: Tuple[float, float] = (0.0, 0.0),
+              offset: float = 0.5, name=None) -> Tuple[Tensor, Tensor]:
+    """SSD prior (anchor) boxes (parity:
+    operators/detection/prior_box_op.cc): returns (boxes [H,W,A,4],
+    variances [H,W,A,4]) normalized to [0,1]."""
+    xt, imt = _t(input), _t(image)
+    h, w = xt.shape[2], xt.shape[3]
+    imh, imw = imt.shape[2], imt.shape[3]
+    step_h = steps[1] or imh / h
+    step_w = steps[0] or imw / w
+
+    wh = []  # anchor (w, h) in pixels
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    for i, ms in enumerate(min_sizes):
+        wh.append((ms, ms))
+        if max_sizes:
+            s = float(np.sqrt(ms * max_sizes[i]))
+            wh.append((s, s))
+        for a in ars:
+            if abs(a - 1.0) < 1e-6:
+                continue
+            wh.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+    wh = np.asarray(wh, np.float32)
+    na = wh.shape[0]
+
+    cx = (np.arange(w) + offset) * step_w
+    cy = (np.arange(h) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [h, w]
+    boxes = np.zeros((h, w, na, 4), np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / imw
+    boxes[..., 1] = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / imh
+    boxes[..., 2] = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / imw
+    boxes[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / imh
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return to_tensor(boxes), to_tensor(var)

@@ -1,0 +1,261 @@
+"""Control flow (SURVEY §2.3 controlflow/), sequence ops (sequence_ops/),
+and detection ops (detection/) tests.
+
+Modeled on the reference's OpTest style: NumPy reference implementations
+compared against the op outputs; grads spot-checked through the tape.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.static import nn as static_nn
+from paddle_tpu.vision import ops as vops
+
+
+# ---------------------------------------------------------------- control flow
+
+def test_cond_eager_both_branches():
+    x = paddle.to_tensor(np.array([2.0], dtype="float32"))
+    out = static_nn.cond(x.sum() > 1.0,
+                         lambda: x * 2,
+                         lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    out = static_nn.cond(x.sum() > 10.0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+def test_cond_traced_lowers_to_lax():
+    def f(xv):
+        x = paddle.to_tensor(xv)
+        out = static_nn.cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+        return out._value
+
+    jf = jax.jit(f)
+    np.testing.assert_allclose(np.asarray(jf(jnp.asarray([3.0]))), [6.0])
+    np.testing.assert_allclose(np.asarray(jf(jnp.asarray([-3.0]))), [3.0])
+
+
+def test_while_loop_eager_and_traced():
+    def counter(i, s):
+        return i + 1, s + i
+
+    i, s = static_nn.while_loop(
+        lambda i, s: i < 5,
+        counter,
+        [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(i) == 5 and int(s) == 10
+
+    def f(n):
+        i, s = static_nn.while_loop(
+            lambda i, s: i < n,
+            lambda i, s: (i + 1, s + i),
+            [paddle.to_tensor(jnp.asarray(0)), paddle.to_tensor(jnp.asarray(0))])
+        return s._value
+
+    out = jax.jit(f)(jnp.asarray(5))
+    assert int(out) == 10
+
+
+def test_case_and_switch_case():
+    x = paddle.to_tensor(np.array(3.0, dtype="float32"))
+    out = static_nn.case(
+        [(x > 5, lambda: x * 10), (x > 1, lambda: x * 2)],
+        default=lambda: x)
+    assert float(out) == 6.0
+    out = static_nn.switch_case(
+        paddle.to_tensor(1),
+        {0: lambda: x * 0, 1: lambda: x + 1, 2: lambda: x * 2})
+    assert float(out) == 4.0
+    # indices beyond 1 must dispatch correctly (not collapse via bool())
+    out = static_nn.switch_case(
+        paddle.to_tensor(2),
+        {0: lambda: x * 0, 1: lambda: x + 1, 2: lambda: x * 2})
+    assert float(out) == 6.0
+
+
+def test_fc_trains():
+    x = paddle.to_tensor(np.random.rand(4, 3).astype("float32"),
+                         stop_gradient=False)
+    y = static_nn.fc(x, 8, activation="relu")
+    assert y.shape == [4, 8]
+    y.sum().backward()
+    assert x.grad is not None
+    # repeated call from the SAME line reuses parameters (training loop)
+    def call():
+        return static_nn.fc(x, 8)
+    p1 = call()
+    p2 = call()
+    np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+def test_sequence_pad_truncation_keeps_offsets():
+    flat = paddle.to_tensor(np.arange(8, dtype="float32").reshape(8, 1))
+    padded, _ = F.sequence_pad(flat, [5, 3], maxlen=3)
+    # sequence 1 must be rows 5..7, not the tail of sequence 0
+    np.testing.assert_allclose(padded.numpy()[1, :, 0], [5.0, 6.0, 7.0])
+    np.testing.assert_allclose(padded.numpy()[0, :, 0], [0.0, 1.0, 2.0])
+
+
+def test_cond_gradient_through_taken_branch():
+    x = paddle.to_tensor(np.array([2.0], dtype="float32"),
+                         stop_gradient=False)
+    out = static_nn.cond(paddle.to_tensor(True), lambda: x * x,
+                        lambda: x)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+# ---------------------------------------------------------------- sequence ops
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor([2, 0, 3]), maxlen=4)
+    np.testing.assert_array_equal(
+        m.numpy(), [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = paddle.to_tensor(np.arange(10, dtype="float32").reshape(5, 2))
+    lengths = [2, 3]
+    padded, ln = F.sequence_pad(flat, lengths, pad_value=-1.0)
+    assert padded.shape == [2, 3, 2]
+    np.testing.assert_allclose(padded.numpy()[0, 2], [-1.0, -1.0])
+    back = F.sequence_unpad(padded, lengths)
+    np.testing.assert_allclose(back.numpy(), flat.numpy())
+
+
+def test_sequence_pool_variants():
+    x = np.zeros((2, 3, 1), np.float32)
+    x[0, :, 0] = [1, 2, 100]   # length 2 -> 100 is padding
+    x[1, :, 0] = [4, 5, 6]     # length 3
+    xt = paddle.to_tensor(x)
+    ln = paddle.to_tensor([2, 3])
+    np.testing.assert_allclose(
+        F.sequence_pool(xt, ln, "sum").numpy()[:, 0], [3.0, 15.0])
+    np.testing.assert_allclose(
+        F.sequence_pool(xt, ln, "mean").numpy()[:, 0], [1.5, 5.0])
+    np.testing.assert_allclose(
+        F.sequence_pool(xt, ln, "max").numpy()[:, 0], [2.0, 6.0])
+    np.testing.assert_allclose(
+        F.sequence_pool(xt, ln, "last").numpy()[:, 0], [2.0, 6.0])
+    np.testing.assert_allclose(
+        F.sequence_pool(xt, ln, "first").numpy()[:, 0], [1.0, 4.0])
+
+
+def test_sequence_softmax_masks_padding():
+    x = paddle.to_tensor(np.ones((1, 4, 1), np.float32))
+    p = F.sequence_softmax(x, paddle.to_tensor([2]))
+    np.testing.assert_allclose(p.numpy()[0, :, 0], [0.5, 0.5, 0.0, 0.0],
+                               atol=1e-6)
+
+
+def test_sequence_pool_grad_respects_mask():
+    x = paddle.to_tensor(np.ones((1, 3, 1), np.float32),
+                         stop_gradient=False)
+    out = F.sequence_pool(x, paddle.to_tensor([2]), "sum")
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy()[0, :, 0], [1.0, 1.0, 0.0])
+
+
+def test_sequence_expand():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], dtype="float32"))
+    out = F.sequence_expand(x, [2, 3])
+    np.testing.assert_allclose(out.numpy()[:, 0], [1, 1, 2, 2, 2])
+
+
+# ---------------------------------------------------------------- detection
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 2, 2]], dtype="float32"))
+    b = paddle.to_tensor(np.array([[1, 1, 3, 3], [4, 4, 5, 5]],
+                                  dtype="float32"))
+    iou = vops.box_iou(a, b).numpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 0.0], atol=1e-6)
+
+
+def test_nms_greedy():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+        dtype="float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype="float32"))
+    keep = vops.nms(boxes, scores, iou_threshold=0.5).numpy()
+    np.testing.assert_array_equal(keep, [0, 2])
+
+
+def test_nms_multiclass_no_cross_category_suppression():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], dtype="float32"))
+    cats = paddle.to_tensor(np.array([0, 1]))
+    keep = vops.nms(boxes, scores, iou_threshold=0.5, category_idxs=cats,
+                    categories=[0, 1]).numpy()
+    assert set(keep.tolist()) == {0, 1}
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+    targets = np.array([[1, 1, 9, 11]], np.float32)
+    enc = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    dec = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(enc.numpy()),
+                         code_type="decode_center_size", axis=1)
+    np.testing.assert_allclose(dec.numpy()[0, 0], targets[0], atol=1e-4)
+    np.testing.assert_allclose(dec.numpy()[0, 1], targets[0], atol=1e-4)
+
+
+def test_yolo_box_shapes_and_range():
+    n, na, c, h, w = 1, 3, 2, 4, 4
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(n, na * (5 + c), h, w)
+        .astype("float32"))
+    img = paddle.to_tensor(np.array([[128, 128]], dtype="int32"))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=c, downsample_ratio=32)
+    assert boxes.shape == [n, h * w * na, 4]
+    assert scores.shape == [n, h * w * na, c]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 127).all()
+
+
+def test_roi_align_constant_map():
+    x = paddle.to_tensor(np.full((1, 1, 8, 8), 5.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], dtype="float32"))
+    out = vops.roi_align(x, boxes, [1], output_size=2, spatial_scale=1.0)
+    assert out.shape == [1, 1, 2, 2]
+    np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 5.0),
+                               atol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    x = paddle.to_tensor(np.random.rand(1, 2, 8, 8).astype("float32"),
+                         stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], dtype="float32"))
+    out = vops.roi_align(x, boxes, [1], output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, 0] = 9.0
+    out = vops.roi_pool(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([[0, 0, 4, 4]],
+                                                  dtype="float32")),
+                        [1], output_size=1)
+    assert float(out.numpy().max()) == pytest.approx(9.0, abs=1e-5)
+    assert out.shape == [1, 1, 1, 1]
+
+
+def test_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, var = vops.prior_box(feat, img, min_sizes=[16.0],
+                                aspect_ratios=[1.0, 2.0], clip=True)
+    assert boxes.shape == [2, 2, 2, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])

@@ -89,6 +89,54 @@ def test_missing_grad_raises(lib):
         y.backward()
 
 
+def test_def_op_shape_inference(lib, tmp_path):
+    src = tmp_path / "red.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        extern "C" void sum_all(const void** ins, void* out,
+                                const int64_t* n) {
+            const float* x = (const float*)ins[0];
+            float s = 0.f;
+            for (int64_t i = 0; i < n[0]; ++i) s += x[i];
+            ((float*)out)[0] = s;
+        }
+    """))
+    l2 = cpp_extension.load("red_ops", [str(src)])
+    op = l2.def_op("sum_all", out_shape_fn=lambda s: (1,))
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    np.testing.assert_allclose(op(x).numpy(), [6.0])
+    # staged path uses the declared output spec, not input 0's shape
+    op.def_grad(lambda x, g: np.broadcast_to(g, x.shape) + x * 0)
+    xg = paddle.to_tensor(np.arange(4, dtype="float32"),
+                          stop_gradient=False)
+    y = op(xg)
+    assert y.shape == [1]
+    y.backward()
+    np.testing.assert_allclose(xg.grad.numpy(), np.ones(4))
+
+
+def test_flag_change_rebuilds(lib, tmp_path):
+    src = tmp_path / "fl.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        #ifdef DOUBLE_IT
+        #define K 2.f
+        #else
+        #define K 1.f
+        #endif
+        extern "C" void scale_f32(const float* x, float* y, int64_t n) {
+            for (int64_t i = 0; i < n; ++i) y[i] = x[i] * K;
+        }
+    """))
+    l_plain = cpp_extension.load("fl_ops", [str(src)])
+    l_flag = cpp_extension.load("fl_ops", [str(src)],
+                                extra_cxx_flags=["-DDOUBLE_IT"])
+    assert l_plain.path != l_flag.path  # different digests
+    x = paddle.to_tensor(np.ones(2, dtype="float32"))
+    np.testing.assert_allclose(
+        l_flag.elementwise_op("scale_f32")(x).numpy(), [2.0, 2.0])
+
+
 def test_cuda_extension_rejected():
     with pytest.raises(RuntimeError, match="Pallas"):
         cpp_extension.CUDAExtension(["kernel.cu"])
