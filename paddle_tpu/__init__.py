@@ -43,8 +43,9 @@ import importlib as _importlib
 
 _SUBPACKAGES = [
     "amp", "autograd", "device", "distributed", "hapi", "inference", "io",
-    "jit", "metric", "nn", "onnx", "optimizer", "profiler", "regularizer",
-    "static", "sysconfig", "text", "utils", "vision", "incubate",
+    "jit", "metric", "nn", "onnx", "optimizer", "profiler", "quantization",
+    "regularizer", "static", "sysconfig", "text", "utils", "vision",
+    "incubate",
 ]
 
 for _pkg in _SUBPACKAGES:

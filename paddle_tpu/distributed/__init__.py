@@ -23,6 +23,7 @@ from .meta_parallel import (  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
 )
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 

@@ -1,0 +1,331 @@
+"""paddle_tpu.quantization — QAT + post-training quantization ("slim").
+
+TPU-native re-design of the reference quantization stack (SURVEY §2.5
+"quantization (slim)", reference python/paddle/fluid/contrib/slim/):
+
+- fake-quant ops        <- operators/fake_quantize_op.cc (abs_max,
+  moving_average_abs_max, channel_wise_abs_max) — here pure jax with a
+  straight-through estimator (x + stop_gradient(q(x) - x)), so the same
+  code differentiates eagerly and under jit.
+- ImperativeQuantAware  <- slim/quantization/imperative/qat.py — walks a
+  Layer tree and swaps Linear/Conv2D for quantized wrappers that
+  fake-quant weights + activations (QAT).
+- PostTrainingQuantization <- slim/quantization/post_training_quantization.py
+  — calibration forward passes collect per-layer activation ranges
+  (abs_max / avg / percentile histogram), then layers are frozen with
+  static scales.
+- freeze/export: ``convert`` rewrites moving-average scales into constants;
+  the frozen model exports through paddle.jit.save like any other (the
+  graph-pass QuantizationFreezePass collapses into this, since the "IR"
+  is the traced jaxpr).
+
+On TPU the deploy story differs from CUDA int8 kernels: XLA consumes the
+quant/dequant pattern and the simulated-quant graph runs on the MXU in
+bf16 with int8-representable values — parity of *capability* (accuracy
+evaluation, scale search, export) rather than of kernel plumbing.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+from ..nn import Conv2D, Layer, Linear
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_moving_average_abs_max",
+    "fake_channel_wise_quantize_abs_max", "FakeQuantAbsMax",
+    "FakeQuantMovingAverageAbsMax", "QuantizedLinear", "QuantizedConv2D",
+    "ImperativeQuantAware", "PostTrainingQuantization", "quant_dtype_range",
+]
+
+
+def quant_dtype_range(bits: int = 8) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+# ----------------------------------------------------------------------
+# functional fake-quant ops (reference operators/fake_quantize_op.cc)
+# ----------------------------------------------------------------------
+
+def _ste_quant(x, scale, qmax):
+    """Simulated quantization with a straight-through gradient."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quantize_abs_max(x, bit_length: int = 8):
+    """Per-tensor abs-max fake quant -> (quantized, scale) (parity:
+    fake_quantize_abs_max op)."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    qmax = quant_dtype_range(bit_length)
+
+    def fn(v):
+        scale = jnp.max(jnp.abs(v))
+        return _ste_quant(v, scale, qmax), scale
+
+    return _apply(fn, x, op_name="fake_quantize_abs_max")
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
+                                       quant_axis: int = -1):
+    """Per-output-channel abs-max fake quant (parity:
+    fake_channel_wise_quantize_abs_max op — used for weights)."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    qmax = quant_dtype_range(bit_length)
+
+    def fn(v):
+        ax = quant_axis % v.ndim
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        scale = jnp.max(jnp.abs(v), axis=red, keepdims=True)
+        return _ste_quant(v, scale, qmax), scale.reshape(-1)
+
+    return _apply(fn, x, op_name="fake_channel_wise_quantize_abs_max")
+
+
+def fake_quantize_moving_average_abs_max(x, state_scale, bit_length: int = 8,
+                                         moving_rate: float = 0.9,
+                                         training: bool = True):
+    """Moving-average abs-max activation quant; returns (out, new_scale)
+    (parity: fake_quantize_moving_average_abs_max op state machine)."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    qmax = quant_dtype_range(bit_length)
+    sv = state_scale._value if isinstance(state_scale, Tensor) \
+        else jnp.asarray(state_scale)
+
+    def fn(v):
+        cur = jnp.max(jnp.abs(v))
+        if training:
+            new = jnp.where(sv > 0,
+                            moving_rate * sv + (1 - moving_rate) * cur, cur)
+        else:
+            # uncalibrated state (scale==0) falls back to the batch
+            # abs-max instead of quantizing everything to ~0
+            new = jnp.where(sv > 0, sv, cur)
+        return _ste_quant(v, jax.lax.stop_gradient(new), qmax), new
+
+    return _apply(fn, x, op_name="fake_quantize_moving_average_abs_max")
+
+
+# ----------------------------------------------------------------------
+# fake-quant layers
+# ----------------------------------------------------------------------
+
+class FakeQuantAbsMax(Layer):
+    def __init__(self, bit_length: int = 8, channel_wise: bool = False,
+                 quant_axis: int = -1):
+        super().__init__()
+        self.bit_length = bit_length
+        self.channel_wise = channel_wise
+        self.quant_axis = quant_axis
+        self.scale = None  # filled on forward (observability/export)
+
+    def forward(self, x):
+        if self.channel_wise:
+            out, scale = fake_channel_wise_quantize_abs_max(
+                x, self.bit_length, self.quant_axis)
+        else:
+            out, scale = fake_quantize_abs_max(x, self.bit_length)
+        self.scale = scale
+        return out
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    def __init__(self, bit_length: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("_scale", to_tensor(np.zeros((), np.float32)))
+        self._frozen = False
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def freeze(self):
+        self._frozen = True
+
+    def forward(self, x):
+        out, new = fake_quantize_moving_average_abs_max(
+            x, self._scale, self.bit_length, self.moving_rate,
+            training=self.training and not self._frozen)
+        if not self._frozen:
+            self._scale = new.detach()
+        return out
+
+
+# ----------------------------------------------------------------------
+# quantized layer wrappers (reference slim/quantization/imperative/quant_layers)
+# ----------------------------------------------------------------------
+
+class QuantizedLinear(Layer):
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._inner = layer
+        self._w_quant = FakeQuantAbsMax(
+            weight_bits,
+            channel_wise=(weight_quantize_type == "channel_wise_abs_max"),
+            quant_axis=1)  # weight [in, out] -> per-out-channel
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                     moving_rate)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        xq = self._a_quant(x)
+        wq = self._w_quant(self._inner.weight)
+        return F.linear(xq, wq, self._inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._inner = layer
+        self._w_quant = FakeQuantAbsMax(
+            weight_bits,
+            channel_wise=(weight_quantize_type == "channel_wise_abs_max"),
+            quant_axis=0)  # weight [out, in, kh, kw]
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                     moving_rate)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        inner = self._inner
+        xq = self._a_quant(x)
+        wq = self._w_quant(inner.weight)
+        return F.conv2d(xq, wq, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)
+
+
+_QUANT_WRAPPERS = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+
+# ----------------------------------------------------------------------
+# QAT driver
+# ----------------------------------------------------------------------
+
+class ImperativeQuantAware:
+    """Dygraph quantization-aware training (parity:
+    slim/quantization/imperative/qat.py ImperativeQuantAware).
+
+    ``quantize(model)`` swaps every Linear/Conv2D in place for its
+    fake-quant wrapper; train as usual; ``convert`` freezes activation
+    scales; ``save_quantized_model`` exports via paddle.jit.save.
+    """
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9,
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 quantizable_layer_type: Sequence[str] = ("Conv2D", "Linear")):
+        self._kw = dict(weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        moving_rate=moving_rate,
+                        weight_quantize_type=weight_quantize_type)
+        self._types = set(quantizable_layer_type)
+
+    def quantize(self, model: Layer) -> Layer:
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            cls = type(sub)
+            if cls in _QUANT_WRAPPERS and cls.__name__ in self._types:
+                setattr(layer, name, _QUANT_WRAPPERS[cls](sub, **self._kw))
+            else:
+                self._swap(sub)
+
+    def convert(self, model: Layer) -> Layer:
+        """Freeze activation scales (QuantizationFreezePass analog)."""
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, FakeQuantMovingAverageAbsMax):
+                sub.freeze()
+        model.eval()
+        return model
+
+    def save_quantized_model(self, model: Layer, path: str, input_spec=None):
+        from .. import jit
+        self.convert(model)
+        jit.save(model, path, input_spec=input_spec)
+
+
+# ----------------------------------------------------------------------
+# post-training quantization
+# ----------------------------------------------------------------------
+
+class PostTrainingQuantization:
+    """Calibration-based PTQ (parity:
+    slim/quantization/post_training_quantization.py).
+
+    ``algo``: 'abs_max' (peak), 'avg' (mean of per-batch abs-max), or
+    'hist' (percentile of the abs histogram, the KL-lite of the
+    reference). After ``quantize()`` the model's Linear/Conv2D layers are
+    wrapped with FROZEN scales derived from calibration.
+    """
+
+    def __init__(self, model: Layer, data_loader=None, batch_nums=None,
+                 algo: str = "abs_max", hist_percent: float = 0.9999,
+                 weight_bits: int = 8, activation_bits: int = 8):
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._hist_percent = hist_percent
+        self._wb, self._ab = weight_bits, activation_bits
+
+    def quantize(self) -> Layer:
+        # 1. wrap layers (moving-rate 1.0 -> scale state only from stats)
+        qat = ImperativeQuantAware(weight_bits=self._wb,
+                                   activation_bits=self._ab)
+        qat.quantize(self._model)
+        observers: Dict[int, List[float]] = {}
+        fqs = [s for s in self._model.sublayers(include_self=True)
+               if isinstance(s, FakeQuantMovingAverageAbsMax)]
+
+        # 2. calibrate: record per-batch abs-max at every activation site
+        originals = {}
+        for fq in fqs:
+            observers[id(fq)] = []
+            originals[id(fq)] = fq.forward
+
+            def observed(x, _fq=fq):
+                v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                observers[id(_fq)].append(float(jnp.max(jnp.abs(v))))
+                return x  # calibration runs the FP model
+
+            fq.forward = observed
+        self._model.eval()
+        if self._loader is not None:
+            for i, batch in enumerate(self._loader):
+                if self._batch_nums is not None and i >= self._batch_nums:
+                    break
+                xs = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self._model(xs if isinstance(xs, Tensor) else to_tensor(xs))
+        for fq in fqs:
+            fq.forward = originals[id(fq)]
+
+        # 3. reduce stats -> frozen scales
+        for fq in fqs:
+            stats = observers[id(fq)]
+            if not stats:
+                continue
+            if self._algo == "avg":
+                s = float(np.mean(stats))
+            elif self._algo == "hist":
+                s = float(np.quantile(np.asarray(stats),
+                                      self._hist_percent))
+            else:  # abs_max
+                s = float(np.max(stats))
+            fq._scale = to_tensor(np.asarray(s, np.float32))
+            fq.freeze()
+        return self._model
+
+    def save_quantized_model(self, save_model_path: str, input_spec=None):
+        from .. import jit
+        jit.save(self._model, save_model_path, input_spec=input_spec)

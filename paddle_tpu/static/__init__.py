@@ -28,9 +28,19 @@ class Program:
     def __init__(self):
         self.random_seed = 0
         self._callables = []
+        self._layers = []  # layers created by static.nn helpers (fc, …)
 
     def global_block(self):
         return self
+
+    def all_parameters(self):
+        """Parameters owned by helper-built layers (parity:
+        Program.global_block().all_parameters(), fluid/framework.py) —
+        feed these to an optimizer when training a helper-built graph."""
+        ps = []
+        for layer in self._layers:
+            ps.extend(layer.parameters())
+        return ps
 
     def clone(self, for_test=False):
         import copy

@@ -169,27 +169,30 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
     call site via the default Layer machinery is not needed here — static
     users pass explicit sizes; we keep a module-level cache keyed by name.
     """
-    import sys
-
     from ..framework.core import _apply
     from ..nn import Linear
     import numpy as np
 
     x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
     in_feat = int(np.prod(x.shape[num_flatten_dims:]))
-    # parameter reuse is per CALL SITE (like the reference, where each
-    # static fc() in the program text owns its parameters but the program
-    # is built once and re-run): unnamed calls key on caller file:line so
-    # a training loop re-invoking the same line reuses the same weights
-    # while two different fc lines stay independent.
-    if name is None:
-        fr = sys._getframe(1)
-        name = f"{fr.f_code.co_filename}:{fr.f_lineno}"
-    key = (name, in_feat, size)
-    layer = _FC_CACHE.get(key)
-    if layer is None:
+    # Parameter semantics follow the reference's static graph: the program
+    # is BUILT ONCE, so each fc() call creates fresh parameters (stacked
+    # fc's in a loop are independent layers). Re-use across calls requires
+    # an explicit ``name`` — the analog of a shared param_attr name. The
+    # created parameters are registered on the default Program so
+    # ``default_main_program().all_parameters()`` reaches them (reference:
+    # params live in the Program's global block).
+    if name is not None:
+        key = (name, in_feat, size)
+        layer = _FC_CACHE.get(key)
+        if layer is None:
+            layer = _FC_CACHE[key] = Linear(in_feat, size)
+    else:
         layer = Linear(in_feat, size)
-        _FC_CACHE[key] = layer
+    from . import default_main_program
+    prog = default_main_program()
+    if layer not in getattr(prog, "_layers", []):
+        prog._layers = getattr(prog, "_layers", []) + [layer]
     lead = tuple(x.shape[:num_flatten_dims])
     n_lead = int(np.prod(lead)) if lead else 1
     # all reshapes/activations go through _apply so grads reach x and the

@@ -128,9 +128,16 @@ def box_coder(prior_box, prior_box_var, target_box,
         ph = p[:, 3] - p[:, 1] + norm
         pcx = p[:, 0] + pw * 0.5
         pcy = p[:, 1] + ph * 0.5
-        o = t if var is None else t * (
-            var.reshape((-1, 4) if var.ndim == 2 else (1, 4))
-            if axis == 0 else var)
+        if var is None:
+            o = t
+        else:
+            # broadcast variances against [N, M, 4] offsets: per-prior
+            # vars ride the prior axis (0 or 1), a flat 4-vector rides all
+            if var.ndim == 2:
+                vshape = (-1, 1, 4) if axis == 0 else (1, -1, 4)
+            else:
+                vshape = (1, 1, 4)
+            o = t * var.reshape(vshape)
         shape = (1, -1) if axis == 1 else (-1, 1)
         pw, ph = pw.reshape(shape), ph.reshape(shape)
         pcx, pcy = pcx.reshape(shape), pcy.reshape(shape)

@@ -83,12 +83,31 @@ def test_fc_trains():
     assert y.shape == [4, 8]
     y.sum().backward()
     assert x.grad is not None
-    # repeated call from the SAME line reuses parameters (training loop)
-    def call():
-        return static_nn.fc(x, 8)
-    p1 = call()
-    p2 = call()
-    np.testing.assert_allclose(p1.numpy(), p2.numpy())
+    # build-once semantics: unnamed calls create INDEPENDENT parameters
+    # (stacked fc's are distinct layers, like the reference's Program)
+    h = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    outs = [static_nn.fc(h, 8) for _ in range(2)]
+    assert not np.allclose(outs[0].numpy(), outs[1].numpy())
+    # explicit name shares parameters
+    a = static_nn.fc(h, 8, name="shared")
+    b = static_nn.fc(h, 8, name="shared")
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    # created params are reachable through the default Program
+    from paddle_tpu.static import default_main_program
+    assert len(default_main_program().all_parameters()) > 0
+
+
+def test_box_coder_decode_axis0_with_var():
+    priors = np.array([[0, 0, 10, 10], [10, 10, 30, 30],
+                       [0, 0, 4, 4]], np.float32)
+    var = np.full((3, 4), 0.5, np.float32)
+    offs = np.zeros((3, 2, 4), np.float32)
+    dec = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                         paddle.to_tensor(offs),
+                         code_type="decode_center_size", axis=0)
+    # zero offsets decode to the priors themselves regardless of var
+    for m in range(2):
+        np.testing.assert_allclose(dec.numpy()[:, m], priors, atol=1e-5)
 
 
 def test_sequence_pad_truncation_keeps_offsets():

@@ -1,0 +1,142 @@
+"""Distributed checkpoint + resharding tests (SURVEY §5.4).
+
+Reference behaviors modeled: per-shard distributed persistence
+(fleet/runtime/parameter_server_runtime.py:544) — improved with
+restore-time resharding, which the reference lacks; save/load numeric
+round-trip (fluid/io.py save_persistables/load).
+Runs on the 8-device virtual CPU mesh from conftest.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import checkpoint as ckpt
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_roundtrip_plain_numpy(tmp_path):
+    state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "b": np.float32(7.0)}
+    ckpt.save_state_dict(state, str(tmp_path / "c"))
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    np.testing.assert_array_equal(back["w"], state["w"])
+    assert float(back["b"]) == 7.0
+
+
+def test_sharded_save_then_reshard_load(tmp_path):
+    mesh1 = _mesh((8,), ("x",))
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(arr, NamedSharding(mesh1, P("x", None)))
+    ckpt.save_state_dict({"w": sharded}, str(tmp_path / "c"))
+    # saved as 8 shards
+    files = [f for f in os.listdir(tmp_path / "c") if f.endswith(".npy")]
+    assert len(files) == 8
+
+    # restore onto a DIFFERENT topology: 2x4 mesh, sharded on axis 1
+    mesh2 = _mesh((2, 4), ("a", "b"))
+    target = NamedSharding(mesh2, P(None, "b"))
+    out = ckpt.load_state_dict(str(tmp_path / "c"), shardings={"w": target})
+    w = out["w"]
+    assert w.sharding.is_equivalent_to(target, 2)
+    np.testing.assert_array_equal(np.asarray(w), arr)
+
+
+def test_replicated_save_single_shard(tmp_path):
+    mesh = _mesh((8,), ("x",))
+    arr = np.ones((4, 4), np.float32)
+    rep = jax.device_put(arr, NamedSharding(mesh, P(None, None)))
+    ckpt.save_state_dict({"w": rep}, str(tmp_path / "c"))
+    files = [f for f in os.listdir(tmp_path / "c") if f.endswith(".npy")]
+    assert len(files) == 1  # replicas deduplicated
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    np.testing.assert_array_equal(back["w"], arr)
+
+
+def test_2d_sharding_roundtrip(tmp_path):
+    mesh = _mesh((2, 4), ("dp", "mp"))
+    arr = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    sharded = jax.device_put(arr, NamedSharding(mesh, P("dp", "mp")))
+    ckpt.save_state_dict({"w": sharded}, str(tmp_path / "c"))
+    # load fully replicated
+    mesh2 = _mesh((8,), ("x",))
+    out = ckpt.load_state_dict(
+        str(tmp_path / "c"),
+        shardings={"w": NamedSharding(mesh2, P(None, None))})
+    np.testing.assert_array_equal(np.asarray(out["w"]), arr)
+
+
+def test_async_save(tmp_path):
+    state = {"w": np.ones((16, 16), np.float32)}
+    ckpt.save_state_dict(state, str(tmp_path / "c"), async_save=True)
+    ckpt.wait_until_finished()
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    np.testing.assert_array_equal(back["w"], state["w"])
+
+
+def test_async_save_error_surfaces(tmp_path, monkeypatch):
+    def boom(*a, **k):
+        raise IOError("disk full")
+
+    monkeypatch.setattr(ckpt.np, "save", boom)
+    ckpt.save_state_dict({"w": np.ones(2, np.float32)},
+                         str(tmp_path / "c"), async_save=True)
+    with pytest.raises(IOError, match="disk full"):
+        ckpt.wait_until_finished()
+
+
+def test_checkpoint_manager_async_rotation(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "m"), max_to_keep=1)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": np.full((2,), step, np.float32)},
+                 async_save=True)
+        ckpt.wait_until_finished()
+    assert mgr.all_steps() == [3]  # rotation enforced on async path too
+
+
+def test_tensor_leaves_accepted(tmp_path):
+    import paddle_tpu as paddle
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    ckpt.save_state_dict({"t": t}, str(tmp_path / "c"))
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    np.testing.assert_array_equal(back["t"], t.numpy())
+
+
+def test_checkpoint_manager_rotation(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "m"), max_to_keep=2)
+    for step in (10, 20, 30):
+        mgr.save(step, {"w": np.full((2,), step, np.float32)})
+    assert mgr.all_steps() == [20, 30]
+    assert mgr.latest_step() == 30
+    out = mgr.restore()
+    np.testing.assert_array_equal(out["w"], [30.0, 30.0])
+    out = mgr.restore(step=20)
+    np.testing.assert_array_equal(out["w"], [20.0, 20.0])
+
+
+def test_restore_into_training_step(tmp_path):
+    """End-to-end: save sharded params, reshard-restore, values drive a
+    pjit step on the new mesh."""
+    mesh1 = _mesh((4,), ("fsdp",))
+    w = np.random.RandomState(1).rand(8, 8).astype(np.float32)
+    sh = jax.device_put(w, NamedSharding(mesh1, P("fsdp", None)))
+    ckpt.save_state_dict({"w": sh}, str(tmp_path / "c"))
+
+    mesh2 = _mesh((2, 2), ("dp", "tp"))
+    tgt = NamedSharding(mesh2, P(None, "tp"))
+    restored = ckpt.load_state_dict(str(tmp_path / "c"),
+                                    shardings={"w": tgt})["w"]
+
+    @jax.jit
+    def step(wv, x):
+        return x @ wv
+
+    out = step(restored, np.ones((2, 8), np.float32))
+    np.testing.assert_allclose(np.asarray(out), np.ones((2, 8)) @ w,
+                               rtol=1e-5)

@@ -1,0 +1,145 @@
+"""Quantization (slim) tests — SURVEY §2.5 "quantization (slim)".
+
+Modeled on the reference's QAT/PTQ test flow
+(slim/tests/test_imperative_qat.py, test_post_training_quantization_*):
+fake-quant numerics vs NumPy, STE gradients, QAT wrapper swap + training,
+PTQ calibration stats, quantized export round-trip.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import quantization as Q
+
+
+def _np_fake_quant(x, scale, qmax=127.0):
+    s = max(scale, 1e-9)
+    return np.clip(np.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def test_fake_quantize_abs_max_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 5).astype("float32")
+    out, scale = Q.fake_quantize_abs_max(paddle.to_tensor(x))
+    assert float(scale) == pytest.approx(np.abs(x).max(), rel=1e-6)
+    np.testing.assert_allclose(out.numpy(),
+                               _np_fake_quant(x, np.abs(x).max()),
+                               atol=1e-6)
+
+
+def test_channel_wise_quant_scales_per_channel():
+    x = np.random.RandomState(1).randn(3, 4).astype("float32")
+    out, scales = Q.fake_channel_wise_quantize_abs_max(
+        paddle.to_tensor(x), quant_axis=1)
+    np.testing.assert_allclose(scales.numpy(), np.abs(x).max(axis=0),
+                               rtol=1e-6)
+    for c in range(4):
+        np.testing.assert_allclose(
+            out.numpy()[:, c],
+            _np_fake_quant(x[:, c], np.abs(x[:, c]).max()), atol=1e-6)
+
+
+def test_ste_gradient_is_identity_in_range():
+    x = paddle.to_tensor(np.array([0.5, -0.25, 0.9], dtype="float32"),
+                         stop_gradient=False)
+    out, _ = Q.fake_quantize_abs_max(x)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(3), atol=1e-6)
+
+
+def test_moving_average_scale_updates():
+    fq = Q.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+    fq.train()
+    x1 = paddle.to_tensor(np.array([2.0], dtype="float32"))
+    fq(x1)
+    assert float(fq.scale) == pytest.approx(2.0)  # first batch seeds
+    fq(paddle.to_tensor(np.array([4.0], dtype="float32")))
+    assert float(fq.scale) == pytest.approx(0.5 * 2.0 + 0.5 * 4.0)
+    fq.freeze()
+    fq(paddle.to_tensor(np.array([100.0], dtype="float32")))
+    assert float(fq.scale) == pytest.approx(3.0)  # frozen
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 4 * 4, 2)
+
+    def forward(self, x):
+        h = F.relu(self.conv(x))
+        h = paddle.reshape(h, [h.shape[0], -1])
+        return self.fc(h)
+
+
+def test_qat_swaps_and_trains():
+    paddle.seed(0)
+    net = _Net()
+    qat = Q.ImperativeQuantAware()
+    qat.quantize(net)
+    assert isinstance(net.conv, Q.QuantizedConv2D)
+    assert isinstance(net.fc, Q.QuantizedLinear)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(8, 1, 4, 4).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)))
+    l0 = None
+    for _ in range(30):
+        loss = F.cross_entropy(net(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0  # fake-quant graph still trains
+    qat.convert(net)
+    out1 = net(x).numpy()
+    out2 = net(x).numpy()
+    np.testing.assert_allclose(out1, out2)  # frozen scales => deterministic
+
+
+def test_qat_quantized_output_close_to_fp():
+    paddle.seed(1)
+    net = _Net()
+    x = paddle.to_tensor(
+        np.random.RandomState(2).rand(4, 1, 4, 4).astype("float32"))
+    ref = net(x).numpy()
+    Q.ImperativeQuantAware().quantize(net)
+    net.eval()
+    outq = net(x).numpy()
+    # int8 simulation error is small relative to activations
+    assert np.max(np.abs(outq - ref)) < 0.15 * (np.abs(ref).max() + 1e-6)
+
+
+def test_ptq_calibration_and_algos():
+    paddle.seed(3)
+    net = _Net()
+    rng = np.random.RandomState(4)
+    loader = [(paddle.to_tensor(rng.rand(4, 1, 4, 4).astype("float32")),)
+              for _ in range(5)]
+    ptq = Q.PostTrainingQuantization(net, data_loader=loader,
+                                     batch_nums=4, algo="avg")
+    model = ptq.quantize()
+    fqs = [s for s in model.sublayers(include_self=True)
+           if isinstance(s, Q.FakeQuantMovingAverageAbsMax)]
+    assert fqs and all(s._frozen for s in fqs)
+    assert all(float(s.scale) > 0 for s in fqs)
+    out = model(loader[0][0])
+    assert out.shape == [4, 2]
+
+
+def test_ptq_save_quantized_model(tmp_path):
+    paddle.seed(5)
+    net = _Net()
+    loader = [(paddle.to_tensor(
+        np.random.RandomState(6).rand(2, 1, 4, 4).astype("float32")),)]
+    ptq = Q.PostTrainingQuantization(net, data_loader=loader, algo="hist")
+    model = ptq.quantize()
+    from paddle_tpu.static import InputSpec
+    path = str(tmp_path / "qmodel")
+    ptq.save_quantized_model(
+        path, input_spec=[InputSpec([None, 1, 4, 4], "float32")])
+    import os
+    assert any(f.startswith("qmodel") for f in os.listdir(tmp_path))
