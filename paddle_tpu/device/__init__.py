@@ -31,3 +31,19 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+    @staticmethod
+    def memory_allocated(device=None):
+        from ..framework.monitor import memory_allocated
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        from ..framework.monitor import max_memory_allocated
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        from ..framework.monitor import device_memory_stats
+        s = device_memory_stats(device)
+        return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))

@@ -159,6 +159,9 @@ def save_state_dict(state: Mapping[str, Any], path: str,
             with open(tmp, "w") as f:
                 json.dump({"version": 1, "entries": merged}, f, indent=1)
             os.replace(tmp, os.path.join(path, _INDEX))
+        # second barrier: no rank may report the checkpoint complete (or
+        # exit, tearing down coordination) until the index is readable
+        _barrier(f"ckpt_index:{path}")
         if _on_complete is not None:
             _on_complete()
 
@@ -188,7 +191,12 @@ def wait_until_finished():
     while _pending:
         _pending.pop().join()
     if _errors:
-        raise _errors.pop()
+        first, rest = _errors[0], _errors[1:]
+        _errors.clear()  # drain: stale errors must not blame later saves
+        if rest:
+            first.add_note(f"({len(rest)} further async save error(s) "
+                           f"were also recorded)")
+        raise first
 
 
 def _read_region(path, entry, region):
@@ -200,6 +208,7 @@ def _read_region(path, entry, region):
              for d, s in enumerate(region)]
     out = np.empty([b - a for a, b in zip(starts, stops)],
                    dtype=np.dtype(entry["dtype"]))
+    covered = 0
     for sh in entry["shards"]:
         lo = [a for a, _ in sh["slice"]]
         hi = [b for _, b in sh["slice"]]
@@ -211,6 +220,17 @@ def _read_region(path, entry, region):
         src = tuple(slice(a - l, b - l) for a, b, l in zip(ilo, ihi, lo))
         dst = tuple(slice(a - s, b - s) for a, b, s in zip(ilo, ihi, starts))
         out[dst] = data[src]
+        covered += int(np.prod([b - a for a, b in zip(ilo, ihi)])) \
+            if ilo else 1
+    # saved shards tile the array disjointly, so covered volume must equal
+    # the region volume — a shortfall means lost/partial shards and
+    # np.empty garbage would otherwise become "weights" silently
+    want = int(np.prod(out.shape)) if out.ndim else 1
+    if covered != want:
+        raise IOError(
+            f"checkpoint entry covers {covered}/{want} elements of the "
+            f"requested region — missing or partially-synced shard files "
+            f"under {path}")
     return out
 
 

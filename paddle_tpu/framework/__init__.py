@@ -15,6 +15,8 @@ from .dtype import (bfloat16, complex64, complex128, convert_dtype, finfo,  # no
                     int64, is_floating_point, is_integer, uint8)
 from .debug import (check_numerics, disable_check_nan_inf,  # noqa: F401
                     enable_check_nan_inf)
+from .monitor import (device_memory_stats, get_all_stats, stat_add,  # noqa: F401
+                      stat_get, stat_reset)
 from .errors import *  # noqa: F401,F403
 from .flags import FLAGS, define_flag, get_flags, set_flags  # noqa: F401
 from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401

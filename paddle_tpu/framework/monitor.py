@@ -1,0 +1,108 @@
+"""Runtime stats registry + device memory monitoring.
+
+TPU-native analog of the reference's monitor subsystem (SURVEY §5.5):
+- ``StatRegistry`` / ``stat_add`` <- platform/monitor.h:77 StatRegistry +
+  STAT_ADD counters (e.g. "STAT_gpu0_mem_size" tracking GPU memory in
+  use), exported to Python via pybind global_value_getter_setter.
+- ``device_memory_stats``: where the reference reads its allocator
+  counters, XLA owns HBM — the numbers come from
+  ``jax.Device.memory_stats()`` (bytes_in_use, peak_bytes_in_use, …).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
+           "get_all_stats", "device_memory_stats", "max_memory_allocated",
+           "memory_allocated"]
+
+_lock = threading.Lock()
+
+
+class StatRegistry:
+    """Named monotonic/settable int64 counters (parity:
+    platform/monitor.h:77; one global instance like the reference's
+    singleton)."""
+
+    def __init__(self):
+        self._stats: Dict[str, int] = {}
+
+    def add(self, name: str, delta: int = 1) -> int:
+        with _lock:
+            v = self._stats.get(name, 0) + int(delta)
+            self._stats[name] = v
+            return v
+
+    def get(self, name: str) -> int:
+        with _lock:
+            return self._stats.get(name, 0)
+
+    def set(self, name: str, value: int):
+        with _lock:
+            self._stats[name] = int(value)
+
+    def reset(self, name: Optional[str] = None):
+        with _lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with _lock:
+            return dict(self._stats)
+
+
+_registry = StatRegistry()
+
+
+def stat_add(name: str, delta: int = 1) -> int:
+    """STAT_ADD analog."""
+    return _registry.add(name, delta)
+
+
+def stat_get(name: str) -> int:
+    return _registry.get(name)
+
+
+def stat_reset(name: Optional[str] = None):
+    _registry.reset(name)
+
+
+def get_all_stats() -> Dict[str, int]:
+    return _registry.snapshot()
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """Per-device memory counters from the XLA allocator (replaces the
+    reference's STAT_gpuN_mem_size counters fed by its own allocators).
+    Returns {} on backends that do not report (e.g. CPU)."""
+    import jax
+    if device is None:
+        dev = jax.devices()[0]
+    elif isinstance(device, int):
+        dev = jax.devices()[device]
+    elif isinstance(device, str):
+        # paddle-style "gpu:0" / "tpu:1" / "cpu" ids
+        idx = int(device.split(":", 1)[1]) if ":" in device else 0
+        dev = jax.devices()[idx]
+    else:
+        dev = device  # a jax.Device
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None  # backend (e.g. CPU) reports nothing
+    return dict(stats) if stats else {}
+
+
+def memory_allocated(device=None) -> int:
+    """bytes currently in use on the device (parity surface:
+    paddle.device.cuda.memory_allocated)."""
+    return int(device_memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """peak bytes in use (parity: paddle.device.cuda.max_memory_allocated).
+    """
+    return int(device_memory_stats(device).get("peak_bytes_in_use", 0))

@@ -191,8 +191,13 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
         layer = Linear(in_feat, size)
     from . import default_main_program
     prog = default_main_program()
-    if layer not in getattr(prog, "_layers", []):
-        prog._layers = getattr(prog, "_layers", []) + [layer]
+    ids = getattr(prog, "_layer_ids", None)
+    if ids is None:
+        ids = prog._layer_ids = set()
+        prog._layers = list(getattr(prog, "_layers", []))
+    if id(layer) not in ids:
+        ids.add(id(layer))
+        prog._layers.append(layer)
     lead = tuple(x.shape[:num_flatten_dims])
     n_lead = int(np.prod(lead)) if lead else 1
     # all reshapes/activations go through _apply so grads reach x and the

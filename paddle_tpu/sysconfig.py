@@ -1,0 +1,22 @@
+"""paddle_tpu.sysconfig (parity: python/paddle/sysconfig.py —
+get_include/get_lib for building extensions against the framework)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory of C headers for custom-op extensions (the reference
+    returns its bundled paddle/include; here extensions use the plain C
+    ABI of utils.cpp_extension, so this points at the native sources)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+
+
+def get_lib() -> str:
+    """Directory of built native libraries."""
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native",
+                     "_build")
+    os.makedirs(d, exist_ok=True)
+    return d

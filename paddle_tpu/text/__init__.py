@@ -4,11 +4,12 @@ Parity target: reference ``python/paddle/text/`` (datasets + viterbi
 decode) extended with the decoder-LM family the TPU north-star requires
 (SURVEY.md §5.7: long-context is greenfield).
 """
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from .models import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel,
     llama_tiny, llama_7b, llama_13b,
 )
 
-__all__ = ["models", "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
-           "llama_tiny", "llama_7b", "llama_13b"]
+__all__ = ["models", "datasets", "LlamaConfig", "LlamaForCausalLM",
+           "LlamaModel", "llama_tiny", "llama_7b", "llama_13b"]

@@ -1,0 +1,189 @@
+"""Text datasets (parity: reference python/paddle/text/datasets/ — Imdb,
+UCIHousing, Conll05st, Movielens, WMT14/16 — and python/paddle/dataset/).
+
+The reference downloads corpora at construction (text/datasets/imdb.py
+_download). This environment has zero egress, so every dataset here reads
+a LOCAL copy via ``data_file``/``data_dir`` and raises a clear error
+pointing at the expected layout when absent; ``FakeTextDataset`` provides
+a synthetic stand-in for pipelines/tests (mirroring vision.datasets.FakeData).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "FakeTextDataset",
+           "build_vocab"]
+
+
+def _require(path, what, layout):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what}: no local data at {path!r}. This build has no network "
+            f"access (the reference would download it); provide the file "
+            f"with the layout: {layout}")
+
+
+def build_vocab(texts: Sequence[str], min_freq: int = 1,
+                specials: Sequence[str] = ("<pad>", "<unk>")) -> dict:
+    """Frequency-sorted token->id map (parity with the vocab the reference
+    builds in text/datasets/imdb.py word_dict)."""
+    freq = {}
+    for t in texts:
+        for w in t.split():
+            freq[w] = freq.get(w, 0) + 1
+    vocab = {s: i for i, s in enumerate(specials)}
+    for w in sorted((w for w, c in freq.items() if c >= min_freq),
+                    key=lambda w: (-freq[w], w)):
+        if w not in vocab:
+            vocab[w] = len(vocab)
+    return vocab
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset from a local ``aclImdb`` tree or tarball
+    (parity: text/datasets/imdb.py Imdb).
+
+    Yields (token_id_array, label) with label 0=neg, 1=pos.
+    """
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, vocab: Optional[dict] = None):
+        assert mode in ("train", "test")
+        self.mode = mode
+        _require(data_dir, "Imdb", "aclImdb/{train,test}/{pos,neg}/*.txt "
+                 "(dir or .tar.gz)")
+        texts: List[str] = []
+        labels: List[int] = []
+        if os.path.isfile(data_dir):
+            with tarfile.open(data_dir) as tf:
+                pat = re.compile(
+                    rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+                for m in tf.getmembers():
+                    g = pat.match(m.name)
+                    if not g:
+                        continue
+                    texts.append(tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower())
+                    labels.append(1 if g.group(1) == "pos" else 0)
+        else:
+            for li, sub in ((1, "pos"), (0, "neg")):
+                d = os.path.join(data_dir, mode, sub)
+                _require(d, "Imdb", "aclImdb/<mode>/<pos|neg>/*.txt")
+                for fn in sorted(os.listdir(d)):
+                    if fn.endswith(".txt"):
+                        with open(os.path.join(d, fn), errors="ignore") as f:
+                            texts.append(f.read().lower())
+                        labels.append(li)
+        # cutoff is the vocab frequency threshold, as in the reference
+        # (text/datasets/imdb.py word_dict drops words rarer than cutoff)
+        self.word_idx = vocab if vocab is not None else build_vocab(
+            texts, min_freq=max(1, cutoff))
+        unk = self.word_idx.get("<unk>", 1)
+        self.docs = [np.asarray([self.word_idx.get(w, unk)
+                                 for w in t.split()], np.int64)
+                     for t in texts]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (parity: text/datasets/uci_housing.py).
+    ``data_file``: whitespace-separated 14-column text (506 rows)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        assert mode in ("train", "test")
+        _require(data_file, "UCIHousing",
+                 "whitespace-separated rows of 14 floats (housing.data)")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        if raw.ndim != 2 or raw.shape[1] != self.FEATURE_DIM + 1:
+            raise ValueError(
+                f"UCIHousing expects 14 columns, got {raw.shape}")
+        # normalize features like the reference (feature_range over train)
+        split = int(raw.shape[0] * 0.8)
+        mx = raw[:split, :-1].max(axis=0)
+        mn = raw[:split, :-1].min(axis=0)
+        avg = raw[:split, :-1].mean(axis=0)
+        raw[:, :-1] = (raw[:, :-1] - avg) / np.maximum(mx - mn, 1e-6)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (parity: text/datasets/conll05.py). Reads a local
+    pre-tokenized TSV: one ``word<TAB>predicate<TAB>label`` triple per
+    token, blank line between sentences."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 vocab: Optional[dict] = None,
+                 label_vocab: Optional[dict] = None):
+        _require(data_file, "Conll05st",
+                 "TSV word\\tpredicate\\tlabel, blank-line sentence breaks")
+        sents, cur = [], []
+        with open(data_file, errors="ignore") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    if cur:
+                        sents.append(cur)
+                        cur = []
+                    continue
+                cur.append(line.split("\t"))
+            if cur:
+                sents.append(cur)
+        words = [" ".join(tok[0] for tok in s) for s in sents]
+        labels = sorted({tok[2] for s in sents for tok in s})
+        self.word_idx = vocab or build_vocab(words)
+        self.label_idx = label_vocab or {l: i for i, l in enumerate(labels)}
+        unk = self.word_idx.get("<unk>", 1)
+        self.samples = []
+        for s in sents:
+            w = np.asarray([self.word_idx.get(t[0].lower(), unk)
+                            for t in s], np.int64)
+            p = np.asarray([1 if t[1] != "-" else 0 for t in s], np.int64)
+            l = np.asarray([self.label_idx.get(t[2], 0) for t in s],
+                           np.int64)
+            self.samples.append((w, p, l))
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FakeTextDataset(Dataset):
+    """Synthetic token/label pairs for pipelines and tests (the text
+    counterpart of vision.datasets.FakeData)."""
+
+    def __init__(self, num_samples: int = 128, seq_len: int = 32,
+                 vocab_size: int = 1000, num_classes: int = 2, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randint(0, vocab_size,
+                             (num_samples, seq_len)).astype(np.int64)
+        self.y = rng.randint(0, num_classes, (num_samples,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)

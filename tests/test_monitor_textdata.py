@@ -1,0 +1,131 @@
+"""Stats registry (SURVEY §5.5 monitor.h), device memory stats, text
+datasets, sysconfig tests."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import monitor
+from paddle_tpu.text import datasets as tds
+
+
+def test_stat_registry_counters():
+    monitor.stat_reset()
+    assert monitor.stat_get("steps") == 0
+    monitor.stat_add("steps")
+    monitor.stat_add("steps", 4)
+    assert monitor.stat_get("steps") == 5
+    monitor.stat_add("tokens", 1024)
+    snap = monitor.get_all_stats()
+    assert snap == {"steps": 5, "tokens": 1024}
+    monitor.stat_reset("steps")
+    assert monitor.stat_get("steps") == 0
+    assert monitor.stat_get("tokens") == 1024
+    monitor.stat_reset()
+
+
+def test_device_memory_stats_shape():
+    # CPU backend may not report; the API contract is dict-of-ints
+    s = monitor.device_memory_stats()
+    assert isinstance(s, dict)
+    assert all(isinstance(v, (int, float)) for v in s.values())
+    assert monitor.memory_allocated() >= 0
+    assert monitor.max_memory_allocated() >= 0
+    assert paddle.device.cuda.memory_allocated() >= 0
+
+
+def test_fake_text_dataset_and_loader():
+    ds = tds.FakeTextDataset(num_samples=16, seq_len=8, vocab_size=100)
+    x, y = ds[0]
+    assert x.shape == (8,) and y.shape == ()
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(ds, batch_size=4)
+    xb, yb = next(iter(dl))
+    assert list(xb.shape) == [4, 8]
+
+
+def test_uci_housing_local_file(tmp_path):
+    rng = np.random.RandomState(0)
+    rows = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    tr = tds.UCIHousing(str(f), mode="train")
+    te = tds.UCIHousing(str(f), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features normalized around train mean
+    allx = np.stack([tr[i][0] for i in range(len(tr))])
+    assert abs(allx.mean()) < 0.5
+
+
+def test_uci_housing_missing_file_clear_error():
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        tds.UCIHousing("/nonexistent/housing.data")
+
+
+def test_imdb_from_directory(tmp_path):
+    for mode in ("train", "test"):
+        for sub, texts in (("pos", ["great movie", "loved it"]),
+                           ("neg", ["terrible film", "awful plot"])):
+            d = tmp_path / mode / sub
+            d.mkdir(parents=True)
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+    ds = tds.Imdb(str(tmp_path), mode="train", cutoff=1)
+    assert len(ds) == 4
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert sorted(set(ds.labels.tolist())) == [0, 1]
+    # vocab is shared into test split like the reference's word_dict
+    ds2 = tds.Imdb(str(tmp_path), mode="test", vocab=ds.word_idx)
+    assert ds2.word_idx is ds.word_idx
+
+
+def test_conll05_parsing(tmp_path):
+    f = tmp_path / "srl.tsv"
+    f.write_text(textwrap.dedent("""\
+        The\t-\tB-A0
+        cat\t-\tI-A0
+        sat\tsat\tB-V
+
+        Dogs\t-\tB-A0
+        bark\tbark\tB-V
+    """))
+    ds = tds.Conll05st(str(f))
+    assert len(ds) == 2
+    w, p, l = ds[0]
+    assert len(w) == 3 and p.tolist() == [0, 0, 1]
+
+
+def test_imdb_cutoff_is_frequency_threshold(tmp_path):
+    d = tmp_path / "train" / "pos"
+    d.mkdir(parents=True)
+    (d / "0.txt").write_text("common common common rare")
+    n = tmp_path / "train" / "neg"
+    n.mkdir(parents=True)
+    (n / "0.txt").write_text("common")
+    (tmp_path / "test" / "pos").mkdir(parents=True)
+    (tmp_path / "test" / "neg").mkdir(parents=True)
+    ds = tds.Imdb(str(tmp_path), mode="train", cutoff=2)
+    assert "common" in ds.word_idx and "rare" not in ds.word_idx
+
+
+def test_memory_stats_accepts_paddle_device_ids():
+    # int and "backend:idx" forms must resolve, not silently report 0
+    assert monitor.memory_allocated(0) >= 0
+    assert monitor.memory_allocated("cpu:0") >= 0
+
+
+def test_build_vocab_frequency_order():
+    v = tds.build_vocab(["a b a", "a c"])
+    assert v["<pad>"] == 0 and v["<unk>"] == 1
+    assert v["a"] == 2  # most frequent first
+
+
+def test_sysconfig_paths():
+    import paddle_tpu.sysconfig as sc
+    assert os.path.isdir(sc.get_include())
+    assert os.path.isdir(sc.get_lib())
