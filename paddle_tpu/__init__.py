@@ -42,7 +42,8 @@ from . import framework  # noqa: F401
 import importlib as _importlib
 
 _SUBPACKAGES = [
-    "amp", "autograd", "device", "distributed", "hapi", "inference", "io",
+    "amp", "autograd", "device", "distribution", "distributed", "hapi",
+    "inference", "io",
     "jit", "metric", "nn", "onnx", "optimizer", "profiler", "quantization",
     "regularizer", "static", "sysconfig", "text", "utils", "vision",
     "incubate",

@@ -15,6 +15,8 @@ from .fleet_base import (  # noqa: F401
 )
 from .dist_step import DistributedTrainStep  # noqa: F401
 from .ps import PSRuntime, SparseTable  # noqa: F401
+from .heter import HeterTrainer  # noqa: F401
+from . import dgc  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetBase, InMemoryDataset, QueueDataset,
 )

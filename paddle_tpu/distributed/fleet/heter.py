@@ -1,0 +1,118 @@
+"""Heterogeneous PS training — host (CPU) sparse embeddings + TPU dense
+compute, overlapped.
+
+Parity target (SURVEY §2.6 "Heterogeneous PS / PS-GPU"): the reference
+splits rec-model training between CPU workers holding huge sparse
+embedding tables and GPU/XPU workers running the dense net
+(framework/heterxpu_trainer.cc, heter_ps/ GPU hashtable cache,
+DownpourWorker's PullSparse -> forward/backward -> PushSparse loop,
+framework/fleet/fleet_wrapper.h:111-185).
+
+TPU-native shape: the sparse tables are the host-side
+:class:`~paddle_tpu.distributed.fleet.ps.SparseTable` (native C++ shards);
+the dense step is ONE jit'd XLA program taking the pulled embedding rows
+as an input and returning (metrics, embedding-row gradients). The trainer
+runs a software pipeline across three lanes so the TPU never waits on the
+host:
+
+    lane P (host threads): pull rows for batch i+1
+    lane C (TPU):          dense step on batch i
+    lane U (host threads): push grads of batch i-1 (async, like the
+                           reference's PushSparseVarsWithLabelAsync)
+
+``sync_mode=True`` degrades to pull->step->push per batch (the
+reference's sync communicator mode).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+from .ps import SparseTable
+
+__all__ = ["HeterTrainer"]
+
+
+class HeterTrainer:
+    def __init__(self, tables: Dict[str, SparseTable],
+                 dense_step: Callable,
+                 sync_mode: bool = False, pull_threads: int = 2):
+        """``dense_step(embeddings: dict[str, np.ndarray], batch) ->
+        (result, grads: dict[str, np.ndarray])`` — typically a jitted
+        closure over the dense params; grads are d(loss)/d(rows), one row
+        per pulled id (duplicate ids get summed by SparseTable.push)."""
+        self._tables = tables
+        self._dense_step = dense_step
+        self._sync = sync_mode
+        self._pool = ThreadPoolExecutor(max_workers=pull_threads,
+                                        thread_name_prefix="heter_ps")
+        self._pending_push = []
+
+    # -- lanes ---------------------------------------------------------
+    def _pull(self, ids_map: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {name: self._tables[name].pull(
+                    np.ascontiguousarray(np.asarray(ids), np.int64))
+                for name, ids in ids_map.items()}
+
+    def _push(self, ids_map, grads: Dict[str, np.ndarray]):
+        for name, g in grads.items():
+            self._tables[name].push(
+                np.ascontiguousarray(np.asarray(ids_map[name]), np.int64),
+                np.asarray(g))
+
+    def _drain_pushes(self, keep: int = 0):
+        while len(self._pending_push) > keep:
+            self._pending_push.pop(0).result()
+
+    # -- run loop ------------------------------------------------------
+    def run(self, batches: Iterable, ids_fn: Callable,
+            on_result: Optional[Callable] = None) -> int:
+        """Train over ``batches``. ``ids_fn(batch) -> {table: int64 ids}``
+        names which rows each batch needs. Returns the step count.
+
+        Pipeline: pull(i+1) on host threads overlaps the TPU dense step
+        on batch i; pushes are fire-and-forget futures drained with one
+        batch of lag (async mode) or inline (sync mode).
+        """
+        it = iter(batches)
+        try:
+            batch = next(it)
+        except StopIteration:
+            return 0
+        ids = ids_fn(batch)
+        pull_f = self._pool.submit(self._pull, ids)
+        steps = 0
+        while True:
+            try:
+                nxt = next(it)
+            except StopIteration:
+                nxt = None
+            nxt_ids = ids_fn(nxt) if nxt is not None else None
+            emb = pull_f.result()
+            if nxt is not None:  # prefetch lane for the NEXT batch
+                # ALL pushes through batch i-1 must land before the pull
+                # for batch i+1 reads the tables — the guaranteed staleness
+                # bound is exactly one batch (batch i's own push), the
+                # async-communicator semantics of the reference
+                self._drain_pushes(keep=0)
+                pull_f = self._pool.submit(self._pull, nxt_ids)
+            result, grads = self._dense_step(emb, batch)   # TPU lane
+            if self._sync:
+                self._push(ids, grads)
+            else:
+                self._pending_push.append(
+                    self._pool.submit(self._push, ids, grads))
+            if on_result is not None:
+                on_result(steps, result)
+            steps += 1
+            if nxt is None:
+                break
+            batch, ids = nxt, nxt_ids
+        self._drain_pushes(keep=0)
+        return steps
+
+    def shutdown(self):
+        self._drain_pushes(keep=0)
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,209 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Parity target: reference python/paddle/distribution.py (v2.0 ships
+Distribution base + Normal, Uniform, Categorical with sample/entropy/
+log_prob/probs/kl_divergence). TPU-native: sampling draws from the global
+PRNG-key stream (framework/random.py) instead of stateful cuRAND, and all
+math is jax — so the same code traces under jit and differentiates.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor, _apply, to_tensor
+from .framework.random import split_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "kl_divergence"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, (jnp.ndarray, jax.Array)) else x
+
+
+def _t(x):
+    """Wrap as Tensor PRESERVING autograd identity — a distribution built
+    on a trainable parameter must backprop into it (the reference's
+    Normal(loc=variable) does)."""
+    return x if isinstance(x, Tensor) else to_tensor(_v(x))
+
+
+class Distribution:
+    """Base class (parity: paddle.distribution.Distribution)."""
+
+    def sample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return _apply(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def kl_divergence(self, other: "Distribution"):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """N(loc, scale) (parity: paddle.distribution.Normal — sample,
+    entropy, log_prob, kl_divergence; reference distribution.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        key = split_key(1)
+        shp = tuple(shape) + tuple(np.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+
+        def fn(loc, scale):
+            eps = jax.random.normal(key, shp, dtype=jnp.float32)
+            return loc + scale * eps
+
+        return _apply(fn, self.loc, self.scale, op_name="normal_sample")
+
+    def entropy(self):
+        def fn(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return _apply(fn, self.scale, op_name="normal_entropy")
+
+    def log_prob(self, value):
+        value = _t(value)  # preserve autograd through the evaluated point
+        # (reparameterized samples need d(log_prob)/d(value))
+
+        def fn(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return _apply(fn, value, self.loc, self.scale,
+                      op_name="normal_log_prob")
+
+    def kl_divergence(self, other: "Normal"):
+        def fn(l1, s1, l2, s2):
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+        return _apply(fn, self.loc, self.scale, other.loc, other.scale,
+                      op_name="normal_kl")
+
+
+class Uniform(Distribution):
+    """U[low, high) (parity: paddle.distribution.Uniform)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        key = split_key(1)
+        shp = tuple(shape) + tuple(np.broadcast_shapes(
+            self.low.shape, self.high.shape))
+
+        def fn(lo, hi):
+            u = jax.random.uniform(key, shp, dtype=jnp.float32)
+            return lo + (hi - lo) * u
+
+        return _apply(fn, self.low, self.high, op_name="uniform_sample")
+
+    def entropy(self):
+        def fn(lo, hi):
+            return jnp.log(hi - lo)
+        return _apply(fn, self.low, self.high, op_name="uniform_entropy")
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return _apply(fn, value, self.low, self.high,
+                      op_name="uniform_log_prob")
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (parity:
+    paddle.distribution.Categorical — sample, entropy, kl_divergence,
+    probs)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def _log_pmf(self):
+        def fn(lg):
+            return lg - jax.scipy.special.logsumexp(lg, axis=-1,
+                                                    keepdims=True)
+        return _apply(fn, self.logits, op_name="categorical_log_pmf")
+
+    def sample(self, shape: Sequence[int] = ()):
+        key = split_key(1)
+
+        def fn(lg):
+            return jax.random.categorical(key, lg, axis=-1,
+                                          shape=tuple(shape) + lg.shape[:-1])
+
+        out = _apply(fn, self.logits, op_name="categorical_sample")
+        out.stop_gradient = True
+        return out
+
+    def entropy(self):
+        def fn(lg):
+            logp = lg - jax.scipy.special.logsumexp(lg, axis=-1,
+                                                    keepdims=True)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return _apply(fn, self.logits, op_name="categorical_entropy")
+
+    def log_prob(self, value):
+        value = to_tensor(value)
+        logp = self._log_pmf()
+
+        def fn(lp, idx):
+            idx = idx.astype(jnp.int32)
+            if lp.ndim == 1:
+                # 1-D logits: value is a list of category indices
+                return lp[idx]
+            return jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0]
+
+        return _apply(fn, logp, value, op_name="categorical_log_prob")
+
+    def probs(self, value=None):
+        def fn(lg):
+            return jax.nn.softmax(lg, axis=-1)
+        p = _apply(fn, self.logits, op_name="categorical_probs")
+        if value is None:
+            return p
+
+        def pick(pv, idx):
+            idx = idx.astype(jnp.int32)
+            if pv.ndim == 1:
+                return pv[idx]
+            return jnp.take_along_axis(pv, idx[..., None], axis=-1)[..., 0]
+        return _apply(pick, p, to_tensor(value), op_name="categorical_pick")
+
+    def kl_divergence(self, other: "Categorical"):
+        def fn(a, b):
+            la = a - jax.scipy.special.logsumexp(a, -1, keepdims=True)
+            lb = b - jax.scipy.special.logsumexp(b, -1, keepdims=True)
+            return (jnp.exp(la) * (la - lb)).sum(-1)
+        return _apply(fn, self.logits, other.logits,
+                      op_name="categorical_kl")
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """paddle.distribution.kl_divergence dispatch."""
+    return p.kl_divergence(q)

@@ -87,6 +87,8 @@ def device_memory_stats(device=None) -> Dict[str, int]:
         # paddle-style "gpu:0" / "tpu:1" / "cpu" ids
         idx = int(device.split(":", 1)[1]) if ":" in device else 0
         dev = jax.devices()[idx]
+    elif hasattr(device, "jax_device"):
+        dev = device.jax_device()  # a paddle Place (TPUPlace/CUDAPlace/…)
     else:
         dev = device  # a jax.Device
     try:

@@ -44,7 +44,13 @@ class Program:
 
     def clone(self, for_test=False):
         import copy
-        return copy.copy(self)
+        c = copy.copy(self)
+        # snapshot helper-layer registration: fc() mutates these in place,
+        # a clone must not grow when the original gains layers afterwards
+        c._layers = list(self._layers)
+        if hasattr(self, "_layer_ids"):
+            c._layer_ids = set(self._layer_ids)
+        return c
 
 
 _main = Program()

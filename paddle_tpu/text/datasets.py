@@ -63,15 +63,20 @@ class Imdb(Dataset):
         labels: List[int] = []
         if os.path.isfile(data_dir):
             with tarfile.open(data_dir) as tf:
-                pat = re.compile(
-                    rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+                # search, not an anchored match: members may carry "./" or
+                # a different root prefix depending on how the tar was made
+                pat = re.compile(rf"(?:^|/){mode}/(pos|neg)/[^/]*\.txt$")
                 for m in tf.getmembers():
-                    g = pat.match(m.name)
+                    g = pat.search(m.name)
                     if not g:
                         continue
                     texts.append(tf.extractfile(m).read().decode(
                         "utf-8", "ignore").lower())
                     labels.append(1 if g.group(1) == "pos" else 0)
+            if not texts:
+                raise FileNotFoundError(
+                    f"Imdb: tarball {data_dir!r} contains no "
+                    f"{mode}/pos|neg/*.txt members")
         else:
             for li, sub in ((1, "pos"), (0, "neg")):
                 d = os.path.join(data_dir, mode, sub)
