@@ -41,6 +41,13 @@ def main():
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
     strategy = fleet.DistributedStrategy()
+    # bf16 compute (f32 master weights): convs/matmuls hit the MXU at
+    # its native precision — the TPU-default training configuration.
+    # CPU smoke runs keep f32 (hosts emulate bf16, slower).
+    # Override either way with BENCH_AMP=0/1.
+    if os.environ.get("BENCH_AMP", "0" if smoke else "1") == "1":
+        strategy.amp = True
+        strategy.amp_configs = {"dtype": "bfloat16"}
 
     def loss_fn(img, label):
         logits = model(img)

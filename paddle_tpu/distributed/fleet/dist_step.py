@@ -123,6 +123,8 @@ class DistributedTrainStep:
         self._compiled = None
         self._accum = None  # gradient-merge accumulators
         self._step_i = np.int64(0)
+        self._use_scaling = False  # set by _build for float16 AMP
+        self._amp_state = None     # (loss_scale, good_step_count)
 
     # sharding derivation ---------------------------------------------
     def _param_specs(self) -> Dict[str, P]:
@@ -180,7 +182,36 @@ class DistributedTrainStep:
         k_steps, gm_avg = self._k_steps, self._gm_avg
         use_remat = strategy.recompute
 
+        # AMP (reference: AMPOptimizer -> mixed_precision/decorator.py graph
+        # rewrite + amp ops). TPU-native: master params stay f32; inside the
+        # step every f32 param/batch leaf is cast to the compute dtype, so
+        # matmuls/convs hit the MXU in bf16 and the f32 grads fall out of
+        # the cast's VJP. float16 additionally runs the reference's dynamic
+        # loss-scaling state machine (check_finite_and_unscale +
+        # update_loss_scaling ops) inside the same compiled step.
+        amp_on = bool(strategy.amp)
+        acfg = strategy.amp_configs
+        amp_jdt = (jnp.bfloat16
+                   if str(acfg.get("dtype", "bfloat16")) in
+                   ("bfloat16", "bf16")
+                   else jnp.float16)
+        use_scaling = bool(amp_on and amp_jdt == jnp.float16
+                           and acfg["use_dynamic_loss_scaling"])
+        if use_scaling and k_steps > 1:
+            raise NotImplementedError(
+                "float16 dynamic loss scaling + gradient_merge is not "
+                "supported; use bfloat16 (TPU-native, no scaling needed)")
+
+        def _amp_cast(tree):
+            return jax.tree_util.tree_map(
+                lambda v: v.astype(amp_jdt)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32 else v,
+                tree)
+
         def loss_of(pvals, buffer_vals, key, args):
+            if amp_on:
+                pvals = _amp_cast(pvals)
+                args = _amp_cast(args)
             targs = _tree_to_tensors(args)
             with use_key(key):
                 st = model.state_dict()
@@ -197,6 +228,8 @@ class DistributedTrainStep:
                     for k, t in st.items():
                         t._value = old[k]
             lv = out._value if isinstance(out, Tensor) else out
+            if amp_on:
+                lv = lv.astype(jnp.float32)
             return lv, new_bufs
 
         if use_remat:
@@ -220,7 +253,55 @@ class DistributedTrainStep:
                                                    lr=lr)
             return dict(zip(names, new_ps)), new_ss
 
-        if k_steps <= 1:
+        if use_scaling:
+            incr_every = int(acfg["incr_every_n_steps"])
+            incr_ratio = float(acfg["incr_ratio"])
+            decr_ratio = float(acfg["decr_ratio"])
+            decr_every = int(acfg["decr_every_n_nan_or_inf"])
+
+            def step(pvals, bufs, opt_state, amp_state, lr, key, args):
+                scale, good, bad = amp_state
+
+                def scaled(p, b, k, a):
+                    l, nb = loss_of(p, b, k, a)
+                    return l * scale, nb
+
+                (slv, nbufs), grads = jax.value_and_grad(
+                    scaled, has_aux=True)(pvals, bufs, key, args)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g / scale).astype(jnp.float32), grads)
+                finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g))
+                     for g in jax.tree_util.tree_leaves(grads)]))
+
+                def apply_branch(op):
+                    pv, st = op
+                    return apply_opt(pv, grads, st, lr)
+
+                def skip_branch(op):  # overflow: drop the step
+                    pv, st = op
+                    return dict(pv), [dict(s) for s in st]
+
+                new_p, new_s = jax.lax.cond(finite, apply_branch,
+                                            skip_branch,
+                                            (pvals, opt_state))
+                # update_loss_scaling state machine (reference
+                # operators/amp/update_loss_scaling_op.cc): grow after
+                # incr_every consecutive finite steps, shrink only after
+                # decr_every CONSECUTIVE nan/inf steps
+                good = jnp.where(finite, good + 1, 0)
+                bad = jnp.where(finite, 0, bad + 1)
+                grow = good >= incr_every
+                shrink = bad >= decr_every
+                new_scale = jnp.where(
+                    grow, scale * incr_ratio,
+                    jnp.where(shrink, scale * decr_ratio, scale))
+                good = jnp.where(grow, 0, good)
+                bad = jnp.where(shrink, 0, bad)
+                return (slv / scale, new_p, nbufs, new_s,
+                        (new_scale, good, bad))
+            donate = (0, 1, 2, 3)
+        elif k_steps <= 1:
             def step(pvals, bufs, opt_state, lr, key, args):
                 loss, nbufs, grads = grads_of(pvals, bufs, key, args)
                 new_p, new_s = apply_opt(pvals, grads, opt_state, lr)
@@ -258,13 +339,22 @@ class DistributedTrainStep:
         bufspec = {k: P() for k in self._buffers}
         in_specs = [pspecs, bufspec, sspecs]
         out_specs = [P(), pspecs, bufspec, sspecs]
-        if k_steps > 1:
+        if use_scaling:
+            in_specs += [(P(), P(), P()), P(), P(), bspec]  # amp_state,lr,key
+            out_specs += [(P(), P(), P())]
+        elif k_steps > 1:
             gspecs = pspecs  # accumulators shard like their params
             in_specs += [gspecs, P(), P(), P(), bspec]
             out_specs += [gspecs]
         else:
             in_specs += [P(), P(), bspec]
         sh = self._shardings
+        self._use_scaling = use_scaling
+        if use_scaling and self._amp_state is None:
+            self._amp_state = (
+                jnp.asarray(float(acfg["init_loss_scaling"]), jnp.float32),
+                jnp.asarray(0, jnp.int32),   # consecutive finite steps
+                jnp.asarray(0, jnp.int32))   # consecutive nan/inf steps
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=sh(tuple(in_specs)),
                        out_shardings=sh(tuple(out_specs)))
@@ -299,7 +389,12 @@ class DistributedTrainStep:
         key = split_key()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with no_grad():
-            if self._k_steps > 1:
+            if self._use_scaling:
+                (loss, new_p, new_b, new_s,
+                 self._amp_state) = self._compiled(
+                    param_vals, buffer_vals, opt_state, self._amp_state,
+                    lr, key, arg_vals)
+            elif self._k_steps > 1:
                 loss, new_p, new_b, new_s, self._accum = self._compiled(
                     param_vals, buffer_vals, opt_state, self._accum,
                     jnp.asarray(self._step_i, jnp.int32), lr, key, arg_vals)

@@ -36,6 +36,14 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(_v(x))
 
 
+def _sample_key(seed: int):
+    """paddle sample(seed=...) semantics: seed=0 means draw from the
+    global stream; a nonzero seed gives a reproducible standalone draw."""
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return split_key(1)
+
+
 class Distribution:
     """Base class (parity: paddle.distribution.Distribution)."""
 
@@ -64,7 +72,7 @@ class Normal(Distribution):
         self.scale = _t(scale)
 
     def sample(self, shape: Sequence[int] = (), seed: int = 0):
-        key = split_key(1)
+        key = _sample_key(seed)
         shp = tuple(shape) + tuple(np.broadcast_shapes(
             self.loc.shape, self.scale.shape))
 
@@ -109,7 +117,7 @@ class Uniform(Distribution):
         self.high = _t(high)
 
     def sample(self, shape: Sequence[int] = (), seed: int = 0):
-        key = split_key(1)
+        key = _sample_key(seed)
         shp = tuple(shape) + tuple(np.broadcast_shapes(
             self.low.shape, self.high.shape))
 

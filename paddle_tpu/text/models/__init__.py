@@ -1,9 +1,16 @@
+from .bert import (  # noqa: F401
+    BertConfig, BertForPretraining, BertModel, BertPretrainingCriterion,
+    bert_base, bert_large, bert_tiny, ernie_base,
+)
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, RMSNorm,
     llama_tiny, llama_7b, llama_13b,
 )
 
 __all__ = [
+    "BertConfig", "BertForPretraining", "BertModel",
+    "BertPretrainingCriterion", "bert_base", "bert_large", "bert_tiny",
+    "ernie_base",
     "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "RMSNorm",
     "llama_tiny", "llama_7b", "llama_13b",
 ]

@@ -1,0 +1,287 @@
+"""BERT/ERNIE-family bidirectional encoder with pretraining heads.
+
+Parity target: the reference's transformer encoder stack
+(python/paddle/nn/layer/transformer.py — TransformerEncoder powering the
+PaddleNLP BERT/ERNIE models of BASELINE.md north-star config 3: "ERNIE-3.0
+/ BERT-base pretrain, Fleet collective") and the dygraph_to_static BERT
+test model (python/paddle/fluid/tests/unittests/dygraph_to_static/
+bert_dygraph_model.py: PretrainModelLayer with MLM + NSP heads).
+
+TPU-native design, mirroring text/models/llama.py:
+- Q/K/V/O projections are tensor-parallel annotated
+  (ColumnParallelLinear/RowParallelLinear over the 'tp' mesh axis), so the
+  same model runs single-chip or sharded under DistributedTrainStep.
+- attention runs the Pallas flash kernel when eligible (non-causal),
+  falling back to the reference jnp path.
+- bf16-friendly: no data-dependent control flow; everything jits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.nn.functional as F
+from ...distributed import mesh as mesh_mod
+from ...distributed.meta_parallel import (ColumnParallelLinear,
+                                          RowParallelLinear,
+                                          VocabParallelEmbedding)
+from ...framework.core import Tensor, _apply
+from ...nn import Dropout, Embedding, Layer, LayerNorm, Linear, Tanh
+from ...nn.initializer import Normal
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertPretrainingCriterion", "bert_base", "bert_large",
+           "bert_tiny", "ernie_base"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def bert_tiny(**kw) -> BertConfig:
+    d = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+             num_attention_heads=2, intermediate_size=512,
+             max_position_embeddings=128)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    d = dict(hidden_size=1024, num_hidden_layers=24,
+             num_attention_heads=16, intermediate_size=4096)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+def ernie_base(**kw) -> BertConfig:
+    """ERNIE-base shares BERT-base geometry (ERNIE differs in pretraining
+    data/masking strategy, not architecture)."""
+    d = dict(vocab_size=18000)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings -> LayerNorm -> dropout."""
+
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(0.0, c.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        B, S = input_ids.shape
+        if position_ids is None:
+            position_ids = Tensor(jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)))
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros((B, S), jnp.int32))
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional MHA with TP-sharded heads (column Q/K/V, row O)."""
+
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(0.0, c.initializer_range)
+        self.config = c
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, weight_attr=init, has_bias=True,
+            input_is_parallel=True)
+        self.dropout_p = c.attention_probs_dropout_prob
+
+    def forward(self, hidden, attention_mask=None):
+        c = self.config
+        qkv = self.qkv_proj(hidden)
+        drop_p = self.dropout_p if self.training else 0.0
+        drop_key = None
+        if drop_p > 0.0:
+            from ...framework.random import split_key
+            drop_key = split_key(1)
+
+        def attn(x, mask):
+            B, S = x.shape[0], x.shape[1]
+            q, k, v = jnp.split(x, 3, axis=-1)
+            qh = q.reshape(B, S, c.num_attention_heads, c.head_dim)
+            kh = k.reshape(B, S, c.num_attention_heads, c.head_dim)
+            vh = v.reshape(B, S, c.num_attention_heads, c.head_dim)
+            qh = mesh_mod.maybe_constrain(qh, P(None, None, "tp", None))
+            from ...nn.functional.attention import _sdpa_ref
+            from ...ops.flash_attention import flash_attention, flash_eligible
+            if mask is None and drop_p == 0.0 and \
+                    flash_eligible(S, c.head_dim):
+                o = flash_attention(qh, kh, vh, causal=False)
+            else:
+                m = None
+                if mask is not None:
+                    # [B, S] 1/0 padding mask -> additive [B, 1, 1, S]
+                    m = (1.0 - mask[:, None, None, :].astype(qh.dtype)) \
+                        * jnp.asarray(jnp.finfo(qh.dtype).min, qh.dtype)
+                o = _sdpa_ref(qh, kh, vh, m, drop_p, False, None,
+                              dropout_key=drop_key)
+            return o.reshape(B, S, c.hidden_size)
+
+        if attention_mask is None:
+            ctx = _apply(attn, qkv, None, op_name="bert_attention")
+        else:
+            ctx = _apply(attn, qkv, attention_mask,
+                         op_name="bert_attention")
+        return self.out_proj(ctx)
+
+
+class BertLayer(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(0.0, c.initializer_range)
+        self.attention = BertSelfAttention(c)
+        self.intermediate = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.output = RowParallelLinear(
+            c.intermediate_size, c.hidden_size, weight_attr=init,
+            has_bias=True, input_is_parallel=True)
+        self.norm1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.norm2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+        self.act = getattr(F, c.hidden_act)
+
+    def forward(self, hidden, attention_mask=None):
+        # post-norm residual blocks, the BERT-original layout (the
+        # reference TransformerEncoderLayer with normalize_before=False)
+        h = self.norm1(hidden + self.dropout(
+            self.attention(hidden, attention_mask)))
+        ff = self.output(self.act(self.intermediate(h)))
+        return self.norm2(h + self.dropout(ff))
+
+
+class BertModel(Layer):
+    """Encoder trunk -> (sequence_output, pooled_output)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        self.layers = LayerList([BertLayer(c)
+                                 for _ in range(c.num_hidden_layers)])
+        self.pooler = Linear(c.hidden_size, c.hidden_size,
+                             weight_attr=Normal(0.0, c.initializer_range))
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.layers:
+            h = layer(h, attention_mask)
+        pooled = self.pooler_act(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (parity: the reference BERT test model's
+    PretrainModelLayer — MLM transform + decoder tied to word embeddings,
+    NSP binary classifier on the pooled [CLS])."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        init = Normal(0.0, c.initializer_range)
+        self.bert = BertModel(c)
+        self.mlm_transform = Linear(c.hidden_size, c.hidden_size,
+                                    weight_attr=init)
+        self.mlm_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.act = getattr(F, c.hidden_act)
+        # decoder ties to word-embedding weights (standard BERT weight
+        # tying; only a bias is a fresh parameter)
+        from ...nn.layer.layers import Parameter
+        self.mlm_bias = Parameter(jnp.zeros((c.vocab_size,), jnp.float32))
+        self.nsp = Linear(c.hidden_size, 2, weight_attr=init)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        h = self.mlm_norm(self.act(self.mlm_transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight  # [V, H]
+
+        def decode(hv, wv, bv):
+            return jnp.einsum("bsh,vh->bsv", hv, wv) + bv
+
+        mlm_logits = _apply(decode, h, w, self.mlm_bias,
+                            op_name="mlm_decode")
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(Layer):
+    """Masked-position MLM cross-entropy + NSP cross-entropy (parity:
+    the reference pretrain loss in bert_dygraph_model.py)."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                mlm_weights=None):
+        def loss_fn(lg, ns, yl, yn, wts):
+            V = lg.shape[-1]
+            lp = lg - jnp.max(lg, -1, keepdims=True)
+            lse = jnp.log(jnp.exp(lp).sum(-1))
+            tok = lse - jnp.take_along_axis(
+                lp, yl.astype(jnp.int32)[..., None], -1)[..., 0]
+            if wts is None:
+                wts = jnp.ones_like(tok)
+            mlm = (tok * wts).sum() / jnp.maximum(wts.sum(), 1.0)
+            np_ = ns - jnp.max(ns, -1, keepdims=True)
+            nlse = jnp.log(jnp.exp(np_).sum(-1))
+            nsp = (nlse - jnp.take_along_axis(
+                np_, yn.astype(jnp.int32)[..., None], -1)[..., 0]).mean()
+            return mlm + nsp
+
+        args = [mlm_logits, nsp_logits, mlm_labels, nsp_labels]
+        if mlm_weights is None:
+            return _apply(lambda a, b, c_, d: loss_fn(a, b, c_, d, None),
+                          *args, op_name="bert_pretrain_loss")
+        return _apply(loss_fn, *args, mlm_weights,
+                      op_name="bert_pretrain_loss")

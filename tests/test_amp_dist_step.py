@@ -1,0 +1,153 @@
+"""AMP inside DistributedTrainStep: bf16 compute cast with f32 master
+weights, and the float16 dynamic loss-scaling state machine.
+
+Reference parity: AMPOptimizer (fleet/meta_optimizers/amp_optimizer.py) →
+mixed_precision/decorator.py rewrite; loss-scaling ops
+operators/amp/check_finite_and_unscale_op.cc + update_loss_scaling_op.cc.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                          DistributedTrainStep)
+
+
+@pytest.fixture(autouse=True)
+def fresh_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _build(seed=3):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=m.parameters())
+    return m, opt
+
+
+def _loss(model):
+    def f(x, y):
+        return ((model(x) - y) ** 2).mean()
+    return f
+
+
+def _data(n=8, b=8):
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(n, b, 16)).astype(np.float32),
+            rng.normal(size=(n, b, 4)).astype(np.float32))
+
+
+def _run(strategy, n=8):
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, strategy, mesh=mesh)
+    xs, ys = _data(n)
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for x, y in zip(xs, ys)]
+    return m, losses, step
+
+
+def test_bf16_amp_trains_and_master_weights_stay_f32():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "bfloat16"}
+    m, losses, _ = _run(s)
+    assert losses[-1] < losses[0]
+    for _, p in m.named_parameters():
+        assert str(p.dtype.name) == "float32"  # master weights untouched
+
+
+def test_bf16_amp_close_to_f32_training():
+    s32 = DistributedStrategy()
+    _, l32, _ = _run(s32)
+    s16 = DistributedStrategy()
+    s16.amp = True
+    s16.amp_configs = {"dtype": "bfloat16"}
+    _, l16, _ = _run(s16)
+    # same trajectory within bf16 rounding
+    np.testing.assert_allclose(l16, l32, rtol=0.1, atol=0.05)
+
+
+def test_fp16_dynamic_loss_scaling_runs_and_grows():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 2.0 ** 10,
+                     "incr_every_n_steps": 4, "incr_ratio": 2.0}
+    m, losses, step = _run(s, n=9)
+    assert losses[-1] < losses[0]
+    scale, good, bad = step._amp_state
+    # 9 finite steps with incr_every=4 -> scale doubled twice
+    assert float(scale) == pytest.approx(2.0 ** 12)
+    assert int(bad) == 0
+
+
+def test_fp16_overflow_skips_update_and_shrinks_scale():
+    s = DistributedStrategy()
+    s.amp = True
+    # scale so large that fp16 grads overflow immediately
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 2.0 ** 60,
+                     "incr_every_n_steps": 1000, "decr_ratio": 0.5,
+                     "decr_every_n_nan_or_inf": 1}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh)
+    before = {n: p.numpy().copy() for n, p in m.named_parameters()}
+    xs, ys = _data(1)
+    step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
+    after = {n: p.numpy() for n, p in m.named_parameters()}
+    for n in before:  # overflowed step must be dropped entirely
+        np.testing.assert_array_equal(before[n], after[n])
+    scale, good, bad = step._amp_state
+    assert float(scale) == pytest.approx(2.0 ** 59)  # decr_ratio applied
+    assert int(good) == 0
+
+
+def test_fp16_transient_overflow_needs_consecutive_bad_steps():
+    """decr_every_n_nan_or_inf=2 (the reference default): ONE overflow
+    must not shrink the scale, two consecutive ones must."""
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 2.0 ** 60,
+                     "incr_every_n_steps": 1000, "decr_ratio": 0.5,
+                     "decr_every_n_nan_or_inf": 2}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh)
+    xs, ys = _data(2)
+    step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
+    scale, good, bad = step._amp_state
+    assert float(scale) == pytest.approx(2.0 ** 60)  # unchanged after 1
+    assert int(bad) == 1
+    step(paddle.to_tensor(xs[1]), paddle.to_tensor(ys[1]))
+    scale, good, bad = step._amp_state
+    assert float(scale) == pytest.approx(2.0 ** 59)  # shrunk after 2
+    assert int(bad) == 0
+
+
+def test_fp16_scaling_with_gradient_merge_rejected():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16"}
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 2}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh)
+    xs, ys = _data(1)
+    with pytest.raises(NotImplementedError, match="bfloat16"):
+        step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
+
+
+def test_bf16_amp_composes_with_zero_sharding():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "bfloat16"}
+    s.sharding = True
+    s.sharding_configs = {"stage": 2, "sharding_degree": 4}
+    m, losses, _ = _run(s)
+    assert losses[-1] < losses[0]

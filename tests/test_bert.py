@@ -1,0 +1,158 @@
+"""BERT/ERNIE encoder family tests (BASELINE north-star config 3;
+reference model shape: dygraph_to_static/bert_dygraph_model.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models import (BertForPretraining,
+                                    BertPretrainingCriterion, BertModel,
+                                    bert_base, bert_tiny)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    paddle.seed(0)
+    return BertForPretraining(bert_tiny())
+
+
+def _batch(rng, cfg, B=2, S=16):
+    return {
+        "input_ids": paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (B, S)).astype("int32")),
+        "token_type_ids": paddle.to_tensor(
+            (rng.rand(B, S) > 0.5).astype("int32")),
+        "attention_mask": paddle.to_tensor(
+            np.concatenate([np.ones((B, S - 4)), np.zeros((B, 4))],
+                           axis=1).astype("float32")),
+    }
+
+
+def test_forward_shapes(tiny):
+    cfg = tiny.config
+    rng = np.random.RandomState(0)
+    b = _batch(rng, cfg)
+    mlm, nsp = tiny(**b)
+    assert mlm.shape == [2, 16, cfg.vocab_size]
+    assert nsp.shape == [2, 2]
+
+
+def test_padding_mask_blocks_attention(tiny):
+    """Changing PAD-position token ids must not change non-pad outputs."""
+    cfg = tiny.config
+    rng = np.random.RandomState(1)
+    b = _batch(rng, cfg)
+    tiny.eval()
+    seq1, _ = tiny.bert(b["input_ids"], b["token_type_ids"],
+                        attention_mask=b["attention_mask"])
+    ids2 = b["input_ids"].numpy().copy()
+    ids2[:, -4:] = (ids2[:, -4:] + 7) % cfg.vocab_size  # perturb pads
+    seq2, _ = tiny.bert(paddle.to_tensor(ids2), b["token_type_ids"],
+                        attention_mask=b["attention_mask"])
+    np.testing.assert_allclose(seq1.numpy()[:, :-4], seq2.numpy()[:, :-4],
+                               atol=2e-5)
+    tiny.train()
+
+
+def test_bidirectional_not_causal(tiny):
+    """A change at the LAST position must affect the FIRST position's
+    representation (bidirectional attention, unlike the llama decoder)."""
+    cfg = tiny.config
+    rng = np.random.RandomState(2)
+    b = _batch(rng, cfg)
+    tiny.eval()
+    seq1, _ = tiny.bert(b["input_ids"])
+    ids2 = b["input_ids"].numpy().copy()
+    ids2[:, -1] = (ids2[:, -1] + 3) % cfg.vocab_size
+    seq2, _ = tiny.bert(paddle.to_tensor(ids2))
+    assert np.abs(seq1.numpy()[:, 0] - seq2.numpy()[:, 0]).max() > 1e-6
+    tiny.train()
+
+
+def test_mlm_decoder_tied_to_embeddings(tiny):
+    w = tiny.bert.embeddings.word_embeddings.weight
+    n_params = sum(1 for _, p in tiny.named_parameters())
+    # the tied decoder must NOT add a second [V, H] matrix
+    mats = [p for _, p in tiny.named_parameters()
+            if list(p.shape) == list(w.shape)]
+    assert len(mats) == 1
+
+
+def test_pretrain_step_decreases_loss():
+    paddle.seed(3)
+    cfg = bert_tiny(num_hidden_layers=1, hidden_size=64,
+                    intermediate_size=128, vocab_size=256)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(4)
+    B, S = 4, 16
+    ids = paddle.to_tensor(rng.randint(0, 256, (B, S)).astype("int32"))
+    mlm_labels = paddle.to_tensor(rng.randint(0, 256, (B, S)))
+    nsp_labels = paddle.to_tensor(rng.randint(0, 2, (B,)))
+    weights = paddle.to_tensor(
+        (rng.rand(B, S) < 0.15).astype("float32"))  # 15% masked positions
+    l0 = None
+    for _ in range(25):
+        mlm, nsp = model(ids)
+        loss = crit(mlm, nsp, mlm_labels, nsp_labels, weights)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0
+
+
+def test_bert_under_jit_matches_eager():
+    import jax
+    paddle.seed(5)
+    cfg = bert_tiny(num_hidden_layers=1)
+    model = BertModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(6)
+    ids = rng.randint(0, cfg.vocab_size, (2, 8)).astype("int32")
+    seq_eager, pooled_eager = model(paddle.to_tensor(ids))
+
+    st = dict(model.named_parameters())
+    names = sorted(st)
+
+    def fn(pvals, x):
+        old = {n: st[n]._value for n in names}
+        try:
+            for n in names:
+                st[n]._value = pvals[n]
+            with paddle.no_grad():
+                seq, pooled = model(paddle.to_tensor(x))
+            return seq._value, pooled._value
+        finally:
+            for n in names:
+                st[n]._value = old[n]
+
+    seq_jit, pooled_jit = jax.jit(fn)({n: st[n]._value for n in names}, ids)
+    np.testing.assert_allclose(seq_eager.numpy(), np.asarray(seq_jit),
+                               atol=2e-5)
+    np.testing.assert_allclose(pooled_eager.numpy(),
+                               np.asarray(pooled_jit), atol=2e-5)
+
+
+def test_tp_sharded_bert_on_mesh():
+    """BertModel forward under a tp=2 mesh mesh-shards the projections."""
+    import jax
+    from paddle_tpu.distributed import mesh as mesh_mod
+    paddle.seed(7)
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    from jax.sharding import Mesh
+    with Mesh(devs, ("dp", "tp")):
+        mesh_mod.set_mesh(Mesh(devs, ("dp", "tp")))
+        try:
+            cfg = bert_tiny(num_hidden_layers=1)
+            model = BertModel(cfg)
+            model.eval()
+            ids = np.random.RandomState(8).randint(
+                0, cfg.vocab_size, (2, 8)).astype("int32")
+            seq, pooled = model(paddle.to_tensor(ids))
+            assert seq.shape == [2, 8, cfg.hidden_size]
+        finally:
+            mesh_mod.set_mesh(None)
