@@ -124,7 +124,8 @@ class DistributedTrainStep:
         self._accum = None  # gradient-merge accumulators
         self._step_i = np.int64(0)
         self._use_scaling = False  # set by _build for float16 AMP
-        self._amp_state = None     # (loss_scale, good_step_count)
+        # (loss_scale, consecutive_finite_steps, consecutive_bad_steps)
+        self._amp_state = None
 
     # sharding derivation ---------------------------------------------
     def _param_specs(self) -> Dict[str, P]:
@@ -195,8 +196,11 @@ class DistributedTrainStep:
                    if str(acfg.get("dtype", "bfloat16")) in
                    ("bfloat16", "bf16")
                    else jnp.float16)
-        use_scaling = bool(amp_on and amp_jdt == jnp.float16
-                           and acfg["use_dynamic_loss_scaling"])
+        # fp16 ALWAYS runs the scaling path (reference: check_finite_and_
+        # unscale runs regardless); use_dynamic_loss_scaling only controls
+        # whether the scale moves — off means a constant init_loss_scaling
+        use_scaling = bool(amp_on and amp_jdt == jnp.float16)
+        dyn_scaling = bool(acfg["use_dynamic_loss_scaling"])
         if use_scaling and k_steps > 1:
             raise NotImplementedError(
                 "float16 dynamic loss scaling + gradient_merge is not "
@@ -288,16 +292,21 @@ class DistributedTrainStep:
                 # update_loss_scaling state machine (reference
                 # operators/amp/update_loss_scaling_op.cc): grow after
                 # incr_every consecutive finite steps, shrink only after
-                # decr_every CONSECUTIVE nan/inf steps
-                good = jnp.where(finite, good + 1, 0)
-                bad = jnp.where(finite, 0, bad + 1)
-                grow = good >= incr_every
-                shrink = bad >= decr_every
-                new_scale = jnp.where(
-                    grow, scale * incr_ratio,
-                    jnp.where(shrink, scale * decr_ratio, scale))
-                good = jnp.where(grow, 0, good)
-                bad = jnp.where(shrink, 0, bad)
+                # decr_every CONSECUTIVE nan/inf steps. Static mode
+                # (use_dynamic_loss_scaling=False): constant scale,
+                # overflow steps still dropped.
+                if dyn_scaling:
+                    good = jnp.where(finite, good + 1, 0)
+                    bad = jnp.where(finite, 0, bad + 1)
+                    grow = good >= incr_every
+                    shrink = bad >= decr_every
+                    new_scale = jnp.where(
+                        grow, scale * incr_ratio,
+                        jnp.where(shrink, scale * decr_ratio, scale))
+                    good = jnp.where(grow, 0, good)
+                    bad = jnp.where(shrink, 0, bad)
+                else:
+                    new_scale = scale
                 return (slv / scale, new_p, nbufs, new_s,
                         (new_scale, good, bad))
             donate = (0, 1, 2, 3)

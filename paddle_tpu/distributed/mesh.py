@@ -119,14 +119,42 @@ def named_sharding(spec: PartitionSpec,
     return NamedSharding(mesh or get_mesh(), spec)
 
 
+def constrain_dim(x, dim: int, axis: str):
+    """Constrain ONE dim of an activation to a mesh axis, leaving every
+    other dim UNCONSTRAINED. A full PartitionSpec with None entries would
+    force those dims to replicated — clobbering the batch's dp/fsdp
+    sharding and making XLA emit an involuntary full reshard (all-gather
+    + re-slice) around the constraint. UNCONSTRAINED lets the partitioner
+    keep whatever layout is already flowing."""
+    mesh = get_mesh(create=False)
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return x
+    spec = [PartitionSpec.UNCONSTRAINED] * x.ndim
+    spec[dim] = axis
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec(*spec)))
+    except ValueError:
+        return x
+
+
 def maybe_constrain(x, spec: Optional[PartitionSpec]):
-    """with_sharding_constraint when a mesh is active, identity otherwise."""
+    """Sharding constraint when a mesh is active, identity otherwise.
+
+    Traced values get ``with_sharding_constraint`` (a compiler hint);
+    concrete arrays get ``jax.device_put`` — eagerly the constraint must
+    actually MOVE data (e.g. ColumnParallelLinear(gather_output=True)
+    promises a replicated result readable on every host), which
+    with_sharding_constraint does not guarantee outside jit."""
     if spec is None:
         return x
     mesh = get_mesh(create=False)
     if mesh is None:
         return x
     try:
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        sh = NamedSharding(mesh, spec)
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sh)
+        return jax.device_put(x, sh)
     except ValueError:
         return x

@@ -75,9 +75,27 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         y = F.linear(x, self.weight, self.bias)
         from ..framework.core import _apply
-        spec = (P(*([None] * (len(y.shape) - 1)), None) if self.gather_output
-                else P(*([None] * (len(y.shape) - 1)), "tp"))
-        return _apply(lambda v: mesh_mod.maybe_constrain(v, spec), y)
+        import jax
+
+        def _constrain(v):
+            # leading dims UNCONSTRAINED: a None there would force the
+            # batch replicated, clobbering its dp/fsdp sharding with a
+            # full reshard inside compiled programs
+            lead = [P.UNCONSTRAINED] * (v.ndim - 1)
+            spec = (P(*lead, None) if self.gather_output
+                    else P(*lead, "tp"))
+            return mesh_mod.maybe_constrain(v, spec)
+
+        out = _apply(_constrain, y)
+        if self.gather_output and not isinstance(out._value,
+                                                 jax.core.Tracer):
+            # eager mode must really gather (docstring contract: result
+            # replicated for host reads); the autograd tape is already
+            # recorded, so resharding the forward value is grad-neutral
+            lead = [None] * (out._value.ndim - 1)
+            out._value = mesh_mod.maybe_constrain(out._value,
+                                                  P(*lead, None))
+        return out
 
 
 class RowParallelLinear(Layer):

@@ -1,0 +1,14 @@
+"""paddle_tpu.incubate — staging namespace (parity:
+python/paddle/incubate/ and the legacy fluid/incubate/fleet API).
+
+The reference's incubate tree mostly hosts the OLD fleet API
+(fluid/incubate/fleet/ collective + parameter_server variants, superseded
+by paddle.distributed.fleet). Those capabilities live in
+``paddle_tpu.distributed.fleet`` here; this namespace re-exports them so
+legacy import paths keep working, plus the experimental optimizer
+wrappers.
+"""
+from ..distributed import fleet  # noqa: F401
+from ..optimizer import LookaheadOptimizer, ModelAverage  # noqa: F401
+
+__all__ = ["fleet", "LookaheadOptimizer", "ModelAverage"]

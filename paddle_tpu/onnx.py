@@ -1,0 +1,35 @@
+"""paddle_tpu.onnx — ONNX-export API surface.
+
+Parity: paddle.onnx.export (python/paddle/onnx/export.py, backed by the
+external paddle2onnx package). This build has no ONNX serializer (zero
+egress; paddle2onnx is CUDA-era tooling); the TPU-native interchange
+format is StableHLO, which ``paddle.jit.save`` /
+``paddle.static.save_inference_model`` already emit and every XLA runtime
+consumes. ``export`` therefore saves the StableHLO bundle at the
+requested path and raises only if a true .onnx file is demanded.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` for interchange. Writes the StableHLO bundle via
+    paddle.jit.save (the TPU-native equivalent); a literal ONNX file is
+    not producible in this environment."""
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX serialization needs the external paddle2onnx package, "
+            "which is unavailable in this TPU build. Export StableHLO "
+            "instead (pass a path without .onnx, or use paddle.jit.save) "
+            "— it is consumable by ONNX-adjacent toolchains via "
+            "stablehlo->onnx converters offline.")
+    warnings.warn(
+        "paddle_tpu.onnx.export writes a StableHLO bundle (the TPU-native "
+        "interchange format), not an .onnx file", stacklevel=2)
+    from . import jit
+    jit.save(layer, path, input_spec=input_spec)
+    return path

@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer (parity: python/paddle/optimizer/)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .wrappers import LookaheadOptimizer, ModelAverage  # noqa: F401
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
                          Lars, Momentum, RMSProp)
 

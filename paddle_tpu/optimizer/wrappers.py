@@ -1,0 +1,154 @@
+"""Optimizer wrappers: Lookahead and ModelAverage.
+
+Parity targets (SURVEY §2.5 "optimizers (py)"): the reference ships both
+as v1 optimizer wrappers — LookaheadOptimizer (fluid/optimizer.py, slow/
+fast weights with k-step interpolation) and ModelAverage
+(fluid/optimizer.py, accumulating parameter averages applied during eval
+via an apply()/restore() scope). Here both wrap any paddle_tpu Optimizer
+and operate on the eager parameter tensors directly — the update math
+stays in jax (single fused device computation per application).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["LookaheadOptimizer", "ModelAverage"]
+
+
+class LookaheadOptimizer:
+    """Lookahead (k steps forward, 1 step back; Zhang et al. 2019).
+
+    ``inner_optimizer`` advances the fast weights every step; every ``k``
+    steps the slow weights move ``alpha`` of the way toward the fast ones
+    and the fast weights are reset to the slow weights (parity:
+    fluid/optimizer.py LookaheadOptimizer).
+    """
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._parameter_list = list(inner_optimizer._parameter_list)
+        # slow weights start at the INITIAL parameters (before any inner
+        # step), as in the paper / reference
+        self._slow: List[jnp.ndarray] = [p._value
+                                         for p in self._parameter_list]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            with no_grad():
+                for i, p in enumerate(self._parameter_list):
+                    slow = self._slow[i] + self.alpha * (p._value
+                                                         - self._slow[i])
+                    self._slow[i] = slow
+                    p._value = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_count": self._step_count,
+                "slow": [np_asarray(s) for s in self._slow]}
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd["inner"])
+        self._step_count = int(sd.get("step_count", 0))
+        if "slow" in sd:
+            self._slow = [jnp.asarray(s) for s in sd["slow"]]
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+def np_asarray(x):
+    import numpy as np
+    return np.asarray(x)
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (parity:
+    fluid/optimizer.py ModelAverage — accumulate each step, swap the
+    averaged weights in under ``apply()`` and swap back with
+    ``restore()``).
+
+    The window grows with training up to ``max_average_window`` (the
+    reference's average_window_rate/min/max mechanics collapse to a
+    moving window over the last N accumulated steps).
+    """
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters=None, min_average_window: int = 10000,
+                 max_average_window: int = 10000 * 10):
+        if parameters is None:
+            raise ValueError("parameters is required")
+        self._params = list(parameters)
+        self._rate = average_window_rate
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._sum = [jnp.zeros_like(p._value) for p in self._params]
+        self._count = 0
+        self._saved: Optional[List[jnp.ndarray]] = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after
+        optimizer.step())."""
+        with no_grad():
+            window = max(self._min_w,
+                         min(self._max_w,
+                             int(self._count * self._rate) or 1))
+            decay = 1.0 - 1.0 / window  # moving window as EMA equivalent
+            for i, p in enumerate(self._params):
+                self._sum[i] = self._sum[i] * decay + p._value
+            self._count += 1
+
+    def _average(self, i):
+        window = max(self._min_w,
+                     min(self._max_w, int(self._count * self._rate) or 1))
+        decay = 1.0 - 1.0 / window
+        # geometric-series normalisation of the EMA accumulator
+        denom = (1.0 - decay ** self._count) / (1.0 - decay) \
+            if self._count else 1.0
+        return self._sum[i] / denom
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged weights in (context manager, like the
+        reference's scope-based apply)."""
+        if self._count == 0:
+            yield
+            return
+        self._saved = [p._value for p in self._params]
+        with no_grad():
+            for i, p in enumerate(self._params):
+                p._value = self._average(i)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved is not None:
+            for p, v in zip(self._params, self._saved):
+                p._value = v
+            self._saved = None

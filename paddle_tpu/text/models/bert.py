@@ -17,12 +17,9 @@ TPU-native design, mirroring text/models/llama.py:
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional
+from dataclasses import dataclass
 
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import PartitionSpec as P
 
 import paddle_tpu.nn.functional as F
 from ...distributed import mesh as mesh_mod
@@ -144,7 +141,7 @@ class BertSelfAttention(Layer):
             qh = q.reshape(B, S, c.num_attention_heads, c.head_dim)
             kh = k.reshape(B, S, c.num_attention_heads, c.head_dim)
             vh = v.reshape(B, S, c.num_attention_heads, c.head_dim)
-            qh = mesh_mod.maybe_constrain(qh, P(None, None, "tp", None))
+            qh = mesh_mod.constrain_dim(qh, 2, "tp")
             from ...nn.functional.attention import _sdpa_ref
             from ...ops.flash_attention import flash_attention, flash_eligible
             if mask is None and drop_p == 0.0 and \
@@ -160,11 +157,7 @@ class BertSelfAttention(Layer):
                               dropout_key=drop_key)
             return o.reshape(B, S, c.hidden_size)
 
-        if attention_mask is None:
-            ctx = _apply(attn, qkv, None, op_name="bert_attention")
-        else:
-            ctx = _apply(attn, qkv, attention_mask,
-                         op_name="bert_attention")
+        ctx = _apply(attn, qkv, attention_mask, op_name="bert_attention")
         return self.out_proj(ctx)
 
 

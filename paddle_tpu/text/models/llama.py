@@ -165,7 +165,7 @@ class LlamaAttention(Layer):
             qh = _rope(qh, pos, c.rope_theta)
             kh = _rope(kh, pos, c.rope_theta)
             # heads stay sharded over 'tp' through the attention
-            qh = mesh_mod.maybe_constrain(qh, P(None, None, "tp", None))
+            qh = mesh_mod.constrain_dim(qh, 2, "tp")
             if c.kv_heads != c.num_attention_heads:
                 rep = c.num_attention_heads // c.kv_heads
                 kh = jnp.repeat(kh, rep, axis=2)
@@ -338,9 +338,8 @@ class LlamaModel(Layer):
         hidden = self.embed_tokens(input_ids)
         if c.compute_dtype:
             hidden = hidden.astype(c.compute_dtype)
-        sp_spec = (P(None, "sp", None) if c.sequence_parallel else None)
-        if sp_spec is not None:
-            hidden = _apply(lambda v: mesh_mod.maybe_constrain(v, sp_spec),
+        if c.sequence_parallel:
+            hidden = _apply(lambda v: mesh_mod.constrain_dim(v, 1, "sp"),
                             hidden)
         if self.decoder is not None:
             hidden = self.decoder(hidden, positions)

@@ -129,6 +129,25 @@ def test_fp16_transient_overflow_needs_consecutive_bad_steps():
     assert int(bad) == 0
 
 
+def test_fp16_static_scaling_constant_scale():
+    """use_dynamic_loss_scaling=False: constant init_loss_scaling is
+    APPLIED (not silently dropped) and never moves."""
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 1024.0,
+                     "use_dynamic_loss_scaling": False,
+                     "incr_every_n_steps": 1}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh)
+    xs, ys = _data(1)
+    x, y = paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])
+    losses = [float(step(x, y)) for _ in range(6)]  # fixed batch
+    assert losses[-1] < losses[0]
+    scale, good, bad = step._amp_state
+    assert float(scale) == pytest.approx(1024.0)  # constant throughout
+
+
 def test_fp16_scaling_with_gradient_merge_rejected():
     s = DistributedStrategy()
     s.amp = True

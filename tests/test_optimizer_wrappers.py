@@ -1,0 +1,110 @@
+"""Lookahead / ModelAverage wrapper tests (reference fluid/optimizer.py
+LookaheadOptimizer, ModelAverage) + incubate/onnx namespace smoke."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import LookaheadOptimizer, ModelAverage
+
+
+def _problem(seed=0):
+    paddle.seed(seed)
+    net = nn.Linear(4, 1)
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.rand(32, 4).astype("float32"))
+    w = rng.rand(4, 1).astype("float32")
+    y = paddle.to_tensor(x.numpy() @ w)
+    return net, x, y
+
+
+def test_lookahead_converges():
+    net, x, y = _problem()
+    inner = paddle.optimizer.SGD(learning_rate=0.2,
+                                 parameters=net.parameters())
+    opt = LookaheadOptimizer(inner, alpha=0.5, k=5)
+    l0 = None
+    for _ in range(60):
+        loss = F.mse_loss(net(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0 * 0.1
+
+
+def test_lookahead_sync_at_k():
+    net, x, y = _problem(1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    opt = LookaheadOptimizer(inner, alpha=0.0, k=3)  # alpha=0: snap back
+    w0 = net.weight.numpy().copy()
+    for i in range(3):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k steps with alpha=0, fast weights reset to the initial slow
+    np.testing.assert_allclose(net.weight.numpy(), w0, atol=1e-6)
+
+
+def test_lookahead_validates_args():
+    net, _, _ = _problem()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    with pytest.raises(ValueError):
+        LookaheadOptimizer(inner, alpha=1.5)
+    with pytest.raises(ValueError):
+        LookaheadOptimizer(inner, k=0)
+
+
+def test_model_average_apply_restore():
+    net, x, y = _problem(2)
+    opt = paddle.optimizer.SGD(learning_rate=0.3,
+                               parameters=net.parameters())
+    ma = ModelAverage(0.15, parameters=net.parameters(),
+                      min_average_window=2, max_average_window=10)
+    for _ in range(20):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        ma.step()
+        opt.clear_grad()
+    raw = net.weight.numpy().copy()
+    with ma.apply():
+        avg = net.weight.numpy().copy()
+        # averaged weights differ from the last raw iterate but are a
+        # plausible parameter vector (same scale)
+        assert not np.allclose(avg, raw)
+        loss_avg = float(F.mse_loss(net(x), y))
+        assert np.isfinite(loss_avg)
+    np.testing.assert_allclose(net.weight.numpy(), raw)  # restored
+
+
+def test_model_average_empty_noop():
+    net, x, y = _problem(3)
+    ma = ModelAverage(0.15, parameters=net.parameters(),
+                      min_average_window=2)
+    w0 = net.weight.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_incubate_namespace():
+    import paddle_tpu.incubate as inc
+    assert hasattr(inc, "fleet")
+    assert inc.LookaheadOptimizer is LookaheadOptimizer
+
+
+def test_onnx_export_stablehlo(tmp_path):
+    import paddle_tpu.onnx as onnx
+    from paddle_tpu.static import InputSpec
+    net, _, _ = _problem(4)
+    with pytest.warns(UserWarning, match="StableHLO"):
+        onnx.export(net, str(tmp_path / "m"),
+                    input_spec=[InputSpec([None, 4], "float32")])
+    with pytest.raises(NotImplementedError, match="paddle2onnx"):
+        onnx.export(net, str(tmp_path / "m.onnx"),
+                    input_spec=[InputSpec([None, 4], "float32")])
