@@ -22,7 +22,8 @@ __version__ = "0.1.0"
 from .framework import (  # noqa: F401
     CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
     Tensor, device_count, enable_grad, get_device, grad,
-    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_xpu,
+    get_cudnn_version, is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_xpu,
     is_grad_enabled, no_grad, seed, set_device, set_grad_enabled, to_tensor,
     get_flags, set_flags,
 )

@@ -203,8 +203,9 @@ class DistributedTrainStep:
         dyn_scaling = bool(acfg["use_dynamic_loss_scaling"])
         if use_scaling and k_steps > 1:
             raise NotImplementedError(
-                "float16 dynamic loss scaling + gradient_merge is not "
-                "supported; use bfloat16 (TPU-native, no scaling needed)")
+                "float16 loss scaling (dynamic or static) + gradient_merge "
+                "is not supported; use bfloat16 (TPU-native, no scaling "
+                "needed)")
 
         def _amp_cast(tree):
             return jax.tree_util.tree_map(

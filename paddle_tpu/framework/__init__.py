@@ -20,6 +20,7 @@ from .monitor import (device_memory_stats, get_all_stats, stat_add,  # noqa: F40
 from .errors import *  # noqa: F401,F403
 from .flags import FLAGS, define_flag, get_flags, set_flags  # noqa: F401
 from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace,  # noqa: F401
+                    get_cudnn_version,
                     XPUPlace, device_count, get_device, is_compiled_with_cuda,
                     is_compiled_with_tpu, is_compiled_with_xpu, set_device)
 from .random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401

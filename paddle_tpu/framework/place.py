@@ -154,6 +154,12 @@ def device_count() -> int:
     return len(jax.devices())
 
 
+def get_cudnn_version():
+    """None: this build has no CUDA/cuDNN (parity: paddle.get_cudnn_version
+    returns None when not compiled with CUDA)."""
+    return None
+
+
 def is_compiled_with_cuda() -> bool:
     return False
 

@@ -1,5 +1,6 @@
 """paddle_tpu.nn — layers + functional (parity surface: python/paddle/nn/)."""
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer.activation import *  # noqa: F401,F403

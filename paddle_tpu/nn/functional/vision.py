@@ -8,7 +8,35 @@ import jax.numpy as jnp
 
 from ...framework.core import Tensor, _apply
 
-__all__ = ["affine_grid", "grid_sample"]
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal channel shift (parity:
+    reference operators/temporal_shift_op.cc). Input [N*T, C, H, W]:
+    the first shift_ratio*C channels shift backward in time, the next
+    shift_ratio*C forward, the rest stay."""
+    from ...framework.core import to_tensor as _tt
+    x = x if isinstance(x, Tensor) else _tt(x)
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift supports NCHW")
+    nt, ch = x.shape[0], x.shape[1]
+    t = int(seg_num)
+    n = nt // t
+    c1 = int(ch * shift_ratio)
+    c2 = int(ch * 2 * shift_ratio)
+
+    def fn(v):
+        v5 = v.reshape((n, t, ch) + tuple(v.shape[2:]))
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        return out.reshape(v.shape)
+
+    return _apply(fn, x, op_name="temporal_shift")
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):

@@ -5,7 +5,7 @@ from .. import functional as F
 from ..initializer import Constant
 from .layers import Layer, Parameter
 
-__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish",
+__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "SiLU", "Swish",
            "Mish", "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh",
            "Hardshrink", "Softshrink", "Tanhshrink", "LeakyReLU", "PReLU",
            "RReLU", "Tanh", "Softmax", "LogSoftmax", "Softplus", "Softsign",
@@ -62,6 +62,9 @@ class GELU(Layer):
 class Silu(Layer):
     def forward(self, x):
         return F.silu(x)
+
+
+SiLU = Silu  # torch-style alias users expect
 
 
 class Swish(Layer):

@@ -1,0 +1,124 @@
+"""paddle_tpu.nn.utils — weight reparameterization utilities.
+
+Parity: python/paddle/nn/utils/weight_norm_hook.py (weight_norm /
+remove_weight_norm) and nn/layer/norm.py SpectralNorm. Implemented as
+forward-pre-hooks recomputing the effective weight from the
+reparameterized pieces each call — same mechanism as the reference's
+hook-based design, and autograd flows into weight_g/weight_v through the
+eager tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+from ..layer.layers import Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt((v * v).sum(axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (parity:
+    paddle.nn.utils.weight_norm). ``dim`` is the kept dimension; dim=None
+    normalizes over the whole tensor."""
+    w = getattr(layer, name)
+    if not isinstance(w, Tensor):
+        raise ValueError(f"layer has no parameter {name!r}")
+    wv = w._value
+    if dim is not None:
+        dim = dim % wv.ndim  # paddle accepts negative dims
+
+    if dim is None:
+        g0 = jnp.sqrt((wv * wv).sum())
+    else:
+        g0 = _norm_except_dim(wv, dim)
+    delattr(layer, name)
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(wv))
+
+    def _compute(lay, inputs):
+        g = getattr(lay, name + "_g")
+        v = getattr(lay, name + "_v")
+
+        def fn(gv, vv):
+            if dim is None:
+                return gv * vv / jnp.sqrt((vv * vv).sum())
+            return gv * vv / jnp.maximum(_norm_except_dim(vv, dim), 1e-12)
+
+        # plain attribute (not a registered parameter): the optimizer
+        # trains weight_g/weight_v, the effective weight is derived
+        object.__setattr__(lay, name, _apply(fn, g, v, op_name="weight_norm"))
+        return None
+
+    handle = layer.register_forward_pre_hook(_compute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _compute(layer, None)  # materialize immediately for direct access
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g*v/||v|| back into a single parameter (parity:
+    paddle.nn.utils.remove_weight_norm)."""
+    info = getattr(layer, "_weight_norm_hook", None)
+    if info is None:
+        raise ValueError("layer is not weight-normalized")
+    handle, nm, dim = info
+    if nm != name:
+        raise ValueError(f"weight_norm was applied to {nm!r}, not {name!r}")
+    handle.remove() if hasattr(handle, "remove") else None
+    w = getattr(layer, name)  # current effective weight
+    delattr(layer, name + "_g")
+    delattr(layer, name + "_v")
+    if hasattr(layer, name):
+        object.__delattr__(layer, name) if name in layer.__dict__ else None
+    layer.add_parameter(name, Parameter(w._value))
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0) -> Layer:
+    """Spectral normalization W/sigma(W) via power iteration (parity:
+    paddle.nn.utils.spectral_norm / reference operators/spectral_norm_op).
+    """
+    w = getattr(layer, name)
+    wv = w._value
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.normal(size=(mat.shape[0],)).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0))))
+    delattr(layer, name)
+    layer.add_parameter(name + "_orig", Parameter(wv))
+
+    def _compute(lay, inputs):
+        worig = getattr(lay, name + "_orig")
+        u = getattr(lay, name + "_u")
+
+        def fn(wval, uval):
+            m = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
+            uu = uval
+            for _ in range(n_power_iterations):
+                vv = m.T @ uu
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+                uu = m @ vv
+                uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+            sigma = uu @ (m @ vv)
+            return wval / sigma, uu
+
+        wn, new_u = _apply(fn, worig, u, op_name="spectral_norm")
+        u._value = new_u._value  # power-iteration state advances
+        object.__setattr__(lay, name, wn)
+        return None
+
+    handle = layer.register_forward_pre_hook(_compute)
+    layer._spectral_norm_hook = (handle, name)
+    _compute(layer, None)
+    return layer

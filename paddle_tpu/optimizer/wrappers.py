@@ -106,29 +106,29 @@ class ModelAverage:
         self._min_w = int(min_average_window)
         self._max_w = int(max_average_window)
         self._sum = [jnp.zeros_like(p._value) for p in self._params]
+        self._denom = 0.0  # accumulated with the SAME decays as _sum
         self._count = 0
         self._saved: Optional[List[jnp.ndarray]] = None
+
+    def _window(self) -> int:
+        return max(self._min_w,
+                   min(self._max_w, int(self._count * self._rate) or 1))
 
     def step(self):
         """Accumulate the current parameter values (call after
         optimizer.step())."""
         with no_grad():
-            window = max(self._min_w,
-                         min(self._max_w,
-                             int(self._count * self._rate) or 1))
-            decay = 1.0 - 1.0 / window  # moving window as EMA equivalent
+            decay = 1.0 - 1.0 / self._window()  # moving window as EMA
             for i, p in enumerate(self._params):
                 self._sum[i] = self._sum[i] * decay + p._value
+            # the denominator must see the exact same decay sequence as
+            # the sum — a closed-form geometric series would assume one
+            # constant decay and bias the average while the window grows
+            self._denom = self._denom * decay + 1.0
             self._count += 1
 
     def _average(self, i):
-        window = max(self._min_w,
-                     min(self._max_w, int(self._count * self._rate) or 1))
-        decay = 1.0 - 1.0 / window
-        # geometric-series normalisation of the EMA accumulator
-        denom = (1.0 - decay ** self._count) / (1.0 - decay) \
-            if self._count else 1.0
-        return self._sum[i] / denom
+        return self._sum[i] / (self._denom or 1.0)
 
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore: bool = True):

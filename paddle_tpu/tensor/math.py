@@ -24,7 +24,7 @@ __all__ = [
     "sigmoid", "logit", "sum", "mean", "max", "min", "prod", "cumsum",
     "cumprod", "logsumexp", "logcumsumexp", "clip", "isnan", "isinf",
     "isfinite", "nan_to_num", "add_n", "scale", "stanh", "multiplex",
-    "amax", "amin", "all", "any", "inner", "outer", "kron", "trace",
+    "amax", "amin", "all", "any", "addmm", "inner", "outer", "kron", "trace",
     "diff", "angle", "conj", "real", "imag", "lerp", "rad2deg", "deg2rad",
     "gcd", "lcm", "heaviside", "frac", "lgamma", "digamma", "multiply_",
     "increment", "count_nonzero", "broadcast_shape",
@@ -471,6 +471,13 @@ def multiplex(inputs, index, name=None):
         rows = jnp.arange(stacked.shape[1])
         return stacked[idx_v.reshape(-1).astype(jnp.int32), rows]
     return _apply(f, *inputs, op_name="multiplex")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) (parity: paddle.addmm,
+    reference operators/addmm_op.cc) — one fused XLA dot+axpy."""
+    return _apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                  _t(input), _t(x), _t(y), op_name="addmm")
 
 
 def inner(x, y, name=None):

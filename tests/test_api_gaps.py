@@ -1,0 +1,96 @@
+"""Small API-parity additions: addmm, SiLU, weight_norm/spectral_norm,
+temporal_shift, get_cudnn_version."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_addmm():
+    inp = paddle.ones([2, 2])
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    y = paddle.ones([3, 2])
+    out = paddle.addmm(inp, x, y, beta=2.0, alpha=0.5)
+    ref = 2.0 * np.ones((2, 2)) + 0.5 * (x.numpy() @ np.ones((3, 2)))
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_silu_alias():
+    assert nn.SiLU is nn.Silu
+    x = paddle.to_tensor(np.array([1.0], dtype="float32"))
+    np.testing.assert_allclose(nn.SiLU()(x).numpy(),
+                               x.numpy() / (1 + np.exp(-x.numpy())),
+                               rtol=1e-6)
+
+
+def test_weight_norm_roundtrip():
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype("float32"))
+    out1 = lin(x)
+    # effective weight equals the original right after reparameterization
+    np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+    # grads flow into g and v
+    out1.sum().backward()
+    assert names["weight_g"].grad is not None
+    assert names["weight_v"].grad is not None
+    nn.utils.remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight_g" not in names and "weight" in names
+    np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-5)
+
+
+def test_weight_norm_trains():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    nn.utils.weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.rand(16, 4).astype("float32"))
+    y = paddle.to_tensor(rng.rand(16, 1).astype("float32"))
+    l0 = None
+    for _ in range(30):
+        loss = F.mse_loss(lin(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(2)
+    lin = nn.Linear(8, 8)
+    # inflate the weight so sigma >> 1
+    lin.weight._value = lin.weight._value * 50.0
+    nn.utils.spectral_norm(lin, n_power_iterations=20)
+    w = np.asarray(lin.weight.numpy())
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert sigma == pytest.approx(1.0, rel=5e-2)
+
+
+def test_temporal_shift():
+    t, n, c = 4, 1, 4
+    x = np.arange(t * c, dtype="float32").reshape(t, c, 1, 1)
+    out = F.temporal_shift(paddle.to_tensor(x), seg_num=t,
+                           shift_ratio=0.25).numpy()
+    # channel 0 shifts backward: out[t] = x[t+1], last zero
+    np.testing.assert_allclose(out[:-1, 0, 0, 0], x[1:, 0, 0, 0])
+    assert out[-1, 0, 0, 0] == 0.0
+    # channel 1 shifts forward: out[t] = x[t-1], first zero
+    np.testing.assert_allclose(out[1:, 1, 0, 0], x[:-1, 1, 0, 0])
+    assert out[0, 1, 0, 0] == 0.0
+    # remaining channels unchanged
+    np.testing.assert_allclose(out[:, 2:], x[:, 2:])
+
+
+def test_get_cudnn_version():
+    assert paddle.get_cudnn_version() is None

@@ -83,6 +83,27 @@ def test_model_average_apply_restore():
     np.testing.assert_allclose(net.weight.numpy(), raw)  # restored
 
 
+def test_model_average_unbiased_for_constant_params():
+    """Averaging a CONSTANT parameter must return exactly that constant,
+    even while the window (hence decay) grows across accumulation."""
+    net = nn.Linear(2, 1)
+    one = np.ones_like(net.weight.numpy())
+    net.weight._value = paddle.to_tensor(one)._value
+    ma = ModelAverage(0.15, parameters=[net.weight],
+                      min_average_window=2, max_average_window=10)
+    for _ in range(20):
+        ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(), one, rtol=1e-6)
+
+
+def test_weight_norm_negative_dim():
+    lin = nn.Linear(4, 3)
+    nn.utils.weight_norm(lin, dim=-1)
+    g = dict(lin.named_parameters())["weight_g"]
+    assert list(g.shape) == [1, 3]  # per-column magnitudes, not a scalar
+
+
 def test_model_average_empty_noop():
     net, x, y = _problem(3)
     ma = ModelAverage(0.15, parameters=net.parameters(),
