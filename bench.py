@@ -32,7 +32,7 @@ def main():
     from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
     from paddle_tpu.vision.models import resnet50
 
-    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
     steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
     hw = 32 if smoke else 224
 

@@ -48,7 +48,8 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
 
         def fn(gv, vv):
             if dim is None:
-                return gv * vv / jnp.sqrt((vv * vv).sum())
+                return gv * vv / jnp.maximum(jnp.sqrt((vv * vv).sum()),
+                                             1e-12)
             return gv * vv / jnp.maximum(_norm_except_dim(vv, dim), 1e-12)
 
         # plain attribute (not a registered parameter): the optimizer
@@ -58,6 +59,7 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
 
     handle = layer.register_forward_pre_hook(_compute)
     layer._weight_norm_hook = (handle, name, dim)
+    layer._weight_norm_compute = _compute
     _compute(layer, None)  # materialize immediately for direct access
     return layer
 
@@ -71,14 +73,22 @@ def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
     handle, nm, dim = info
     if nm != name:
         raise ValueError(f"weight_norm was applied to {nm!r}, not {name!r}")
+    # recompute from the CURRENT g/v — the cached attribute is stale if
+    # the optimizer stepped since the last forward; folding it would drop
+    # that update
+    info_fn = getattr(layer, "_weight_norm_compute", None)
+    if info_fn is not None:
+        info_fn(layer, None)
     handle.remove() if hasattr(handle, "remove") else None
-    w = getattr(layer, name)  # current effective weight
+    w = getattr(layer, name)  # effective weight, freshly derived
     delattr(layer, name + "_g")
     delattr(layer, name + "_v")
     if hasattr(layer, name):
         object.__delattr__(layer, name) if name in layer.__dict__ else None
     layer.add_parameter(name, Parameter(w._value))
     del layer._weight_norm_hook
+    if hasattr(layer, "_weight_norm_compute"):
+        del layer._weight_norm_compute
     return layer
 
 
@@ -105,11 +115,15 @@ def spectral_norm(layer: Layer, name: str = "weight",
         def fn(wval, uval):
             m = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
             uu = uval
+            # n_power_iterations=0 is valid (reuse the stored u): vv is
+            # always derived from the current u at least once
+            vv = m.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
             for _ in range(n_power_iterations):
-                vv = m.T @ uu
-                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
                 uu = m @ vv
                 uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+                vv = m.T @ uu
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
             sigma = uu @ (m @ vv)
             return wval / sigma, uu
 

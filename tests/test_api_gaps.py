@@ -94,3 +94,38 @@ def test_temporal_shift():
 
 def test_get_cudnn_version():
     assert paddle.get_cudnn_version() is None
+
+
+def test_remove_weight_norm_keeps_last_update():
+    """Folding must derive from the CURRENT g/v, not a stale cache."""
+    paddle.seed(4)
+    lin = nn.Linear(3, 2)
+    nn.utils.weight_norm(lin)
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=lin.parameters())
+    lin(x).sum().backward()
+    opt.step()  # g/v move AFTER the last forward
+    g = dict(lin.named_parameters())["weight_g"].numpy()
+    v = dict(lin.named_parameters())["weight_v"].numpy()
+    expect = g * v / np.maximum(
+        np.sqrt((v * v).sum(axis=1, keepdims=True)), 1e-12)
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(lin.weight.numpy(), expect, atol=1e-6)
+
+
+def test_spectral_norm_zero_iterations():
+    lin = nn.Linear(4, 4)
+    nn.utils.spectral_norm(lin, n_power_iterations=0)
+    out = lin(paddle.to_tensor(np.ones((1, 4), np.float32)))
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_temporal_shift_validation():
+    x = paddle.to_tensor(np.ones((10, 4, 1, 1), np.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        F.temporal_shift(x, seg_num=4)
+    with pytest.raises(ValueError, match="shift_ratio"):
+        F.temporal_shift(paddle.to_tensor(np.ones((8, 4, 1, 1),
+                                                  np.float32)),
+                         seg_num=4, shift_ratio=0.6)
