@@ -21,10 +21,11 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
     x = x if isinstance(x, Tensor) else _tt(x)
     if data_format != "NCHW":
         raise ValueError("temporal_shift supports NCHW")
-    if not 0.0 < shift_ratio <= 0.5:
+    if not 0.0 < shift_ratio < 0.5:
         raise ValueError(
-            f"shift_ratio must be in (0, 0.5], got {shift_ratio} "
-            f"(the two shifted blocks may not overlap)")
+            f"shift_ratio must be in (0, 0.5), got {shift_ratio} "
+            f"(reference temporal_shift_op.cc:52 requires strictly "
+            f"less than 0.5)")
     nt, ch = x.shape[0], x.shape[1]
     t = int(seg_num)
     if t <= 0 or nt % t:

@@ -59,6 +59,9 @@ class MoELayer(Layer):
         super().__init__()
         if top_k not in (1, 2):
             raise ValueError("top_k must be 1 (Switch) or 2 (GShard)")
+        if num_experts < max(top_k, 2):
+            raise ValueError(
+                f"num_experts ({num_experts}) must be >= max(top_k, 2)")
         acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                 "silu": jax.nn.silu, "swish": jax.nn.silu,
                 "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid}
@@ -132,7 +135,8 @@ class MoELayer(Layer):
                 pos = jnp.cumsum(mask, axis=0) - mask + occupancy[None, :]
                 pos_tok = (pos * mask).sum(-1)                 # [N]
                 keep = (pos_tok < C) & (mask.sum(-1) > 0)
-                gate_val = (probs * mask).sum(-1) * keep       # [N]
+                gate_raw = (probs * mask).sum(-1)              # [N]
+                gate_val = gate_raw * keep
                 pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), C,
                                         dtype=jnp.float32)
                 d = mask[:, :, None] * pos_oh[:, None, :] \
@@ -140,9 +144,12 @@ class MoELayer(Layer):
                 dispatch = dispatch + d
                 combine = combine + d * gate_val[:, None, None]
                 occupancy = occupancy + (mask * keep[:, None]).sum(0)
-                gates_sum = gates_sum + gate_val
+                # denominator uses the PRE-drop gates: a token whose
+                # second route overflows keeps weight g1/(g1+g2), not 1.0
+                # — the GShard normalisation is capacity-independent
+                gates_sum = gates_sum + gate_raw
             if K == 2:
-                # GShard: the two gates renormalise to sum to 1 per token;
+                # GShard: the two gates renormalise by their sum;
                 # Switch (K=1) keeps the raw router prob as the scale
                 combine = combine / jnp.maximum(gates_sum,
                                                 1e-9)[:, None, None]

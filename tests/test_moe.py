@@ -74,8 +74,9 @@ def test_capacity_drops_overflow_tokens():
     x = paddle.to_tensor(
         np.random.RandomState(4).rand(1, 8, d).astype("float32"))
     y = moe(x).numpy().reshape(8, d)
-    # capacity = ceil(8/2 * 0.25 * 1) = 2 slots -> first 2 tokens served,
-    # the rest dropped to zero (residual path is the caller's job)
+    # capacity = max(ceil(8/2 * 0.25 * 1), 2) = 2 slots (the _capacity
+    # floor) -> first 2 tokens served, the rest dropped to zero
+    # (residual path is the caller's job)
     assert np.abs(y[:2]).sum() > 0
     np.testing.assert_allclose(y[2:], 0.0, atol=1e-6)
 
