@@ -46,7 +46,7 @@ _SUBPACKAGES = [
     "amp", "autograd", "device", "distribution", "distributed", "hapi",
     "inference", "io",
     "jit", "metric", "nn", "onnx", "optimizer", "profiler", "quantization",
-    "regularizer", "static", "sysconfig", "text", "utils", "vision",
+    "rec", "regularizer", "static", "sysconfig", "text", "utils", "vision",
     "incubate",
 ]
 

@@ -1,0 +1,18 @@
+"""paddle_tpu.rec — recommendation model zoo (wide&deep, DeepFM).
+
+Parity target: BASELINE north-star config 4 ("PaddleRec-style wide_deep /
+DeepFM, Fleet parameter-server sparse embeddings"). The reference ships
+these as PaddleRec configs over its PS stack (SURVEY §2.6 "Parameter
+server"); here they are first-class Layers:
+
+- single-chip/dense mode: `nn.Embedding` tables, everything on the TPU —
+  batch the multi-field int ids as one [B, F] tensor with per-field id
+  offsets (TPU-friendly: one gather);
+- PS mode: the same dense trunk compiled with `jax` while embeddings live
+  in host :class:`~paddle_tpu.distributed.fleet.ps.SparseTable` shards,
+  driven by :class:`~paddle_tpu.distributed.fleet.heter.HeterTrainer`
+  (see tests/test_ps_e2e.py for the wired slice).
+"""
+from .models import DeepFM, WideDeep  # noqa: F401
+
+__all__ = ["WideDeep", "DeepFM"]

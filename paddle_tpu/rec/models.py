@@ -1,0 +1,155 @@
+"""Wide&Deep and DeepFM (BASELINE config 4 model families).
+
+Design notes (TPU-first): all F sparse fields share ONE embedding table
+addressed with per-field id offsets, so a batch is a single [B, F] int
+tensor and the lookup is one gather the XLA partitioner can shard; the FM
+interaction uses the O(F*D) identity 0.5*((Σv)² − Σv²) instead of the
+O(F²) pairwise form.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import _apply
+from ..tensor import concat as _concat
+from ..tensor import sum as _sum
+from ..tensor.manipulation import flatten as _flatten
+from ..tensor.math import sigmoid as _sigmoid
+from ..nn import Embedding, Layer, Linear, ReLU, Sequential
+
+__all__ = ["WideDeep", "DeepFM"]
+
+
+def _offsets(field_dims: Sequence[int]) -> np.ndarray:
+    return np.concatenate([[0], np.cumsum(field_dims)[:-1]]).astype(np.int64)
+
+
+def _check_dense(dense_dim: int, dense_feats):
+    if dense_dim and dense_feats is None:
+        raise ValueError(
+            f"model was built with dense_dim={dense_dim}; pass dense_feats")
+    if not dense_dim and dense_feats is not None:
+        raise ValueError(
+            "dense_feats given but the model was built with dense_dim=0 "
+            "(they would be silently ignored)")
+
+
+def _mlp(in_dim: int, hidden: Sequence[int], out_dim: int = 1):
+    layers = []
+    d = in_dim
+    for h in hidden:
+        layers += [Linear(d, h), ReLU()]
+        d = h
+    layers.append(Linear(d, out_dim))
+    return Sequential(*layers)
+
+
+class _FieldEmbedding(Layer):
+    """Shared table over all fields with id offsets (one gather)."""
+
+    def __init__(self, field_dims: Sequence[int], embed_dim: int):
+        super().__init__()
+        self.table = Embedding(int(sum(field_dims)), embed_dim)
+        self._dims = np.asarray(field_dims, np.int64)
+        self._off = _offsets(field_dims)
+
+    def forward(self, ids):
+        off = self._off
+        import jax
+        v = ids._value if hasattr(ids, "_value") else ids
+        if not isinstance(v, jax.core.Tracer):
+            # eager: out-of-range ids would silently read a NEIGHBORING
+            # field's rows after the offset shift — fail loudly instead
+            a = np.asarray(v)
+            bad = (a < 0) | (a >= self._dims[None, :])
+            if bad.any():
+                f = int(np.argwhere(bad)[0][1])
+                raise ValueError(
+                    f"sparse id {a[bad][0]} out of range for field {f} "
+                    f"(dim {int(self._dims[f])})")
+
+        def shift(vv):
+            return vv + jnp.asarray(off)[None, :]
+
+        return self.table(_apply(shift, ids, op_name="field_offset"))
+
+
+class WideDeep(Layer):
+    """Wide & Deep (Cheng et al. 2016; PaddleRec wide_deep config).
+
+    ``forward(sparse_ids [B, F], dense_feats [B, Dd] or None)`` ->
+    logits [B, 1]. The wide half is a linear model over the sparse ids
+    (one 1-dim embedding) + dense features; the deep half is an MLP over
+    concatenated field embeddings + dense features.
+    """
+
+    def __init__(self, field_dims: Sequence[int], dense_dim: int = 0,
+                 embed_dim: int = 16,
+                 hidden_units: Sequence[int] = (64, 32)):
+        super().__init__()
+        self.num_fields = len(field_dims)
+        self.dense_dim = dense_dim
+        self.wide_emb = _FieldEmbedding(field_dims, 1)
+        self.wide_dense = Linear(dense_dim, 1) if dense_dim else None
+        self.deep_emb = _FieldEmbedding(field_dims, embed_dim)
+        self.deep_mlp = _mlp(self.num_fields * embed_dim + dense_dim,
+                             hidden_units)
+
+    def forward(self, sparse_ids, dense_feats=None):
+        _check_dense(self.dense_dim, dense_feats)
+        wide = _sum(self.wide_emb(sparse_ids), axis=1)       # [B, 1]
+        if self.wide_dense is not None:
+            wide = wide + self.wide_dense(dense_feats)
+        emb = self.deep_emb(sparse_ids)                       # [B, F, D]
+        flat = _flatten(emb, start_axis=1)
+        if self.dense_dim:
+            flat = _concat([flat, dense_feats], axis=1)
+        deep = self.deep_mlp(flat)                            # [B, 1]
+        return wide + deep
+
+    def predict_proba(self, sparse_ids, dense_feats=None):
+        return _sigmoid(self.forward(sparse_ids, dense_feats))
+
+
+class DeepFM(Layer):
+    """DeepFM (Guo et al. 2017; PaddleRec deepfm config).
+
+    logit = first_order(ids) + FM second-order over shared field
+    embeddings + MLP(deep). ``forward(sparse_ids [B, F])`` -> [B, 1].
+    """
+
+    def __init__(self, field_dims: Sequence[int], embed_dim: int = 16,
+                 hidden_units: Sequence[int] = (64, 32),
+                 dense_dim: int = 0):
+        super().__init__()
+        self.num_fields = len(field_dims)
+        self.dense_dim = dense_dim
+        self.first_order = _FieldEmbedding(field_dims, 1)
+        self.embedding = _FieldEmbedding(field_dims, embed_dim)
+        self.deep_mlp = _mlp(self.num_fields * embed_dim + dense_dim,
+                             hidden_units)
+
+    def fm(self, emb):
+        """0.5 * ((Σ_f v)² − Σ_f v²) summed over embed dim -> [B, 1]."""
+        def fn(v):
+            s = v.sum(axis=1)
+            return 0.5 * (s * s - (v * v).sum(axis=1)).sum(
+                axis=-1, keepdims=True)
+        return _apply(fn, emb, op_name="fm_interaction")
+
+    def forward(self, sparse_ids, dense_feats=None):
+        _check_dense(self.dense_dim, dense_feats)
+        first = _sum(self.first_order(sparse_ids), axis=1)   # [B, 1]
+        emb = self.embedding(sparse_ids)                      # [B, F, D]
+        second = self.fm(emb)
+        flat = _flatten(emb, start_axis=1)
+        if self.dense_dim:
+            flat = _concat([flat, dense_feats], axis=1)
+        deep = self.deep_mlp(flat)
+        return first + second + deep
+
+    def predict_proba(self, sparse_ids, dense_feats=None):
+        return _sigmoid(self.forward(sparse_ids, dense_feats))
