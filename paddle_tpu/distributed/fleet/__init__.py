@@ -17,6 +17,8 @@ from .dist_step import DistributedTrainStep  # noqa: F401
 from .ps import PSRuntime, SparseTable  # noqa: F401
 from .heter import HeterTrainer  # noqa: F401
 from . import dgc  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetBase, InMemoryDataset, QueueDataset,
 )

@@ -1,0 +1,203 @@
+"""Filesystem clients for distributed checkpoint storage.
+
+Parity: python/paddle/distributed/fleet/utils/fs.py (LocalFS + HDFSClient;
+C++ side framework/io/fs.cc shells out via io/shell.cc). Same scheme here:
+LocalFS wraps the local tree; HDFSClient shells to the ``hadoop``/``afs``
+binary when one is configured and raises a clear error otherwise — the
+framework itself carries no JVM dependency.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(RuntimeError):
+    pass
+
+
+class FS:
+    """Abstract client (reference fs.py FS)."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+    def upload(self, local_path, remote_path):
+        raise NotImplementedError
+
+    def download(self, remote_path, local_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        return False
+
+
+class LocalFS(FS):
+    """Local filesystem client (reference fs.py LocalFS)."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, e))
+             else files).append(e)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if not overwrite and os.path.exists(dst):
+            raise ExecuteError(f"{dst} exists and overwrite=False")
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise ExecuteError(f"{path} exists")
+        open(path, "a").close()
+
+    def upload(self, local_path, remote_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, remote_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, remote_path)
+
+    download = upload
+
+
+class HDFSClient(FS):
+    """Shell-out HDFS client (reference fs.py HDFSClient — runs
+    ``hadoop fs -D... -<cmd>``). Needs a hadoop binary; constructing the
+    client without one raises immediately with guidance (zero-egress
+    environments have no JVM stack to bundle)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._base = None
+        home = hadoop_home or os.environ.get("HADOOP_HOME")
+        cand = (os.path.join(home, "bin", "hadoop") if home else
+                shutil.which("hadoop"))
+        if not cand or not os.path.exists(cand):
+            raise ExecuteError(
+                "HDFSClient needs a hadoop binary (set hadoop_home or "
+                "HADOOP_HOME, or put `hadoop` on PATH); for local storage "
+                "use LocalFS")
+        self._base = [cand, "fs"]
+        for k, v in (configs or {}).items():
+            self._base += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args) -> str:
+        try:
+            r = subprocess.run([*self._base, *args], capture_output=True,
+                               text=True, timeout=self._timeout)
+        except subprocess.TimeoutExpired as e:
+            raise ExecuteError(
+                f"hadoop {' '.join(args)} timed out after "
+                f"{self._timeout:.0f}s") from e
+        if r.returncode != 0:
+            raise ExecuteError(
+                f"hadoop {' '.join(args)} failed: {r.stderr[-2000:]}")
+        return r.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if self.is_exist(dst):
+            if not overwrite:
+                # hadoop -mv would nest src INTO an existing dst dir;
+                # match LocalFS semantics and fail loudly instead
+                raise ExecuteError(f"{dst} exists and overwrite=False")
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise ExecuteError(f"{path} exists")
+            return  # -touchz errors on non-empty existing files
+        self._run("-touchz", path)
+
+    def upload(self, local_path, remote_path):
+        self._run("-put", "-f", local_path, remote_path)
+
+    def download(self, remote_path, local_path):
+        self._run("-get", remote_path, local_path)
+
+    def need_upload_download(self):
+        return True

@@ -56,11 +56,11 @@ class _FieldEmbedding(Layer):
         self._dims = np.asarray(field_dims, np.int64)
         self._off = _offsets(field_dims)
 
-    def forward(self, ids):
+    def forward(self, ids, validate: bool = True):
         off = self._off
         import jax
         v = ids._value if hasattr(ids, "_value") else ids
-        if not isinstance(v, jax.core.Tracer):
+        if validate and not isinstance(v, jax.core.Tracer):
             # eager: out-of-range ids would silently read a NEIGHBORING
             # field's rows after the offset shift — fail loudly instead
             a = np.asarray(v)
@@ -103,7 +103,7 @@ class WideDeep(Layer):
         wide = _sum(self.wide_emb(sparse_ids), axis=1)       # [B, 1]
         if self.wide_dense is not None:
             wide = wide + self.wide_dense(dense_feats)
-        emb = self.deep_emb(sparse_ids)                       # [B, F, D]
+        emb = self.deep_emb(sparse_ids, validate=False)       # [B, F, D]
         flat = _flatten(emb, start_axis=1)
         if self.dense_dim:
             flat = _concat([flat, dense_feats], axis=1)
@@ -143,7 +143,7 @@ class DeepFM(Layer):
     def forward(self, sparse_ids, dense_feats=None):
         _check_dense(self.dense_dim, dense_feats)
         first = _sum(self.first_order(sparse_ids), axis=1)   # [B, 1]
-        emb = self.embedding(sparse_ids)                      # [B, F, D]
+        emb = self.embedding(sparse_ids, validate=False)      # [B, F, D]
         second = self.fm(emb)
         flat = _flatten(emb, start_axis=1)
         if self.dense_dim:

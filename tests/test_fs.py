@@ -1,0 +1,48 @@
+"""Filesystem client tests (reference distributed/fleet/utils/fs.py)."""
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet import LocalFS
+from paddle_tpu.distributed.fleet.fs import ExecuteError, HDFSClient
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = tmp_path / "a" / "b"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d))
+    f = d / "x.txt"
+    fs.touch(str(f))
+    assert fs.is_file(str(f))
+    dirs, files = fs.ls_dir(str(d.parent))
+    assert dirs == ["b"] and files == []
+    dirs, files = fs.ls_dir(str(d))
+    assert files == ["x.txt"]
+    fs.mv(str(f), str(d / "y.txt"))
+    assert fs.is_exist(str(d / "y.txt")) and not fs.is_exist(str(f))
+    with pytest.raises(ExecuteError):
+        fs.touch(str(d / "y.txt"), exist_ok=False)
+    fs.upload(str(d / "y.txt"), str(tmp_path / "copy.txt"))
+    assert fs.is_file(str(tmp_path / "copy.txt"))
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert not fs.need_upload_download()
+
+
+def test_localfs_mv_overwrite(tmp_path):
+    fs = LocalFS()
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.write_text("1")
+    b.write_text("2")
+    with pytest.raises(ExecuteError):
+        fs.mv(str(a), str(b))
+    fs.mv(str(a), str(b), overwrite=True)
+    assert b.read_text() == "1"
+
+
+def test_hdfs_client_requires_binary(monkeypatch):
+    monkeypatch.delenv("HADOOP_HOME", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(ExecuteError, match="hadoop binary"):
+        HDFSClient()
