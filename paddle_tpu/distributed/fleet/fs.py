@@ -147,10 +147,10 @@ class HDFSClient(FS):
         out = self._run("-ls", path)
         dirs, files = [], []
         for line in out.splitlines():
-            parts = line.split()
+            parts = line.split(None, 7)  # name (field 8) may hold spaces
             if len(parts) < 8:
                 continue
-            name = parts[-1].rsplit("/", 1)[-1]
+            name = parts[7].rsplit("/", 1)[-1]
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
@@ -169,7 +169,11 @@ class HDFSClient(FS):
             return False
 
     def is_file(self, path):
-        return self.is_exist(path) and not self.is_dir(path)
+        try:
+            self._run("-test", "-f", path)  # one JVM spawn, not two
+            return True
+        except ExecuteError:
+            return False
 
     def mkdirs(self, path):
         self._run("-mkdir", "-p", path)

@@ -5,7 +5,9 @@ decode) extended with the decoder-LM family the TPU north-star requires
 (SURVEY.md §5.7: long-context is greenfield).
 """
 from . import datasets  # noqa: F401
+from . import generation  # noqa: F401
 from . import models  # noqa: F401
+from .generation import generate  # noqa: F401
 from .models import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel,
     llama_tiny, llama_7b, llama_13b,

@@ -1,0 +1,157 @@
+"""Autoregressive text generation: greedy, sampling, beam search.
+
+Parity target: the reference's decoding machinery (beam search kernels
+operators/math/beam_search.*, fluid/layers/rnn.py BeamSearchDecoder,
+dynamic_decode) re-designed for XLA:
+
+- the sequence lives in a FIXED-SHAPE [B, S0+max_new] buffer: each step
+  writes one token and re-runs the model forward on the whole buffer.
+  Causality makes right-padding safe (logits at position t depend only on
+  tokens <= t), and the fixed shape means ONE compiled program serves
+  every step — no per-length recompiles, no dynamic shapes.
+  (Incremental KV-cache decode is a further optimization on the same API;
+  the reference's dynamic_decode also re-enters the cell per step.)
+- sampling draws from the framework PRNG stream (framework/random.py);
+- beam search keeps [B*num_beams] rows in the same buffer and reorders
+  them by gather at each step, scoring with length-normalized summed
+  log-probs (the reference BeamSearchDecoder's scheme).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, no_grad, to_tensor
+from ..framework.random import split_key
+
+__all__ = ["generate"]
+
+
+def _logits_at(model, buf, pos_idx):
+    """Model forward over the full buffer; gather logits at pos_idx-1
+    (the last REAL token of each row)."""
+    out = model(Tensor(buf))
+    # forward convention: bare logits, or (loss, logits) — logits LAST
+    logits = out[-1] if isinstance(out, tuple) else out
+    lv = logits._value if isinstance(logits, Tensor) else logits
+    return jnp.take_along_axis(
+        lv, (pos_idx - 1)[:, None, None], axis=1)[:, 0, :]
+
+
+def _filter_logits(logits, temperature, top_k, top_p):
+    if temperature is not None and temperature != 1.0:
+        # temperature 0.0 means near-greedy, not "skip scaling"
+        logits = logits / max(float(temperature), 1e-6)
+    V = logits.shape[-1]
+    if top_k and 0 < top_k < V:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # number of tokens needed to reach top_p mass (>= 1)
+        k_keep = jnp.maximum((cum < top_p).sum(-1) + 1, 1)
+        kth = jnp.take_along_axis(srt, (k_keep - 1)[:, None], axis=-1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
+@no_grad()
+def generate(model, input_ids, max_new_tokens: int = 32,
+             do_sample: bool = False, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0, num_beams: int = 1,
+             eos_token_id: Optional[int] = None,
+             pad_token_id: int = 0,
+             length_penalty: float = 1.0) -> Tensor:
+    """Generate continuations for ``input_ids`` [B, S0] -> [B, S0+new].
+
+    ``do_sample`` enables temperature/top-k/top-p sampling; ``num_beams>1``
+    runs beam search (mutually exclusive with sampling). Rows that hit
+    ``eos_token_id`` are frozen (padded with ``pad_token_id``).
+    """
+    ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                     else input_ids).astype(np.int32)
+    B, S0 = ids.shape
+    total = S0 + max_new_tokens
+    if num_beams > 1 and do_sample:
+        raise ValueError("beam search and sampling are mutually exclusive")
+    if num_beams > 1:
+        return _beam_search(model, ids, max_new_tokens, num_beams,
+                            eos_token_id, pad_token_id, length_penalty)
+
+    # pad-fill the tail so an early all-done break leaves pad tokens,
+    # not zeros (causality: tail values never affect earlier logits)
+    buf = jnp.full((B, total), pad_token_id, jnp.int32).at[:, :S0].set(ids)
+    pos = jnp.full((B,), S0, jnp.int32)
+    done = jnp.zeros((B,), bool)
+    for _ in range(max_new_tokens):
+        logits = _logits_at(model, buf, pos)
+        if do_sample:
+            logits = _filter_logits(logits, temperature, top_k, top_p)
+            key = split_key(1)
+            nxt = jax.random.categorical(key, logits, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(done, pad_token_id, nxt).astype(jnp.int32)
+        buf = buf.at[jnp.arange(B), pos].set(nxt)
+        if eos_token_id is not None:
+            done = done | (nxt == eos_token_id)
+        pos = pos + 1  # frozen rows advance too, emitting pad tokens
+        if eos_token_id is not None and bool(done.all()):
+            break
+    return to_tensor(np.asarray(buf))
+
+
+def _beam_search(model, ids, max_new_tokens, num_beams, eos_token_id,
+                 pad_token_id, length_penalty):
+    B, S0 = ids.shape
+    total = S0 + max_new_tokens
+    K = num_beams
+    # rows: [B*K, total]; beam 0 starts live, others start at -inf so the
+    # first expansion fans out from the prompt once
+    buf = jnp.full((B * K, total), pad_token_id, jnp.int32)
+    buf = buf.at[:, :S0].set(jnp.repeat(jnp.asarray(ids), K, axis=0))
+    scores = jnp.full((B, K), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
+    done = jnp.zeros((B, K), bool)
+    blen = jnp.zeros((B, K), jnp.int32)   # per-beam generated length
+    pos = S0
+    for step in range(max_new_tokens):
+        logits = _logits_at(model, buf, jnp.full((B * K,), pos, jnp.int32))
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, -1)
+        V = logp.shape[-1]
+        # frozen beams contribute exactly one continuation (pad, score 0)
+        if eos_token_id is not None:
+            frozen = jnp.full((B, K, V), -jnp.inf).at[:, :, pad_token_id] \
+                .set(0.0)
+            logp = jnp.where(done[:, :, None], frozen, logp)
+        cand = scores[:, :, None] + logp                 # [B, K, V]
+        flat = cand.reshape(B, K * V)
+        top_s, top_i = jax.lax.top_k(flat, K)            # [B, K]
+        beam_idx = top_i // V                            # source beam
+        tok = (top_i % V).astype(jnp.int32)
+        # reorder rows + append
+        gather = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        buf = buf[gather]
+        buf = buf.at[jnp.arange(B * K), pos].set(tok.reshape(-1))
+        scores = top_s
+        prev_done = (jnp.take_along_axis(done, beam_idx, axis=1)
+                     if eos_token_id is not None
+                     else jnp.zeros((B, K), bool))
+        blen = jnp.take_along_axis(blen, beam_idx, axis=1) \
+            + (~prev_done).astype(jnp.int32)   # frozen beams stop growing
+        if eos_token_id is not None:
+            done = prev_done | (tok == eos_token_id)
+            if bool(done.all()):
+                pos += 1
+                break
+        pos += 1
+    # pick best beam per batch by PER-BEAM length-normalized score
+    lengths = jnp.maximum(blen, 1).astype(jnp.float32)
+    norm = scores / (lengths ** length_penalty)
+    best = jnp.argmax(norm, axis=-1)                     # [B]
+    rows = (jnp.arange(B) * K + best)
+    return to_tensor(np.asarray(buf[rows]))

@@ -420,6 +420,12 @@ class LlamaForCausalLM(Layer):
         loss = _apply(_causal_lm_loss, logits, labels, op_name="lm_loss")
         return loss, logits
 
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive decoding (greedy/sampling/beam) — see
+        paddle_tpu.text.generation.generate."""
+        from ..generation import generate
+        return generate(self, input_ids, **kwargs)
+
 
 def _causal_lm_loss(logits, labels):
     lg = logits[:, :-1, :]

@@ -1,0 +1,127 @@
+"""Text generation tests (reference: beam_search kernels
+operators/math/beam_search.*, fluid/layers/rnn.py dynamic_decode).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import generate
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def lm():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompt(b=2, s=4, v=64, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(1, v, (b, s)).astype("int32"))
+
+
+def test_greedy_shapes_and_determinism(lm):
+    ids = _prompt()
+    out1 = lm.generate(ids, max_new_tokens=6)
+    out2 = lm.generate(ids, max_new_tokens=6)
+    assert out1.shape == [2, 10]
+    np.testing.assert_array_equal(out1.numpy(), out2.numpy())
+    # prompt preserved
+    np.testing.assert_array_equal(out1.numpy()[:, :4], ids.numpy())
+
+
+def test_greedy_matches_stepwise_argmax(lm):
+    ids = _prompt(b=1)
+    out = lm.generate(ids, max_new_tokens=3).numpy()[0]
+    # manual: feed growing prefix, take argmax each step
+    cur = ids.numpy()[0].tolist()
+    for _ in range(3):
+        logits = lm(paddle.to_tensor(np.asarray([cur], np.int32))).numpy()
+        cur.append(int(logits[0, -1].argmax()))
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_sampling_respects_top_k(lm):
+    paddle.seed(1)
+    ids = _prompt(b=1)
+    logits = lm(ids).numpy()[0, -1]
+    top2 = set(np.argsort(logits)[-2:].tolist())
+    for trial in range(5):
+        out = lm.generate(ids, max_new_tokens=1, do_sample=True,
+                          top_k=2).numpy()[0, -1]
+        assert int(out) in top2
+
+
+def test_temperature_zero_like_greedy(lm):
+    paddle.seed(2)
+    ids = _prompt(b=1, seed=3)
+    greedy = lm.generate(ids, max_new_tokens=4).numpy()
+    cold = lm.generate(ids, max_new_tokens=4, do_sample=True,
+                       temperature=1e-6).numpy()
+    np.testing.assert_array_equal(greedy, cold)
+
+
+def test_eos_freezes_row(lm):
+    ids = _prompt(b=1, seed=4)
+    # find the first greedy token, use it as "eos": generation stops and
+    # the remaining positions stay pad (0)
+    first = int(lm.generate(ids, max_new_tokens=1).numpy()[0, -1])
+    out = lm.generate(ids, max_new_tokens=5, eos_token_id=first,
+                      pad_token_id=0).numpy()[0]
+    assert out[4] == first
+    np.testing.assert_array_equal(out[5:], 0)
+
+
+def test_beam_search_not_worse_than_greedy(lm):
+    ids = _prompt(b=1, seed=5)
+    T = 4
+
+    def seq_logprob(tokens):
+        lp = 0.0
+        cur = ids.numpy()[0].tolist()
+        for t in tokens:
+            logits = lm(paddle.to_tensor(
+                np.asarray([cur], np.int32))).numpy()[0, -1]
+            p = np.exp(logits - logits.max())
+            p = p / p.sum()
+            lp += float(np.log(p[t] + 1e-20))
+            cur.append(int(t))
+        return lp
+
+    greedy = lm.generate(ids, max_new_tokens=T).numpy()[0, 4:]
+    beam = lm.generate(ids, max_new_tokens=T, num_beams=3).numpy()[0, 4:]
+    assert seq_logprob(beam.tolist()) >= seq_logprob(greedy.tolist()) - 1e-4
+
+
+def test_eos_early_break_tail_is_pad(lm):
+    ids = _prompt(b=1, seed=7)
+    first = int(lm.generate(ids, max_new_tokens=1).numpy()[0, -1])
+    out = lm.generate(ids, max_new_tokens=8, eos_token_id=first,
+                      pad_token_id=9).numpy()[0]
+    # all-done break path: the UNWRITTEN tail must be pad (9), not 0
+    np.testing.assert_array_equal(out[5:], 9)
+
+
+def test_temperature_zero_is_near_greedy(lm):
+    paddle.seed(8)
+    ids = _prompt(b=1, seed=8)
+    greedy = lm.generate(ids, max_new_tokens=3).numpy()
+    t0 = lm.generate(ids, max_new_tokens=3, do_sample=True,
+                     temperature=0.0).numpy()
+    np.testing.assert_array_equal(greedy, t0)
+
+
+def test_beam_and_sample_exclusive(lm):
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        lm.generate(_prompt(b=1), max_new_tokens=2, do_sample=True,
+                    num_beams=2)
+
+
+def test_generate_function_api(lm):
+    out = generate(lm, _prompt(b=1, seed=6), max_new_tokens=2)
+    assert out.shape == [1, 6]
