@@ -13,5 +13,6 @@ from .models import (  # noqa: F401
     llama_tiny, llama_7b, llama_13b,
 )
 
-__all__ = ["models", "datasets", "LlamaConfig", "LlamaForCausalLM",
-           "LlamaModel", "llama_tiny", "llama_7b", "llama_13b"]
+__all__ = ["models", "datasets", "generation", "generate", "LlamaConfig",
+           "LlamaForCausalLM", "LlamaModel", "llama_tiny", "llama_7b",
+           "llama_13b"]

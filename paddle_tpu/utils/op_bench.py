@@ -1,0 +1,166 @@
+"""Per-op micro-benchmark harness.
+
+Parity: the reference's op benchmark infrastructure (SURVEY §6 —
+operators/benchmark/op_tester.cc + op_tester_config.cc: config-driven
+per-op latency with warmup/repeat; CI gate tools/test_op_benchmark.sh).
+TPU-native: each case is a jitted jax callable timed with
+``block_until_ready`` after warmup; results print as a table and/or JSON
+lines so a CI gate can diff runs (the reference's
+check_op_benchmark_result.py role).
+
+CLI: ``python -m paddle_tpu.utils.op_bench [--repeat N] [--json]
+[--filter substr]``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["OpBenchCase", "run_cases", "default_cases", "main"]
+
+
+class OpBenchCase:
+    """One benchmark case: a name, a builder returning (fn, args)."""
+
+    def __init__(self, name: str, build: Callable):
+        self.name = name
+        self.build = build
+
+
+def _time_case(case: OpBenchCase, repeat: int, warmup: int) -> Dict:
+    import jax
+
+    fn, args = case.build()
+    jfn = jax.jit(fn)
+    out = jfn(*args)
+    jax.block_until_ready(out)          # compile + first run
+    for _ in range(warmup):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = np.asarray(times)
+    return {
+        "op": case.name,
+        "repeat": repeat,
+        "mean_us": float(t.mean() * 1e6),
+        "min_us": float(t.min() * 1e6),
+        "p50_us": float(np.percentile(t, 50) * 1e6),
+        "p99_us": float(np.percentile(t, 99) * 1e6),
+    }
+
+
+def run_cases(cases: Sequence[OpBenchCase], repeat: int = 50,
+              warmup: int = 5, as_json: bool = False,
+              out=print) -> List[Dict]:
+    rows = [_time_case(c, repeat, warmup) for c in cases]
+    if as_json:
+        for r in rows:
+            out(json.dumps(r))
+    else:
+        out(f"{'op':<28}{'mean(us)':>12}{'min(us)':>12}{'p50(us)':>12}"
+            f"{'p99(us)':>12}")
+        for r in rows:
+            out(f"{r['op']:<28}{r['mean_us']:>12.1f}{r['min_us']:>12.1f}"
+                f"{r['p50_us']:>12.1f}{r['p99_us']:>12.1f}")
+    return rows
+
+
+def default_cases(size: int = 1024) -> List[OpBenchCase]:
+    """Representative MXU/VPU/HBM-bound ops (the reference ships per-op
+    configs; these cover the classes that matter on TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = size
+
+    def _mk(shape, dtype=jnp.float32, seed=0):
+        return jnp.asarray(np.random.RandomState(seed)
+                           .rand(*shape).astype("float32")).astype(dtype)
+
+    def matmul_f32():
+        a, b = _mk((n, n)), _mk((n, n), seed=1)
+        return (lambda x, y: x @ y), (a, b)
+
+    def matmul_bf16():
+        a = _mk((n, n), jnp.bfloat16)
+        b = _mk((n, n), jnp.bfloat16, seed=1)
+        return (lambda x, y: x @ y), (a, b)
+
+    def conv2d():
+        x = _mk((8, 64, 56, 56))
+        w = _mk((64, 64, 3, 3), seed=1)
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        return (lambda a, b: jax.lax.conv_general_dilated(
+            a, b, (1, 1), "SAME", dimension_numbers=dn)), (x, w)
+
+    def layer_norm():
+        x = _mk((64, 4096))
+        return (lambda v: (v - v.mean(-1, keepdims=True))
+                * jax.lax.rsqrt(v.var(-1, keepdims=True) + 1e-5)), (x,)
+
+    def softmax():
+        x = _mk((64, 4096))
+        return (lambda v: jax.nn.softmax(v, axis=-1)), (x,)
+
+    def elementwise_fused():
+        x = _mk((n, n))
+        return (lambda v: jnp.tanh(v) * jax.nn.sigmoid(v) + v), (x,)
+
+    def reduce_sum():
+        x = _mk((n, n))
+        return (lambda v: v.sum()), (x,)
+
+    def gather_embedding():
+        table = _mk((50000, 512))
+        idx = jnp.asarray(np.random.RandomState(2)
+                          .randint(0, 50000, (8192,)))
+        return (lambda t, i: t[i]), (table, idx)
+
+    def flash_attention():
+        from ..ops.flash_attention import flash_attention as fa
+        q = _mk((2, 1024, 8, 128), jnp.bfloat16)
+        return (lambda a: fa(a, a, a, causal=True)), (q,)
+
+    cases = [
+        OpBenchCase("matmul_f32", matmul_f32),
+        OpBenchCase("matmul_bf16", matmul_bf16),
+        OpBenchCase("conv2d_3x3", conv2d),
+        OpBenchCase("layer_norm", layer_norm),
+        OpBenchCase("softmax", softmax),
+        OpBenchCase("elementwise_fused", elementwise_fused),
+        OpBenchCase("reduce_sum", reduce_sum),
+        OpBenchCase("gather_embedding", gather_embedding),
+    ]
+    # Pallas kernels compile only on real TPU backends (interpret mode
+    # elsewhere would benchmark the interpreter, not the op)
+    if jax.devices()[0].platform == "tpu":
+        cases.append(OpBenchCase("flash_attention", flash_attention))
+    return cases
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser("paddle_tpu.utils.op_bench")
+    p.add_argument("--repeat", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--size", type=int, default=1024)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--filter", type=str, default="")
+    args = p.parse_args(argv)
+    cases = [c for c in default_cases(args.size)
+             if args.filter in c.name]
+    run_cases(cases, repeat=args.repeat, warmup=args.warmup,
+              as_json=args.json)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Op micro-benchmark harness tests (reference op_tester.cc parity)."""
+import json
+
+from paddle_tpu.utils import op_bench
+
+
+def test_run_cases_table_and_stats():
+    import jax.numpy as jnp
+
+    cases = [
+        op_bench.OpBenchCase(
+            "tiny_add", lambda: ((lambda a, b: a + b),
+                                 (jnp.ones((64, 64)), jnp.ones((64, 64))))),
+        op_bench.OpBenchCase(
+            "tiny_mm", lambda: ((lambda a, b: a @ b),
+                                (jnp.ones((64, 64)), jnp.ones((64, 64))))),
+    ]
+    lines = []
+    rows = op_bench.run_cases(cases, repeat=3, warmup=1,
+                              out=lines.append)
+    assert len(rows) == 2
+    for r in rows:
+        assert r["mean_us"] > 0 and r["min_us"] <= r["mean_us"]
+        assert r["repeat"] == 3
+    assert any("tiny_add" in l for l in lines)
+
+
+def test_json_output():
+    import jax.numpy as jnp
+
+    cases = [op_bench.OpBenchCase(
+        "j", lambda: ((lambda a: a * 2), (jnp.ones((8,)),)))]
+    lines = []
+    op_bench.run_cases(cases, repeat=2, warmup=0, as_json=True,
+                       out=lines.append)
+    rec = json.loads(lines[0])
+    assert rec["op"] == "j" and "p99_us" in rec
+
+
+def test_cli_filter(capsys):
+    op_bench.main(["--repeat", "2", "--warmup", "0", "--size", "64",
+                   "--filter", "reduce_sum"])
+    out = capsys.readouterr().out
+    assert "reduce_sum" in out
+    assert "matmul" not in out
