@@ -88,8 +88,25 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     buf = jnp.full((B, total), pad_token_id, jnp.int32).at[:, :S0].set(ids)
     pos = jnp.full((B,), S0, jnp.int32)
     done = jnp.zeros((B,), bool)
-    for _ in range(max_new_tokens):
-        logits = _logits_at(model, buf, pos)
+    # KV-cache fast path: prefill once, then O(1)-token decode steps
+    # (models without cache support fall back to full-prefix recompute)
+    use_cache = bool(getattr(model, "supports_kv_cache", lambda: False)())
+    caches = None
+    if use_cache:
+        caches = model.init_cache(B, total)
+        prefill_pos = jnp.broadcast_to(jnp.arange(S0, dtype=jnp.int32),
+                                       (B, S0))
+        logits_last, caches = model.forward_with_cache(
+            Tensor(jnp.asarray(ids)), Tensor(prefill_pos), caches,
+            last_logits_only=True)
+        lv = logits_last._value if isinstance(logits_last, Tensor) \
+            else logits_last
+        last_logits = lv[:, -1, :]
+    for it in range(max_new_tokens):
+        if use_cache:
+            logits = last_logits
+        else:
+            logits = _logits_at(model, buf, pos)
         if do_sample:
             logits = _filter_logits(logits, temperature, top_k, top_p)
             key = split_key(1)
@@ -103,6 +120,14 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         pos = pos + 1  # frozen rows advance too, emitting pad tokens
         if eos_token_id is not None and bool(done.all()):
             break
+        if use_cache and it + 1 < max_new_tokens:
+            # no decode forward after the LAST token — its logits would
+            # never be consumed
+            step_logits, caches = model.forward_with_cache(
+                Tensor(nxt[:, None]), Tensor((pos - 1)[:, None]), caches)
+            sv = step_logits._value if isinstance(step_logits, Tensor) \
+                else step_logits
+            last_logits = sv[:, 0, :]
     return to_tensor(np.asarray(buf))
 
 

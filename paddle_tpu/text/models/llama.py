@@ -156,6 +156,8 @@ class LlamaAttention(Layer):
         q = self.q_proj(hidden)
         k = self.k_proj(hidden)
         v = self.v_proj(hidden)
+        if cache is not None:
+            return self._forward_cached(q, k, v, positions, cache)
 
         def attn(qv, kv, vv, pos):
             B, S = qv.shape[0], qv.shape[1]
@@ -194,6 +196,49 @@ class LlamaAttention(Layer):
         ctx = _apply(attn, q, k, v, positions, op_name="llama_attention")
         return self.o_proj(ctx)
 
+    def _forward_cached(self, q, k, v, positions, cache):
+        """Incremental decode: write this call's K/V into the cache
+        buffers at ``positions`` and attend the (few) query tokens against
+        the whole prefix. Cache = {"k": [B,Smax,KH,D], "v": ...}; slot
+        index == absolute position, so the validity mask is simply
+        key_slot <= query_position (RoPE is applied before caching, like
+        every standard KV-cache implementation)."""
+        c = self.config
+
+        def attn_cached(qv, kv, vv, pos, kbuf, vbuf):
+            B, S = qv.shape[0], qv.shape[1]
+            Smax = kbuf.shape[1]
+            qh = qv.reshape(B, S, c.num_attention_heads, c.head_dim)
+            kh = kv.reshape(B, S, c.kv_heads, c.head_dim)
+            vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
+            qh = _rope(qh, pos, c.rope_theta)
+            kh = _rope(kh, pos, c.rope_theta)
+            bidx = jnp.arange(B)[:, None]
+            kbuf = kbuf.at[bidx, pos].set(kh.astype(kbuf.dtype))
+            vbuf = vbuf.at[bidx, pos].set(vh.astype(vbuf.dtype))
+            # GQA: group the query heads instead of materialising a
+            # repeated [B,Smax,H,D] copy of the cache every step
+            G = c.kv_heads
+            R = c.num_attention_heads // G
+            qg = qh.reshape(B, S, G, R, c.head_dim)
+            scale = 1.0 / (c.head_dim ** 0.5)
+            logits = jnp.einsum(
+                "bsgrd,btgd->bgrst", qg.astype(jnp.float32),
+                kbuf.astype(jnp.float32)) * scale      # [B,G,R,S,Smax]
+            valid = (jnp.arange(Smax)[None, None, None, None, :]
+                     <= pos[:, None, None, :, None])
+            logits = jnp.where(valid, logits, -jnp.inf)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bgrst,btgd->bsgrd", w,
+                           vbuf.astype(jnp.float32)).astype(qv.dtype)
+            return (o.reshape(B, S, c.num_attention_heads * c.head_dim),
+                    kbuf, vbuf)
+
+        ctx, kbuf, vbuf = _apply(attn_cached, q, k, v, positions,
+                                 cache["k"], cache["v"],
+                                 op_name="llama_attention_cached")
+        return self.o_proj(ctx), {"k": kbuf, "v": vbuf}
+
 
 class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
@@ -224,9 +269,15 @@ class LlamaDecoderLayer(Layer):
                                                 config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, hidden, positions):
-        h = hidden + self.self_attn(self.input_layernorm(hidden), positions)
-        return h + self.mlp(self.post_attention_layernorm(h))
+    def forward(self, hidden, positions, cache=None):
+        if cache is None:
+            h = hidden + self.self_attn(self.input_layernorm(hidden),
+                                        positions)
+            return h + self.mlp(self.post_attention_layernorm(h))
+        attn_out, cache = self.self_attn(self.input_layernorm(hidden),
+                                         positions, cache)
+        h = hidden + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
 
 
 class StackedLlamaDecoder(Layer):
@@ -291,7 +342,11 @@ class StackedLlamaDecoder(Layer):
 
         def stage_fn(local_stacked, h, pos):
             def body(hh, per_layer):
-                return body_fn(hh, per_layer, pos), None
+                out = body_fn(hh, per_layer, pos)
+                # f32 params promote a bf16 carry (bf16 x f32 -> f32);
+                # scan requires carry-in == carry-out, so fold the layer
+                # output back to the compute dtype
+                return out.astype(hh.dtype), None
             h2, _ = jax.lax.scan(body, h, local_stacked)
             return h2
 
@@ -327,7 +382,7 @@ class LlamaModel(Layer):
                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, positions=None):
+    def forward(self, input_ids, positions=None, caches=None):
         c = self.config
         if positions is None:
             S = input_ids.shape[1]
@@ -341,6 +396,17 @@ class LlamaModel(Layer):
         if c.sequence_parallel:
             hidden = _apply(lambda v: mesh_mod.constrain_dim(v, 1, "sp"),
                             hidden)
+        if caches is not None:
+            if self.decoder is not None:
+                raise NotImplementedError(
+                    "KV-cache decoding is not supported with scan_layers "
+                    "(stacked decoder); build the model with "
+                    "scan_layers=False for incremental generation")
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                hidden, cache = layer(hidden, positions, cache)
+                new_caches.append(cache)
+            return self.norm(hidden), new_caches
         if self.decoder is not None:
             hidden = self.decoder(hidden, positions)
         else:
@@ -425,6 +491,34 @@ class LlamaForCausalLM(Layer):
         paddle_tpu.text.generation.generate."""
         from ..generation import generate
         return generate(self, input_ids, **kwargs)
+
+    # -- KV-cache incremental decode API (generation fast path) --------
+    def supports_kv_cache(self) -> bool:
+        c = self.config
+        # scan-stacked decoders and sequence/context-parallel configs
+        # (ring/ulysses exchange, sp-sharded activations) must use the
+        # full-recompute path — the cached attention has no CP dispatch
+        return (self.model.decoder is None and not c.context_parallel
+                and not c.sequence_parallel)
+
+    def init_cache(self, batch_size: int, max_len: int):
+        """Per-layer K/V buffers; slot index == absolute position."""
+        c = self.config
+        dt = jnp.dtype(c.compute_dtype) if c.compute_dtype else jnp.float32
+        shape = (batch_size, max_len, c.kv_heads, c.head_dim)
+        return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                for _ in range(c.num_hidden_layers)]
+
+    def forward_with_cache(self, input_ids, positions, caches,
+                           last_logits_only: bool = False):
+        """(logits, caches) for the given token block; caches advance.
+        ``last_logits_only`` skips the vocab projection for all but the
+        final position (prefill only needs the last-token logits — the
+        full [B, S0, V] f32 tensor is the dominant prefill cost)."""
+        hidden, caches = self.model(input_ids, positions, caches=caches)
+        if last_logits_only:
+            hidden = hidden[:, -1:]
+        return self._logits(hidden), caches
 
 
 def _causal_lm_loss(logits, labels):

@@ -117,3 +117,32 @@ def test_beam_and_sample_exclusive(lm):
 def test_generate_function_api(lm):
     out = generate(lm, _prompt(b=1, seed=6), max_new_tokens=2)
     assert out.shape == [1, 6]
+
+
+def test_kv_cache_matches_recompute(lm):
+    """The cache fast path must produce byte-identical greedy output to
+    the full-prefix recompute fallback."""
+    ids = _prompt(b=2, seed=9)
+    assert lm.supports_kv_cache()
+    cached = lm.generate(ids, max_new_tokens=5).numpy()
+    try:
+        lm.supports_kv_cache = lambda: False  # force the fallback
+        recompute = lm.generate(ids, max_new_tokens=5).numpy()
+    finally:
+        del lm.supports_kv_cache
+    np.testing.assert_array_equal(cached, recompute)
+
+
+def test_scan_layers_model_falls_back():
+    paddle.seed(10)
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+    # bf16 compute default: the scan carry must stay bf16 across layers
+    cfg = llama_tiny(vocab_size=32, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=32,
+                     scan_layers=True)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    assert not m.supports_kv_cache()
+    out = m.generate(_prompt(b=1, s=3, v=32, seed=11), max_new_tokens=3)
+    assert out.shape == [1, 6]
