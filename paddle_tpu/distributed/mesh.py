@@ -129,11 +129,17 @@ def constrain_dim(x, dim: int, axis: str):
     mesh = get_mesh(create=False)
     if mesh is None or mesh.shape.get(axis, 1) <= 1:
         return x
-    spec = [PartitionSpec.UNCONSTRAINED] * x.ndim
-    spec[dim] = axis
     try:
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, PartitionSpec(*spec)))
+        if isinstance(x, jax.core.Tracer):
+            spec = [PartitionSpec.UNCONSTRAINED] * x.ndim
+            spec[dim] = axis
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, PartitionSpec(*spec)))
+        # concrete array: actually lay it out (UNCONSTRAINED is only
+        # meaningful under jit; eager device_put needs explicit Nones)
+        spec = [None] * x.ndim
+        spec[dim] = axis
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
     except ValueError:
         return x
 
@@ -152,9 +158,14 @@ def maybe_constrain(x, spec: Optional[PartitionSpec]):
     if mesh is None:
         return x
     try:
-        sh = NamedSharding(mesh, spec)
         if isinstance(x, jax.core.Tracer):
-            return jax.lax.with_sharding_constraint(x, sh)
-        return jax.device_put(x, sh)
-    except ValueError:
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+        # concrete: UNCONSTRAINED is only meaningful under jit — map those
+        # entries to None (replicated) for an actual device_put layout
+        concrete_spec = PartitionSpec(
+            *(None if s is PartitionSpec.UNCONSTRAINED else s
+              for s in spec))
+        return jax.device_put(x, NamedSharding(mesh, concrete_spec))
+    except (ValueError, KeyError):
         return x

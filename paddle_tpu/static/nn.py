@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 
 __all__ = ["cond", "while_loop", "case", "switch_case", "fc",
+           "embedding", "conv2d",
            "sequence_pool", "sequence_mask", "sequence_pad",
            "sequence_unpad", "sequence_softmax", "sequence_expand",
            "sequence_first_step", "sequence_last_step"]
@@ -189,15 +190,7 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
             layer = _FC_CACHE[key] = Linear(in_feat, size)
     else:
         layer = Linear(in_feat, size)
-    from . import default_main_program
-    prog = default_main_program()
-    ids = getattr(prog, "_layer_ids", None)
-    if ids is None:
-        ids = prog._layer_ids = set()
-        prog._layers = list(getattr(prog, "_layers", []))
-    if id(layer) not in ids:
-        ids.add(id(layer))
-        prog._layers.append(layer)
+    _register_layer(layer)
     lead = tuple(x.shape[:num_flatten_dims])
     n_lead = int(np.prod(lead)) if lead else 1
     # all reshapes/activations go through _apply so grads reach x and the
@@ -217,6 +210,73 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
 
 
 _FC_CACHE = {}
+
+def _register_layer(layer):
+    """Register a helper-built layer on the default Program (same pattern
+    as fc: build-once semantics, params reachable via all_parameters)."""
+    from . import default_main_program
+    prog = default_main_program()
+    ids = getattr(prog, "_layer_ids", None)
+    if ids is None:
+        ids = prog._layer_ids = set()
+        prog._layers = list(getattr(prog, "_layers", []))
+    if id(layer) not in ids:
+        ids.add(id(layer))
+        prog._layers.append(layer)
+    return layer
+
+
+def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    """Static embedding helper (parity: paddle.static.nn.embedding;
+    reference fluid/layers/nn.py embedding). ``size`` = [vocab, dim];
+    build-once parameters like fc (explicit ``name`` shares)."""
+    from ..nn import Embedding
+    # the key carries EVERY config knob: a named re-call with different
+    # hyperparameters must not silently reuse the first call's layer
+    key = ("emb", name, tuple(size), padding_idx, is_sparse) \
+        if name is not None else None
+    layer = _FC_CACHE.get(key) if key else None
+    if layer is None:
+        layer = Embedding(size[0], size[1],
+                          padding_idx=padding_idx,
+                          weight_attr=param_attr)
+        if key:
+            _FC_CACHE[key] = layer
+    _register_layer(layer)
+    return layer(input if isinstance(input, Tensor)
+                 else Tensor(jnp.asarray(input)))
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    """Static conv helper (parity: paddle.static.nn.conv2d)."""
+    from ..nn import Conv2D
+    x = input if isinstance(input, Tensor) else Tensor(jnp.asarray(input))
+    in_ch = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+
+    def _h(v):  # hashable form of int-or-tuple args
+        return tuple(v) if isinstance(v, (list, tuple)) else v
+
+    key = ("conv2d", name, in_ch, num_filters, _h(filter_size),
+           _h(stride), _h(padding), _h(dilation), groups,
+           bias_attr is False, data_format) if name is not None else None
+    layer = _FC_CACHE.get(key) if key else None
+    if layer is None:
+        layer = Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+        if key:
+            _FC_CACHE[key] = layer
+    _register_layer(layer)
+    out = layer(x)
+    if act is not None:
+        import paddle_tpu.nn.functional as PF
+        out = getattr(PF, act)(out)
+    return out
+
 
 # sequence ops re-exported from functional (reference exposes them under
 # fluid.layers.sequence_* / paddle.static.nn.sequence_*)

@@ -213,9 +213,31 @@ class LlamaAttention(Layer):
             vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
             qh = _rope(qh, pos, c.rope_theta)
             kh = _rope(kh, pos, c.rope_theta)
+            qh = mesh_mod.constrain_dim(qh, 2, "tp")  # heads stay sharded
             bidx = jnp.arange(B)[:, None]
             kbuf = kbuf.at[bidx, pos].set(kh.astype(kbuf.dtype))
             vbuf = vbuf.at[bidx, pos].set(vh.astype(vbuf.dtype))
+            if S > 1:
+                # PREFILL (empty cache, contiguous positions from 0):
+                # causal attention over the block equals attention against
+                # the cache — use the flash/sdpa path instead of the
+                # [B,H,S,Smax] logits tensor (quadratic in the FULL
+                # buffer), then keep the scattered K/V for decode
+                kh2, vh2 = kh, vh
+                if c.kv_heads != c.num_attention_heads:
+                    rep = c.num_attention_heads // c.kv_heads
+                    kh2 = jnp.repeat(kh, rep, axis=2)
+                    vh2 = jnp.repeat(vh, rep, axis=2)
+                from ...nn.functional.attention import _sdpa_ref
+                from ...ops.flash_attention import (flash_attention as
+                                                    _fa_t, flash_eligible)
+                if flash_eligible(S, c.head_dim):
+                    o = _fa_t(qh, kh2, vh2, causal=True)
+                else:
+                    o = _sdpa_ref(qh, kh2, vh2, None, 0.0, True, None)
+                return (o.reshape(B, S,
+                                  c.num_attention_heads * c.head_dim),
+                        kbuf, vbuf)
             # GQA: group the query heads instead of materialising a
             # repeated [B,Smax,H,D] copy of the cache every step
             G = c.kv_heads
@@ -502,11 +524,18 @@ class LlamaForCausalLM(Layer):
                 and not c.sequence_parallel)
 
     def init_cache(self, batch_size: int, max_len: int):
-        """Per-layer K/V buffers; slot index == absolute position."""
+        """Per-layer K/V buffers; slot index == absolute position. Under
+        a tp mesh the kv-head dim is sharded so each device holds only
+        its heads' cache (matching the projections' head sharding)."""
         c = self.config
         dt = jnp.dtype(c.compute_dtype) if c.compute_dtype else jnp.float32
         shape = (batch_size, max_len, c.kv_heads, c.head_dim)
-        return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+        def make():
+            buf = jnp.zeros(shape, dt)
+            return mesh_mod.constrain_dim(buf, 2, "tp")
+
+        return [{"k": make(), "v": make()}
                 for _ in range(c.num_hidden_layers)]
 
     def forward_with_cache(self, input_ids, positions, caches,

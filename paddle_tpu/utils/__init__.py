@@ -11,11 +11,12 @@ import functools
 import warnings
 
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 from . import op_bench  # noqa: F401
 from . import profiler  # noqa: F401
 
-__all__ = ["cpp_extension", "op_bench", "profiler", "deprecated",
-           "run_check", "try_import"]
+__all__ = ["cpp_extension", "download", "op_bench", "profiler",
+           "deprecated", "run_check", "try_import"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):

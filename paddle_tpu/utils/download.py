@@ -1,0 +1,103 @@
+"""Weight/dataset retrieval cache (parity: python/paddle/utils/download.py
+get_weights_path_from_url / get_path_from_url).
+
+This build runs with ZERO egress: nothing is ever fetched. The functions
+resolve URLs against the local cache (~/.cache/paddle_tpu/hapi, override
+with PADDLE_TPU_HOME) and raise a clear error naming the expected path
+when the artifact is absent, so reference code calling these APIs fails
+actionably instead of hanging on a download.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url",
+           "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = osp.join(
+    os.environ.get("PADDLE_TPU_HOME",
+                   osp.join(osp.expanduser("~"), ".cache", "paddle_tpu")),
+    "hapi")
+
+
+def _md5check(path: str, md5sum: str) -> bool:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+_ARCHIVE_SUFFIXES = (".tar.gz", ".tgz", ".tar", ".zip")
+
+
+def _decompress(path: str) -> str:
+    """Extract an archive next to itself and return the extracted root
+    (the reference decompresses by default and returns that path)."""
+    import tarfile
+    import zipfile
+
+    root = osp.dirname(path)
+    base = osp.basename(path)
+    for suf in _ARCHIVE_SUFFIXES:
+        if base.endswith(suf):
+            base = base[: -len(suf)]
+            break
+    target = osp.join(root, base)
+    if osp.isdir(target):
+        return target
+    if path.endswith(".zip"):
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            z.extractall(root)
+    else:
+        with tarfile.open(path) as t:
+            names = t.getnames()
+            t.extractall(root, filter="data")
+    # single top-level dir -> that dir (the common layout); else target
+    tops = {n.split("/", 1)[0] for n in names if n}
+    if len(tops) == 1:
+        return osp.join(root, tops.pop())
+    os.makedirs(target, exist_ok=True)
+    return target
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True,
+                      decompress: bool = True) -> str:
+    """Resolve ``url`` to a cached local file under ``root_dir``;
+    archives are extracted (once) and the extracted path returned, like
+    the reference."""
+    fname = url.split("/")[-1].split("?")[0]
+    fullpath = osp.join(root_dir, fname)
+    is_archive = fname.endswith(_ARCHIVE_SUFFIXES)
+    if is_archive and decompress:
+        # an already-extracted copy satisfies the request without the
+        # archive being present
+        base = fname
+        for suf in _ARCHIVE_SUFFIXES:
+            if base.endswith(suf):
+                base = base[: -len(suf)]
+                break
+        extracted = osp.join(root_dir, base)
+        if not osp.exists(fullpath) and osp.isdir(extracted):
+            return extracted
+    if osp.exists(fullpath):
+        if md5sum and not _md5check(fullpath, md5sum):
+            raise RuntimeError(
+                f"cached file {fullpath} fails its md5 check "
+                f"({md5sum}); delete it and place a correct copy")
+        if is_archive and decompress:
+            return _decompress(fullpath)
+        return fullpath
+    raise FileNotFoundError(
+        f"no cached copy of {url!r}. This environment has no network "
+        f"access (the reference would download it); place the file at "
+        f"{fullpath} manually")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    """Parity: paddle.utils.download.get_weights_path_from_url."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -97,6 +97,60 @@ def test_fc_trains():
     assert len(default_main_program().all_parameters()) > 0
 
 
+def test_static_embedding_and_conv2d_helpers():
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 0]]))
+    out = static_nn.embedding(ids, size=[10, 6])
+    assert out.shape == [2, 2, 6]
+    # named reuse returns identical values
+    a = static_nn.embedding(ids, size=[10, 6], name="shared_emb")
+    b = static_nn.embedding(ids, size=[10, 6], name="shared_emb")
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(2, 3, 8, 8).astype("float32"))
+    y = static_nn.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                         act="relu")
+    assert y.shape == [2, 4, 8, 8]
+    assert (y.numpy() >= 0).all()
+    from paddle_tpu.static import default_main_program
+    assert len(default_main_program().all_parameters()) > 0
+
+
+def test_download_shim(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        download.get_path_from_url("https://x.test/w.pdparams",
+                                   str(tmp_path))
+    f = tmp_path / "w.pdparams"
+    f.write_bytes(b"weights")
+    assert download.get_path_from_url("https://x.test/w.pdparams",
+                                      str(tmp_path)) == str(f)
+    import hashlib
+    good = hashlib.md5(b"weights").hexdigest()
+    assert download.get_path_from_url("https://x.test/w.pdparams",
+                                      str(tmp_path), md5sum=good) == str(f)
+    with pytest.raises(RuntimeError, match="md5"):
+        download.get_path_from_url("https://x.test/w.pdparams",
+                                   str(tmp_path), md5sum="0" * 32)
+    # archives: extracted path returned (reference decompress behavior)
+    import tarfile
+    data_dir = tmp_path / "src" / "mydata"
+    data_dir.mkdir(parents=True)
+    (data_dir / "train.txt").write_text("x")
+    tar = tmp_path / "mydata.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(str(data_dir), arcname="mydata")
+    out = download.get_path_from_url("https://x.test/mydata.tar.gz",
+                                     str(tmp_path))
+    assert out == str(tmp_path / "mydata")
+    assert (tmp_path / "mydata" / "train.txt").exists()
+    # named conv2d with DIFFERENT config must not reuse the cached layer
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .rand(1, 3, 8, 8).astype("float32"))
+    y1 = static_nn.conv2d(x, 4, 3, stride=1, padding=1, name="ck")
+    y2 = static_nn.conv2d(x, 4, 3, stride=2, padding=1, name="ck")
+    assert y1.shape == [1, 4, 8, 8] and y2.shape == [1, 4, 4, 4]
+
+
 def test_box_coder_decode_axis0_with_var():
     priors = np.array([[0, 0, 10, 10], [10, 10, 30, 30],
                        [0, 0, 4, 4]], np.float32)
