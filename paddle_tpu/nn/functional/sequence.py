@@ -21,7 +21,8 @@ from ...framework.core import Tensor, _apply, to_tensor
 
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_pool", "sequence_softmax", "sequence_expand",
-           "sequence_first_step", "sequence_last_step"]
+           "sequence_first_step", "sequence_last_step",
+           "sequence_reverse", "sequence_concat", "sequence_slice"]
 
 
 def _t(x):
@@ -169,6 +170,99 @@ def sequence_expand(x, ref_lengths, name=None) -> Tensor:
         return xv[idx]
 
     return _apply(fn, x, op_name="sequence_expand")
+
+
+def sequence_reverse(x, lengths=None, name=None) -> Tensor:
+    """Reverse each sequence within its valid length; padding stays in
+    place (parity: operators/sequence_ops/sequence_reverse_op.h)."""
+    x = _t(x)
+    maxlen = x.shape[1]
+    if lengths is None:
+        lengths = np.full((x.shape[0],), maxlen, np.int64)
+
+    def fn(xv, lv):
+        idx = jnp.arange(maxlen)[None, :]
+        rev = lv[:, None] - 1 - idx            # reversed index inside seq
+        src = jnp.where(idx < lv[:, None], rev, idx).astype(jnp.int32)
+        return jnp.take_along_axis(
+            xv, src.reshape(src.shape + (1,) * (xv.ndim - 2)), axis=1)
+
+    return _apply(fn, x, _t(lengths), op_name="sequence_reverse")
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate per-row sequences from several padded inputs ->
+    (padded, lengths) (parity: sequence_ops/sequence_concat_op.h: rows
+    are joined sequence-wise, not batch-wise)."""
+    xs = [_t(x) for x in xs]
+    lens = [np.asarray(_len_val(l)).astype(np.int64) for l in lengths_list]
+    for xi, (x, ln) in enumerate(zip(xs, lens)):
+        if np.any(ln < 0) or np.any(ln > x.shape[1]):
+            raise ValueError(
+                f"lengths for input {xi} must be in [0, {x.shape[1]}] "
+                f"(its padded width), got {ln.tolist()} — an over-long "
+                f"length would silently read the NEXT input's rows")
+    total = np.sum(lens, axis=0)               # [batch]
+    out_len = int(total.max()) if total.size else 0
+    batch = xs[0].shape[0]
+    # gather map computed host-side (lengths are concrete)
+    idx_src = np.zeros((batch, out_len), np.int64)   # position in concat-x
+    valid = np.zeros((batch, out_len), bool)
+    widths = [x.shape[1] for x in xs]
+    offsets = np.concatenate([[0], np.cumsum(widths)])[:-1]
+    for b in range(batch):
+        o = 0
+        for xi, ln in enumerate(lens):
+            n = int(ln[b])
+            idx_src[b, o:o + n] = offsets[xi] + np.arange(n)
+            valid[b, o:o + n] = True
+            o += n
+
+    def fn(*vals):
+        cat = jnp.concatenate(vals, axis=1)    # [B, sum(widths), ...]
+        g = jnp.take_along_axis(
+            cat, jnp.asarray(idx_src).reshape(
+                (batch, out_len) + (1,) * (cat.ndim - 2)), axis=1)
+        m = jnp.asarray(valid).reshape(
+            (batch, out_len) + (1,) * (cat.ndim - 2))
+        return jnp.where(m, g, jnp.zeros((), cat.dtype))
+
+    out = _apply(fn, *xs, op_name="sequence_concat")
+    return out, to_tensor(total)
+
+
+def sequence_slice(x, lengths, offset, length, name=None):
+    """Per-row subsequence [offset, offset+length) -> (padded, lengths)
+    (parity: sequence_ops/sequence_slice_op.h)."""
+    x = _t(x)
+    off = np.asarray(_len_val(offset)).astype(np.int64)
+    ln = np.asarray(_len_val(length)).astype(np.int64)
+    lv = np.asarray(_len_val(lengths)).astype(np.int64)
+    if np.any(off < 0) or np.any(ln < 0):
+        raise ValueError(
+            f"offset and length must be non-negative, got "
+            f"offsets={off.tolist()}, lengths={ln.tolist()} "
+            f"(reference sequence_slice_op enforces offset >= 0)")
+    if np.any(off + ln > lv):
+        raise ValueError(
+            f"slice [offset+length] exceeds sequence lengths: "
+            f"offsets={off.tolist()}, lengths={ln.tolist()}, "
+            f"seq_lengths={lv.tolist()}")
+    out_len = int(ln.max()) if ln.size else 0
+    batch = x.shape[0]
+
+    def fn(xv):
+        idx = (jnp.asarray(off)[:, None]
+               + jnp.arange(out_len)[None, :]).astype(jnp.int32)
+        idx = jnp.minimum(idx, xv.shape[1] - 1)
+        g = jnp.take_along_axis(
+            xv, idx.reshape((batch, out_len) + (1,) * (xv.ndim - 2)),
+            axis=1)
+        m = (jnp.arange(out_len)[None, :] < jnp.asarray(ln)[:, None])
+        m = m.reshape((batch, out_len) + (1,) * (xv.ndim - 2))
+        return jnp.where(m, g, jnp.zeros((), xv.dtype))
+
+    return _apply(fn, x, op_name="sequence_slice"), to_tensor(ln)
 
 
 def sequence_first_step(x, lengths=None, name=None) -> Tensor:

@@ -22,7 +22,8 @@ __all__ = ["cond", "while_loop", "case", "switch_case", "fc",
            "embedding", "conv2d",
            "sequence_pool", "sequence_mask", "sequence_pad",
            "sequence_unpad", "sequence_softmax", "sequence_expand",
-           "sequence_first_step", "sequence_last_step"]
+           "sequence_first_step", "sequence_last_step",
+           "sequence_reverse", "sequence_concat", "sequence_slice"]
 
 
 def _unwrap(tree):
@@ -281,5 +282,6 @@ def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
 # sequence ops re-exported from functional (reference exposes them under
 # fluid.layers.sequence_* / paddle.static.nn.sequence_*)
 from ..nn.functional.sequence import (  # noqa: E402,F401
-    sequence_expand, sequence_first_step, sequence_last_step, sequence_mask,
-    sequence_pad, sequence_pool, sequence_softmax, sequence_unpad)
+    sequence_concat, sequence_expand, sequence_first_step,
+    sequence_last_step, sequence_mask, sequence_pad, sequence_pool,
+    sequence_reverse, sequence_slice, sequence_softmax, sequence_unpad)

@@ -238,6 +238,54 @@ def test_sequence_expand():
     np.testing.assert_allclose(out.numpy()[:, 0], [1, 1, 2, 2, 2])
 
 
+def test_sequence_reverse():
+    x = np.zeros((2, 4, 1), np.float32)
+    x[0, :, 0] = [1, 2, 3, 99]   # len 3: 99 is padding
+    x[1, :, 0] = [4, 5, 6, 7]    # len 4
+    out = F.sequence_reverse(paddle.to_tensor(x),
+                             paddle.to_tensor([3, 4])).numpy()
+    np.testing.assert_allclose(out[0, :, 0], [3, 2, 1, 99])
+    np.testing.assert_allclose(out[1, :, 0], [7, 6, 5, 4])
+
+
+def test_sequence_concat():
+    a = np.zeros((2, 3, 1), np.float32)
+    a[0, :2, 0] = [1, 2]
+    a[1, :3, 0] = [7, 8, 9]
+    b = np.zeros((2, 2, 1), np.float32)
+    b[0, :1, 0] = [3]
+    b[1, :2, 0] = [10, 11]
+    out, lens = F.sequence_concat([paddle.to_tensor(a),
+                                   paddle.to_tensor(b)],
+                                  [[2, 3], [1, 2]])
+    np.testing.assert_array_equal(lens.numpy(), [3, 5])
+    np.testing.assert_allclose(out.numpy()[0, :3, 0], [1, 2, 3])
+    np.testing.assert_allclose(out.numpy()[1, :5, 0], [7, 8, 9, 10, 11])
+    np.testing.assert_allclose(out.numpy()[0, 3:, 0], 0.0)
+
+
+def test_sequence_slice():
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+    out, lens = F.sequence_slice(paddle.to_tensor(x), [4, 4],
+                                 offset=[1, 0], length=[2, 3])
+    np.testing.assert_array_equal(lens.numpy(), [2, 3])
+    np.testing.assert_allclose(out.numpy()[0, :, 0], [1, 2, 0])
+    np.testing.assert_allclose(out.numpy()[1, :, 0], [4, 5, 6])
+    with pytest.raises(ValueError, match="exceeds"):
+        F.sequence_slice(paddle.to_tensor(x), [4, 4],
+                         offset=[3, 0], length=[3, 1])
+    with pytest.raises(ValueError, match="non-negative"):
+        F.sequence_slice(paddle.to_tensor(x), [4, 4],
+                         offset=[-1, 0], length=[2, 3])
+
+
+def test_sequence_concat_validates_lengths():
+    a = paddle.to_tensor(np.zeros((1, 2, 1), np.float32))
+    b = paddle.to_tensor(np.zeros((1, 2, 1), np.float32))
+    with pytest.raises(ValueError, match="padded width"):
+        F.sequence_concat([a, b], [[3], [1]])  # 3 > a's width 2
+
+
 # ---------------------------------------------------------------- detection
 
 def test_box_iou():
