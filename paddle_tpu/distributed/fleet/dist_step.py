@@ -400,17 +400,25 @@ class DistributedTrainStep:
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with no_grad():
             if self._use_scaling:
+                call_args = (param_vals, buffer_vals, opt_state,
+                             self._amp_state, lr, key, arg_vals)
                 (loss, new_p, new_b, new_s,
-                 self._amp_state) = self._compiled(
-                    param_vals, buffer_vals, opt_state, self._amp_state,
-                    lr, key, arg_vals)
+                 self._amp_state) = self._compiled(*call_args)
             elif self._k_steps > 1:
+                call_args = (param_vals, buffer_vals, opt_state, self._accum,
+                             jnp.asarray(self._step_i, jnp.int32), lr, key,
+                             arg_vals)
                 loss, new_p, new_b, new_s, self._accum = self._compiled(
-                    param_vals, buffer_vals, opt_state, self._accum,
-                    jnp.asarray(self._step_i, jnp.int32), lr, key, arg_vals)
+                    *call_args)
             else:
-                loss, new_p, new_b, new_s = self._compiled(
-                    param_vals, buffer_vals, opt_state, lr, key, arg_vals)
+                call_args = (param_vals, buffer_vals, opt_state, lr, key,
+                             arg_vals)
+                loss, new_p, new_b, new_s = self._compiled(*call_args)
+        # keep only shape/dtype avals (not buffers: holding the arrays
+        # would pin a full batch + donated-state aliases in HBM)
+        self._last_call_args = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            if hasattr(v, "shape") and hasattr(v, "dtype") else v, call_args)
         self._step_i += 1
         for n, p in self._params.items():
             p._value = new_p[n]
@@ -418,3 +426,25 @@ class DistributedTrainStep:
             b._value = new_b[n]
         self._opt.load_opt_state(new_s)
         return Tensor(loss)
+
+    def cost_analysis(self):
+        """XLA-reported cost of the compiled step program.
+
+        Returns a dict (e.g. ``{'flops': ..., 'bytes accessed': ...}``)
+        from the compiler's own cost model — a timing-independent ground
+        truth for plausibility-checking measured throughput (the analog
+        of the reference's FLAGS_benchmark per-op accounting,
+        reference: paddle/fluid/platform/flags.cc FLAGS_benchmark).
+        Empty dict if the step has not run yet or analysis is unavailable.
+        """
+        if self._compiled is None or not hasattr(self, "_last_call_args"):
+            return {}
+        try:
+            # saved args are ShapeDtypeStructs; compile() hits jax's cache
+            out = self._compiled.lower(
+                *self._last_call_args).compile().cost_analysis()
+            if isinstance(out, (list, tuple)):  # older jax: one per device
+                out = out[0] if out else {}
+            return dict(out or {})
+        except Exception:
+            return {}

@@ -154,26 +154,38 @@ class HDFSClient(FS):
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
-    def is_exist(self, path):
+    def _test(self, flag, path) -> bool:
+        """Run ``hadoop fs -test`` distinguishing a clean negative (rc!=0,
+        silent — the path simply fails the predicate) from timeouts and
+        transient hadoop failures (which must NOT read as "does not
+        exist": mv(overwrite=False) relies on these predicates to avoid
+        nesting src into an existing dst)."""
         try:
-            self._run("-test", "-e", path)
+            r = subprocess.run([*self._base, "-test", flag, path],
+                               capture_output=True, text=True,
+                               timeout=self._timeout)
+        except subprocess.TimeoutExpired as e:
+            raise ExecuteError(
+                f"hadoop -test {flag} {path} timed out after "
+                f"{self._timeout:.0f}s") from e
+        if r.returncode == 0:
             return True
-        except ExecuteError:
+        if r.returncode == 1:
+            # `hadoop fs -test` contract: rc 1 = predicate false.  stderr
+            # may still hold benign WARN/log4j noise — not an error.
             return False
+        raise ExecuteError(  # rc >1 = infra failure, must not read as
+            f"hadoop -test {flag} {path} failed "  # "does not exist"
+            f"(rc={r.returncode}): {r.stderr[-2000:]}")
+
+    def is_exist(self, path):
+        return self._test("-e", path)
 
     def is_dir(self, path):
-        try:
-            self._run("-test", "-d", path)
-            return True
-        except ExecuteError:
-            return False
+        return self._test("-d", path)
 
     def is_file(self, path):
-        try:
-            self._run("-test", "-f", path)  # one JVM spawn, not two
-            return True
-        except ExecuteError:
-            return False
+        return self._test("-f", path)  # one JVM spawn, not two
 
     def mkdirs(self, path):
         self._run("-mkdir", "-p", path)

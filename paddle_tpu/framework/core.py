@@ -86,13 +86,19 @@ class GradNode:
     OpBase's saved VariableWrappers).
     """
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "name")
+    __slots__ = ("vjp_fn", "parents", "out_avals", "name", "primal_fn")
 
-    def __init__(self, vjp_fn, parents: Sequence["Tensor"], out_avals, name=""):
+    def __init__(self, vjp_fn, parents: Sequence["Tensor"], out_avals, name="",
+                 primal_fn=None):
         self.vjp_fn = vjp_fn
         self.parents = list(parents)
         self.out_avals = out_avals  # list of (shape, dtype) per output
         self.name = name
+        # the closed-over forward fn of the diff args; double-grad
+        # re-linearizes through it so the backward op can itself be
+        # differentiated w.r.t. the forward inputs (reference:
+        # imperative/partial_grad_engine.cc + double-grad op makers)
+        self.primal_fn = primal_fn
 
     def __repr__(self):
         return f"GradNode({self.name}, n_out={len(self.out_avals)})"
@@ -372,7 +378,9 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
     parents = [args[p] for p in diff_pos]
     outs = out_val if isinstance(out_val, (tuple, list)) else (out_val,)
     out_avals = [(o.shape, o.dtype) for o in outs]
-    node = GradNode(vjp_fn, parents, out_avals, name=op_name or getattr(fn, "__name__", "op"))
+    node = GradNode(vjp_fn, parents, out_avals,
+                    name=op_name or getattr(fn, "__name__", "op"),
+                    primal_fn=closed)
     return _wrap_outputs(out_val, node, stop_gradient=False)
 
 
@@ -395,15 +403,24 @@ def _wrap_outputs(out, node, stop_gradient):
 # ----------------------------------------------------------------------
 
 def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
-                 retain_graph: bool = False):
+                 retain_graph: bool = False, create_graph: bool = False):
     """BasicEngine::Execute analog (reference imperative/basic_engine.cc:265).
 
     Topologically sorts the GradNode DAG reachable from ``t`` and runs each
     node's vjp once all its output cotangents have been accumulated.
+
+    ``create_graph=True`` runs every backward op through ``_apply`` as a
+    re-linearization of the node's primal fn, so the grad computation is
+    itself recorded on the tape and can be differentiated again (the
+    reference's PartialGradEngine + per-op double-grad makers,
+    imperative/partial_grad_engine.cc).
     """
     if t.stop_gradient:
         raise RuntimeError(
             "backward() on a tensor with stop_gradient=True; nothing to do")
+    if create_graph:
+        _run_backward_tracked(t, grad_tensor)
+        return
     if grad_tensor is None:
         seed = jnp.ones(t._value.shape, t._value.dtype)
     else:
@@ -455,7 +472,8 @@ def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
         else:
             in_grads = node.vjp_fn(arg)
         if not retain_graph:
-            node.vjp_fn = None  # free residuals
+            node.vjp_fn = None     # free residuals
+            node.primal_fn = None  # and the closed-over input values
         for parent, g in zip(node.parents, in_grads):
             if g is None:
                 continue
@@ -478,8 +496,101 @@ def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
         t._node = None
 
 
-def _accum_leaf(parent: Tensor, g):
+def _run_backward_tracked(t: Tensor, grad_tensor: Optional[Tensor]):
+    """The create_graph sweep: cotangents are live Tensors and every
+    backward op goes through ``_apply``, so grads carry their own tape."""
+    if grad_tensor is None:
+        seed = Tensor(jnp.ones(t._value.shape, t._value.dtype))
+    elif isinstance(grad_tensor, Tensor):
+        seed = grad_tensor
+    else:
+        seed = Tensor(jnp.asarray(grad_tensor))
+
+    if t._node is None:
+        _accum_leaf(t, seed, tracked=True)
+        return
+
+    order: List[GradNode] = []
+    seen = set()
+
+    def visit(node: GradNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for p in node.parents:
+            if p._node is not None:
+                visit(p._node)
+        order.append(node)
+
+    visit(t._node)
+    order.reverse()
+
+    with enable_grad():
+        cots = {id(n): [None] * len(n.out_avals) for n in order}
+        c = cots[id(t._node)]
+        c[t._out_idx] = seed if c[t._out_idx] is None else c[t._out_idx] + seed
+        for h in t._hooks:
+            g = h(c[t._out_idx])
+            if g is not None:
+                c[t._out_idx] = g if isinstance(g, Tensor) else Tensor(g)
+
+        for node in order:
+            if node.primal_fn is None:
+                raise RuntimeError(
+                    f"create_graph=True but op {node.name!r} has no primal "
+                    "recorded (its graph was already freed by a previous "
+                    "backward without retain_graph)")
+            buf = cots[id(node)]
+            full = [buf[i] if buf[i] is not None
+                    else Tensor(jnp.zeros(shape, dt))
+                    for i, (shape, dt) in enumerate(node.out_avals)]
+            n_out = len(full)
+            primal = node.primal_fn
+
+            def gop(*vals, _primal=primal, _n=n_out):
+                cot, prim = vals[:_n], vals[_n:]
+                _, vjp = jax.vjp(_primal, *prim)
+                out = vjp(tuple(cot) if _n > 1 else cot[0])
+                # unwrap 1-tuples: a recorded op's cotangent structure must
+                # match its output structure exactly on the next sweep
+                return out if len(out) > 1 else out[0]
+
+            ev = _backward_event
+            if ev is not None:
+                with ev(f"{node.name}_grad"):
+                    in_grads = _apply(gop, *full, *node.parents,
+                                      op_name=f"{node.name}_grad")
+            else:
+                in_grads = _apply(gop, *full, *node.parents,
+                                  op_name=f"{node.name}_grad")
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            for parent, g in zip(node.parents, in_grads):
+                if g is None:
+                    continue
+                for h in parent._hooks:
+                    out = h(g)
+                    if out is not None:
+                        g = out if isinstance(out, Tensor) else Tensor(out)
+                if parent._node is None:
+                    _accum_leaf(parent, g, tracked=True)
+                else:
+                    pbuf = cots.get(id(parent._node))
+                    if pbuf is None:
+                        continue
+                    i = parent._out_idx
+                    pbuf[i] = g if pbuf[i] is None else pbuf[i] + g
+            cots[id(node)] = None
+    # create_graph implies the graph stays alive for the next order
+
+
+def _accum_leaf(parent: Tensor, g, tracked: bool = False):
     if parent.stop_gradient:
+        return
+    if tracked:
+        # keep the grad's own tape so it can be differentiated again
+        with enable_grad():
+            parent.grad = g if parent.grad is None else parent.grad + g
         return
     if parent.grad is None:
         parent.grad = Tensor(g)
@@ -533,7 +644,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         go = None
         if grad_outputs is not None and grad_outputs[k] is not None:
             go = grad_outputs[k]
-        run_backward(o, go, retain_graph=retain)
+        run_backward(o, go, retain_graph=retain, create_graph=create_graph)
     res = []
     for i in ins:
         if i.grad is None:

@@ -42,6 +42,9 @@ def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="int64",
     if maxlen is None:
         maxlen = int(np.asarray(lv).max()) if lv.size else 0
     from ...framework import dtype as dtypes
+    import jax
+    if dtype in ("int64", np.int64) and not jax.config.jax_enable_x64:
+        dtype = "int32"  # avoid a per-call truncation UserWarning
     jd = dtypes.to_jax(dtype)
 
     def fn(l):

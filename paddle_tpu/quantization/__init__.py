@@ -297,7 +297,11 @@ class PostTrainingQuantization:
             def observed(x, _fq=fq):
                 v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
                 observers[id(_fq)].append(float(jnp.max(jnp.abs(v))))
-                return x  # calibration runs the FP model
+                # activation fake-quant is bypassed here, but WEIGHT
+                # fake-quant stays active: activation stats are collected
+                # under quantized weights on purpose — that matches the
+                # deployed int8 graph, a better estimator than FP weights
+                return x
 
             fq.forward = observed
         self._model.eval()

@@ -50,6 +50,8 @@ class Program:
         c._layers = list(self._layers)
         if hasattr(self, "_layer_ids"):
             c._layer_ids = set(self._layer_ids)
+        if hasattr(self, "_named_layer_cache"):
+            c._named_layer_cache = dict(self._named_layer_cache)
         return c
 
 
@@ -66,13 +68,26 @@ def default_startup_program():
 
 
 class program_guard:
+    """Swap the default main/startup Programs for the scope (reference:
+    fluid/framework.py program_guard) — helper-built named layers (fc,
+    embedding, conv2d) and their caches are per-Program, so a fresh
+    Program inside the guard starts with no inherited parameters."""
+
     def __init__(self, main_program, startup_program=None):
         self.main = main_program
+        self.startup = startup_program
 
     def __enter__(self):
+        global _main, _startup
+        self._prev = (_main, _startup)
+        _main = self.main
+        if self.startup is not None:
+            _startup = self.startup
         return self.main
 
     def __exit__(self, *exc):
+        global _main, _startup
+        _main, _startup = self._prev
         return False
 
 

@@ -186,9 +186,10 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
     # params live in the Program's global block).
     if name is not None:
         key = (name, in_feat, size)
-        layer = _FC_CACHE.get(key)
+        cache = _named_cache()
+        layer = cache.get(key)
         if layer is None:
-            layer = _FC_CACHE[key] = Linear(in_feat, size)
+            layer = cache[key] = Linear(in_feat, size)
     else:
         layer = Linear(in_feat, size)
     _register_layer(layer)
@@ -210,7 +211,18 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
     return out
 
 
-_FC_CACHE = {}
+def _named_cache():
+    """Named-layer cache scoped to the default Program: a new Program (or
+    ``program_guard`` scope) starts with no named layers, so a name+shape
+    reused in a fresh Program never inherits another Program's trained
+    weights (reference: params live per-Program in the global block)."""
+    from . import default_main_program
+    prog = default_main_program()
+    cache = getattr(prog, "_named_layer_cache", None)
+    if cache is None:
+        cache = prog._named_layer_cache = {}
+    return cache
+
 
 def _register_layer(layer):
     """Register a helper-built layer on the default Program (same pattern
@@ -237,13 +249,14 @@ def embedding(input, size, is_sparse: bool = False, padding_idx=None,
     # hyperparameters must not silently reuse the first call's layer
     key = ("emb", name, tuple(size), padding_idx, is_sparse) \
         if name is not None else None
-    layer = _FC_CACHE.get(key) if key else None
+    cache = _named_cache() if key else None
+    layer = cache.get(key) if key else None
     if layer is None:
         layer = Embedding(size[0], size[1],
                           padding_idx=padding_idx,
                           weight_attr=param_attr)
         if key:
-            _FC_CACHE[key] = layer
+            cache[key] = layer
     _register_layer(layer)
     return layer(input if isinstance(input, Tensor)
                  else Tensor(jnp.asarray(input)))
@@ -263,14 +276,15 @@ def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
     key = ("conv2d", name, in_ch, num_filters, _h(filter_size),
            _h(stride), _h(padding), _h(dilation), groups,
            bias_attr is False, data_format) if name is not None else None
-    layer = _FC_CACHE.get(key) if key else None
+    cache = _named_cache() if key else None
+    layer = cache.get(key) if key else None
     if layer is None:
         layer = Conv2D(in_ch, num_filters, filter_size, stride=stride,
                        padding=padding, dilation=dilation, groups=groups,
                        weight_attr=param_attr, bias_attr=bias_attr,
                        data_format=data_format)
         if key:
-            _FC_CACHE[key] = layer
+            cache[key] = layer
     _register_layer(layer)
     out = layer(x)
     if act is not None:

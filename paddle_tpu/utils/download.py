@@ -51,6 +51,12 @@ def _decompress(path: str) -> str:
     if path.endswith(".zip"):
         with zipfile.ZipFile(path) as z:
             names = z.namelist()
+            # zip-slip guard, mirroring the tar path's filter="data"
+            for n in names:
+                if n.startswith(("/", "\\")) or osp.isabs(n) \
+                        or ".." in n.split("/"):
+                    raise ValueError(
+                        f"refusing to extract unsafe zip member {n!r}")
             z.extractall(root)
     else:
         with tarfile.open(path) as t:

@@ -1,0 +1,135 @@
+"""Higher-order autograd (double grad) tests.
+
+Parity target: the reference's PartialGradEngine + per-op double-grad
+registrations (reference: paddle/fluid/imperative/partial_grad_engine.cc,
+python/paddle/fluid/backward.py:1795 calc_gradient; double-grad ops e.g.
+operators/activation_op.cc TanhDoubleGrad).  Here the backward sweep with
+``create_graph=True`` re-linearizes every recorded op through ``_apply``,
+so grads carry their own tape and can be differentiated again — to any
+order (the reference needs hand-written NthGrad kernels per op; jax.vjp
+composition gives it for every op at once).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_second_order_polynomial():
+    x = paddle.to_tensor(np.array([1.5, -2.0, 0.5], np.float32),
+                         stop_gradient=False)
+    y = (x * x * x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._value),
+                               3 * np.array([1.5, -2.0, 0.5]) ** 2, rtol=1e-6)
+    assert not g1.stop_gradient  # differentiable result
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._value),
+                               6 * np.array([1.5, -2.0, 0.5]), rtol=1e-6)
+
+
+def test_third_order():
+    x = paddle.to_tensor(np.array([1.2], np.float32), stop_gradient=False)
+    (g1,) = paddle.grad((x ** 4).sum(), [x], create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), [x], create_graph=True)
+    (g3,) = paddle.grad(g2.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g3._value), [24 * 1.2], rtol=1e-5)
+
+
+def test_tanh_double_grad_vs_finite_difference():
+    pts = np.array([0.3, -0.7, 1.1], np.float32)
+    x = paddle.to_tensor(pts, stop_gradient=False)
+    (g1,) = paddle.grad(paddle.tanh(x).sum(), [x], create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), [x])
+    # finite difference of the analytic first derivative 1 - tanh^2
+    eps = 1e-3
+    fd = ((1 - np.tanh(pts + eps) ** 2) - (1 - np.tanh(pts - eps) ** 2)) \
+        / (2 * eps)
+    np.testing.assert_allclose(np.asarray(g2._value), fd, atol=1e-3)
+
+
+def test_first_order_result_is_detached_without_create_graph():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    (g,) = paddle.grad((x * x).sum(), [x])
+    assert g.stop_gradient
+    assert g._node is None
+
+
+def test_gradient_penalty_reaches_params():
+    """WGAN-GP pattern: penalty on d(out)/d(input), backward into weights."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    xi = paddle.to_tensor(
+        np.random.RandomState(0).rand(3, 4).astype(np.float32),
+        stop_gradient=False)
+    out = net(xi).sum()
+    (gx,) = paddle.grad(out, [xi], create_graph=True)
+    gp = ((gx * gx).sum(axis=1).sqrt() - 1.0)
+    loss = (gp * gp).mean()
+    loss.backward()
+    for p in net.parameters():
+        if p.name and "linear_0" in str(p.name):
+            break
+    w = net[0].weight
+    assert w.grad is not None
+    assert float(np.abs(np.asarray(w.grad._value)).sum()) > 0
+
+
+def test_gradient_penalty_matches_jax_reference():
+    """Second-order param grads equal pure-jax nested AD on the same net."""
+    import jax
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    w0 = np.asarray(net[0].weight._value)
+    b0 = np.asarray(net[0].bias._value)
+    w1 = np.asarray(net[2].weight._value)
+    b1 = np.asarray(net[2].bias._value)
+    xin = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+
+    def jref(params, x):
+        W0, B0, W1, B1 = params
+
+        def f(xv):
+            return (jnp.tanh(xv @ W0 + B0) @ W1 + B1).sum()
+
+        gx = jax.grad(f)(x)
+        gp = jnp.sqrt((gx * gx).sum(1)) - 1.0
+        return (gp * gp).mean()
+
+    ref_grads = jax.grad(jref)((w0, b0, w1, b1), jnp.asarray(xin))
+
+    xi = paddle.to_tensor(xin, stop_gradient=False)
+    out = net(xi).sum()
+    (gx,) = paddle.grad(out, [xi], create_graph=True)
+    gp = ((gx * gx).sum(axis=1).sqrt() - 1.0)
+    ((gp * gp).mean()).backward()
+    got = [net[0].weight.grad, net[0].bias.grad,
+           net[2].weight.grad, net[2].bias.grad]
+    for g, r in zip(got, ref_grads):
+        np.testing.assert_allclose(np.asarray(g._value), np.asarray(r),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_grad_outputs_and_multi_inputs():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32),
+                         stop_gradient=False)
+    z = (x * x * y).sum()
+    gx, gy = paddle.grad(z, [x, y], create_graph=True)
+    # d2z/dxdy = 2x via differentiating gx w.r.t. y
+    (gxy,) = paddle.grad(gx.sum(), [y])
+    np.testing.assert_allclose(np.asarray(gxy._value), [2.0, 4.0], rtol=1e-6)
+    (gyx,) = paddle.grad(gy.sum(), [x])
+    np.testing.assert_allclose(np.asarray(gyx._value), [2.0, 4.0], rtol=1e-6)
+
+
+def test_create_graph_after_freed_graph_raises():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()  # frees vjp closures
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x], create_graph=True)
