@@ -414,11 +414,14 @@ class DistributedTrainStep:
                 call_args = (param_vals, buffer_vals, opt_state, lr, key,
                              arg_vals)
                 loss, new_p, new_b, new_s = self._compiled(*call_args)
-        # keep only shape/dtype avals (not buffers: holding the arrays
-        # would pin a full batch + donated-state aliases in HBM)
-        self._last_call_args = jax.tree_util.tree_map(
-            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
-            if hasattr(v, "shape") and hasattr(v, "dtype") else v, call_args)
+        if not hasattr(self, "_last_call_args"):
+            # captured once: avals never change after _build.  Only
+            # shape/dtype structs are kept (holding the arrays would pin
+            # a full batch + donated-state aliases in HBM)
+            self._last_call_args = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                if hasattr(v, "shape") and hasattr(v, "dtype") else v,
+                call_args)
         self._step_i += 1
         for n, p in self._params.items():
             p._value = new_p[n]

@@ -130,7 +130,12 @@ class HDFSClient(FS):
             self._base += ["-D", f"{k}={v}"]
         self._timeout = time_out / 1000.0
 
-    def _run(self, *args) -> str:
+    def _run(self, *args, ok_rcs=(0,)):
+        """Run a hadoop fs command; returns (returncode, stdout).
+
+        Exit codes outside ``ok_rcs`` — and timeouts — raise ExecuteError;
+        callers that treat nonzero as data (``-test``) pass ok_rcs=(0, 1).
+        """
         try:
             r = subprocess.run([*self._base, *args], capture_output=True,
                                text=True, timeout=self._timeout)
@@ -138,13 +143,14 @@ class HDFSClient(FS):
             raise ExecuteError(
                 f"hadoop {' '.join(args)} timed out after "
                 f"{self._timeout:.0f}s") from e
-        if r.returncode != 0:
+        if r.returncode not in ok_rcs:
             raise ExecuteError(
-                f"hadoop {' '.join(args)} failed: {r.stderr[-2000:]}")
-        return r.stdout
+                f"hadoop {' '.join(args)} failed "
+                f"(rc={r.returncode}): {r.stderr[-2000:]}")
+        return r.returncode, r.stdout
 
     def ls_dir(self, path):
-        out = self._run("-ls", path)
+        _, out = self._run("-ls", path)
         dirs, files = [], []
         for line in out.splitlines():
             parts = line.split(None, 7)  # name (field 8) may hold spaces
@@ -155,28 +161,13 @@ class HDFSClient(FS):
         return dirs, files
 
     def _test(self, flag, path) -> bool:
-        """Run ``hadoop fs -test`` distinguishing a clean negative (rc!=0,
-        silent — the path simply fails the predicate) from timeouts and
-        transient hadoop failures (which must NOT read as "does not
-        exist": mv(overwrite=False) relies on these predicates to avoid
-        nesting src into an existing dst)."""
-        try:
-            r = subprocess.run([*self._base, "-test", flag, path],
-                               capture_output=True, text=True,
-                               timeout=self._timeout)
-        except subprocess.TimeoutExpired as e:
-            raise ExecuteError(
-                f"hadoop -test {flag} {path} timed out after "
-                f"{self._timeout:.0f}s") from e
-        if r.returncode == 0:
-            return True
-        if r.returncode == 1:
-            # `hadoop fs -test` contract: rc 1 = predicate false.  stderr
-            # may still hold benign WARN/log4j noise — not an error.
-            return False
-        raise ExecuteError(  # rc >1 = infra failure, must not read as
-            f"hadoop -test {flag} {path} failed "  # "does not exist"
-            f"(rc={r.returncode}): {r.stderr[-2000:]}")
+        """``hadoop fs -test`` contract: rc 0 = predicate true, rc 1 =
+        predicate false (stderr may hold benign WARN noise).  Anything
+        else — rc >1 or a timeout — raises, so transient hadoop failures
+        are never read as "does not exist" (mv(overwrite=False) relies on
+        these predicates to avoid nesting src into an existing dst)."""
+        rc, _ = self._run("-test", flag, path, ok_rcs=(0, 1))
+        return rc == 0
 
     def is_exist(self, path):
         return self._test("-e", path)

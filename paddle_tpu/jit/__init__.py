@@ -183,8 +183,13 @@ class StaticFunction:
         return param_vals, buf_vals
 
     def _make_compiled(self, skeleton, kw_skeleton):
+        from .dy2static import convert_func
         layer = self._layer_obj()
-        fn = self._fn
+        # AST-convert tensor-dependent Python control flow (if/while/for
+        # range) into runtime-dispatched lax.cond/while_loop combinators
+        # (the reference's dygraph_to_static compiler, program_translator
+        # .py:233); non-convertible functions pass through unchanged
+        fn = convert_func(self._fn)
 
         def traced(param_vals, buf_vals, key, leaf_vals):
             args = _fill_args(skeleton, leaf_vals)

@@ -1,0 +1,518 @@
+"""dygraph→static control-flow conversion (AST pass + runtime dispatch).
+
+The reference converts Python control flow to graph ops with an ~8k-LoC
+AST compiler (reference: fluid/dygraph/dygraph_to_static/
+program_translator.py:233, ifelse_transformer.py, loop_transformer.py).
+The TPU-native equivalent is far smaller because the *runtime* does the
+heavy lifting: every rewritten ``if``/``while``/``for range()`` becomes a
+call to a ``_jst.convert_*`` helper that dispatches at execution time —
+plain Python semantics when the predicate is a concrete value, XLA-native
+``lax.cond``/``lax.while_loop`` (via ``static.nn``) when it is traced.
+So one rewrite serves both eager calls and ``to_static`` tracing, and
+non-tensor control flow is untouched in behavior.
+
+Scope (documented contract, mirrors the reference's supported subset):
+  * ``if``/``elif``/``else`` on tensor predicates — including branches
+    that both end in ``return``;
+  * ``while`` with tensor conditions;
+  * ``for <name> in range(...)`` with tensor bounds;
+  * statements containing ``break``/``continue``/mid-branch ``return``,
+    ``global``/``nonlocal``, or loop ``else`` clauses are left as plain
+    Python (they convert only if their predicates stay concrete).
+Conversion failures (no source, exotic constructs) fall back to the
+original function — tracing then fails only where it would have anyway.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Optional
+
+
+# ----------------------------------------------------------------------
+# runtime: undefined-variable sentinel
+# ----------------------------------------------------------------------
+
+class _Undefined:
+    """Placeholder for a variable not yet bound at a control-flow merge
+    point (the reference's UndefinedVar).  Any use raises a NameError."""
+
+    __slots__ = ()
+
+    def _die(self, *a, **k):
+        raise NameError(
+            "variable used before assignment in converted control flow "
+            "(assign it on every branch, or before the loop)")
+
+    __bool__ = __call__ = __iter__ = __len__ = _die
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _die
+    __truediv__ = __getitem__ = __float__ = __int__ = _die
+
+    def __getattr__(self, name):
+        self._die()
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def get(thunk: Callable):
+    """Read a variable via closure; UNDEF if unbound (NameError trick
+    gives uniform local/closure/global resolution)."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _is_traced(v) -> bool:
+    import jax
+
+    from ..framework.core import Tensor
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+def _check_defined(vals, names, what):
+    for v, n in zip(vals, names):
+        if v is UNDEF:
+            raise ValueError(
+                f"to_static control-flow conversion: variable {n!r} is "
+                f"undefined after {what} under tracing; XLA control flow "
+                "needs every carried variable bound on all paths with "
+                "matching shape/dtype")
+
+
+def convert_ifelse(pred, true_fn, false_fn, args, names=()):
+    """Runtime dispatch for a rewritten ``if`` statement."""
+    if _is_traced(pred):
+        from ..static.nn import cond
+        try:
+            out = cond(pred, lambda: true_fn(*args),
+                       lambda: false_fn(*args))
+        except Exception as e:
+            raise type(e)(
+                f"{e}\n[to_static] while converting an `if` on a traced "
+                f"tensor (carried vars: {list(names)}). Both branches must "
+                "bind every carried variable with matching shape/dtype — "
+                "a variable assigned on only one side cannot convert."
+            ) from e
+        vals = out if isinstance(out, (tuple, list)) else (out,)
+        _check_defined(vals, names, "an if/else")
+        return out
+    taken = true_fn if pred else false_fn
+    return taken(*args)
+
+
+def convert_while(cond_fn, body_fn, args, names=()):
+    """Runtime dispatch for a rewritten ``while`` (or ``for range``).
+
+    Only a *traced predicate* forces the XLA path: carried variables may
+    be traced tensors in a perfectly ordinary Python loop (concrete trip
+    count inside to_static), which must keep eager semantics — including
+    variables first assigned inside the body.
+    """
+    probe = cond_fn(*args)
+    if _is_traced(probe):
+        _check_defined(args, names, "entering a while loop")
+        from ..static.nn import while_loop
+        out = while_loop(cond_fn, body_fn, list(args))
+        return tuple(out)
+    vals = list(args)
+    keep = bool(probe)
+    while keep:
+        out = body_fn(*vals)
+        vals = list(out) if isinstance(out, (tuple, list)) else [out]
+        keep = bool(cond_fn(*vals))
+    return tuple(vals)
+
+
+def normalize_range(*args):
+    """range() arguments -> (start, stop, step), tensors allowed."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+def range_cond(i, stop, step):
+    """Loop-continue predicate of a normalized range."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+    iv = i._value if isinstance(i, Tensor) else i
+    sv = stop._value if isinstance(stop, Tensor) else stop
+    st = step._value if isinstance(step, Tensor) else step
+    if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+        return jnp.where(jnp.asarray(st) > 0, jnp.asarray(iv) < jnp.asarray(sv),
+                         jnp.asarray(iv) > jnp.asarray(sv))
+    return iv < sv if st > 0 else iv > sv
+
+
+# ----------------------------------------------------------------------
+# static analysis helpers
+# ----------------------------------------------------------------------
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _assigned_names(stmts) -> set:
+    """Names bound by simple assignments in a statement list, recursing
+    into nested compound statements but not into nested scopes."""
+    found = set()
+
+    def target_names(t):
+        if isinstance(t, ast.Name):
+            found.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                target_names(e)
+        elif isinstance(t, ast.Starred):
+            target_names(t.value)
+        # attribute/subscript targets mutate objects, not local bindings
+
+    def walk(body):
+        for s in body:
+            if isinstance(s, _SCOPES):
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    found.add(s.name)
+                continue
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    target_names(t)
+            elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+                target_names(s.target)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                target_names(s.target)
+                walk(s.body)
+                walk(s.orelse)
+            elif isinstance(s, (ast.While, ast.If)):
+                walk(s.body)
+                walk(s.orelse)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    if item.optional_vars is not None:
+                        target_names(item.optional_vars)
+                walk(s.body)
+            elif isinstance(s, ast.Try):
+                walk(s.body)
+                walk(s.orelse)
+                walk(s.finalbody)
+                for h in s.handlers:
+                    if h.name:
+                        found.add(h.name)
+                    walk(h.body)
+            elif isinstance(s, ast.Import):
+                for a in s.names:
+                    found.add((a.asname or a.name).split(".")[0])
+            elif isinstance(s, ast.ImportFrom):
+                for a in s.names:
+                    found.add(a.asname or a.name)
+    walk(stmts)
+    return found
+
+
+def _scan(stmts, kinds, loop_barrier: bool):
+    """True if any statement of the given AST kinds appears, not crossing
+    nested scopes; with loop_barrier, not crossing nested loops either
+    (break/continue bind to the innermost loop)."""
+    for s in stmts:
+        if isinstance(s, _SCOPES):
+            continue
+        if isinstance(s, kinds):
+            return True
+        if loop_barrier and isinstance(s, (ast.For, ast.While,
+                                           ast.AsyncFor)):
+            # a break/continue inside binds to that inner loop; its else
+            # clause still belongs to us
+            if _scan(s.orelse, kinds, loop_barrier):
+                return True
+            continue
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.stmt):
+                if _scan([child], kinds, loop_barrier):
+                    return True
+            elif isinstance(child, ast.excepthandler):
+                if _scan(child.body, kinds, loop_barrier):
+                    return True
+    return False
+
+
+def _has_return(stmts) -> bool:
+    return _scan(stmts, ast.Return, loop_barrier=False)
+
+
+def _has_break_continue(stmts) -> bool:
+    return _scan(stmts, (ast.Break, ast.Continue), loop_barrier=True)
+
+
+def _has_scope_decl(stmts) -> bool:
+    return _scan(stmts, (ast.Global, ast.Nonlocal), loop_barrier=False)
+
+
+def _filter_carried(names) -> List[str]:
+    """Drop generated helper bindings (branch fns, range temps) from a
+    carried-variable set — they are always local to one statement group.
+    ``__dy2st_ret_*`` stays: trailing-return conversion reads it after
+    the merge."""
+    return sorted(
+        n for n in names
+        if not n.startswith("__dy2st_") or n.startswith("__dy2st_ret_"))
+
+
+# ----------------------------------------------------------------------
+# AST construction helpers
+# ----------------------------------------------------------------------
+
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+def _jst_call(func: str, args: list, names=None):
+    call = ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=func, ctx=ast.Load()),
+        args=args, keywords=[])
+    if names is not None:
+        call.keywords.append(ast.keyword(
+            arg="names",
+            value=ast.Tuple([ast.Constant(n) for n in names], ast.Load())))
+    return call
+
+
+def _get_expr(n: str):
+    """``_jst.get(lambda: n)`` — closure-safe maybe-undefined read."""
+    lam = ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=_name(n))
+    return _jst_call("get", [lam])
+
+
+def _fn_def(name: str, params: List[str], body: list, returns: List[str]):
+    body = list(body) + [ast.Return(ast.Tuple(
+        [_name(r) for r in returns], ast.Load()))]
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+def _unpack_assign(names: List[str], value):
+    tgt = ast.Tuple([_name(n, ast.Store()) for n in names], ast.Store())
+    return ast.Assign(targets=[tgt], value=value)
+
+
+# ----------------------------------------------------------------------
+# the transformer
+# ----------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- if ------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        both = node.body + node.orelse
+        if _has_break_continue(both) or _has_scope_decl(both):
+            return node
+        trailing_return = False
+        if _has_return(node.body) or _has_return(node.orelse):
+            # only the symmetric trailing-return form converts
+            if (node.orelse and isinstance(node.body[-1], ast.Return)
+                    and isinstance(node.orelse[-1], ast.Return)
+                    and not _has_return(node.body[:-1])
+                    and not _has_return(node.orelse[:-1])):
+                trailing_return = True
+            else:
+                return node
+        i = self._uid()
+        body, orelse = list(node.body), list(node.orelse)
+        ret_name = f"__dy2st_ret_{i}"
+        if trailing_return:
+            body[-1] = ast.Assign(
+                targets=[_name(ret_name, ast.Store())],
+                value=body[-1].value or ast.Constant(None))
+            orelse[-1] = ast.Assign(
+                targets=[_name(ret_name, ast.Store())],
+                value=orelse[-1].value or ast.Constant(None))
+        carried = _filter_carried(_assigned_names(body)
+                                  | _assigned_names(orelse))
+        if not carried:
+            return node
+        tname, fname = f"__dy2st_true_{i}", f"__dy2st_false_{i}"
+        tdef = _fn_def(tname, carried, body, carried)
+        fdef = _fn_def(fname, carried, orelse or [ast.Pass()], carried)
+        call = _jst_call(
+            "convert_ifelse",
+            [node.test, _name(tname), _name(fname),
+             ast.Tuple([_get_expr(n) for n in carried], ast.Load())],
+            names=carried)
+        out: list = [tdef, fdef, _unpack_assign(carried, call)]
+        if trailing_return:
+            out.append(ast.Return(_name(ret_name)))
+        self.changed = True
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in out]
+
+    # -- while ---------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if (node.orelse or _has_return(node.body)
+                or _has_break_continue(node.body)
+                or _has_scope_decl(node.body)):
+            return node
+        carried = _filter_carried(_assigned_names(node.body))
+        if not carried:
+            return node
+        i = self._uid()
+        cname, bname = f"__dy2st_wcond_{i}", f"__dy2st_wbody_{i}"
+        cdef = _fn_def(cname, carried, [], [])
+        cdef.body = [ast.Return(node.test)]
+        bdef = _fn_def(bname, carried, list(node.body), carried)
+        call = _jst_call(
+            "convert_while",
+            [_name(cname), _name(bname),
+             ast.Tuple([_get_expr(n) for n in carried], ast.Load())],
+            names=carried)
+        self.changed = True
+        out = [cdef, bdef, _unpack_assign(carried, call)]
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in out]
+
+    # -- for over range() ---------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or _has_return(node.body)
+                or _has_break_continue(node.body)
+                or _has_scope_decl(node.body)):
+            return node
+        i = self._uid()
+        tgt = node.target.id
+        start, stop, step = (f"__dy2st_start_{i}", f"__dy2st_stop_{i}",
+                             f"__dy2st_step_{i}")
+        idx = f"__dy2st_i_{i}"
+        norm = _unpack_assign(
+            [start, stop, step],
+            _jst_call("normalize_range", list(node.iter.args)))
+        # python leaves the target at the last iterated value; initialize
+        # to start so a zero-trip traced loop still has a bound value
+        init_tgt = ast.Assign(targets=[_name(tgt, ast.Store())],
+                              value=_name(start))
+        carried = _filter_carried(_assigned_names(node.body) | {tgt})
+        params = [idx] + carried
+        cname, bname = f"__dy2st_fcond_{i}", f"__dy2st_fbody_{i}"
+        cdef = _fn_def(cname, params, [], [])
+        cdef.body = [ast.Return(_jst_call(
+            "range_cond", [_name(idx), _name(stop), _name(step)]))]
+        bbody = [ast.Assign(targets=[_name(tgt, ast.Store())],
+                            value=_name(idx))] + list(node.body)
+        bnext = ast.BinOp(left=_name(idx), op=ast.Add(), right=_name(step))
+        bdef = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=bbody + [ast.Return(ast.Tuple(
+                [bnext] + [_name(c) for c in carried], ast.Load()))],
+            decorator_list=[], returns=None, type_params=[])
+        init_args = ast.Tuple(
+            [_name(start)] + [_get_expr(c) if c != tgt else _name(tgt)
+                              for c in carried], ast.Load())
+        call = _jst_call("convert_while", [_name(cname), _name(bname),
+                                           init_args],
+                         names=[idx] + carried)
+        assign = _unpack_assign([idx] + carried, call)
+        self.changed = True
+        out = [norm, init_tgt, cdef, bdef, assign]
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in out]
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+
+_CONVERTED: Dict[Any, Callable] = {}
+
+
+def convert_func(fn: Callable) -> Callable:
+    """AST-convert ``fn`` (or the underlying function of a bound method);
+    returns ``fn`` unchanged when conversion is unnecessary/impossible."""
+    bound_self = getattr(fn, "__self__", None)
+    f = fn.__func__ if inspect.ismethod(fn) else fn
+    if f in _CONVERTED:
+        conv = _CONVERTED[f]
+    else:
+        try:
+            conv = _do_convert(f)
+        except Exception:
+            conv = f
+        try:
+            _CONVERTED[f] = conv
+        except TypeError:
+            pass
+    if conv is f:
+        return fn
+    if bound_self is not None:
+        return conv.__get__(bound_self)
+    return conv
+
+
+def _do_convert(f: Callable) -> Callable:
+    src = textwrap.dedent(inspect.getsource(f))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return f
+    fdef.decorator_list = []
+    tr = _ControlFlowTransformer()
+    tree = tr.visit(tree)
+    if not tr.changed:
+        return f
+
+    freevars = f.__code__.co_freevars
+    if freevars:
+        outer = ast.FunctionDef(
+            name="__dy2st_outer__",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=list(tree.body) + [ast.Return(_name(fdef.name))],
+            decorator_list=[], returns=None, type_params=[])
+        tree = ast.Module(body=[outer], type_ignores=[])
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dy2static:{f.__qualname__}>", "exec")
+    import paddle_tpu.jit.dy2static as _jst_mod
+    glb = dict(getattr(f, "__globals__", {}))
+    glb["_jst"] = _jst_mod
+    exec(code, glb)
+    if freevars:
+        cells = [c.cell_contents for c in (f.__closure__ or ())]
+        new = glb["__dy2st_outer__"](*cells)
+    else:
+        new = glb[fdef.name]
+    new.__defaults__ = f.__defaults__
+    new.__kwdefaults__ = f.__kwdefaults__
+    new.__dict__.update(getattr(f, "__dict__", {}))
+    new.__wrapped_dy2static__ = f
+    return new
