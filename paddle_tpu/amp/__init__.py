@@ -130,8 +130,15 @@ class GradScaler:
             return
         inv = 1.0 / self._scale
         found = False
+        from ..framework.selected_rows import SelectedRows
         for p in optimizer._parameter_list:
-            if p.grad is not None:
+            if p.grad is None:
+                continue
+            if isinstance(p.grad, SelectedRows):
+                sr = p.grad.scale(inv)
+                found = found or bool(~jnp.isfinite(sr.values).all())
+                p.grad = sr
+            else:
                 g = p.grad._value * inv
                 found = found or bool(~jnp.isfinite(g).all())
                 p.grad = Tensor(g)

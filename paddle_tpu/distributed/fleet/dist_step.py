@@ -414,10 +414,16 @@ class DistributedTrainStep:
                 call_args = (param_vals, buffer_vals, opt_state, lr, key,
                              arg_vals)
                 loss, new_p, new_b, new_s = self._compiled(*call_args)
-        if not hasattr(self, "_last_call_args"):
-            # captured once: avals never change after _build.  Only
-            # shape/dtype structs are kept (holding the arrays would pin
-            # a full batch + donated-state aliases in HBM)
+        # cheap signature over just the batch args: params/opt-state avals
+        # are fixed after _build, but a different batch shape retraces the
+        # jit silently and cost_analysis must report the live variant
+        arg_sig = tuple((tuple(v.shape), str(v.dtype))
+                        for v in jax.tree_util.tree_leaves(arg_vals)
+                        if hasattr(v, "shape"))
+        if getattr(self, "_last_arg_sig", None) != arg_sig:
+            self._last_arg_sig = arg_sig
+            # only shape/dtype structs are kept (holding the arrays would
+            # pin a full batch + donated-state aliases in HBM)
             self._last_call_args = jax.tree_util.tree_map(
                 lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
                 if hasattr(v, "shape") and hasattr(v, "dtype") else v,

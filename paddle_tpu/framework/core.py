@@ -477,6 +477,27 @@ def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
         for parent, g in zip(node.parents, in_grads):
             if g is None:
                 continue
+            from .selected_rows import SelectedRows
+            if isinstance(g, SelectedRows):
+                if parent._hooks:
+                    # hooks are written against dense Tensors; densify so
+                    # a rescaling/zeroing hook is never silently skipped
+                    # (costs the dense grad only when a hook opted in),
+                    # then fall through to the normal dense path below
+                    g = g.to_dense()
+                elif parent._node is None:
+                    _accum_leaf(parent, g)
+                    continue
+                else:
+                    # non-leaf consumer of a sparse grad: densify (the
+                    # reference's gradient_accumulator does the same when
+                    # a SelectedRows meets a dense sum)
+                    gd = g.to_dense()
+                    pbuf = cots.get(id(parent._node))
+                    if pbuf is not None:
+                        i = parent._out_idx
+                        pbuf[i] = gd if pbuf[i] is None else pbuf[i] + gd
+                    continue
             for h in parent._hooks:
                 out = h(Tensor(g))
                 if out is not None:
@@ -536,6 +557,12 @@ def _run_backward_tracked(t: Tensor, grad_tensor: Optional[Tensor]):
 
         for node in order:
             if node.primal_fn is None:
+                if node.vjp_fn is not None:
+                    raise RuntimeError(
+                        f"op {node.name!r} does not support "
+                        "create_graph=True (custom sparse backward, e.g. "
+                        "Embedding(sparse=True)); use the dense path for "
+                        "higher-order gradients")
                 raise RuntimeError(
                     f"create_graph=True but op {node.name!r} has no primal "
                     "recorded (its graph was already freed by a previous "
@@ -585,7 +612,25 @@ def _run_backward_tracked(t: Tensor, grad_tensor: Optional[Tensor]):
 
 
 def _accum_leaf(parent: Tensor, g, tracked: bool = False):
+    from .selected_rows import SelectedRows
     if parent.stop_gradient:
+        return
+    if isinstance(g, SelectedRows) or isinstance(parent.grad, SelectedRows):
+        # sparse accumulation (reference imperative/gradient_accumulator.cc
+        # SelectedRows sum rules): sparse+sparse stacks rows, mixed
+        # sparse/dense falls back to dense
+        if parent.grad is None:
+            parent.grad = g
+        elif isinstance(parent.grad, SelectedRows) and \
+                isinstance(g, SelectedRows):
+            parent.grad = parent.grad.concat(g)
+        else:
+            pg = (parent.grad.to_dense() if isinstance(parent.grad,
+                                                       SelectedRows)
+                  else parent.grad._value)
+            gv = g.to_dense() if isinstance(g, SelectedRows) else \
+                (g._value if isinstance(g, Tensor) else g)
+            parent.grad = Tensor(pg + gv)
         return
     if tracked:
         # keep the grad's own tape so it can be differentiated again

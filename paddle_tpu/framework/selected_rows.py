@@ -1,0 +1,72 @@
+"""SelectedRows — row-sparse gradient container.
+
+Parity: reference framework/selected_rows.h:41 (the tensor type NCCL-era
+Paddle uses for embedding gradients), sparse summation in
+imperative/gradient_accumulator.cc and math/selected_rows_functor.cc
+(MergeAdd), and the lazy-mode row updates of
+operators/optimizers/adam_op.h.
+
+Eager-only by design: inside a jitted program XLA fuses the dense
+scatter-add away, so the sparse container only pays off in the eager
+tape, where a dense gradient would materialize the full [vocab, dim]
+array per step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """``rows[i]`` indexes the first axis of the dense shape; ``values[i]``
+    is that row's gradient block.  Rows may repeat — ``merge()`` is the
+    canonicalizing sum."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = values
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+
+    # -- basic views ---------------------------------------------------
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return (f"SelectedRows(n_rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape})")
+
+    # -- algebra -------------------------------------------------------
+    def merge(self) -> "SelectedRows":
+        """Deduplicate row ids, summing duplicate blocks (MergeAdd)."""
+        rows, inv = jnp.unique(self.rows, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                   num_segments=int(rows.shape[0]))
+        return SelectedRows(rows, vals, self.dense_shape)
+
+    def to_dense(self):
+        """Materialize the full dense gradient (scatter-add)."""
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * s, self.dense_shape)
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        """Gradient accumulation: stack row lists (sum deferred to
+        merge(), the reference's sparse gradient_accumulator behavior)."""
+        if other.dense_shape != self.dense_shape:
+            raise ValueError(
+                f"cannot accumulate SelectedRows of shape "
+                f"{other.dense_shape} into {self.dense_shape}")
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_shape)

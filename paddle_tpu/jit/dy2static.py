@@ -275,9 +275,13 @@ def _name(n, ctx=None):
     return ast.Name(id=n, ctx=ctx or ast.Load())
 
 
+_JST_NAME = "__dy2st_jst__"  # injected into the fn's module globals
+
+
 def _jst_call(func: str, args: list, names=None):
     call = ast.Call(
-        func=ast.Attribute(value=_name("_jst"), attr=func, ctx=ast.Load()),
+        func=ast.Attribute(value=_name(_JST_NAME), attr=func,
+                           ctx=ast.Load()),
         args=args, keywords=[])
     if names is not None:
         call.keywords.append(ast.keyword(
@@ -479,6 +483,8 @@ def convert_func(fn: Callable) -> Callable:
 
 
 def _do_convert(f: Callable) -> Callable:
+    import types
+
     src = textwrap.dedent(inspect.getsource(f))
     tree = ast.parse(src)
     fdef = tree.body[0]
@@ -490,29 +496,43 @@ def _do_convert(f: Callable) -> Callable:
     if not tr.changed:
         return f
 
+    # compile inside a factory whose params mirror the original free
+    # variables, so the converted code object keeps them as freevars; the
+    # final function is then rebuilt with types.FunctionType over the
+    # fn's LIVE module globals (a snapshot would go stale when the module
+    # rebinds a global after first compile) and the original closure cells
     freevars = f.__code__.co_freevars
-    if freevars:
-        outer = ast.FunctionDef(
-            name="__dy2st_outer__",
-            args=ast.arguments(
-                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
-                kwonlyargs=[], kw_defaults=[], defaults=[]),
-            body=list(tree.body) + [ast.Return(_name(fdef.name))],
-            decorator_list=[], returns=None, type_params=[])
-        tree = ast.Module(body=[outer], type_ignores=[])
-    ast.fix_missing_locations(tree)
-    code = compile(tree, f"<dy2static:{f.__qualname__}>", "exec")
+    outer = ast.FunctionDef(
+        name="__dy2st_outer__",
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=list(tree.body) + [ast.Return(_name(fdef.name))],
+        decorator_list=[], returns=None, type_params=[])
+    mod = ast.Module(body=[outer], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    code = compile(mod, f"<dy2static:{f.__qualname__}>", "exec")
+    outer_code = next(c for c in code.co_consts
+                      if isinstance(c, types.CodeType)
+                      and c.co_name == "__dy2st_outer__")
+    fn_code = next(c for c in outer_code.co_consts
+                   if isinstance(c, types.CodeType)
+                   and c.co_name == fdef.name)
+
     import paddle_tpu.jit.dy2static as _jst_mod
-    glb = dict(getattr(f, "__globals__", {}))
-    glb["_jst"] = _jst_mod
-    exec(code, glb)
-    if freevars:
-        cells = [c.cell_contents for c in (f.__closure__ or ())]
-        new = glb["__dy2st_outer__"](*cells)
-    else:
-        new = glb[fdef.name]
-    new.__defaults__ = f.__defaults__
+    glb = getattr(f, "__globals__", None)
+    if glb is None:
+        return f
+    if glb.get(_JST_NAME, _jst_mod) is not _jst_mod:
+        return f  # user global with our name: don't clobber, don't convert
+    glb[_JST_NAME] = _jst_mod
+
+    cellmap = dict(zip(freevars, f.__closure__ or ()))
+    closure = tuple(cellmap[n] for n in fn_code.co_freevars)
+    new = types.FunctionType(fn_code, glb, f.__name__, f.__defaults__,
+                             closure or None)
     new.__kwdefaults__ = f.__kwdefaults__
     new.__dict__.update(getattr(f, "__dict__", {}))
+    new.__qualname__ = f.__qualname__
     new.__wrapped_dy2static__ = f
     return new

@@ -1,10 +1,22 @@
 """Gradient clipping (parity: python/paddle/fluid/clip.py —
-ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)."""
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm).
+
+SelectedRows gradients are clipped on their merged row blocks (the
+reference merges row-sparse grads before clipping too, fluid/clip.py
+merge_selected_rows)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from ..framework.selected_rows import SelectedRows
+
+
+def _merged(g):
+    """Canonical value for norm math: merged rows for sparse grads."""
+    if isinstance(g, SelectedRows):
+        return g.merge()
+    return g
 
 __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
 
@@ -31,8 +43,14 @@ class ClipGradByValue(ClipGradBase):
         for p, g in params_grads:
             if g is None:
                 out.append((p, g))
-                continue
-            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+            elif isinstance(g, SelectedRows):
+                sr = g.merge()
+                out.append((p, SelectedRows(
+                    sr.rows, jnp.clip(sr.values, self.min, self.max),
+                    sr.dense_shape)))
+            else:
+                out.append((p, Tensor(jnp.clip(g._value, self.min,
+                                               self.max))))
         return out
 
 
@@ -46,9 +64,12 @@ class ClipGradByNorm(ClipGradBase):
             if g is None:
                 out.append((p, g))
                 continue
-            n = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+            g = _merged(g)
+            gv = g.values if isinstance(g, SelectedRows) else g._value
+            n = jnp.sqrt(jnp.sum(jnp.square(gv)))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
-            out.append((p, Tensor(g._value * scale)))
+            out.append((p, g.scale(scale) if isinstance(g, SelectedRows)
+                        else Tensor(gv * scale)))
         return out
 
 
@@ -57,16 +78,20 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
-        sq = [jnp.sum(jnp.square(g._value)) for _, g in params_grads
-              if g is not None]
+        merged = [(p, _merged(g)) for p, g in params_grads]
+        sq = [jnp.sum(jnp.square(g.values if isinstance(g, SelectedRows)
+                                 else g._value))
+              for _, g in merged if g is not None]
         if not sq:
             return params_grads
         gnorm = jnp.sqrt(sum(sq))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
         out = []
-        for p, g in params_grads:
+        for p, g in merged:
             if g is None:
                 out.append((p, g))
+            elif isinstance(g, SelectedRows):
+                out.append((p, g.scale(scale)))
             else:
                 out.append((p, Tensor(g._value * scale)))
         return out

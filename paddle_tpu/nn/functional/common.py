@@ -86,8 +86,21 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: operators/lookup_table_v2_op.* — here a gather the TPU
-    executes natively; `sparse` grads become dense (XLA scatter-add)."""
+    executes natively.  With ``sparse=True`` the eager backward emits a
+    row-sparse ``SelectedRows`` gradient (ids + touched rows) instead of
+    a dense [vocab, dim] scatter-add — the reference's is_sparse path
+    (framework/selected_rows.h:41).  Inside jit the dense path is used
+    (XLA fuses the scatter; sparse only pays off on the eager tape)."""
+    import jax as _jax
+
+    from ...framework.core import is_grad_enabled
     idx = x._value.astype(jnp.int32) if isinstance(x, Tensor) else jnp.asarray(x, jnp.int32)
+
+    if (sparse and isinstance(weight, Tensor) and not weight.stop_gradient
+            and is_grad_enabled()
+            and not isinstance(weight._value, _jax.core.Tracer)
+            and not isinstance(idx, _jax.core.Tracer)):
+        return _sparse_embedding(idx, weight, padding_idx)
 
     def f(w):
         out = jnp.take(w, idx, axis=0)
@@ -96,6 +109,33 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
     return _apply(f, weight, op_name="embedding")
+
+
+def _sparse_embedding(idx, weight, padding_idx):
+    """Gather forward + custom GradNode producing SelectedRows for the
+    weight (no dense vocab-sized gradient is ever materialized)."""
+    from ...framework.core import GradNode
+    from ...framework.selected_rows import SelectedRows
+
+    wv = weight._value
+    out = jnp.take(wv, idx, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((idx == padding_idx)[..., None],
+                        jnp.zeros((), out.dtype), out)
+    flat_ids = idx.reshape(-1)
+
+    def vjp_fn(cot):
+        vals = cot.reshape(-1, cot.shape[-1])
+        if padding_idx is not None:
+            vals = jnp.where((flat_ids == padding_idx)[:, None],
+                             jnp.zeros((), vals.dtype), vals)
+        return (SelectedRows(flat_ids, vals, wv.shape),)
+
+    node = GradNode(vjp_fn, [weight], [(out.shape, out.dtype)],
+                    name="embedding_sparse")
+    t = Tensor(out, stop_gradient=False)
+    t._node = node
+    return t
 
 
 def one_hot(x, num_classes, name=None):

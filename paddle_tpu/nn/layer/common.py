@@ -58,9 +58,10 @@ class Linear(Layer):
 
 class Embedding(Layer):
     """Parity: nn/layer/common.py Embedding (reference kernel
-    lookup_table_v2). ``sparse`` is accepted for API parity; gradients are
-    dense scatter-adds (XLA) — the PS sparse path lives in
-    distributed/fleet/ps instead."""
+    lookup_table_v2). ``sparse=True`` makes eager backward produce
+    row-sparse SelectedRows gradients with lazy optimizer row updates
+    (reference is_sparse + adam lazy_mode); huge-vocab PS offload lives
+    in distributed/fleet/ps."""
 
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):
@@ -68,11 +69,13 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = sparse
         w_init = _resolve_init(weight_attr, Normal(0.0, 1.0))
         self.weight = Parameter(w_init((num_embeddings, embedding_dim)))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

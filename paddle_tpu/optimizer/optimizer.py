@@ -40,6 +40,10 @@ class Optimizer:
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._global_step = 0
         self._jit_update = jax.jit(self._update)
+        # NOT jitted: rows/vals shapes track the batch's unique-id count,
+        # which changes almost every step — jit would retrace per count.
+        # The row-sliced update is a handful of small eager ops.
+        self._sparse_update = self._sparse_apply
 
     # ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -63,6 +67,23 @@ class Optimizer:
         """Pure update rule (override): returns (new_p, new_state)."""
         raise NotImplementedError
 
+    def _sparse_apply(self, pv, rows, vals, lr, state):
+        """Lazy row-wise update for a SelectedRows gradient: run the
+        optimizer's own dense ``_update`` rule on the touched rows only
+        (reference: operators/optimizers/adam_op.h lazy_mode — untouched
+        rows keep their momenta/params; scalar state such as beta powers
+        advances globally, matching the reference's per-step beta_pow
+        ops)."""
+        sub = {k: (v[rows] if getattr(v, "shape", None) == pv.shape else v)
+               for k, v in state.items()}
+        new_rows, new_sub = self._update(pv[rows], vals, lr, sub)
+        new_p = pv.at[rows].set(new_rows.astype(pv.dtype))
+        new_state = {
+            k: (state[k].at[rows].set(v) if getattr(
+                state[k], "shape", None) == pv.shape else v)
+            for k, v in new_sub.items()}
+        return new_p, new_state
+
     # ------------------------------------------------------------------
     @no_grad()
     def step(self):
@@ -71,15 +92,26 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = jnp.asarray(self.get_lr(), jnp.float32)
+        from ..framework.selected_rows import SelectedRows
         for p, g in params_grads:
-            gv = g._value if isinstance(g, Tensor) else g
-            if self._weight_decay is not None:
-                gv = self._weight_decay.apply_gradient(p._value, gv)
             sid = id(p)
             if sid not in self._accumulators:
                 self._accumulators[sid] = self._create_state(p)
-            new_p, new_state = self._jit_update(p._value, gv, lr,
-                                               self._accumulators[sid])
+            if isinstance(g, SelectedRows):
+                sr = g.merge()
+                vals = sr.values
+                if self._weight_decay is not None:
+                    # lazy semantics: decay only the touched rows
+                    vals = self._weight_decay.apply_gradient(
+                        p._value[sr.rows], vals)
+                new_p, new_state = self._sparse_update(
+                    p._value, sr.rows, vals, lr, self._accumulators[sid])
+            else:
+                gv = g._value if isinstance(g, Tensor) else g
+                if self._weight_decay is not None:
+                    gv = self._weight_decay.apply_gradient(p._value, gv)
+                new_p, new_state = self._jit_update(
+                    p._value, gv, lr, self._accumulators[sid])
             p._value = new_p
             self._accumulators[sid] = new_state
         self._global_step += 1

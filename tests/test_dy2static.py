@@ -235,6 +235,36 @@ def test_one_sided_assignment_raises_under_trace():
         f(paddle.to_tensor(-np.ones(2, np.float32)))
 
 
+_SCALE = 2.0
+
+
+def test_converted_fn_reads_live_globals():
+    """The converted function runs over the fn's LIVE module globals (no
+    snapshot): rebinding a module global between eager calls must be
+    visible.  (Inside a jit trace a global is baked at trace time — same
+    as unconverted code; this covers the eager/conversion layer.)"""
+    global _SCALE
+    from paddle_tpu.jit.dy2static import convert_func
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * _SCALE
+        else:
+            y = x - _SCALE
+        return y
+
+    conv = convert_func(f)
+    assert conv is not f  # actually converted
+    arr = np.ones(2, np.float32)
+    _SCALE = 2.0
+    _allclose(conv(paddle.to_tensor(arr)), arr * 2)
+    _SCALE = 10.0
+    try:
+        _allclose(conv(paddle.to_tensor(arr)), arr * 10)
+    finally:
+        _SCALE = 2.0
+
+
 def test_undefined_sentinel_raises_on_use():
     from paddle_tpu.jit.dy2static import UNDEF
     with pytest.raises(NameError):

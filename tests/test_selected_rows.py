@@ -1,0 +1,224 @@
+"""Row-sparse embedding gradients (SelectedRows) tests.
+
+Parity targets: reference framework/selected_rows.h:41 (container),
+imperative/gradient_accumulator.cc (sparse sum), operators/optimizers/
+adam_op.h lazy_mode (row-wise updates), fluid/clip.py merge_selected_rows.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.selected_rows import SelectedRows
+
+
+def _np(v):
+    return np.asarray(v)
+
+
+def test_merge_and_to_dense():
+    import jax.numpy as jnp
+    sr = SelectedRows(jnp.asarray([2, 0, 2], jnp.int32),
+                      jnp.asarray([[1., 1.], [2., 2.], [3., 3.]]),
+                      (4, 2))
+    m = sr.merge()
+    assert sorted(_np(m.rows).tolist()) == [0, 2]
+    dense = _np(sr.to_dense())
+    exp = np.zeros((4, 2), np.float32)
+    exp[2] = [4, 4]
+    exp[0] = [2, 2]
+    np.testing.assert_allclose(dense, exp)
+    np.testing.assert_allclose(_np(m.to_dense()), exp)
+
+
+def test_embedding_sparse_grad_structure():
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 5, 5], [7, 1, 9]], np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.values.shape == (6, 8)      # batch*seq rows, not vocab
+    assert g.dense_shape == (100, 8)
+    # matches the dense gradient exactly
+    emb2 = nn.Embedding(100, 8, sparse=False)
+    emb2.weight._value = emb.weight._value
+    out2 = emb2(ids)
+    out2.sum().backward()
+    np.testing.assert_allclose(_np(g.to_dense()),
+                               _np(emb2.weight.grad._value), rtol=1e-6)
+
+
+def test_padding_idx_rows_get_zero_grad():
+    paddle.seed(0)
+    emb = nn.Embedding(50, 4, padding_idx=0, sparse=True)
+    ids = paddle.to_tensor(np.array([0, 3, 0, 7], np.int64))
+    emb(ids).sum().backward()
+    dense = _np(emb.weight.grad.to_dense())
+    np.testing.assert_allclose(dense[0], 0.0)
+    assert np.abs(dense[3]).sum() > 0
+
+
+def test_accumulation_two_backwards():
+    paddle.seed(0)
+    emb = nn.Embedding(20, 4, sparse=True)
+    ids1 = paddle.to_tensor(np.array([1, 2], np.int64))
+    ids2 = paddle.to_tensor(np.array([2, 3], np.int64))
+    emb(ids1).sum().backward()
+    emb(ids2).sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    dense = _np(g.to_dense())
+    exp = np.zeros((20, 4), np.float32)
+    for i in (1, 2, 2, 3):
+        exp[i] += 1
+    np.testing.assert_allclose(dense, exp, rtol=1e-6)
+
+
+def _train(sparse, opt_name, steps=4, clip=None):
+    paddle.seed(0)
+    emb = nn.Embedding(16, 4, sparse=sparse)
+    head = nn.Linear(4, 2)
+    params = list(emb.parameters()) + list(head.parameters())
+    kw = dict(learning_rate=0.1, parameters=params)
+    if clip is not None:
+        kw["grad_clip"] = clip
+    opt = getattr(paddle.optimizer, opt_name)(**kw)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 16, (8,)).astype(np.int64))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int64))
+    losses = []
+    for s in range(steps):
+        loss = F.cross_entropy(head(emb(ids)), y)
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return _np(emb.weight._value), losses
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Adam", "AdamW", "Momentum"])
+def test_sparse_matches_dense_when_all_rows_touched(opt_name):
+    """With every step's batch drawn over the whole vocab repeatedly,
+    lazy row updates coincide with dense updates on touched rows; over a
+    few steps trajectories must agree wherever rows were touched every
+    step — enforced here by a vocab small enough that updates dominate."""
+    w_sparse, l_sparse = _train(True, opt_name)
+    w_dense, l_dense = _train(False, opt_name)
+    np.testing.assert_allclose(l_sparse[0], l_dense[0], rtol=1e-5)
+    if opt_name == "SGD":  # SGD is stateless: exact row-for-row parity
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+    # training progressed in both modes
+    assert l_sparse[-1] < l_sparse[0]
+    assert l_dense[-1] < l_dense[0]
+
+
+def test_lazy_momentum_leaves_untouched_rows_alone():
+    """Step 1 touches row 1; step 2 touches only row 2.  Dense momentum
+    would keep moving row 1 in step 2 (velocity), lazy must not."""
+    paddle.seed(0)
+    emb = nn.Embedding(4, 3, sparse=True)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=emb.parameters())
+    ids1 = paddle.to_tensor(np.array([1], np.int64))
+    emb(ids1).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    row1_after_step1 = _np(emb.weight._value)[1].copy()
+    ids2 = paddle.to_tensor(np.array([2], np.int64))
+    emb(ids2).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    np.testing.assert_allclose(_np(emb.weight._value)[1], row1_after_step1)
+
+
+def test_global_norm_clip_mixed_sparse_dense():
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+    w_sparse, _ = _train(True, "SGD", clip=ClipGradByGlobalNorm(0.1))
+    w_dense, _ = _train(False, "SGD", clip=ClipGradByGlobalNorm(0.1))
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_big_vocab_memory_bounded():
+    """1M-row embedding: the gradient object stays O(batch*dim) — the
+    VERDICT acceptance test (no dense vocab-sized grad materialized)."""
+    paddle.seed(0)
+    emb = nn.Embedding(1_000_000, 8, sparse=True)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=emb.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, 1_000_000, (32,)).astype(np.int64))
+    target = paddle.to_tensor(rng.rand(32, 8).astype(np.float32))
+    losses = []
+    for _ in range(3):
+        loss = ((emb(ids) - target) ** 2).mean()
+        losses.append(float(loss))
+        loss.backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.values.shape == (32, 8)
+        assert int(np.prod(g.values.shape)) < 1000  # vs 8M dense elems
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_hook_sees_densified_sparse_grad():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    calls = []
+    emb.weight.register_hook(lambda g: calls.append(g) or None)
+    ids = paddle.to_tensor(np.array([1, 2], np.int64))
+    emb(ids).sum().backward()
+    assert len(calls) == 1          # hook ran (densified grad)
+    assert calls[0]._value.shape == (10, 4)
+    assert not isinstance(emb.weight.grad, SelectedRows)
+
+
+def test_sparse_create_graph_raises_clear_error():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    ids = paddle.to_tensor(np.array([1, 2], np.int64))
+    out = emb(ids).sum()
+    with pytest.raises(RuntimeError, match="does not support"):
+        paddle.grad(out, [emb.weight], create_graph=True)
+
+
+def test_grad_scaler_unscales_sparse():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    ids = paddle.to_tensor(np.array([1, 2], np.int64))
+    loss = emb(ids).sum()
+    scaler.scale(loss).backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    scaler.step(opt)  # unscale + apply must handle SelectedRows
+    # after unscale the effective grad was 1.0 per touched element
+    assert not np.isnan(_np(emb.weight._value)).any()
+
+
+def test_sparse_inside_jit_falls_back_to_dense():
+    """Under to_static/jit tracing the dense path is used (XLA fuses the
+    scatter); the program must still compile and train."""
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(32, 4, sparse=True)
+
+        def forward(self, ids):
+            return self.emb(ids).sum()
+
+    paddle.seed(0)
+    net = M()
+    fwd = paddle.jit.to_static(net)
+    ids = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+    out = fwd(ids)
+    out.backward()
+    g = net.emb.weight.grad
+    assert g is not None and not isinstance(g, SelectedRows)
