@@ -21,7 +21,8 @@ from .meta_parallel import (  # noqa: F401
     get_rng_state_tracker, mark_sharding, shard_parameter,
 )
 from .parallel import (  # noqa: F401
-    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+    DataParallel, ParallelEnv, get_rank, get_world_size, global_batch,
+    init_parallel_env,
 )
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
@@ -29,7 +30,7 @@ from .spawn import spawn  # noqa: F401
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
-    "DataParallel", "ReduceOp", "Group", "new_group", "get_group",
+    "DataParallel", "global_batch", "ReduceOp", "Group", "new_group", "get_group",
     "all_reduce", "all_gather", "reduce", "reduce_scatter", "broadcast",
     "scatter", "alltoall", "send", "recv", "barrier", "wait", "split",
     "init_mesh", "get_mesh", "set_mesh", "communication", "fleet", "spawn",

@@ -27,7 +27,7 @@ import numpy as np
 from . import mesh as mesh_mod
 
 __all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
-           "DataParallel"]
+           "DataParallel", "global_batch"]
 
 
 _initialized = False
@@ -113,6 +113,30 @@ def get_rank() -> int:
 
 def get_world_size() -> int:
     return jax.process_count()
+
+
+def global_batch(data, mesh=None):
+    """Assemble this process's batch shard into one GLOBAL array sharded
+    over the mesh's data axes — the multi-host SPMD input path.
+
+    The reference feeds each process its own graph + local batch (every
+    trainer runs an independent Program; reference
+    fleet/launch_utils.py per-process env); under single-controller SPMD
+    every process instead holds one shard of a global array, and jitted
+    steps consume the global view.  Single-process: equivalent to a
+    device_put onto the batch sharding.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..framework.core import Tensor
+    arr = data._value if isinstance(data, Tensor) else data
+    arr = np.asarray(arr)
+    m = mesh or mesh_mod.get_mesh()
+    # scalars replicate (no batch dim to shard); single-process is just
+    # the degenerate local==global case of the same assembly call
+    spec = P() if arr.ndim == 0 else mesh_mod.batch_spec(arr.ndim, m)
+    sharding = mesh_mod.named_sharding(spec, m)
+    return Tensor(jax.make_array_from_process_local_data(sharding, arr))
 
 
 class DataParallel:
