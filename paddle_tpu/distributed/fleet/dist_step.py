@@ -122,10 +122,18 @@ class DistributedTrainStep:
         self._gm_avg = gm["avg"]
         self._compiled = None
         self._accum = None  # gradient-merge accumulators
+        self._dgc_state = None  # DGC (u, v) accumulator pair
+        self._use_dgc = bool(self._strategy.dgc)
         self._step_i = np.int64(0)
         self._use_scaling = False  # set by _build for float16 AMP
         # (loss_scale, consecutive_finite_steps, consecutive_bad_steps)
         self._amp_state = None
+        if self._strategy.fp16_allreduce:
+            import warnings
+            warnings.warn(
+                "strategy.fp16_allreduce is a no-op on TPU: gradients "
+                "already ride ICI in the compute dtype (bf16 under AMP); "
+                "XLA owns the collective encoding", UserWarning)
 
     # sharding derivation ---------------------------------------------
     def _param_specs(self) -> Dict[str, P]:
@@ -206,6 +214,11 @@ class DistributedTrainStep:
                 "float16 loss scaling (dynamic or static) + gradient_merge "
                 "is not supported; use bfloat16 (TPU-native, no scaling "
                 "needed)")
+        if self._use_dgc and (use_scaling or k_steps > 1):
+            raise NotImplementedError(
+                "strategy.dgc cannot combine with float16 loss scaling or "
+                "gradient_merge (the reference treats DGC as its own meta "
+                "optimizer too)")
 
         def _amp_cast(tree):
             return jax.tree_util.tree_map(
@@ -311,6 +324,61 @@ class DistributedTrainStep:
                 return (slv / scale, new_p, nbufs, new_s,
                         (new_scale, good, bad))
             donate = (0, 1, 2, 3)
+        elif self._use_dgc:
+            # DGC (reference: fleet/meta_optimizers/dgc_optimizer.py +
+            # sparse_all_reduce_op_handle.cc).  Under SPMD the dp-sum is
+            # already fused into the backward by XLA, so compression acts
+            # on the global gradient: momentum-corrected top-k with error
+            # feedback (fleet/dgc.py).  Before rampup_begin_step the
+            # user's Momentum optimizer applies uncompressed grads; once
+            # compressing, momentum lives in DGC's u accumulator and the
+            # apply becomes plain SGD (reference dgc_momentum_op.h
+            # selects momentum-vs-sgd on rampup_begin_step).  The
+            # sparsity list ramps in-graph via lax.switch — one static
+            # top-k branch per stage.
+            from ...optimizer import SGD as _SGD, Momentum as _Momentum
+            from .dgc import dgc_compress
+            if not isinstance(opt, (_Momentum, _SGD)):
+                raise ValueError(
+                    "strategy.dgc requires a Momentum or SGD optimizer "
+                    "(parity: the reference's DGCMomentumOptimizer)")
+            dcfg = strategy.dgc_configs
+            dgc_m = float(dcfg.get("momentum", 0.9))
+            spars = dcfg.get("sparsity", [0.999])
+            spars = [float(s) for s in (spars if isinstance(
+                spars, (list, tuple)) else [spars])]
+            warm = int(dcfg.get("rampup_begin_step", 0))
+            ramp = max(int(dcfg.get("rampup_step", 1)), 1)
+            n_stage = len(spars)
+
+            def step(pvals, bufs, opt_state, dgc_state, i, lr, key, args):
+                loss, nbufs, grads = grads_of(pvals, bufs, key, args)
+
+                def warm_branch(op):
+                    st, g, pv, ost = op
+                    new_p, new_s = apply_opt(pv, g, ost, lr)
+                    return new_p, new_s, {"u": dict(st["u"]),
+                                          "v": dict(st["v"])}
+
+                def make_comp(sp):
+                    def comp(op):
+                        st, g, pv, ost = op
+                        new_st, g2 = dgc_compress(st, g, momentum=dgc_m,
+                                                  sparsity=sp)
+                        new_p = {
+                            n: pv[n] - lr.astype(pv[n].dtype)
+                            * g2[n].astype(pv[n].dtype) for n in pv}
+                        return new_p, [dict(s) for s in ost], new_st
+                    return comp
+
+                branches = [warm_branch] + [make_comp(s) for s in spars]
+                stage = jnp.clip((i - warm) * n_stage // ramp,
+                                 0, n_stage - 1)
+                sel = jnp.where(i < warm, 0, 1 + stage)
+                new_p, new_s, new_dgc = jax.lax.switch(
+                    sel, branches, (dgc_state, grads, pvals, opt_state))
+                return loss, new_p, nbufs, new_s, new_dgc
+            donate = (0, 1, 2, 3)
         elif k_steps <= 1:
             def step(pvals, bufs, opt_state, lr, key, args):
                 loss, nbufs, grads = grads_of(pvals, bufs, key, args)
@@ -352,6 +420,10 @@ class DistributedTrainStep:
         if use_scaling:
             in_specs += [(P(), P(), P()), P(), P(), bspec]  # amp_state,lr,key
             out_specs += [(P(), P(), P())]
+        elif self._use_dgc:
+            dspec = {"u": pspecs, "v": pspecs}  # (u,v) shard like params
+            in_specs += [dspec, P(), P(), P(), bspec]
+            out_specs += [dspec]
         elif k_steps > 1:
             gspecs = pspecs  # accumulators shard like their params
             in_specs += [gspecs, P(), P(), P(), bspec]
@@ -396,6 +468,12 @@ class DistributedTrainStep:
                     n: jnp.zeros_like(
                         v, device=NamedSharding(self._mesh, pspecs[n]))
                     for n, v in param_vals.items()}
+            if self._use_dgc and self._dgc_state is None:
+                self._dgc_state = {
+                    ax: {n: jnp.zeros_like(
+                        v, device=NamedSharding(self._mesh, pspecs[n]))
+                        for n, v in param_vals.items()}
+                    for ax in ("u", "v")}
         key = split_key()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with no_grad():
@@ -404,6 +482,13 @@ class DistributedTrainStep:
                              self._amp_state, lr, key, arg_vals)
                 (loss, new_p, new_b, new_s,
                  self._amp_state) = self._compiled(*call_args)
+            elif self._use_dgc:
+                call_args = (param_vals, buffer_vals, opt_state,
+                             self._dgc_state,
+                             jnp.asarray(self._step_i, jnp.int32), lr, key,
+                             arg_vals)
+                loss, new_p, new_b, new_s, self._dgc_state = self._compiled(
+                    *call_args)
             elif self._k_steps > 1:
                 call_args = (param_vals, buffer_vals, opt_state, self._accum,
                              jnp.asarray(self._step_i, jnp.int32), lr, key,

@@ -172,6 +172,18 @@ class DistributedOptimizer:
         self.user_defined_strategy = strategy
         self._fleet = fleet_obj
         self.inner_opt = self._maybe_swap(optimizer, strategy)
+        import warnings
+        if strategy.fp16_allreduce:
+            warnings.warn(
+                "strategy.fp16_allreduce is a no-op on TPU: gradients "
+                "already ride ICI in the compute dtype (bf16 under AMP); "
+                "XLA owns the collective encoding", UserWarning)
+        if strategy.dgc:
+            warnings.warn(
+                "strategy.dgc compresses gradients only through the "
+                "compiled step path (fleet.distributed_train_step / "
+                "DistributedTrainStep); a hand-written eager loop over "
+                "this optimizer is NOT compressed", UserWarning)
 
     @staticmethod
     def _maybe_swap(opt, strategy):

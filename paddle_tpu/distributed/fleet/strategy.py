@@ -17,8 +17,10 @@ sequence_parallel   'sp' mesh axis (Ulysses/ring attention)
 gradient_merge      in-graph k-step gradient accumulation
 localsgd            periodic parameter averaging over 'dp'
 lamb / lars         optimizer swap (large-batch rules)
-dgc / fp16_allreduce accepted for config parity; grads ride ICI in
-                    bf16/f32 — XLA owns the collective encoding
+dgc                 in-step top-k gradient compression with momentum
+                    correction + error feedback (dist_step + fleet/dgc.py)
+fp16_allreduce      no-op with a loud warning: grads already ride ICI in
+                    the compute dtype — XLA owns the collective encoding
 a_sync              parameter-server async modes (fleet/ps)
 ==================  ==================================================
 
@@ -58,6 +60,8 @@ _DEFAULT_CONFIGS = {
                                     tensor_parallel_seed=0),
     "sequence_parallel_configs": dict(sequence_parallel_degree=1,
                                       mode="ring"),  # "ring" | "ulysses"
+    "dgc_configs": dict(rampup_begin_step=0, rampup_step=1,
+                        sparsity=[0.999], momentum=0.9),
     "gradient_merge_configs": dict(k_steps=1, avg=True),
     "localsgd_configs": dict(k_steps=1, begin_step=1),
     "lamb_configs": dict(lamb_weight_decay=0.01, exclude_from_weight_decay=[]),

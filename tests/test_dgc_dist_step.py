@@ -1,0 +1,147 @@
+"""strategy.dgc wired into DistributedTrainStep (no silent toggles).
+
+Parity: reference fleet/meta_optimizers/dgc_optimizer.py +
+details/sparse_all_reduce_op_handle.cc — here the compression (momentum
+correction, top-k, error feedback, warmup) runs inside the compiled step
+on the XLA-summed global gradient.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
+
+
+def _run(strategy, steps=25, lr=0.2, seed=0):
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(6, 32), nn.Tanh(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Momentum(learning_rate=lr, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return F.cross_entropy(model(x), y).mean()
+
+    step = DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh)
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(16, 6).astype(np.float32)
+    y_np = (x_np.sum(axis=1) > 0).astype(np.int64)
+    x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+    losses = [float(step(x, y)) for _ in range(steps)]
+    mesh_mod.set_mesh(None)
+    return losses
+
+
+def test_dgc_trains_close_to_dense():
+    dense = _run(fleet.DistributedStrategy())
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.75],
+                     "momentum": 0.9}
+    dgc = _run(s)
+    assert dgc[-1] < 0.5 * dgc[0]          # converges
+    assert dgc[-1] < dense[0]              # and beats the dense start
+    # error feedback keeps compressed training near the dense trajectory
+    assert abs(dgc[-1] - dense[-1]) < 0.25
+
+
+def test_dgc_warmup_matches_dense_exactly():
+    """Before rampup_begin_step no compression: identical losses."""
+    dense = _run(fleet.DistributedStrategy(), steps=5)
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 100, "sparsity": [0.999]}
+    dgc = _run(s, steps=5)
+    np.testing.assert_allclose(dense, dgc, rtol=1e-6)
+
+
+def test_dgc_post_warmup_uses_sgd_apply():
+    """Once compressing, momentum lives in DGC's u accumulator and the
+    optimizer's own velocity must stay zero (reference dgc_momentum_op.h
+    switches momentum→sgd at rampup_begin_step) — no double momentum."""
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+
+    def loss_fn(x, y):
+        return F.cross_entropy(model(x), y).mean()
+
+    step = DistributedTrainStep(model, loss_fn, opt, s, mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int64))
+    for _ in range(3):
+        step(x, y)
+    for st in opt.opt_state():
+        for k, v in st.items():
+            if k == "velocity":
+                assert float(np.abs(np.asarray(v)).sum()) == 0.0
+    mesh_mod.set_mesh(None)
+
+
+def test_dgc_multi_stage_ramp_trains():
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 2, "rampup_step": 10,
+                     "sparsity": [0.5, 0.75, 0.9], "momentum": 0.9}
+    losses = _run(s, steps=30)
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_dgc_requires_momentum_or_sgd():
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    step = DistributedTrainStep(
+        model, lambda x, y: F.cross_entropy(model(x), y).mean(),
+        opt, s, mesh=mesh)
+    x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4,), np.int64))
+    with pytest.raises(ValueError, match="Momentum or SGD"):
+        step(x, y)
+    mesh_mod.set_mesh(None)
+
+
+def test_distributed_optimizer_warns_dgc_and_fp16():
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.fp16_allreduce = True
+    paddle.seed(0)
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+    fleet.init(is_collective=True, strategy=s)
+    with pytest.warns(UserWarning):
+        fleet.distributed_optimizer(opt, s)
+
+
+def test_dgc_incompatible_combos_raise():
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    with pytest.raises(NotImplementedError):
+        _run(s, steps=1)
+
+
+def test_fp16_allreduce_warns_loudly():
+    s = fleet.DistributedStrategy()
+    s.fp16_allreduce = True
+    with pytest.warns(UserWarning, match="no-op"):
+        _run(s, steps=1)
