@@ -28,7 +28,17 @@ from typing import Any, Callable, Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["DGCState", "dgc_init", "dgc_compress", "rampup_sparsity"]
+__all__ = ["DGCState", "dgc_init", "dgc_compress", "rampup_sparsity",
+           "rampup_stage_index"]
+
+
+def rampup_stage_index(step, rampup_begin_step, rampup_step, n_stage):
+    """Index into the sparsity list for ``step`` — the ONE definition of
+    the ramp schedule, shared by the host-side :func:`rampup_sparsity`
+    and the in-graph lax.switch selector in DistributedTrainStep (works
+    on Python ints and traced arrays alike; caller clamps to
+    ``[0, n_stage-1]``)."""
+    return ((step - rampup_begin_step) * n_stage) // max(int(rampup_step), 1)
 
 
 def dgc_init(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -55,7 +65,8 @@ def rampup_sparsity(step: int, rampup_begin_step: int = 0,
     step = int(step)
     if step < rampup_begin_step:
         return 0.0
-    idx = ((step - rampup_begin_step) * len(sparsity)) // max(rampup_step, 1)
+    idx = rampup_stage_index(step, rampup_begin_step, rampup_step,
+                             len(sparsity))
     return float(sparsity[min(max(idx, 0), len(sparsity) - 1)])
 
 

@@ -128,12 +128,8 @@ class DistributedTrainStep:
         self._use_scaling = False  # set by _build for float16 AMP
         # (loss_scale, consecutive_finite_steps, consecutive_bad_steps)
         self._amp_state = None
-        if self._strategy.fp16_allreduce:
-            import warnings
-            warnings.warn(
-                "strategy.fp16_allreduce is a no-op on TPU: gradients "
-                "already ride ICI in the compute dtype (bf16 under AMP); "
-                "XLA owns the collective encoding", UserWarning)
+        from .strategy import warn_noop_toggles
+        warn_noop_toggles(self._strategy)
 
     # sharding derivation ---------------------------------------------
     def _param_specs(self) -> Dict[str, P]:
@@ -337,18 +333,25 @@ class DistributedTrainStep:
             # sparsity list ramps in-graph via lax.switch — one static
             # top-k branch per stage.
             from ...optimizer import SGD as _SGD, Momentum as _Momentum
-            from .dgc import dgc_compress
+            from .dgc import dgc_compress, rampup_stage_index
             if not isinstance(opt, (_Momentum, _SGD)):
                 raise ValueError(
                     "strategy.dgc requires a Momentum or SGD optimizer "
                     "(parity: the reference's DGCMomentumOptimizer)")
+            if getattr(opt, "_nesterov", False):
+                raise NotImplementedError(
+                    "strategy.dgc does not support use_nesterov=True "
+                    "(DGC's u-accumulator implements plain momentum)")
             dcfg = strategy.dgc_configs
-            dgc_m = float(dcfg.get("momentum", 0.9))
+            # DGC inherits the wrapped optimizer's momentum (reference:
+            # DGCMomentumOptimizer); the config key covers SGD users
+            dgc_m = float(getattr(opt, "_momentum",
+                                  dcfg.get("momentum", 0.9)))
             spars = dcfg.get("sparsity", [0.999])
             spars = [float(s) for s in (spars if isinstance(
                 spars, (list, tuple)) else [spars])]
             warm = int(dcfg.get("rampup_begin_step", 0))
-            ramp = max(int(dcfg.get("rampup_step", 1)), 1)
+            ramp = int(dcfg.get("rampup_step", 1))
             n_stage = len(spars)
 
             def step(pvals, bufs, opt_state, dgc_state, i, lr, key, args):
@@ -365,15 +368,27 @@ class DistributedTrainStep:
                         st, g, pv, ost = op
                         new_st, g2 = dgc_compress(st, g, momentum=dgc_m,
                                                   sparsity=sp)
-                        new_p = {
-                            n: pv[n] - lr.astype(pv[n].dtype)
-                            * g2[n].astype(pv[n].dtype) for n in pv}
+                        # sgd apply keeps the optimizer's grad_clip +
+                        # weight_decay exactly like functional_update
+                        # does on the warmup path — only the momentum
+                        # accumulation moves into DGC's u
+                        glist = [g2[n] for n in names]
+                        if opt._grad_clip is not None:
+                            glist = opt._grad_clip.apply_values(glist)
+                        new_p = {}
+                        for n, gv in zip(names, glist):
+                            if opt._weight_decay is not None:
+                                gv = opt._weight_decay.apply_gradient(
+                                    pv[n], gv)
+                            new_p[n] = (pv[n] - lr.astype(pv[n].dtype)
+                                        * gv.astype(pv[n].dtype))
                         return new_p, [dict(s) for s in ost], new_st
                     return comp
 
                 branches = [warm_branch] + [make_comp(s) for s in spars]
-                stage = jnp.clip((i - warm) * n_stage // ramp,
-                                 0, n_stage - 1)
+                stage = jnp.clip(
+                    rampup_stage_index(i, warm, ramp, n_stage),
+                    0, n_stage - 1)
                 sel = jnp.where(i < warm, 0, 1 + stage)
                 new_p, new_s, new_dgc = jax.lax.switch(
                     sel, branches, (dgc_state, grads, pvals, opt_state))

@@ -173,11 +173,8 @@ class DistributedOptimizer:
         self._fleet = fleet_obj
         self.inner_opt = self._maybe_swap(optimizer, strategy)
         import warnings
-        if strategy.fp16_allreduce:
-            warnings.warn(
-                "strategy.fp16_allreduce is a no-op on TPU: gradients "
-                "already ride ICI in the compute dtype (bf16 under AMP); "
-                "XLA owns the collective encoding", UserWarning)
+        from .strategy import warn_noop_toggles
+        warn_noop_toggles(strategy)
         if strategy.dgc:
             warnings.warn(
                 "strategy.dgc compresses gradients only through the "

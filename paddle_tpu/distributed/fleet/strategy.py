@@ -32,7 +32,23 @@ from __future__ import annotations
 import copy
 import json
 
-__all__ = ["DistributedStrategy"]
+__all__ = ["DistributedStrategy", "warn_noop_toggles"]
+
+
+def warn_noop_toggles(strategy):
+    """Warn ONCE per strategy object about accepted-but-inert toggles
+    (called from both fleet.distributed_optimizer and
+    DistributedTrainStep so neither path is silent, without double
+    warnings when a user goes through both)."""
+    if getattr(strategy, "_warned_noop", False):
+        return
+    object.__setattr__(strategy, "_warned_noop", True)
+    import warnings
+    if strategy.fp16_allreduce:
+        warnings.warn(
+            "strategy.fp16_allreduce is a no-op on TPU: gradients "
+            "already ride ICI in the compute dtype (bf16 under AMP); "
+            "XLA owns the collective encoding", UserWarning)
 
 _BOOL_TOGGLES = [
     "amp", "recompute", "sharding", "pipeline", "tensor_parallel",

@@ -30,7 +30,9 @@ from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_mod
 
-__all__ = ["gpipe_spmd", "pipeline_apply", "num_stages"]
+__all__ = ["gpipe_spmd", "pipeline_apply", "num_stages",
+           "one_f_one_b_spmd", "pipeline_train_1f1b", "schedule_ticks",
+           "ring_size"]
 
 
 def num_stages(mesh=None) -> int:
@@ -85,6 +87,171 @@ def gpipe_spmd(stage_fn: Callable, local_params: Any, payload_mb,
     return _tmap(
         lambda o: lax.psum(jnp.where(s == S - 1, o, jnp.zeros_like(o)),
                            axis), outputs)
+
+
+def schedule_ticks(num_microbatches: int, num_stages: int,
+                   schedule: str = "1F1B") -> int:
+    """Combined forward+backward SCHEDULE-SLOT count of a schedule.
+
+    GPipe (fwd wavefront + autodiff reverse wavefront) runs
+    ``2*(M + S - 1)`` slots each doing one stage pass; 1F1B interleaves
+    the backward of microbatch m right behind its forward, finishing in
+    ``M + 2*(S - 1)`` slots.  NOTE on units: a 1F1B slot in
+    :func:`one_f_one_b_spmd` performs a forward AND a recompute+backward
+    (~3x a GPipe slot's compute), so the delivered win here is the O(S)
+    activation stash (:func:`ring_size`) and the interleaving itself —
+    not a wall-clock claim from slot counts alone.  (Reference
+    comparison point: SectionWorker's sequential microbatch streams,
+    framework/device_worker.h:641.)"""
+    M, S = int(num_microbatches), int(num_stages)
+    if schedule.upper() == "1F1B":
+        return M + 2 * (S - 1)
+    return 2 * (M + S - 1)
+
+
+def ring_size(num_microbatches: int, num_stages: int) -> int:
+    """Activation-stash bound of 1F1B: a microbatch's input is held for
+    at most ``2*(S-1-s)`` ticks at stage ``s``, so ``min(M, 2S-1)`` ring
+    slots suffice — the O(S) (not O(M)) peak memory that motivates 1F1B."""
+    return min(int(num_microbatches), 2 * int(num_stages) - 1)
+
+
+def one_f_one_b_spmd(stage_fn: Callable, local_params: Any, payload_mb,
+                     cot_fn: Callable, *, num_stages: int, axis: str = "pp"):
+    """1F1B pipeline — forward AND backward interleaved in ONE scan.
+
+    Call INSIDE a shard_map manual over ``axis``.  Unlike
+    :func:`gpipe_spmd` (whose backward is jax.grad of the forward scan —
+    a full second wavefront holding per-tick residuals), the loss is
+    computed in-pipeline: ``cot_fn(h_out, m) -> (loss_m, dh)`` runs on
+    the LAST stage the moment microbatch ``m``'s forward finishes, and
+    the cotangent immediately chases the activations backwards through a
+    reverse ``ppermute``.  Stage inputs are stashed in a
+    ``ring_size(M, S)``-slot ring and the stage vjp is recomputed at
+    backward time (activation checkpointing), so peak stash is O(S).
+
+    Returns ``(loss_sum, dparams, dpayload_mb)``: the summed microbatch
+    losses (replicated), this stage's parameter cotangents, and the
+    payload cotangents (replicated).  ``cot_fn`` defines the objective's
+    scaling (return d(total)/d(h_m)).
+    """
+    S = num_stages
+    s = lax.axis_index(axis)
+    leaves = jax.tree_util.tree_leaves(payload_mb)
+    M = leaves[0].shape[0]
+    R = ring_size(M, S)
+    T = schedule_ticks(M, S, "1F1B")
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        fwd_state, cot_state, ring, dparams, dpayload, loss_acc = carry
+        # ---- forward half: stage s runs microbatch m_f = t - s
+        m_f = t - s
+        f_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        mf = jnp.clip(m_f, 0, M - 1)
+        inp = _tmap(lambda x, st: jnp.where(s == 0, x[mf], st),
+                    payload_mb, fwd_state)
+        slot_f = mf % R
+        ring = _tmap(
+            lambda rb, v: rb.at[slot_f].set(
+                jnp.where(f_valid, v, rb[slot_f])), ring, inp)
+        out = stage_fn(local_params, inp)
+        # last stage: loss + output cotangent for this microbatch, used
+        # by the backward half of this very tick (m_b == m_f there)
+        loss_m, dh = cot_fn(out, mf)
+        at_last = jnp.logical_and(s == S - 1, f_valid)
+        loss_acc = loss_acc + jnp.where(at_last, loss_m, 0.0)
+        # ---- backward half: stage s runs microbatch m_b
+        m_b = t - 2 * (S - 1) + s
+        b_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        mb = jnp.clip(m_b, 0, M - 1)
+        saved = _tmap(lambda rb: rb[mb % R], ring)
+        cot_in = _tmap(lambda d, c: jnp.where(s == S - 1, d, c),
+                       dh, cot_state)
+        _, vjp = jax.vjp(stage_fn, local_params, saved)
+        dp, dx = vjp(cot_in)
+        dparams = _tmap(
+            lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            dparams, dp)
+        dpayload = _tmap(
+            lambda buf, g: buf.at[mb].set(jnp.where(
+                jnp.logical_and(b_valid, s == 0), g, buf[mb])),
+            dpayload, dx)
+        fwd_state = _tmap(lambda o: lax.ppermute(o, axis, perm_fwd), out)
+        cot_state = _tmap(lambda d: lax.ppermute(d, axis, perm_bwd), dx)
+        return (fwd_state, cot_state, ring, dparams, dpayload,
+                loss_acc), None
+
+    zero_like_mb = _tmap(lambda x: jnp.zeros_like(x[0]), payload_mb)
+    ring0 = _tmap(
+        lambda x: jnp.zeros((R,) + tuple(x.shape[1:]), x.dtype), payload_mb)
+    carry0 = (zero_like_mb,                       # incoming activation
+              zero_like_mb,                       # incoming cotangent
+              ring0,                              # stashed stage inputs
+              _tmap(jnp.zeros_like, local_params),
+              _tmap(jnp.zeros_like, payload_mb),  # payload cotangents
+              jnp.zeros((), jnp.float32))
+    (_, _, _, dparams, dpayload, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+    # loss lives on the last stage, dpayload on stage 0: psum replicates
+    # (other ranks contributed zeros)
+    loss = lax.psum(loss_acc, axis)
+    dpayload = _tmap(lambda g: lax.psum(g, axis), dpayload)
+    return loss, dparams, dpayload
+
+
+def pipeline_train_1f1b(stage_fn: Callable, stacked_params: Any, hidden,
+                        labels, head_loss_fn: Callable, *,
+                        num_microbatches: int = 1, mesh=None):
+    """Loss + grads of a layer-stacked pipelined block under the 1F1B
+    schedule (reference schedule_mode="1F1B",
+    pipeline_configs; modern non-interleaved 1F1B ordering).
+
+    ``stage_fn(local_params, h) -> h`` is one stage over its layer chunk;
+    ``head_loss_fn(h, y) -> scalar`` is the (pp-replicated) head+loss on
+    one microbatch, averaged so that the mean over microbatches equals
+    the full-batch objective.  Returns ``(loss, dstacked, dhidden)`` —
+    numerically identical to GPipe (same math, different schedule).
+    """
+    mesh = mesh or mesh_mod.get_mesh()
+    S = num_stages(mesh)
+    M = int(num_microbatches)
+    if S <= 1:
+        def whole(params, h, y):
+            return head_loss_fn(stage_fn(params, h), y)
+        loss, (dp, dh) = jax.value_and_grad(whole, argnums=(0, 1))(
+            stacked_params, hidden, labels)
+        return loss, dp, dh
+    B = hidden.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+
+    def split(v):
+        return v.reshape((M, B // M) + tuple(v.shape[1:]))
+
+    x_mb, y_mb = split(hidden), split(labels)
+
+    def mapped(params, xm, ym):
+        def cot(h_out, m):
+            def obj(h):
+                return head_loss_fn(h, ym[m]) / M
+            lm, dh = jax.value_and_grad(obj)(h_out)
+            return lm, dh
+
+        return one_f_one_b_spmd(stage_fn, params, xm, cot, num_stages=S)
+
+    p_spec = _tmap(lambda v: P(*(("pp",) + (None,) * (v.ndim - 1))),
+                   stacked_params)
+    rep_x = _tmap(lambda v: P(), x_mb)
+    rep_y = _tmap(lambda v: P(), y_mb)
+    sm = jax.shard_map(mapped, mesh=mesh, axis_names={"pp"},
+                       in_specs=(p_spec, rep_x, rep_y),
+                       out_specs=(P(), p_spec, rep_x),
+                       check_vma=False)
+    loss, dstacked, dx_mb = jax.jit(sm)(stacked_params, x_mb, y_mb)
+    dhidden = dx_mb.reshape((B,) + tuple(dx_mb.shape[2:]))
+    return loss, dstacked, dhidden
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params: Any, hidden,

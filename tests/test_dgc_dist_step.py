@@ -131,6 +131,58 @@ def test_distributed_optimizer_warns_dgc_and_fp16():
         fleet.distributed_optimizer(opt, s)
 
 
+def test_dgc_keeps_clip_and_decay_when_compressing():
+    """The compressed (SGD-apply) branch must still run the optimizer's
+    grad_clip + weight_decay like the warmup branch does."""
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    w0 = np.asarray(model.weight._value).copy()
+    opt = paddle.optimizer.Momentum(
+        learning_rate=1.0, momentum=0.0, weight_decay=0.5,
+        grad_clip=ClipGradByGlobalNorm(1e-12),
+        parameters=model.parameters())
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.0]}
+
+    def loss_fn(x, y):
+        return F.cross_entropy(model(x), y).mean()
+
+    step = DistributedTrainStep(model, loss_fn, opt, s, mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int64))
+    step(x, y)
+    # clip crushes the data gradient to ~0; the visible update is pure
+    # weight decay: w1 ≈ w0 - lr * 0.5 * w0 = 0.5 * w0
+    w1 = np.asarray(model.weight._value)
+    np.testing.assert_allclose(w1, 0.5 * w0, rtol=1e-4, atol=1e-6)
+    mesh_mod.set_mesh(None)
+
+
+def test_dgc_nesterov_rejected():
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    use_nesterov=True,
+                                    parameters=model.parameters())
+    s = fleet.DistributedStrategy()
+    s.dgc = True
+    step = DistributedTrainStep(
+        model, lambda x, y: F.cross_entropy(model(x), y).mean(),
+        opt, s, mesh=mesh)
+    x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4,), np.int64))
+    with pytest.raises(NotImplementedError, match="nesterov"):
+        step(x, y)
+    mesh_mod.set_mesh(None)
+
+
 def test_dgc_incompatible_combos_raise():
     s = fleet.DistributedStrategy()
     s.dgc = True

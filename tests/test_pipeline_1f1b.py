@@ -1,0 +1,125 @@
+"""1F1B pipeline schedule tests.
+
+Parity: the reference only has GPipe-style streaming (SectionWorker,
+framework/device_worker.h:641); 1F1B is the standard fix for its bubble
+and memory profile.  Requirements (VERDICT r1 #8): both schedules run on
+the virtual-device mesh, numerics identical, and the tick/stash
+accounting shows the shrink.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import (
+    pipeline_apply, pipeline_train_1f1b, ring_size, schedule_ticks)
+
+
+def _stage_fn(local_params, h):
+    """Scan this stage's chunk of stacked tanh-linear layers."""
+    def body(carry, wb):
+        w, b = wb
+        return jnp.tanh(carry @ w + b), None
+
+    out, _ = jax.lax.scan(body, h, local_params)
+    return out
+
+
+def _head_loss(h, y):
+    return jnp.mean((h - y) ** 2)
+
+
+def _setup(L=4, d=8, B=8, seed=0):
+    rng = np.random.RandomState(seed)
+    stacked = (jnp.asarray(rng.randn(L, d, d).astype(np.float32)) * 0.3,
+               jnp.asarray(rng.randn(L, d).astype(np.float32)) * 0.1)
+    x = jnp.asarray(rng.randn(B, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(B, d).astype(np.float32))
+    return stacked, x, y
+
+
+def _reference_grads(stacked, x, y):
+    """Ground truth: no pipeline, plain autodiff over the stacked scan."""
+    def whole(params, h):
+        return _head_loss(_stage_fn(params, h), y)
+
+    loss, (dp, dx) = jax.value_and_grad(whole, argnums=(0, 1))(stacked, x)
+    return loss, dp, dx
+
+
+@pytest.mark.parametrize("pp,M", [(2, 4), (4, 8), (4, 2)])
+def test_1f1b_matches_ground_truth(pp, M):
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"pp": pp, "dp": -1})
+    stacked, x, y = _setup()
+    ref_loss, ref_dp, ref_dx = _reference_grads(stacked, x, y)
+    loss, dp, dx = pipeline_train_1f1b(
+        _stage_fn, stacked, x, y, _head_loss,
+        num_microbatches=M, mesh=mesh)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, r in zip(jax.tree_util.tree_leaves(dp),
+                    jax.tree_util.tree_leaves(ref_dp)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               rtol=2e-4, atol=1e-5)
+    mesh_mod.set_mesh(None)
+
+
+def test_1f1b_matches_gpipe_schedule():
+    """Same math, different schedule: GPipe forward + autodiff backward
+    must produce identical numbers to the interleaved 1F1B loop."""
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"pp": 4, "dp": -1})
+    stacked, x, y = _setup(seed=3)
+    M = 4
+
+    def gpipe_loss(params, h):
+        out = pipeline_apply(lambda p, hh, e: _stage_fn(p, hh), params, h,
+                             num_microbatches=M, mesh=mesh)
+        return _head_loss(out, y)
+
+    g_loss, (g_dp, g_dx) = jax.value_and_grad(
+        gpipe_loss, argnums=(0, 1))(stacked, x)
+    f_loss, f_dp, f_dx = pipeline_train_1f1b(
+        _stage_fn, stacked, x, y, _head_loss,
+        num_microbatches=M, mesh=mesh)
+    np.testing.assert_allclose(float(f_loss), float(g_loss), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(f_dp),
+                    jax.tree_util.tree_leaves(g_dp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f_dx), np.asarray(g_dx),
+                               rtol=2e-4, atol=1e-5)
+    mesh_mod.set_mesh(None)
+
+
+def test_single_stage_fallback():
+    mesh_mod.set_mesh(None)
+    mesh_mod.init_mesh({"dp": -1})  # no pp axis
+    stacked, x, y = _setup()
+    ref_loss, ref_dp, ref_dx = _reference_grads(stacked, x, y)
+    loss, dp, dx = pipeline_train_1f1b(_stage_fn, stacked, x, y,
+                                       _head_loss, num_microbatches=2)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               rtol=1e-5)
+    mesh_mod.set_mesh(None)
+
+
+def test_tick_accounting_bubble_shrink():
+    """1F1B finishes the combined fwd+bwd in fewer ticks than GPipe for
+    every M, S — and the gap grows with M (the bubble amortizes)."""
+    for S in (2, 4, 8):
+        for M in (S, 2 * S, 8 * S):
+            f1b = schedule_ticks(M, S, "1F1B")
+            gp = schedule_ticks(M, S, "gpipe")
+            assert f1b == M + 2 * (S - 1)
+            assert gp == 2 * (M + S - 1)
+            assert f1b < gp
+    # memory: the activation stash is O(S), not O(M)
+    assert ring_size(64, 4) == 7
+    assert ring_size(2, 4) == 2
+    assert ring_size(64, 8) == 15
