@@ -235,15 +235,56 @@ class PSRuntime:
                     t.load(os.path.join(dirname, f))
                     self._tables[name] = t
 
-    def run_server(self):
+    def run_server(self, expected_workers: Optional[int] = None):
         from .ps_service import PSServer
-        self._server = PSServer(self._tables)
+        kw = {}
+        cfg = getattr(self._strategy, "a_sync_configs", None)
+        if cfg:
+            kw = dict(heartbeat_timeout=cfg.get("heartbeat_timeout", 10.0),
+                      on_dead=cfg.get("on_dead", "evict"))
+        self._server = PSServer(self._tables,
+                                expected_workers=expected_workers, **kw)
         self._server.start()
 
-    def init_worker(self):
-        pass
+    def init_worker(self, endpoints=None, worker_id=None):
+        """Connect this trainer to the PS cluster (parity:
+        the_one_ps.py _init_worker — builds the communicator).
+
+        Picks the Communicator mode from the strategy (sync by default,
+        async when ``a_sync``, geo when ``geo_sgd_mode``) and starts
+        heartbeats at a third of the server's liveness timeout.
+        """
+        if endpoints is None:  # single-host in-process tables: no client
+            self._client = None
+            return None
+        from .ps_service import PSClient
+        cfg = dict(getattr(self._strategy, "a_sync_configs", None) or {})
+        mode = "sync"
+        if getattr(self._strategy, "a_sync", False):
+            mode = "geo" if cfg.get("geo_sgd_mode") else "async"
+        self._client = PSClient(
+            endpoints, mode=mode,
+            send_queue_size=cfg.get("send_queue_size", 16),
+            geo_k_steps=cfg.get("geo_sgd_need_push_nums", 100),
+            worker_id=worker_id,
+            heartbeat_interval=(cfg.get("heartbeat_timeout", 10.0) / 3.0
+                                if worker_id is not None else 0.0))
+        return self._client
+
+    def worker_barrier(self, timeout=None):
+        if getattr(self, "_client", None) is None:
+            return []
+        return self._client.worker_barrier(timeout=timeout)
+
+    def stop_worker(self):
+        cli = getattr(self, "_client", None)
+        if cli is not None:
+            cli.leave()
+            cli.close()
+            self._client = None
 
     def stop(self):
+        self.stop_worker()
         if self._server is not None:
             self._server.stop()
 

@@ -14,6 +14,15 @@ server, client with a background push thread implementing the async modes:
   async      same queue, no barrier coupling (AsyncCommunicator)
   geo        client trains on a local mirror, pushes step deltas every
              k steps (GeoCommunicator:495 delta-push semantics)
+
+Worker liveness (parity: operators/distributed/heart_beat_monitor.cc):
+clients register a worker id and a background thread beats every
+``heartbeat_interval``; the server's monitor thread marks a worker dead
+once its beat is older than ``heartbeat_timeout`` and wakes any blocked
+sync barriers.  ``worker_barrier`` is a true rendezvous across live
+workers — under ``on_dead="evict"`` it completes without dead workers
+(reporting who was evicted), under ``on_dead="fail"`` it raises on the
+surviving workers so the job stops instead of silently shrinking.
 """
 from __future__ import annotations
 
@@ -22,6 +31,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -55,11 +65,74 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
+class HeartBeatMonitor:
+    """Tracks trainer liveness on the server.
+
+    Reference: paddle/fluid/operators/distributed/heart_beat_monitor.cc —
+    a LonelyMonitor thread walks UnderMonitoredWorker timestamps and
+    declares workers lost after a timeout.  Here eviction additionally
+    wakes blocked sync barriers so they can re-evaluate membership.
+    """
+
+    def __init__(self, timeout: float = 10.0, interval: float = 0.5):
+        self.timeout = timeout
+        self._interval = interval
+        self.cond = threading.Condition()
+        self.registered: Dict[str, float] = {}   # worker id -> last beat
+        self.dead: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        with self.cond:
+            self.cond.notify_all()
+
+    def beat(self, worker: str):
+        with self.cond:
+            self.registered[worker] = time.monotonic()
+            if worker in self.dead:      # a lost worker came back
+                self.dead.discard(worker)
+            self.cond.notify_all()
+
+    def leave(self, worker: str):
+        """Graceful exit — stop counting this worker toward barriers."""
+        with self.cond:
+            self.registered.pop(worker, None)
+            self.dead.discard(worker)
+            self.cond.notify_all()
+
+    def live_workers(self) -> set:
+        with self.cond:
+            return set(self.registered) - self.dead
+
+    def _watch(self):
+        while not self._stop.wait(self._interval):
+            now = time.monotonic()
+            with self.cond:
+                newly_dead = [w for w, t in self.registered.items()
+                              if w not in self.dead
+                              and now - t > self.timeout]
+                if newly_dead:
+                    self.dead.update(newly_dead)
+                    self.cond.notify_all()
+
+
 class PSServer:
     """Serves SparseTable pull/push (parity: brpc_ps_server.cc)."""
 
     def __init__(self, tables: Dict[str, "SparseTable"],
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 heartbeat_timeout: float = 10.0,
+                 on_dead: str = "evict",
+                 expected_workers: Optional[int] = None):
+        if on_dead not in ("evict", "fail"):
+            raise ValueError(f"on_dead must be 'evict' or 'fail', "
+                             f"got {on_dead!r}")
         self._tables = tables
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -68,8 +141,19 @@ class PSServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads = []
+        self._on_dead = on_dead
+        self.monitor = HeartBeatMonitor(timeout=heartbeat_timeout)
+        # rendezvous state: barrier generation -> set of arrived workers
+        self._barrier_gen = 0
+        self._arrived: set = set()
+        self._barrier_results: Dict[int, dict] = {}
+        # launch-skew guard: the first barrier must not complete before
+        # expected_workers distinct workers have ever registered
+        self._expected = expected_workers
+        self._ever_registered: set = set()
 
     def start(self, block: bool = False):
+        self.monitor.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -97,6 +181,14 @@ class PSServer:
                 if msg is None:
                     break
                 op = msg["op"]
+                # any RPC that names its worker is proof of life, so a
+                # client doing only pull/push (no beat thread) stays live
+                w = msg.get("worker")
+                if w is not None and op not in ("register", "heartbeat",
+                                                "unregister"):
+                    self.monitor.beat(w)
+                    with self.monitor.cond:
+                        self._ever_registered.add(w)
                 if op == "pull":
                     t = self._tables[msg["table"]]
                     _send_msg(conn, {"vals": t.pull(msg["ids"])})
@@ -112,6 +204,18 @@ class PSServer:
                         _send_msg(conn, {"ok": True})
                 elif op == "barrier":
                     _send_msg(conn, {"ok": True})
+                elif op == "register" or op == "heartbeat":
+                    self.monitor.beat(msg["worker"])
+                    with self.monitor.cond:
+                        self._ever_registered.add(msg["worker"])
+                    if op == "register":
+                        _send_msg(conn, {"ok": True})
+                elif op == "unregister":
+                    self.monitor.leave(msg["worker"])
+                    _send_msg(conn, {"ok": True})
+                elif op == "worker_barrier":
+                    _send_msg(conn, self._worker_barrier(
+                        msg["worker"], msg.get("timeout")))
                 elif op == "stop":
                     _send_msg(conn, {"ok": True})
                     self._stop.set()
@@ -119,8 +223,78 @@ class PSServer:
         finally:
             conn.close()
 
+    def _worker_barrier(self, worker: str, timeout: Optional[float]):
+        """Block this connection thread until every live worker arrives.
+
+        Completion advances a generation counter; every waiter of that
+        generation returns the same result dict.  Dead workers (per the
+        monitor) are excluded from membership under ``on_dead="evict"``
+        and fail the whole barrier under ``on_dead="fail"``.
+        """
+        mon = self.monitor
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # a waiter can't heartbeat (its client blocks on this RPC), so it
+        # refreshes its own beat each wakeup; wake at least this often
+        poll = min(1.0, mon.timeout / 4)
+        with mon.cond:
+            # arriving at a barrier is itself proof of life
+            mon.registered[worker] = time.monotonic()
+            mon.dead.discard(worker)
+            self._ever_registered.add(worker)
+            gen = self._barrier_gen
+            self._arrived.add(worker)
+            mon.cond.notify_all()
+
+            def _complete(result):
+                # results are per-generation: a slow waiter from gen g
+                # must not read gen g+1's outcome
+                self._barrier_results[gen] = result
+                for g in list(self._barrier_results):
+                    if g < gen - 8:
+                        del self._barrier_results[g]
+                self._barrier_gen += 1
+                self._arrived = set()
+                mon.cond.notify_all()
+                return result
+
+            while True:
+                if self._barrier_gen != gen:
+                    return self._barrier_results.get(
+                        gen, {"ok": True, "evicted": []})
+                if mon.dead and self._on_dead == "fail":
+                    return _complete({
+                        "ok": False,
+                        "error": f"workers lost: {sorted(mon.dead)}",
+                        "evicted": sorted(mon.dead)})
+                live = set(mon.registered) - mon.dead
+                # launch skew: never complete before the full expected
+                # membership has shown up at least once (dead included —
+                # the monitor, not absence, decides who is gone)
+                roster_full = (self._expected is None
+                               or len(self._ever_registered) >= self._expected)
+                if roster_full and live and self._arrived >= live:
+                    result = _complete({"ok": True,
+                                        "evicted": sorted(mon.dead)})
+                    # purge the evicted: out of the job now, not to be
+                    # re-reported at every later barrier (a returning
+                    # worker re-registers via its next beat)
+                    for w in mon.dead:
+                        mon.registered.pop(w, None)
+                    mon.dead.clear()
+                    return result
+                mon.registered[worker] = time.monotonic()
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._arrived.discard(worker)
+                        return {"ok": False, "error": "barrier timeout"}
+                    mon.cond.wait(min(remaining, poll))
+                else:
+                    mon.cond.wait(poll)
+
     def stop(self):
         self._stop.set()
+        self.monitor.stop()
         try:
             self._sock.close()
         except OSError:
@@ -131,7 +305,8 @@ class PSClient:
     """Worker-side client (parity: brpc_ps_client.cc + Communicator modes)."""
 
     def __init__(self, endpoints, mode: str = "sync", send_queue_size=16,
-                 geo_k_steps: int = 100):
+                 geo_k_steps: int = 100, worker_id: Optional[str] = None,
+                 heartbeat_interval: float = 0.0):
         self._eps = [(h, int(p)) for h, p in
                      (e.rsplit(":", 1) for e in endpoints)]
         self._socks = []
@@ -144,9 +319,41 @@ class PSClient:
         self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
         self._stop = threading.Event()
         self._push_err: "Exception | None" = None
+        self.worker_id = worker_id
+        self._beat_stop = threading.Event()
+        self._beat_socks = []
+        if worker_id is not None:
+            for r in range(len(self._socks)):
+                self._rpc(r, {"op": "register", "worker": worker_id},
+                          reply=True)
+            if heartbeat_interval > 0:
+                # beats ride dedicated sockets: the data sockets' locks
+                # are held for the whole duration of a blocking
+                # worker_barrier, which would starve heartbeats to every
+                # other server and get this live worker evicted there
+                for h, p in self._eps:
+                    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                    s.connect((h, p))
+                    self._beat_socks.append(s)
+                self._beater = threading.Thread(
+                    target=self._beat, args=(heartbeat_interval,),
+                    daemon=True)
+                self._beater.start()
         if mode in ("async", "half_async"):
             self._drainer = threading.Thread(target=self._drain, daemon=True)
             self._drainer.start()
+
+    def _beat(self, interval: float):
+        while not self._beat_stop.wait(interval):
+            if self._stop.is_set():
+                return
+            for s in self._beat_socks:
+                try:
+                    _send_msg(s, {"op": "heartbeat",
+                                  "worker": self.worker_id})
+                except OSError:
+                    continue  # one dead server must not stop beats to
+                              # the healthy ones
 
     def _shard(self, ids: np.ndarray) -> np.ndarray:
         return np.asarray(ids) % len(self._socks)
@@ -214,6 +421,42 @@ class PSClient:
         for r in range(len(self._socks)):
             self._rpc(r, {"op": "barrier"}, reply=True)
 
+    def worker_barrier(self, timeout: Optional[float] = None):
+        """Rendezvous with every live worker (sync-mode step barrier).
+
+        Flushes this worker's async queue first so pushed grads are
+        visible to whoever runs after the barrier.  Returns the list of
+        workers evicted as dead; raises if the server reports failure
+        (``on_dead="fail"`` or timeout).
+        """
+        if self.worker_id is None:
+            raise RuntimeError("worker_barrier needs a client worker_id")
+        self.barrier()  # flush async queue + per-server round trip
+        rep = self._rpc(0, {"op": "worker_barrier", "worker": self.worker_id,
+                            "timeout": timeout}, reply=True)
+        if rep is None:
+            raise RuntimeError("worker_barrier failed: server connection "
+                               "closed while waiting")
+        if not rep.get("ok"):
+            raise RuntimeError(f"worker_barrier failed: {rep.get('error')}")
+        return rep.get("evicted", [])
+
+    def leave(self):
+        """Gracefully deregister so barriers stop counting this worker."""
+        if self.worker_id is None:
+            return
+        self._beat_stop.set()  # beats after unregister would re-register
+        beater = getattr(self, "_beater", None)
+        if beater is not None:
+            beater.join()  # an in-flight beat landing after the
+            # unregister would re-register the departed worker
+        for r in range(len(self._socks)):
+            try:
+                self._rpc(r, {"op": "unregister", "worker": self.worker_id},
+                          reply=True)
+            except OSError:
+                pass
+
     def stop_server(self):
         for r in range(len(self._socks)):
             try:
@@ -223,13 +466,18 @@ class PSClient:
 
     def close(self):
         self._stop.set()
-        for s in self._socks:
+        self._beat_stop.set()
+        for s in self._socks + self._beat_socks:
             try:
                 s.close()
             except OSError:
                 pass
 
     def _rpc(self, rank, msg, reply=False):
+        if self.worker_id is not None:
+            # every RPC names its worker: data traffic is proof of life,
+            # so pull/push-only clients (no beat thread) stay live
+            msg.setdefault("worker", self.worker_id)
         with self._lock[rank]:
             _send_msg(self._socks[rank], msg)
             if reply:

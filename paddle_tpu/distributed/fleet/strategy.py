@@ -88,7 +88,9 @@ _DEFAULT_CONFIGS = {
                            min_send_grad_num_before_recv=1, thread_pool_size=1,
                            send_wait_times=1, runtime_split_send_recv=False,
                            launch_barrier=True, geo_sgd_mode=False,
-                           geo_sgd_need_push_nums=100),
+                           geo_sgd_need_push_nums=100,
+                           # worker liveness (heart_beat_monitor.cc parity)
+                           heartbeat_timeout=10.0, on_dead="evict"),
     "hybrid_configs": dict(dp_degree=-1, mp_degree=1, pp_degree=1,
                            sharding_degree=1, sep_degree=1),
 }

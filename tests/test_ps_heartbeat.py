@@ -1,0 +1,187 @@
+"""Worker liveness: heartbeats, dead-worker eviction, sync barriers.
+
+Parity model: reference heart_beat_monitor.cc (UnderMonitoredWorker
+timestamps + LonelyMonitor eviction) and the Communicator sync-mode
+barrier that would otherwise hang forever on a dead trainer.
+"""
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import (
+    HeartBeatMonitor, PSClient, PSServer)
+
+
+def _server(on_dead="evict", timeout=0.6):
+    tables = {"emb": SparseTable(4, optimizer="sgd", lr=0.5)}
+    srv = PSServer(tables, host="127.0.0.1",
+                   heartbeat_timeout=timeout, on_dead=on_dead)
+    srv.monitor._interval = 0.05  # fast watcher for tests
+    srv.start()
+    return srv, [f"127.0.0.1:{srv.port}"]
+
+
+def test_monitor_marks_stale_worker_dead():
+    mon = HeartBeatMonitor(timeout=0.2, interval=0.05)
+    mon.start()
+    try:
+        mon.beat("w0")
+        mon.beat("w1")
+        assert mon.live_workers() == {"w0", "w1"}
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            mon.beat("w0")  # only w0 keeps beating
+            if mon.live_workers() == {"w0"}:
+                break
+            time.sleep(0.05)
+        assert mon.live_workers() == {"w0"}
+        # a lost worker that comes back is live again
+        mon.beat("w1")
+        assert mon.live_workers() == {"w0", "w1"}
+    finally:
+        mon.stop()
+
+
+def test_worker_barrier_rendezvous():
+    srv, eps = _server()
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.1)
+        order = []
+
+        def late():
+            time.sleep(0.3)
+            order.append("w1-enter")
+            c1.worker_barrier(timeout=5.0)
+
+        t = threading.Thread(target=late)
+        t.start()
+        evicted = c0.worker_barrier(timeout=5.0)  # blocks until w1 arrives
+        t.join()
+        assert evicted == []
+        assert order == ["w1-enter"]
+        # a second round works (generation advanced)
+        t2 = threading.Thread(target=lambda: c1.worker_barrier(timeout=5.0))
+        t2.start()
+        c0.worker_barrier(timeout=5.0)
+        t2.join()
+        c0.close(); c1.close()
+    finally:
+        srv.stop()
+
+
+def test_barrier_survives_killed_worker_evict_mode():
+    srv, eps = _server(on_dead="evict", timeout=0.4)
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        # w1 registers then dies abruptly: no unregister, no more beats
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.0)
+        c1.close()
+        evicted = c0.worker_barrier(timeout=10.0)
+        assert evicted == ["w1"]
+        # pushes from the survivor still apply normally
+        ids = np.arange(4, dtype=np.int64)
+        base = c0.pull("emb", ids).copy()
+        c0.push("emb", ids, np.ones((4, 4), np.float32))
+        np.testing.assert_allclose(c0.pull("emb", ids), base - 0.5,
+                                   rtol=1e-5)
+        c0.close()
+    finally:
+        srv.stop()
+
+
+def test_barrier_fails_loudly_on_dead_worker_fail_mode():
+    srv, eps = _server(on_dead="fail", timeout=0.4)
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.0)
+        c1.close()
+        try:
+            c0.worker_barrier(timeout=10.0)
+            raise AssertionError("expected RuntimeError on dead worker")
+        except RuntimeError as e:
+            assert "w1" in str(e)
+        c0.close()
+    finally:
+        srv.stop()
+
+
+def test_graceful_leave_is_not_an_eviction():
+    srv, eps = _server(on_dead="fail", timeout=5.0)
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.1)
+        c1.leave()   # early exit (e.g. finished its shard) — not a death
+        c1.close()
+        evicted = c0.worker_barrier(timeout=5.0)
+        assert evicted == []
+        c0.close()
+    finally:
+        srv.stop()
+
+
+def test_expected_workers_gates_early_barrier():
+    # launch skew: w0 reaches the first barrier before w1 has even
+    # registered — without an expected count it would pass alone
+    tables = {"emb": SparseTable(4)}
+    srv = PSServer(tables, host="127.0.0.1", heartbeat_timeout=5.0,
+                   expected_workers=2)
+    srv.monitor._interval = 0.05
+    srv.start()
+    eps = [f"127.0.0.1:{srv.port}"]
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        done = []
+
+        def late_joiner():
+            time.sleep(0.5)
+            c = PSClient(eps, worker_id="w1", heartbeat_interval=0.1)
+            c.worker_barrier(timeout=5.0)
+            done.append(c)
+
+        t = threading.Thread(target=late_joiner)
+        t.start()
+        t0 = time.monotonic()
+        c0.worker_barrier(timeout=5.0)
+        assert time.monotonic() - t0 > 0.3  # actually waited for w1
+        t.join()
+        done[0].close(); c0.close()
+    finally:
+        srv.stop()
+
+
+def test_pull_push_traffic_counts_as_liveness():
+    # a worker with no beat thread stays live through data RPCs alone
+    srv, eps = _server(on_dead="fail", timeout=0.5)
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.0)
+        ids = np.arange(4, dtype=np.int64)
+        for _ in range(15):  # 1.5s of data traffic > heartbeat timeout
+            c1.pull("emb", ids)
+            time.sleep(0.1)
+        assert srv.monitor.live_workers() == {"w0", "w1"}
+        c0.close(); c1.close()
+    finally:
+        srv.stop()
+
+
+def test_barrier_timeout_errors_instead_of_hanging():
+    # one worker never shows up but keeps beating: barrier cannot
+    # complete, the timeout turns a hang into an error
+    srv, eps = _server(on_dead="evict", timeout=30.0)
+    try:
+        c0 = PSClient(eps, worker_id="w0", heartbeat_interval=0.1)
+        c1 = PSClient(eps, worker_id="w1", heartbeat_interval=0.1)
+        t0 = time.monotonic()
+        try:
+            c0.worker_barrier(timeout=0.5)
+            raise AssertionError("expected timeout")
+        except RuntimeError as e:
+            assert "timeout" in str(e)
+        assert time.monotonic() - t0 < 5.0
+        c0.close(); c1.close()
+    finally:
+        srv.stop()
