@@ -117,17 +117,21 @@ class Fleet:
 
     # -- PS lifecycle (wired to the host embedding service, fleet/ps) --
     def init_worker(self):
-        if self._ps_runtime is not None:
-            # multi-host: connect to the server list from the launcher
-            # env (reference PADDLE_PSERVERS_IP_PORT_LIST contract);
-            # single-host in-process tables otherwise.  The id comes from
-            # PADDLE_TRAINER_ID, not jax.process_index(): PS-mode
-            # trainers never initialize jax.distributed, so the process
-            # index is 0 in every one of them.
-            eps = self._rm().server_endpoints() or None
-            tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-            wid = f"trainer-{tid}" if eps else None
-            self._ps_runtime.init_worker(endpoints=eps, worker_id=wid)
+        # multi-host: connect to the server list from the launcher
+        # env (reference PADDLE_PSERVERS_IP_PORT_LIST contract);
+        # single-host in-process tables otherwise.  The id comes from
+        # PADDLE_TRAINER_ID, not jax.process_index(): PS-mode
+        # trainers never initialize jax.distributed, so the process
+        # index is 0 in every one of them.
+        eps = self._rm().server_endpoints() or None
+        if self._ps_runtime is None:
+            # pure trainer process: init_server never ran here, but the
+            # client side still needs a runtime to hold the connection
+            from .ps import PSRuntime
+            self._ps_runtime = PSRuntime(self._strategy)
+        tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        wid = f"trainer-{tid}" if eps else None
+        self._ps_runtime.init_worker(endpoints=eps, worker_id=wid)
 
     def init_server(self, *args, **kwargs):
         from .ps import PSRuntime

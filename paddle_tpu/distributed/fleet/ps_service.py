@@ -94,10 +94,21 @@ class HeartBeatMonitor:
 
     def beat(self, worker: str):
         with self.cond:
+            is_new = (worker not in self.registered
+                      or worker in self.dead)
             self.registered[worker] = time.monotonic()
-            if worker in self.dead:      # a lost worker came back
-                self.dead.discard(worker)
-            self.cond.notify_all()
+            self.dead.discard(worker)
+            if is_new:   # registration / resurrection changes barrier
+                self.cond.notify_all()   # membership; a refresh doesn't
+
+    def touch(self, worker: str):
+        """Timestamp-only refresh for the data hot path: no notify (a
+        pull/push from a live worker never unblocks a barrier)."""
+        with self.cond:
+            if worker in self.registered and worker not in self.dead:
+                self.registered[worker] = time.monotonic()
+            else:
+                self.beat(worker)
 
     def leave(self, worker: str):
         """Graceful exit — stop counting this worker toward barriers."""
@@ -186,9 +197,10 @@ class PSServer:
                 w = msg.get("worker")
                 if w is not None and op not in ("register", "heartbeat",
                                                 "unregister"):
-                    self.monitor.beat(w)
-                    with self.monitor.cond:
-                        self._ever_registered.add(w)
+                    if w not in self._ever_registered:
+                        with self.monitor.cond:
+                            self._ever_registered.add(w)
+                    self.monitor.touch(w)
                 if op == "pull":
                     t = self._tables[msg["table"]]
                     _send_msg(conn, {"vals": t.pull(msg["ids"])})
@@ -334,11 +346,20 @@ class PSClient:
                 for h, p in self._eps:
                     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                     s.connect((h, p))
+                    # bound sendall: a frozen-but-connected server must
+                    # not wedge the beater once the send buffer fills
+                    s.settimeout(2.0)
                     self._beat_socks.append(s)
                 self._beater = threading.Thread(
                     target=self._beat, args=(heartbeat_interval,),
                     daemon=True)
                 self._beater.start()
+        # geo mode: deltas accumulate locally and flush to the servers'
+        # push_delta every k pushes (GeoCommunicator:495 — the trainer
+        # trains a local mirror; only step deltas travel)
+        self._geo_k = geo_k_steps
+        self._geo_acc: Dict[str, Dict[int, np.ndarray]] = {}
+        self._geo_pushes = 0
         if mode in ("async", "half_async"):
             self._drainer = threading.Thread(target=self._drain, daemon=True)
             self._drainer.start()
@@ -351,7 +372,7 @@ class PSClient:
                 try:
                     _send_msg(s, {"op": "heartbeat",
                                   "worker": self.worker_id})
-                except OSError:
+                except (OSError, socket.timeout):
                     continue  # one dead server must not stop beats to
                               # the healthy ones
 
@@ -380,10 +401,43 @@ class PSClient:
     def push(self, table: str, ids, grads):
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32)
+        if self._mode == "geo":
+            acc = self._geo_acc.setdefault(table, {})
+            for i, g in zip(ids.tolist(), grads):
+                if i in acc:
+                    acc[i] = acc[i] + g
+                else:
+                    acc[i] = g.copy()
+            self._geo_pushes += 1
+            if self._geo_pushes % self._geo_k == 0:
+                self.flush_deltas()
+            return
         if self._mode in ("async", "half_async"):
             self._q.put((table, ids, grads))
             return
         self._push_now(table, ids, grads, sync=True)
+
+    def flush_deltas(self):
+        """Send accumulated geo deltas to the servers (push_delta adds
+        them raw — no server-side optimizer)."""
+        for table, acc in self._geo_acc.items():
+            if not acc:
+                continue
+            ids = np.fromiter(acc.keys(), np.int64, len(acc))
+            deltas = np.stack([acc[i] for i in ids.tolist()])
+            if len(self._socks) == 1:
+                self._rpc(0, {"op": "push_delta", "table": table,
+                              "ids": ids, "deltas": deltas, "sync": True},
+                          reply=True)
+            else:
+                shard = self._shard(ids)
+                for r in range(len(self._socks)):
+                    m = shard == r
+                    if m.any():
+                        self._rpc(r, {"op": "push_delta", "table": table,
+                                      "ids": ids[m], "deltas": deltas[m],
+                                      "sync": True}, reply=True)
+            acc.clear()
 
     def _push_now(self, table, ids, grads, sync):
         if len(self._socks) == 1:
@@ -414,6 +468,8 @@ class PSClient:
         # flush the async queue (join waits for task_done, so in-flight
         # pushes count — q.empty() would race the drainer) then round-trip
         # every server
+        if self._mode == "geo":
+            self.flush_deltas()
         self._q.join()
         if self._push_err is not None:
             err, self._push_err = self._push_err, None
@@ -448,8 +504,10 @@ class PSClient:
         self._beat_stop.set()  # beats after unregister would re-register
         beater = getattr(self, "_beater", None)
         if beater is not None:
-            beater.join()  # an in-flight beat landing after the
-            # unregister would re-register the departed worker
+            # an in-flight beat landing after the unregister would
+            # re-register the departed worker; bounded so a wedged
+            # socket can't hang shutdown
+            beater.join(timeout=5.0)
         for r in range(len(self._socks)):
             try:
                 self._rpc(r, {"op": "unregister", "worker": self.worker_id},

@@ -15,6 +15,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import traceback
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -77,19 +78,79 @@ def _to_device(batch, places=None):
     return conv(batch)
 
 
+class _RemoteError:
+    """An exception raised in a worker process, shipped with its trace."""
+
+    def __init__(self, exc: BaseException):
+        self.type_name = type(exc).__name__
+        self.message = str(exc)
+        self.trace = traceback.format_exc()
+
+    def reraise(self):
+        raise RuntimeError(
+            f"DataLoader worker raised {self.type_name}: {self.message}\n"
+            f"worker traceback:\n{self.trace}")
+
+
+def _process_worker_loop(dataset, collate_fn, worker_init_fn, wid,
+                         num_workers, index_queue, result_queue):
+    """Runs in a forked child: pull index lists, push collated batches.
+
+    Parity: reference fluid/dataloader/worker.py _worker_loop (the
+    reference ships results through shared memory via core._convert_to_
+    tensor_list; here the mp.Queue pickles numpy batches, and the fork
+    start method means the dataset itself is never pickled).
+    """
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(wid)
+        except Exception as e:          # surface init failures per-batch
+            err = _RemoteError(e)
+            while True:
+                job = index_queue.get()
+                if job is None:
+                    return
+                result_queue.put((job[0], err))
+    while True:
+        job = index_queue.get()
+        if job is None:             # shutdown sentinel
+            return
+        batch_idx, idxs = job
+        try:
+            out = collate_fn([dataset[i] for i in idxs])
+        except Exception as e:
+            out = _RemoteError(e)
+        result_queue.put((batch_idx, out))
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process=False,
+                 mp_start_method="fork"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.worker_init_fn = worker_init_fn
         self.places = places
+        self.timeout = timeout
+        # use_process=True forks OS workers (the reference's default
+        # multi-process mode): needed when per-sample work is Python-
+        # bound (PIL-style transforms, per-element loops) and the GIL
+        # would serialize a thread pool. Threads remain the default for
+        # numpy-bound collate, which releases the GIL.
+        # Workers must stay off jax: fork from a process with a live
+        # backend is only safe because children touch numpy alone (the
+        # device transfer happens in the parent). Pass
+        # mp_start_method="spawn" for fully isolated workers — the
+        # dataset and collate_fn must then be picklable.
+        self.use_process = use_process
+        self.mp_start_method = mp_start_method
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -163,9 +224,92 @@ class DataLoader:
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
 
+    def _iter_batches_process(self):
+        """Forked worker processes with per-worker index queues, a shared
+        result queue, and an in-order reorder buffer (the reference's
+        _DataLoaderIterMultiProcess structure, dataloader_iter.py:469).
+
+        A worker that dies without replying (OOM-killed, segfault in a
+        C transform) is detected by polling liveness while waiting, so
+        the loader raises instead of hanging forever.
+        """
+        import multiprocessing as mp
+        ctx = mp.get_context(self.mp_start_method)
+
+        workers, index_queues = [], []
+        result_queue = ctx.Queue()
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(
+                target=_process_worker_loop,
+                args=(self.dataset, self.collate_fn, self.worker_init_fn,
+                      wid, self.num_workers, iq, result_queue),
+                daemon=True)
+            p.start()
+            workers.append(p)
+            index_queues.append(iq)
+
+        try:
+            it = enumerate(iter(self.batch_sampler))
+            send_idx = 0            # next batch number to dispatch
+            recv_idx = 0            # next batch number to yield
+            reorder: dict = {}
+
+            def dispatch():
+                nonlocal send_idx
+                job = next(it, None)
+                if job is None:
+                    return False
+                index_queues[send_idx % self.num_workers].put(job)
+                send_idx += 1
+                return True
+
+            for _ in range(self.num_workers * self.prefetch_factor):
+                if not dispatch():
+                    break
+            while recv_idx < send_idx:
+                while recv_idx not in reorder:
+                    try:
+                        idx, data = result_queue.get(
+                            timeout=self.timeout or 5.0)
+                    except queue.Empty:
+                        dead = [w.pid for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} exited "
+                                f"unexpectedly") from None
+                        if self.timeout:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for a batch")
+                        continue
+                    reorder[idx] = data
+                data = reorder.pop(recv_idx)
+                recv_idx += 1
+                dispatch()
+                if isinstance(data, _RemoteError):
+                    data.reraise()
+                yield data
+        finally:
+            for iq in index_queues:
+                try:
+                    iq.put(None)
+                except (OSError, ValueError):
+                    pass
+            for w in workers:
+                w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()
+            for q_ in index_queues + [result_queue]:
+                q_.cancel_join_thread()
+                q_.close()
+
     def __iter__(self):
-        gen = (self._iter_batches_workers() if self.num_workers > 0 and
-               not self._iterable_mode else self._iter_batches_sync())
+        if self.num_workers > 0 and not self._iterable_mode:
+            gen = (self._iter_batches_process() if self.use_process
+                   else self._iter_batches_workers())
+        else:
+            gen = self._iter_batches_sync()
 
         # prefetch-to-device pipeline (double buffering). The feeder checks
         # ``abandoned`` around every blocking put so an early `break` in the

@@ -168,6 +168,55 @@ def test_pull_push_traffic_counts_as_liveness():
         srv.stop()
 
 
+def test_geo_mode_accumulates_and_flushes_deltas():
+    # GeoCommunicator parity: deltas accumulate client-side and hit the
+    # server only every k pushes (and at barrier), via push_delta (raw
+    # add, no server optimizer)
+    srv, eps = _server()
+    try:
+        cli = PSClient(eps, mode="geo", geo_k_steps=3, worker_id="w0")
+        ids = np.arange(4, dtype=np.int64)
+        base = cli.pull("emb", ids).copy()
+        cli.push("emb", ids, np.full((4, 4), 0.5, np.float32))
+        cli.push("emb", ids, np.full((4, 4), 0.5, np.float32))
+        # 2 < k pushes: nothing on the server yet
+        np.testing.assert_allclose(cli.pull("emb", ids), base)
+        cli.push("emb", ids, np.full((4, 4), 0.5, np.float32))
+        # 3rd push flushed the accumulated 1.5 raw delta
+        np.testing.assert_allclose(cli.pull("emb", ids), base + 1.5,
+                                   rtol=1e-6)
+        cli.push("emb", ids, np.full((4, 4), 0.25, np.float32))
+        cli.barrier()   # barrier flushes the remainder
+        np.testing.assert_allclose(cli.pull("emb", ids), base + 1.75,
+                                   rtol=1e-6)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_fleet_pure_trainer_builds_client(monkeypatch):
+    # a trainer process never calls init_server; init_worker must still
+    # connect, register and rendezvous via the launcher env contract
+    from paddle_tpu.distributed.fleet.fleet_base import Fleet
+    from paddle_tpu.distributed.fleet.ps import SparseTable
+    srv = PSServer({"emb": SparseTable(4)}, host="127.0.0.1",
+                   heartbeat_timeout=5.0)
+    srv.monitor._interval = 0.05
+    srv.start()
+    try:
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                           f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        f = Fleet()
+        f.init(is_collective=False)
+        f.init_worker()
+        assert f._ps_runtime._client.worker_id == "trainer-3"
+        assert f._ps_runtime.worker_barrier(timeout=5.0) == []
+        f.stop_worker()
+    finally:
+        srv.stop()
+
+
 def test_barrier_timeout_errors_instead_of_hanging():
     # one worker never shows up but keeps beating: barrier cannot
     # complete, the timeout turns a hang into an error
