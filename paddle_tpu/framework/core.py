@@ -29,7 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes
+from . import flags
 from .place import CPUPlace, Place, TPUPlace, _default_place
+
+flags.define_flag("FLAGS_eager_vjp_cache", True,
+                  "cache jitted (out, vjp) pairs per op/shape/dtype to "
+                  "skip per-call jax.vjp re-tracing in eager mode")
 
 __all__ = [
     "Tensor", "to_tensor", "no_grad", "enable_grad", "set_grad_enabled",
@@ -347,6 +352,177 @@ def _apply(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
                        **kwargs)
 
 
+# ----------------------------------------------------------------------
+# eager vjp cache: skip per-call jax.vjp re-tracing for repeat dispatches
+# ----------------------------------------------------------------------
+
+_TRACE_FALLBACK_ERRORS = tuple(
+    e for e in (getattr(jax.errors, n, None) for n in
+                ("ConcretizationTypeError", "TracerArrayConversionError",
+                 "TracerBoolConversionError", "TracerIntegerConversionError",
+                 "UnexpectedTracerError"))
+    if e is not None)
+
+_SCALARS = (int, float, bool, str, bytes, type(None))
+_vjp_cache_lock = threading.Lock()
+_vjp_cache: "dict" = {}          # key -> jitted (out, vjp_fn) builder
+_vjp_poisoned: set = set()       # keys that failed to trace: stay eager
+_vjp_stats = {"hits": 0, "misses": 0, "uncacheable": 0}
+_VJP_CACHE_MAX = 4096
+
+
+class _Unhashable(Exception):
+    pass
+
+
+def _is_jax_array(v) -> bool:
+    return hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def _key_scalar(v):
+    if isinstance(v, _SCALARS):
+        # type-tagged: 1, 1.0 and True compare/hash equal in python but
+        # promote differently under jax weak typing — an int32 entry must
+        # never be replayed for a float operand
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        return tuple(_key_scalar(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _key_scalar(x)) for k, x in v.items()))
+    if callable(v):
+        # a closure in a key is unsafe: instances share a code object
+        # (collisions) and keying by identity would pin captured arrays
+        if getattr(v, "__closure__", None):
+            raise _Unhashable
+        code = getattr(v, "__code__", None)
+        if code is not None:
+            # cell-free python function: behavior is its code + defaults
+            return ("pyfn", code,
+                    tuple(_key_scalar(d)
+                          for d in (v.__defaults__ or ())))
+        # non-function callable (jnp ufunc, builtin, type): persistent
+        # singletons — the object itself (strong ref prevents id reuse)
+        return v
+    raise _Unhashable
+
+
+_amp_state_fn = None
+
+
+def _amp_key():
+    """Ambient autocast config: white-listed ops cast inputs INSIDE fn
+    via thread-local amp state (amp/__init__.py maybe_cast_inputs), so
+    the same fn+avals trace differently under auto_cast — the state must
+    key the cache or an fp32 entry gets replayed inside autocast."""
+    global _amp_state_fn
+    if _amp_state_fn is None:
+        from ..amp import amp_state as _f
+        _amp_state_fn = _f
+    st = _amp_state_fn()
+    if st is None:
+        return None
+    return (st.level, str(st.dtype), frozenset(st.custom_white),
+            frozenset(st.custom_black))
+
+
+def _vjp_cache_key(fn, vals, diff_pos, kwargs):
+    cells = tuple(_key_scalar(c.cell_contents)
+                  for c in (getattr(fn, "__closure__", None) or ()))
+    # defaults are binding sites too (`def gop(*a, _primal=primal)`
+    # patterns): two fns sharing a code object but bound to different
+    # defaults must never share a cache entry
+    dflt = tuple(_key_scalar(d)
+                 for d in (getattr(fn, "__defaults__", None) or ()))
+    kdflt = tuple(sorted(
+        (k, _key_scalar(d))
+        for k, d in (getattr(fn, "__kwdefaults__", None) or {}).items()))
+    fkey = (getattr(fn, "__code__", None) or fn, cells, dflt, kdflt)
+    akey = tuple(("a", v.shape, str(v.dtype)) if _is_jax_array(v)
+                 else ("s", _key_scalar(v)) for v in vals)
+    kkey = tuple(sorted((k, _key_scalar(v)) for k, v in kwargs.items()))
+    return (fkey, akey, kkey, diff_pos, _amp_key())
+
+
+def _vjp_cache_build(fn, vals, diff_pos, kwargs):
+    """Jit a callable (array_vals) -> out | (out, vjp_fn). ``vjp_fn`` is a
+    ``jax.tree_util.Partial`` — a pytree, so it round-trips through jit;
+    non-array operands are baked in as constants (they are part of the
+    cache key, so constant-folding them is exact)."""
+    n = len(vals)
+    arr_pos = tuple(i for i, v in enumerate(vals) if _is_jax_array(v))
+    statics = {i: v for i, v in enumerate(vals) if i not in set(arr_pos)}
+
+    def assemble(arr_vals):
+        v = [None] * n
+        for j, i in enumerate(arr_pos):
+            v[i] = arr_vals[j]
+        for i, s in statics.items():
+            v[i] = s
+        return v
+
+    if diff_pos:
+        def traced(arr_vals):
+            v = assemble(arr_vals)
+
+            def closed(*dv):
+                vv = list(v)
+                for p, d in zip(diff_pos, dv):
+                    vv[p] = d
+                return fn(*vv, **kwargs)
+            return jax.vjp(closed, *[v[p] for p in diff_pos])
+    else:
+        def traced(arr_vals):
+            return fn(*assemble(arr_vals), **kwargs)
+    return jax.jit(traced)
+
+
+def _vjp_cache_lookup(fn, vals, diff_pos, kwargs):
+    if not getattr(flags.FLAGS, "eager_vjp_cache", True):
+        return None
+    try:
+        key = _vjp_cache_key(fn, vals, diff_pos, kwargs)
+    except _Unhashable:
+        _vjp_stats["uncacheable"] += 1
+        return None
+    with _vjp_cache_lock:
+        if key in _vjp_poisoned:
+            return None
+        hit = _vjp_cache.get(key)
+        if hit is not None:
+            _vjp_stats["hits"] += 1
+            return hit
+        _vjp_stats["misses"] += 1
+        built = _vjp_cache_build(fn, vals, diff_pos, kwargs)
+        _vjp_cache[key] = built
+        if len(_vjp_cache) > _VJP_CACHE_MAX:   # bounded: drop ~oldest
+            _vjp_cache.pop(next(iter(_vjp_cache)))
+        return built
+
+
+def _vjp_cache_poison(fn, vals, diff_pos, kwargs):
+    """Mark a key permanently uncacheable (its fn cannot trace)."""
+    try:
+        key = _vjp_cache_key(fn, vals, diff_pos, kwargs)
+    except _Unhashable:
+        return
+    with _vjp_cache_lock:
+        _vjp_cache.pop(key, None)
+        _vjp_poisoned.add(key)
+
+
+def _vjp_cache_stats():
+    return dict(_vjp_stats, size=len(_vjp_cache),
+                poisoned=len(_vjp_poisoned))
+
+
+def _vjp_cache_clear():
+    with _vjp_cache_lock:
+        _vjp_cache.clear()
+        _vjp_poisoned.clear()
+        for k in _vjp_stats:
+            _vjp_stats[k] = 0
+
+
 def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
                 **kwargs) -> Any:
     """Execute ``fn`` over the jax values of ``args``; record a GradNode.
@@ -354,6 +530,14 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
     This is the single choke point every op goes through — the analog of
     Tracer::TraceOp (reference imperative/tracer.cc:132): run forward,
     then (if grads are on) create the backward node via jax.vjp.
+
+    Eager dispatch cost: a bare ``jax.vjp`` re-traces forward+backward on
+    every call (SURVEY hard-part #3, the analog of the reference's
+    cached PreparedOp/kernel lookup, imperative/prepared_operator.cc).
+    Repeat calls with the same op / shapes / dtypes / scalar operands hit
+    ``_VJP_CACHE`` — a jitted (out, vjp_fn) pair — skipping the re-trace;
+    ops whose closures capture arrays (dropout keys) or that cannot
+    trace fall back to the uncached path permanently for that key.
     """
     vals = [a._value if isinstance(a, Tensor) else a for a in args]
 
@@ -364,17 +548,34 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
             if isinstance(a, Tensor) and not a.stop_gradient and _is_float_dtype(a._value):
                 diff_pos.append(i)
 
-    if not diff_pos:
-        out = fn(*vals, **kwargs)
-        return _wrap_outputs(out, None, stop_gradient=True)
-
     def closed(*diff_vals):
         v = list(vals)
         for p, dv in zip(diff_pos, diff_vals):
             v[p] = dv
         return fn(*v, **kwargs)
 
-    out_val, vjp_fn = jax.vjp(closed, *[vals[p] for p in diff_pos])
+    cached = _vjp_cache_lookup(fn, vals, tuple(diff_pos), kwargs)
+
+    if not diff_pos:
+        if cached is not None:
+            try:
+                out = cached(
+                    [v for v in vals if _is_jax_array(v)])
+                return _wrap_outputs(out, None, stop_gradient=True)
+            except _TRACE_FALLBACK_ERRORS:
+                _vjp_cache_poison(fn, vals, tuple(diff_pos), kwargs)
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, None, stop_gradient=True)
+
+    out_val = vjp_fn = None
+    if cached is not None:
+        try:
+            out_val, vjp_fn = cached(
+                [v for v in vals if _is_jax_array(v)])
+        except _TRACE_FALLBACK_ERRORS:
+            _vjp_cache_poison(fn, vals, tuple(diff_pos), kwargs)
+    if vjp_fn is None:
+        out_val, vjp_fn = jax.vjp(closed, *[vals[p] for p in diff_pos])
     parents = [args[p] for p in diff_pos]
     outs = out_val if isinstance(out_val, (tuple, list)) else (out_val,)
     out_avals = [(o.shape, o.dtype) for o in outs]

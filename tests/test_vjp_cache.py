@@ -1,0 +1,158 @@
+"""Eager-dispatch vjp cache.
+
+SURVEY hard-part #3: a bare jax.vjp re-traces forward+backward on every
+eager op call. The cache (framework/core.py _vjp_cache_lookup) reuses a
+jitted (out, vjp_fn) pair per (op, closure scalars, shapes, dtypes,
+scalar operands) — the analog of the reference's PreparedOp/kernel
+cache (imperative/prepared_operator.cc). These tests pin:
+numerics identical to the uncached path, real hit-rates on a training
+loop, randomness not frozen, untraceable ops falling back, and the
+dispatch-latency win itself.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework import core
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    core._vjp_cache_clear()
+    paddle.set_flags({"FLAGS_eager_vjp_cache": True})
+    yield
+    paddle.set_flags({"FLAGS_eager_vjp_cache": True})
+
+
+def _train(steps=25, lr=0.05):
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+    xs = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    x_t, y_t = paddle.to_tensor(xs), paddle.to_tensor(ys)
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=net.parameters())
+    losses = []
+    for _ in range(steps):
+        loss = F.mse_loss(net(x_t), y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_cached_training_matches_uncached():
+    paddle.set_flags({"FLAGS_eager_vjp_cache": False})
+    ref = _train()
+    core._vjp_cache_clear()
+    paddle.set_flags({"FLAGS_eager_vjp_cache": True})
+    got = _train()
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-6)
+    stats = core._vjp_cache_stats()
+    assert stats["hits"] > stats["misses"] * 5, stats
+
+
+def test_cache_hits_on_repeat_shapes_misses_on_new():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    x.stop_gradient = False
+    for _ in range(5):
+        (x * 2.0).sum().backward()
+        x.clear_grad()
+    s1 = core._vjp_cache_stats()
+    assert s1["hits"] >= 8  # both ops hit after the first dispatch
+    y = paddle.to_tensor(np.ones((2, 8), np.float32))  # new shape
+    y.stop_gradient = False
+    (y * 2.0).sum().backward()
+    s2 = core._vjp_cache_stats()
+    assert s2["misses"] > s1["misses"]
+
+
+def test_dropout_randomness_not_frozen():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.ones((1000,), np.float32))
+    a = F.dropout(x, p=0.5, training=True).numpy()
+    b = F.dropout(x, p=0.5, training=True).numpy()
+    assert not np.array_equal(a, b), "dropout mask frozen by the cache"
+
+
+def test_untraceable_op_falls_back_and_poisons():
+    # value-dependent python branching can only ever work on the no-grad
+    # path (jax.vjp itself traces, cached or not); the cache must fall
+    # back to the concrete eager call instead of erroring
+    def value_branch(v):
+        # concrete eager value: fine; under trace: ConcretizationTypeError
+        if float(jnp.sum(v)) > 0:
+            return v * 2.0
+        return v * 3.0
+
+    xp = paddle.to_tensor(np.ones((3,), np.float32))      # stop_gradient
+    xn = paddle.to_tensor(-np.ones((3,), np.float32))
+    for _ in range(2):  # second call exercises the poisoned path
+        out = core._apply(value_branch, xp, op_name="vb")
+        np.testing.assert_allclose(out.numpy(), 2.0 * np.ones(3))
+    out = core._apply(value_branch, xn, op_name="vb")
+    np.testing.assert_allclose(out.numpy(), -3.0 * np.ones(3))
+    assert core._vjp_cache_stats()["poisoned"] >= 1
+
+
+def test_scalar_operands_key_the_cache():
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    x.stop_gradient = False
+    a = (x * 2.0).numpy()
+    b = (x * 3.0).numpy()  # same shapes, different scalar: distinct entry
+    np.testing.assert_allclose(a, 2.0 * np.ones(4))
+    np.testing.assert_allclose(b, 3.0 * np.ones(4))
+
+
+def test_scalar_keys_are_type_tagged():
+    # 1 == 1.0 == True in python; jax weak typing promotes them
+    # differently, so they must not share a cache entry
+    x = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+    a = x + 1
+    b = x + 1.0
+    assert str(a.dtype).endswith("int32")
+    assert str(b.dtype).endswith("float32"), (
+        "int32 cache entry replayed for a float scalar operand")
+
+
+def test_autocast_state_keys_the_cache():
+    # amp casts inputs INSIDE the op fn via thread-local state; a cached
+    # fp32 trace must never be replayed inside auto_cast (and vice versa)
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = paddle.to_tensor(np.ones((4, 4), np.float32))
+    out_fp32 = paddle.matmul(a, b)
+    assert str(out_fp32.dtype).endswith("float32")
+    with paddle.amp.auto_cast():
+        out_bf16 = paddle.matmul(a, b)
+    assert str(out_bf16.dtype).endswith("bfloat16")
+    out_fp32_again = paddle.matmul(a, b)
+    assert str(out_fp32_again.dtype).endswith("float32")
+
+
+def test_dispatch_latency_improves():
+    def measure():
+        paddle.set_flags({"FLAGS_eager_vjp_cache": False})
+        t0 = time.perf_counter()
+        _train(steps=20)
+        t_off = time.perf_counter() - t0
+        core._vjp_cache_clear()
+        paddle.set_flags({"FLAGS_eager_vjp_cache": True})
+        _train(steps=5)   # warm the cache
+        t0 = time.perf_counter()
+        _train(steps=20)
+        return t_off, time.perf_counter() - t0
+
+    # measured ~3.3x on a quiet host; demand a conservative 1.3x, with
+    # one retry to ride out transient load on a shared CI core
+    for attempt in range(2):
+        t_off, t_on = measure()
+        if t_on < t_off / 1.3:
+            return
+    assert t_on < t_off / 1.3, (t_off, t_on)
