@@ -18,8 +18,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st", "FakeTextDataset",
-           "build_vocab"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "Imikolov",
+           "WMT14", "WMT16", "FakeTextDataset", "build_vocab"]
 
 
 def _require(path, what, layout):
@@ -174,6 +174,187 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (parity: text/datasets/movielens.py +
+    python/paddle/dataset/movielens.py — the input for the rec configs).
+
+    Reads the ml-1m layout from ``data_dir``: ``users.dat`` /
+    ``movies.dat`` / ``ratings.dat`` with ``::`` separators. Each sample
+    is the reference's feature tuple, already integer-encoded:
+    ``(user_id, gender_id, age_id, job_id, movie_id, category_multihot,
+    title_ids, rating)``. Split: deterministic 1-in-10 holdout by rating
+    index (the reference shuffles with a fixed seed; a hash split keeps
+    the same 9:1 ratio without loading order mattering).
+    """
+
+    AGE_BUCKETS = (1, 18, 25, 35, 45, 50, 56)
+    MAX_JOB_ID = 20
+    TITLE_LEN = 10
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1):
+        assert mode in ("train", "test")
+        _require(data_dir, "Movielens",
+                 "ml-1m dir with users.dat / movies.dat / ratings.dat")
+        self.user = {}
+        with open(os.path.join(data_dir, "users.dat"),
+                  errors="ignore") as f:
+            for line in f:
+                uid, gender, age, job, _zip = line.strip().split("::")
+                self.user[int(uid)] = (
+                    int(uid), 0 if gender == "M" else 1,
+                    self.AGE_BUCKETS.index(int(age))
+                    if int(age) in self.AGE_BUCKETS else 0,
+                    min(int(job), self.MAX_JOB_ID))
+        titles, genres = [], set()
+        movies = {}
+        with open(os.path.join(data_dir, "movies.dat"),
+                  errors="ignore") as f:
+            for line in f:
+                mid, title, cats = line.strip().split("::")
+                cats = cats.split("|")
+                genres.update(cats)
+                title = re.sub(r"\(\d{4}\)$", "", title).strip().lower()
+                titles.append(title)
+                movies[int(mid)] = (title, cats)
+        self.genre_idx = {g: i for i, g in enumerate(sorted(genres))}
+        self.title_vocab = build_vocab(titles)
+        unk = self.title_vocab.get("<unk>", 1)
+        self.movie = {}
+        for mid, (title, cats) in movies.items():
+            mh = np.zeros(len(self.genre_idx), np.float32)
+            for c in cats:
+                mh[self.genre_idx[c]] = 1.0
+            tid = [self.title_vocab.get(w, unk) for w in title.split()]
+            tid = (tid + [0] * self.TITLE_LEN)[:self.TITLE_LEN]
+            self.movie[mid] = (mh, np.asarray(tid, np.int64))
+        self.samples = []
+        k = max(int(round(1.0 / max(test_ratio, 1e-9))), 2)
+        with open(os.path.join(data_dir, "ratings.dat"),
+                  errors="ignore") as f:
+            for n, line in enumerate(f):
+                uid, mid, rating, _ts = line.strip().split("::")
+                is_test = (n % k) == 0
+                if (mode == "test") == is_test:
+                    self.samples.append((int(uid), int(mid),
+                                         float(rating)))
+
+    @property
+    def n_genres(self):
+        return len(self.genre_idx)
+
+    def __getitem__(self, i):
+        uid, mid, rating = self.samples[i]
+        u = self.user[uid]
+        mh, tid = self.movie[mid]
+        return (np.int64(u[0]), np.int64(u[1]), np.int64(u[2]),
+                np.int64(u[3]), np.int64(mid), mh, tid,
+                np.asarray([rating], np.float32))
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (parity: text/datasets/imikolov.py).
+    Reads a local ``ptb.{train,valid}.txt``; ``data_type="NGRAM"`` yields
+    fixed windows, ``"SEQ"`` yields (input, shifted-target) pairs."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 data_type: str = "NGRAM", window_size: int = 5,
+                 vocab: Optional[dict] = None, min_word_freq: int = 1):
+        assert data_type in ("NGRAM", "SEQ")
+        _require(data_file, "Imikolov", "ptb.train.txt-style text")
+        with open(data_file, errors="ignore") as f:
+            lines = [l.strip() for l in f if l.strip()]
+        self.word_idx = vocab or build_vocab(
+            lines, min_freq=min_word_freq,
+            specials=("<pad>", "<unk>", "<s>", "<e>"))
+        unk = self.word_idx["<unk>"]
+        s, e = self.word_idx["<s>"], self.word_idx["<e>"]
+        self.samples = []
+        for line in lines:
+            ids = [s] + [self.word_idx.get(w, unk)
+                         for w in line.split()] + [e]
+            if data_type == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.samples.append(
+                        np.asarray(ids[i:i + window_size], np.int64))
+            else:
+                self.samples.append(
+                    (np.asarray(ids[:-1], np.int64),
+                     np.asarray(ids[1:], np.int64)))
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(Dataset):
+    """Parallel translation corpus (parity: text/datasets/wmt14.py).
+
+    Reads local ``src_file``/``trg_file`` (one sentence per line,
+    aligned). Samples follow the reference's (src_ids, trg_in, trg_next)
+    convention: the decoder input is ``<s> + trg`` and the target is
+    ``trg + <e>``. Vocabularies are built from the files (or passed in),
+    truncated to ``dict_size`` most-frequent words like the reference.
+    """
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, src_file: Optional[str] = None,
+                 trg_file: Optional[str] = None, dict_size: int = 30000,
+                 src_vocab: Optional[dict] = None,
+                 trg_vocab: Optional[dict] = None):
+        _require(src_file, type(self).__name__,
+                 "aligned one-sentence-per-line source/target files")
+        _require(trg_file, type(self).__name__,
+                 "aligned one-sentence-per-line source/target files")
+        with open(src_file, errors="ignore") as f:
+            src = [l.strip() for l in f]
+        with open(trg_file, errors="ignore") as f:
+            trg = [l.strip() for l in f]
+        if len(src) != len(trg):
+            raise ValueError(
+                f"unaligned corpus: {len(src)} src vs {len(trg)} trg lines")
+        specials = ("<pad>", self.UNK, self.BOS, self.EOS)
+        self.src_vocab = src_vocab or self._cap(
+            build_vocab(src, specials=specials), dict_size)
+        self.trg_vocab = trg_vocab or self._cap(
+            build_vocab(trg, specials=specials), dict_size)
+        su, tu = self.src_vocab[self.UNK], self.trg_vocab[self.UNK]
+        bos, eos = self.trg_vocab[self.BOS], self.trg_vocab[self.EOS]
+        self.samples = []
+        for s, t in zip(src, trg):
+            if not s or not t:
+                continue
+            si = [self.src_vocab.get(w, su) for w in s.split()]
+            ti = [self.trg_vocab.get(w, tu) for w in t.split()]
+            self.samples.append((np.asarray(si, np.int64),
+                                 np.asarray([bos] + ti, np.int64),
+                                 np.asarray(ti + [eos], np.int64)))
+
+    @staticmethod
+    def _cap(vocab: dict, dict_size: int) -> dict:
+        if len(vocab) <= dict_size:
+            return vocab
+        return {w: i for w, i in vocab.items() if i < dict_size}
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT16(WMT14):
+    """Same local-corpus surface as WMT14 (parity: text/datasets/wmt16.py
+    — the reference variants differ in their download source and BPE
+    preprocessing, not in the sample convention)."""
 
 
 class FakeTextDataset(Dataset):

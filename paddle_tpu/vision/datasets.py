@@ -16,8 +16,9 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "ImageFolder", "DatasetFolder"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "VOC_CLASSES", "FakeData", "ImageFolder",
+           "DatasetFolder"]
 
 
 class FakeData(Dataset):
@@ -201,3 +202,121 @@ class ImageFolder(DatasetFolder):
         if self.transform:
             img = self.transform(img)
         return [img]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (parity: python/paddle/vision/datasets/
+    flowers.py). Reads the standard local layout under ``data_dir``:
+    ``jpg/image_*.jpg``, ``imagelabels.mat`` (1-based labels) and
+    ``setid.mat`` ('trnid'/'valid'/'tstid' 1-based image ids); .npy
+    equivalents of the two .mat files are accepted too."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 transform=None, backend=None):
+        assert mode in self._SPLIT_KEY
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"Flowers: no local data at {data_dir!r}. This build has "
+                f"no network access (the reference would download it); "
+                f"expected jpg/ + imagelabels.mat + setid.mat")
+        self.transform = transform
+        labels = self._load_mat(data_dir, "imagelabels", "labels")
+        ids = self._load_mat(data_dir, "setid", self._SPLIT_KEY[mode])
+        labels = np.asarray(labels).ravel().astype(np.int64)
+        self.samples = []
+        for i in np.asarray(ids).ravel().astype(int):
+            self.samples.append(
+                (os.path.join(data_dir, "jpg", f"image_{i:05d}.jpg"),
+                 int(labels[i - 1]) - 1))   # 1-based -> 0-based
+
+    @staticmethod
+    def _load_mat(data_dir, stem, key):
+        npz = os.path.join(data_dir, f"{stem}.npz")
+        if os.path.exists(npz):
+            return np.load(npz)[key]
+        npy = os.path.join(data_dir, f"{stem}.npy")
+        if os.path.exists(npy):
+            d = np.load(npy, allow_pickle=True)
+            if d.dtype == object:
+                return d.item()[key]
+            if stem == "setid":
+                # a plain array cannot hold the three splits; returning
+                # it for every mode would silently alias train/test
+                raise ValueError(
+                    "setid.npy must be a dict with trnid/valid/tstid "
+                    "(np.save of a dict, or use setid.npz)")
+            return d
+        from scipy.io import loadmat
+        return loadmat(os.path.join(data_dir, f"{stem}.mat"))[key]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = DatasetFolder._default_loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(label)
+
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+class VOC2012(Dataset):
+    """Pascal VOC detection (parity: python/paddle/dataset/voc2012.py +
+    vision/datasets/voc2012.py). Reads a local ``VOCdevkit/VOC2012``
+    tree (``data_dir`` may point at either level): JPEGImages/,
+    Annotations/*.xml, ImageSets/Main/{mode}.txt. Samples are
+    ``(image, boxes[n,4] xyxy float32, labels[n] int64, difficult[n])``
+    — dense arrays for the TPU detection ops (vision/ops.py)."""
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 transform=None):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"VOC2012: no local data at {data_dir!r}. This build has "
+                f"no network access (the reference would download it); "
+                f"expected the VOCdevkit/VOC2012 layout")
+        inner = os.path.join(data_dir, "VOCdevkit", "VOC2012")
+        if os.path.isdir(inner):
+            data_dir = inner
+        self.root = data_dir
+        self.transform = transform
+        self.class_to_idx = {c: i for i, c in enumerate(VOC_CLASSES)}
+        split = os.path.join(data_dir, "ImageSets", "Main", f"{mode}.txt")
+        with open(split) as f:
+            self.ids = [l.strip().split()[0] for l in f if l.strip()]
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        import xml.etree.ElementTree as ET
+        name = self.ids[idx]
+        img = DatasetFolder._default_loader(
+            os.path.join(self.root, "JPEGImages", f"{name}.jpg"))
+        tree = ET.parse(
+            os.path.join(self.root, "Annotations", f"{name}.xml"))
+        boxes, labels, difficult = [], [], []
+        for obj in tree.findall("object"):
+            cls = obj.findtext("name", "").strip()
+            if cls not in self.class_to_idx:
+                continue
+            bb = obj.find("bndbox")
+            boxes.append([float(bb.findtext(k)) for k in
+                          ("xmin", "ymin", "xmax", "ymax")])
+            labels.append(self.class_to_idx[cls])
+            difficult.append(int(obj.findtext("difficult", "0")))
+        boxes = (np.asarray(boxes, np.float32) if boxes
+                 else np.zeros((0, 4), np.float32))
+        labels = np.asarray(labels, np.int64)
+        difficult = np.asarray(difficult, np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, boxes, labels, difficult

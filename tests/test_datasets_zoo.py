@@ -1,0 +1,159 @@
+"""Dataset zoo breadth (parity: python/paddle/dataset/ — movielens,
+imikolov, wmt14/16, flowers, voc2012). Zero-egress environment: each
+test writes a tiny local corpus in the reference's on-disk layout and
+checks parsing, encoding, and split semantics.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import Imikolov, Movielens, WMT14, WMT16
+from paddle_tpu.vision.datasets import VOC2012, VOC_CLASSES, Flowers
+
+
+# ---------------------------------------------------------------- movielens
+def _write_ml1m(root):
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "users.dat"), "w") as f:
+        f.write("1::F::1::10::48067\n2::M::56::16::70072\n"
+                "3::M::25::15::55117\n")
+    with open(os.path.join(root, "movies.dat"), "w") as f:
+        f.write("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+                "2::Jumanji (1995)::Adventure|Children's|Fantasy\n"
+                "3::Heat (1995)::Action|Crime|Thriller\n")
+    with open(os.path.join(root, "ratings.dat"), "w") as f:
+        for n, (u, m, r) in enumerate([(1, 1, 5), (1, 2, 3), (2, 1, 4),
+                                       (2, 3, 4), (3, 2, 2), (3, 3, 5),
+                                       (1, 3, 4), (2, 2, 1), (3, 1, 3),
+                                       (1, 1, 2)]):
+            f.write(f"{u}::{m}::{r}::97830{n:04d}\n")
+
+
+def test_movielens_features_and_split(tmp_path):
+    root = str(tmp_path / "ml-1m")
+    _write_ml1m(root)
+    train = Movielens(root, mode="train")
+    test = Movielens(root, mode="test")
+    assert len(train) + len(test) == 10
+    assert len(test) == 1  # 1-in-10 deterministic holdout
+    uid, gender, age, job, mid, genres, title, rating = train[0]
+    assert gender in (0, 1) and genres.shape == (train.n_genres,)
+    assert genres.sum() == 3.0   # every ml-1m movie row lists 3 genres
+    assert title.shape == (Movielens.TITLE_LEN,)
+    assert 1.0 <= float(rating[0]) <= 5.0
+    # age bucket: user 1 has age 1 -> bucket 0; user 2 age 56 -> bucket 6
+    assert train.user[1][2] == 0 and train.user[2][2] == 6
+
+
+def test_movielens_missing_dir_raises():
+    with pytest.raises(FileNotFoundError, match="no local data"):
+        Movielens("/nonexistent/ml-1m")
+
+
+# ---------------------------------------------------------------- imikolov
+def test_imikolov_ngram_and_seq(tmp_path):
+    p = tmp_path / "ptb.train.txt"
+    p.write_text("the cat sat\nthe dog sat on the mat\n")
+    ng = Imikolov(str(p), data_type="NGRAM", window_size=3)
+    # sentence 1: <s> the cat sat <e> -> 3 windows; sentence 2: 6 windows
+    assert len(ng) == 9
+    assert all(s.shape == (3,) for s in ng)
+    seq = Imikolov(str(p), data_type="SEQ")
+    x, y = seq[0]
+    np.testing.assert_array_equal(x[1:], y[:-1])  # shifted by one
+    assert x[0] == seq.word_idx["<s>"] and y[-1] == seq.word_idx["<e>"]
+    # vocab is shared/reusable across splits like the reference
+    valid = Imikolov(str(p), data_type="SEQ", vocab=seq.word_idx)
+    assert valid.word_idx is seq.word_idx
+
+
+# ---------------------------------------------------------------- wmt
+def test_wmt14_pairs_and_vocab_cap(tmp_path):
+    src = tmp_path / "train.src"
+    trg = tmp_path / "train.trg"
+    src.write_text("ein haus\nder hund schläft\n")
+    trg.write_text("a house\nthe dog sleeps\n")
+    ds = WMT14(str(src), str(trg))
+    assert len(ds) == 2
+    s, tin, tout = ds[1]
+    assert tin[0] == ds.trg_vocab["<s>"]
+    assert tout[-1] == ds.trg_vocab["<e>"]
+    np.testing.assert_array_equal(tin[1:], tout[:-1])
+    capped = WMT14(str(src), str(trg), dict_size=5)
+    assert len(capped.src_vocab) == 5  # most-frequent truncation
+    # unknown words map to <unk>, ids stay in range
+    for si, ti, to in capped:
+        assert si.max() < 5 and ti.max() < 5 and to.max() < 5
+
+
+def test_wmt_unaligned_raises(tmp_path):
+    src = tmp_path / "s"; trg = tmp_path / "t"
+    src.write_text("one line\n")
+    trg.write_text("two\nlines\n")
+    with pytest.raises(ValueError, match="unaligned"):
+        WMT16(str(src), str(trg))
+
+
+# ---------------------------------------------------------------- flowers
+def test_flowers_layout_and_splits(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+    root = tmp_path / "flowers"
+    (root / "jpg").mkdir(parents=True)
+    for i in range(1, 7):
+        Image.fromarray(
+            np.full((8, 8, 3), i * 20, np.uint8)).save(
+                root / "jpg" / f"image_{i:05d}.jpg")
+    savemat(root / "imagelabels.mat",
+            {"labels": np.asarray([[1, 1, 2, 2, 3, 3]])})
+    savemat(root / "setid.mat",
+            {"trnid": np.asarray([[1, 3, 5]]),
+             "valid": np.asarray([[2, 4]]),
+             "tstid": np.asarray([[6]])})
+    train = Flowers(str(root), mode="train")
+    assert len(train) == 3
+    img, label = train[1]
+    assert img.shape == (8, 8, 3) and label == 1  # 1-based -> 0-based
+    assert len(Flowers(str(root), mode="valid")) == 2
+    assert len(Flowers(str(root), mode="test")) == 1
+
+
+def test_flowers_plain_setid_npy_rejected(tmp_path):
+    from PIL import Image
+    root = tmp_path / "flowers"
+    (root / "jpg").mkdir(parents=True)
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+        root / "jpg" / "image_00001.jpg")
+    np.save(root / "imagelabels.npy", np.asarray([1]))
+    np.save(root / "setid.npy", np.asarray([1]))  # plain array: ambiguous
+    with pytest.raises(ValueError, match="trnid/valid/tstid"):
+        Flowers(str(root), mode="train")
+
+
+# ---------------------------------------------------------------- voc2012
+def test_voc2012_detection_samples(tmp_path):
+    from PIL import Image
+    root = tmp_path / "VOCdevkit" / "VOC2012"
+    for d in ("JPEGImages", "Annotations", "ImageSets/Main"):
+        (root / d).mkdir(parents=True)
+    Image.fromarray(np.zeros((10, 12, 3), np.uint8)).save(
+        root / "JPEGImages" / "2007_000001.jpg")
+    (root / "Annotations" / "2007_000001.xml").write_text("""
+<annotation><size><width>12</width><height>10</height></size>
+ <object><name>dog</name><difficult>0</difficult>
+  <bndbox><xmin>1</xmin><ymin>2</ymin><xmax>6</xmax><ymax>8</ymax></bndbox>
+ </object>
+ <object><name>person</name><difficult>1</difficult>
+  <bndbox><xmin>3</xmin><ymin>1</ymin><xmax>9</xmax><ymax>9</ymax></bndbox>
+ </object>
+</annotation>""")
+    (root / "ImageSets" / "Main" / "train.txt").write_text("2007_000001\n")
+    ds = VOC2012(str(tmp_path), mode="train")  # outer level accepted
+    assert len(ds) == 1
+    img, boxes, labels, difficult = ds[0]
+    assert img.shape == (10, 12, 3)
+    np.testing.assert_allclose(boxes[0], [1, 2, 6, 8])
+    assert labels.tolist() == [VOC_CLASSES.index("dog"),
+                               VOC_CLASSES.index("person")]
+    assert difficult.tolist() == [0, 1]
