@@ -25,6 +25,7 @@ reference's sync communicator mode).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -32,7 +33,159 @@ import numpy as np
 
 from .ps import SparseTable
 
-__all__ = ["HeterTrainer"]
+__all__ = ["HeterTrainer", "DeviceCachedTable"]
+
+
+class DeviceCachedTable:
+    """Device-resident cache over a host :class:`SparseTable` — the TPU
+    analog of the reference's GPU embedding cache
+    (framework/fleet/heter_ps/hashtable.h + heter_comm.h, and
+    PSGPUWrapper's BuildGPUTask/EndPass lifecycle).
+
+    Hot rows live in one HBM buffer ``[capacity, dim]``; the host keeps
+    the id->slot map and LRU order. ``pull`` returns device rows (a
+    single gather — no host<->device row traffic on a hit), misses
+    pull-through from the host table and evict cold slots. ``push``
+    applies the optimizer ON DEVICE (scatter update), so a training step
+    over cached rows never ships embedding rows across the host link.
+    Evicted/flushed rows write back exactly via ``push_delta`` (value
+    delta against the row as it was admitted), matching the reference's
+    end-of-pass sync. Divergence from the reference, by design: adagrad
+    accumulator state is cache-resident and restarts on re-admission
+    (the reference ships moments with the row; a delta-merge of
+    accumulators across workers is not well-defined anyway).
+    """
+
+    def __init__(self, table: SparseTable, capacity: int,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 eps: float = 1e-6):
+        import jax.numpy as jnp
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"device cache optimizer must be sgd|adagrad, "
+                             f"got {optimizer!r}")
+        self._table = table
+        self._cap = int(capacity)
+        self._dim = table.dim
+        self._opt = optimizer
+        self._lr = lr
+        self._eps = eps
+        self._buf = jnp.zeros((self._cap, self._dim), jnp.float32)
+        self._acc = (jnp.zeros((self._cap, self._dim), jnp.float32)
+                     if optimizer == "adagrad" else None)
+        self._orig = np.zeros((self._cap, self._dim), np.float32)
+        self._slot_of: Dict[int, int] = {}
+        self._id_of = np.full(self._cap, -1, np.int64)
+        self._dirty = np.zeros(self._cap, bool)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._free = list(range(self._cap - 1, -1, -1))
+        self.hits = self.misses = self.evictions = 0
+
+    # -- admission / eviction -----------------------------------------
+    def _admit(self, miss_ids: np.ndarray, pinned: set) -> np.ndarray:
+        """Allocate slots for ``miss_ids`` (evicting LRU slots not pinned
+        by the current batch), pull rows from the host table, install."""
+        import jax.numpy as jnp
+        n = len(miss_ids)
+        slots = np.empty(n, np.int64)
+        evict = []
+        for j in range(n):
+            if self._free:
+                s = self._free.pop()
+            else:
+                s = next((k for k in self._lru if k not in pinned), None)
+                if s is None:
+                    raise RuntimeError(
+                        f"device cache thrashing: batch needs more unique "
+                        f"rows than capacity={self._cap}")
+                del self._lru[s]
+                evict.append(s)
+                del self._slot_of[int(self._id_of[s])]
+                self.evictions += 1
+            slots[j] = s
+        if evict:
+            self._write_back(np.asarray(evict, np.int64))
+        rows = self._table.pull(miss_ids)
+        self._buf = self._buf.at[jnp.asarray(slots)].set(jnp.asarray(rows))
+        if self._acc is not None:
+            self._acc = self._acc.at[jnp.asarray(slots)].set(0.0)
+        self._orig[slots] = rows
+        self._id_of[slots] = miss_ids
+        self._dirty[slots] = False
+        for s, i in zip(slots.tolist(), miss_ids.tolist()):
+            self._slot_of[i] = s
+            self._lru[s] = None
+        return slots
+
+    def _write_back(self, slots: np.ndarray):
+        """Exact sync of dirty rows to the host table: push the value
+        delta accumulated since admission (push_delta adds raw)."""
+        d = slots[self._dirty[slots]]
+        if d.size == 0:
+            return
+        vals = np.asarray(self._buf[d])
+        self._table.push_delta(self._id_of[d], vals - self._orig[d])
+        self._orig[d] = vals
+        self._dirty[d] = False
+
+    # -- SparseTable-compatible surface --------------------------------
+    def pull(self, ids: np.ndarray):
+        """Device rows for ``ids`` (duplicates allowed) — one HBM gather."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        slots = np.empty(len(uniq), np.int64)
+        miss_j = []
+        for j, i in enumerate(uniq.tolist()):
+            s = self._slot_of.get(i)
+            if s is None:
+                miss_j.append(j)
+            else:
+                slots[j] = s
+                self._lru.move_to_end(s)
+                self.hits += 1
+        if miss_j:
+            self.misses += len(miss_j)
+            missing = set(miss_j)
+            pinned = {int(s) for j, s in enumerate(slots)
+                      if j not in missing}
+            slots[miss_j] = self._admit(uniq[miss_j], pinned)
+        self._last = (uniq, slots)   # push() fast path for the same batch
+        return self._buf[np.asarray(slots)[inverse]]
+
+    def push(self, ids: np.ndarray, grads):
+        """Apply the optimizer on device to the rows of ``ids``;
+        duplicate ids' grads are segment-summed first."""
+        import jax
+        import jax.numpy as jnp
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        last = getattr(self, "_last", None)
+        if last is not None and np.array_equal(last[0], uniq):
+            slots = last[1]
+        else:
+            slots = np.asarray([self._slot_of[i] for i in uniq.tolist()],
+                               np.int64)
+        g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
+                                jnp.asarray(inverse),
+                                num_segments=len(uniq))
+        sl = jnp.asarray(slots)
+        if self._opt == "adagrad":
+            self._acc = self._acc.at[sl].add(g * g)
+            step = g / (jnp.sqrt(self._acc[sl]) + self._eps)
+        else:
+            step = g
+        self._buf = self._buf.at[sl].add(-self._lr * step)
+        self._dirty[slots] = True
+
+    def flush(self):
+        """Write every dirty row back to the host table (the reference's
+        PSGPUWrapper::EndPass)."""
+        self._write_back(np.flatnonzero(self._dirty).astype(np.int64))
+
+    end_pass = flush
+
+    @property
+    def load(self) -> float:
+        return 1.0 - len(self._free) / self._cap
 
 
 class HeterTrainer:
