@@ -14,10 +14,10 @@ from typing import Optional
 from .input_spec import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
 
-__all__ = ["InputSpec", "Program", "default_main_program",
-           "default_startup_program", "program_guard", "Executor",
-           "CompiledProgram", "name_scope", "data", "nn",
-           "save_inference_model", "load_inference_model"]
+__all__ = ["InputSpec", "Program", "UnsupportedProgramSurgery",
+           "default_main_program", "default_startup_program",
+           "program_guard", "Executor", "CompiledProgram", "name_scope",
+           "data", "nn", "save_inference_model", "load_inference_model"]
 
 
 class Program:
@@ -53,6 +53,64 @@ class Program:
         if hasattr(self, "_named_layer_cache"):
             c._named_layer_cache = dict(self._named_layer_cache)
         return c
+
+    # -- unsupported ProgramDesc surgery: fail loudly, never silently ----
+    def _no_desc_surgery(self, what: str, alternative: str):
+        raise UnsupportedProgramSurgery(
+            f"Program.{what} walks the reference's ProgramDesc op/var "
+            f"graph; under XLA the IR is the jaxpr/StableHLO produced by "
+            f"tracing, so there is no op-level desc to edit. {alternative}")
+
+    def prune(self, targets):
+        self._no_desc_surgery(
+            "prune", "Export the pruned graph by tracing the sub-"
+            "computation you want: paddle.jit.save(fn, path, input_spec) "
+            "— XLA dead-code-eliminates everything not feeding fn's "
+            "outputs.")
+
+    def _prune_with_input(self, feeded_var_names, targets):
+        self.prune(targets)
+
+    @property
+    def desc(self):
+        self._no_desc_surgery(
+            "desc", "For a serializable IR use paddle.jit.save (StableHLO "
+            "bundle) and inspect the .mlir it writes.")
+
+    def block(self, index):
+        self._no_desc_surgery(
+            "block(i)", "Helper-built layers live on the Program itself: "
+            "use all_parameters(); op-level blocks do not exist.")
+
+    @property
+    def blocks(self):
+        self.block(0)
+
+    def current_block(self):
+        return self.global_block()   # widely used as a param container
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def list_vars(self):
+        self._no_desc_surgery(
+            "list_vars", "Trace with paddle.jit.to_static and inspect "
+            "inputs/outputs via its InputSpec, or use "
+            "all_parameters() for the parameters.")
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        return (f"Program(traced callables={len(self._callables)}, "
+                f"helper layers={len(self._layers)}; op-level desc "
+                f"collapses into XLA — see paddle_tpu.static docs)")
+
+
+class UnsupportedProgramSurgery(NotImplementedError):
+    """Reference Program/ProgramDesc graph surgery that cannot exist under
+    the traced-IR design (SURVEY §7: executors/IR passes collapse into
+    XLA). Raised loudly so ported scripts fail at the call site with a
+    pointer to the tpu-native equivalent, instead of silently training a
+    wrong graph."""
 
 
 _main = Program()
