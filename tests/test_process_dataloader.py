@@ -138,20 +138,27 @@ def test_early_break_releases_workers():
 def test_python_heavy_transforms_scale_with_process_workers():
     import os
     ds = _PythonHeavy()
-    t0 = time.monotonic()
-    a = _collect(DataLoader(ds, batch_size=8))
-    t_sync = time.monotonic() - t0
-    t0 = time.monotonic()
-    b = _collect(DataLoader(ds, batch_size=8, num_workers=4,
-                            use_process=True))
-    t_proc = time.monotonic() - t0
-    for x, y in zip(a, b):
-        np.testing.assert_array_equal(x, y)
-    if (os.cpu_count() or 1) >= 2:
-        # forked workers on GIL-bound work: demand a conservative 1.3x
-        # so the assertion is robust to a loaded CI host
-        assert t_proc < t_sync / 1.3, (t_sync, t_proc)
-    else:
-        # a single-core host cannot parallelize CPU-bound work at all;
-        # just bound the process-mode overhead
-        assert t_proc < t_sync * 2.0, (t_sync, t_proc)
+
+    def measure():
+        t0 = time.monotonic()
+        a = _collect(DataLoader(ds, batch_size=8))
+        t_sync = time.monotonic() - t0
+        t0 = time.monotonic()
+        b = _collect(DataLoader(ds, batch_size=8, num_workers=4,
+                                use_process=True))
+        t_proc = time.monotonic() - t0
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        return t_sync, t_proc
+
+    multi = (os.cpu_count() or 1) >= 2
+    # multi-core: forked workers on GIL-bound work must win (1.3x,
+    # conservative). single core: CPU-bound work cannot parallelize;
+    # just bound the process-mode overhead. one retry rides out
+    # transient load on a shared CI core.
+    for attempt in range(2):
+        t_sync, t_proc = measure()
+        ok = (t_proc < t_sync / 1.3) if multi else (t_proc < t_sync * 2.0)
+        if ok:
+            return
+    assert ok, (t_sync, t_proc)
