@@ -6,6 +6,10 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, RMSNorm,
     llama_tiny, llama_7b, llama_13b,
 )
+from .transformer import (  # noqa: F401
+    CrossEntropyCriterion, TransformerConfig, TransformerModel,
+    greedy_translate, transformer_base, transformer_big, transformer_tiny,
+)
 
 __all__ = [
     "BertConfig", "BertForPretraining", "BertModel",
@@ -13,4 +17,7 @@ __all__ = [
     "ernie_base",
     "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "RMSNorm",
     "llama_tiny", "llama_7b", "llama_13b",
+    "CrossEntropyCriterion", "TransformerConfig", "TransformerModel",
+    "greedy_translate", "transformer_base", "transformer_big",
+    "transformer_tiny",
 ]
