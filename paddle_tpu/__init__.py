@@ -25,7 +25,7 @@ from .framework import (  # noqa: F401
     get_cudnn_version, is_compiled_with_cuda, is_compiled_with_tpu,
     is_compiled_with_xpu,
     is_grad_enabled, no_grad, seed, set_device, set_grad_enabled, to_tensor,
-    get_flags, set_flags,
+    get_flags, set_flags, set_printoptions, ParamAttr,
 )
 from .framework.dtype import (  # noqa: F401
     bfloat16, bool, complex64, complex128, dtype, finfo, float16, float32,
@@ -35,6 +35,8 @@ from .framework.dtype import (  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .tensor import __all__ as _tensor_all
 from .tensor import linalg  # noqa: F401  (paddle.linalg namespace)
+from .tensor.array import (  # noqa: F401
+    array_length, array_read, array_write, create_array)
 
 from . import framework  # noqa: F401
 
@@ -66,6 +68,9 @@ if "io" in globals() and hasattr(globals().get("framework"), "io"):
         pass
 if "hapi" in globals():
     from .hapi import Model, flops, summary  # noqa: F401
+    from .hapi import callbacks  # noqa: F401
+if "distributed" in globals():
+    from .distributed.parallel import DataParallel  # noqa: F401
 
 # paddle-compat mode toggles: the reference flips between dygraph and
 # static graph globally; here "static" only changes default tracing hints,
@@ -108,3 +113,23 @@ def get_default_dtype():
 
 def summary_(*a, **k):  # placeholder to avoid name clash
     raise NotImplementedError
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Parity: paddle.create_parameter (fluid/layers/tensor.py:97)."""
+    from .nn.layer.layers import create_parameter as _cp
+    return _cp(shape, dtype, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def get_cuda_rng_state():
+    """CUDA-era API (reference fluid/framework.py); maps to the seeded
+    jax key streams so checkpoint scripts round-trip."""
+    from .framework import random as _r
+    return _r.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .framework import random as _r
+    _r.set_rng_state(state)

@@ -7,7 +7,9 @@ TPU-native core: jax.Array storage, XLA memory, vjp-tape autograd.
 from . import dtype  # noqa: F401  (the module; the class is dtype.dtype)
 from . import io  # noqa: F401
 from .core import (GradNode, Tensor, enable_grad, grad, is_grad_enabled,  # noqa: F401
-                   no_grad, run_backward, set_grad_enabled, to_tensor)
+                   no_grad, run_backward, set_grad_enabled,
+                   set_printoptions, to_tensor)
+from .param_attr import ParamAttr  # noqa: F401
 # NOTE: deliberately no `from .dtype import *` — it would shadow the
 # submodule name `framework.dtype` with the dtype *class*.
 from .dtype import (bfloat16, complex64, complex128, convert_dtype, finfo,  # noqa: F401

@@ -38,8 +38,21 @@ flags.define_flag("FLAGS_eager_vjp_cache", True,
 
 __all__ = [
     "Tensor", "to_tensor", "no_grad", "enable_grad", "set_grad_enabled",
-    "is_grad_enabled", "GradNode",
+    "is_grad_enabled", "GradNode", "set_printoptions",
 ]
+
+# parity: paddle.set_printoptions (fluid/framework.py set_printoptions)
+_print_options = dict(precision=6, threshold=40, edgeitems=3,
+                      linewidth=75, sci_mode=False)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("linewidth", linewidth),
+                 ("sci_mode", sci_mode)):
+        if v is not None:
+            _print_options[k] = v
 
 _state = threading.local()
 
@@ -293,8 +306,17 @@ class Tensor:
     def __repr__(self):
         try:
             val = np.asarray(self._value)
-            body = np.array2string(val, precision=6, separator=", ",
-                                   threshold=40)
+            fmt = {}
+            if _print_options["sci_mode"] and val.dtype.kind == "f":
+                prec = _print_options["precision"]
+                fmt = {"formatter": {"float_kind":
+                       lambda v: np.format_float_scientific(
+                           v, precision=prec)}}
+            body = np.array2string(
+                val, precision=_print_options["precision"],
+                separator=", ", threshold=_print_options["threshold"],
+                edgeitems=_print_options["edgeitems"],
+                max_line_width=_print_options["linewidth"], **fmt)
         except Exception:
             body = f"<traced {self._value.aval if hasattr(self._value, 'aval') else self._value}>"
         return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
@@ -583,6 +605,15 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
                     name=op_name or getattr(fn, "__name__", "op"),
                     primal_fn=closed)
     return _wrap_outputs(out_val, node, stop_gradient=False)
+
+
+def _rebind(x: "Tensor", out: "Tensor") -> "Tensor":
+    """Eager in-place contract (the `op_` family): rebind ``x`` to the
+    freshly computed value+tape of ``out`` and return ``x`` — one
+    definition shared by every in-place variant."""
+    x._value, x._node, x._out_idx = (out._value, out._node,
+                                     getattr(out, "_out_idx", 0))
+    return x
 
 
 def _wrap_outputs(out, node, stop_gradient):

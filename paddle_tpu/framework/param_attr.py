@@ -1,0 +1,31 @@
+"""ParamAttr — per-parameter configuration.
+
+Parity: reference python/paddle/fluid/param_attr.py ParamAttr. Layers
+here resolve it duck-typed (nn/layer/common.py _resolve_init reads
+``.initializer``); the remaining fields are carried so reference
+configs round-trip: ``learning_rate`` and ``regularizer`` are consumed
+by the optimizer when it walks parameters, ``trainable=False`` maps to
+``stop_gradient``.
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    def __repr__(self):
+        return (f"ParamAttr(name={self.name!r}, "
+                f"initializer={self.initializer!r}, "
+                f"learning_rate={self.learning_rate}, "
+                f"trainable={self.trainable})")

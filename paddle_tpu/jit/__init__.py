@@ -29,7 +29,8 @@ from ..framework.random import split_key, use_key
 from ..static.input_spec import InputSpec
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
-           "TrainStep", "ignore_module", "enable_to_static"]
+           "TrainStep", "ignore_module", "enable_to_static",
+           "ProgramTranslator"]
 
 _TO_STATIC_ENABLED = True
 
@@ -588,3 +589,28 @@ class TrainStep:
             b._value = new_bufs[n]
         self._opt.load_opt_state(new_state)
         return Tensor(loss)
+
+
+class ProgramTranslator:
+    """Singleton compat shim (parity: dygraph_to_static/
+    program_translator.py:233 ProgramTranslator) — reference scripts call
+    ``ProgramTranslator().enable(False)`` to force to_static functions to
+    run eagerly; that maps directly onto :func:`enable_to_static`."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static_flag: bool):
+        enable_to_static(bool(enable_to_static_flag))
+
+    @property
+    def enable_to_static(self):
+        return _TO_STATIC_ENABLED

@@ -14,6 +14,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 
 from .layer import (activation, common, container, conv, loss, norm,  # noqa: F401
                     pooling, rnn, transformer)
@@ -32,6 +33,7 @@ from .layer.pooling import __all__ as _p
 from .layer.rnn import __all__ as _r
 from .layer.transformer import __all__ as _t
 
-__all__ = (["Layer", "Parameter", "create_parameter", "functional",
+__all__ = (["Layer", "Parameter", "create_parameter",
+            "BeamSearchDecoder", "Decoder", "dynamic_decode", "functional",
             "initializer", "ClipGradByGlobalNorm", "ClipGradByNorm",
             "ClipGradByValue"] + _a + _c + _ct + _cv + _l + _n + _p + _r + _t)

@@ -1,0 +1,156 @@
+"""Dynamic decoding: BeamSearchDecoder + dynamic_decode.
+
+Parity: reference python/paddle/fluid/layers/rnn.py (Decoder:1064,
+BeamSearchDecoder:1193, dynamic_decode:1689) and the gather_tree op.
+
+TPU-native shape: each beam step is dense math over a (batch*beam)
+leading axis — cell step, log-softmax, a single top-k over beam*vocab,
+and gathers by parent index — so every step is a handful of XLA ops;
+the host only drives the loop and the stop test (decode is eval-time;
+training uses teacher forcing through one jitted program).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad, to_tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _map_state(st, fn):
+    if isinstance(st, (tuple, list)):
+        return type(st)(_map_state(s, fn) for s in st)
+    return fn(st)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+class Decoder:
+    """Abstract stepwise decoder (parity: fluid/layers/rnn.py:1064)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (parity: rnn.py:1193).
+
+    ``cell(inputs, states) -> (out, new_states)``; ``embedding_fn`` maps
+    (batch*beam,) int ids to cell inputs; ``output_fn`` maps cell output
+    to vocab logits (e.g. the projection layer).
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -------------------------------------------------------
+    def _tile(self, v):
+        """(B, ...) -> (B*K, ...) repeating each row K times."""
+        import jax.numpy as jnp
+        v = _val(v)
+        return jnp.repeat(v, self.beam_size, axis=0)
+
+    def initialize(self, inits):
+        import jax.numpy as jnp
+        states = _map_state(inits, lambda s: self._tile(s))
+        some = states
+        while isinstance(some, (tuple, list)):
+            some = some[0]
+        b = some.shape[0] // self.beam_size
+        tokens = np.full((b, self.beam_size), self.start_token, np.int64)
+        # beam 0 live, others -inf so step 1 fans out distinct tokens
+        scores = np.full((b, self.beam_size), -1e9, np.float32)
+        scores[:, 0] = 0.0
+        finished = np.zeros((b, self.beam_size), bool)
+        return tokens, states, scores, finished
+
+    def step(self, time, tokens, states, scores, finished, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        b, k = tokens.shape
+        flat = to_tensor(tokens.reshape(-1))
+        emb = self.embedding_fn(flat) if self.embedding_fn else flat
+        out, new_states = self.cell(emb, _map_state(
+            states, lambda s: Tensor(s)), **kwargs)
+        logits = self.output_fn(out) if self.output_fn else out
+        # score update + top-k stay ON DEVICE: only the (B, K)
+        # tokens/parents/scores cross to the host, never the (B*K, V)
+        # log-prob tensor
+        logp = jax.nn.log_softmax(_val(logits), axis=-1)   # (B*K, V)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, k, v)
+        fin = jnp.asarray(finished)
+        # finished beams may only extend with <eos> at zero cost
+        fin_row = jnp.full((v,), -1e9,
+                           logp.dtype).at[self.end_token].set(0.0)
+        logp = jnp.where(fin[:, :, None], fin_row[None, None, :], logp)
+        total = jnp.asarray(scores)[:, :, None] + logp     # (B, K, V)
+        new_scores_d, top = jax.lax.top_k(total.reshape(b, k * v), k)
+        parent_d = top // v
+        new_tokens_d = top % v
+        gidx = jnp.arange(b)[:, None] * k + parent_d       # (B, K)
+
+        def g(s):
+            return jnp.take(_val(s), gidx.reshape(-1), axis=0)
+        new_states = _map_state(new_states, g)
+        new_scores = np.asarray(new_scores_d)
+        parent = np.asarray(parent_d).astype(np.int64)
+        new_tokens = np.asarray(new_tokens_d).astype(np.int64)
+        new_finished = np.take_along_axis(finished, parent, 1) | (
+            new_tokens == self.end_token)
+        return new_tokens, parent, new_states, new_scores, new_finished
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 100, output_time_major=False,
+                   **kwargs):
+    """Run the decoder until every beam finishes or ``max_step_num``
+    (parity: rnn.py:1689). Returns ``(ids, sequence_lengths)`` with
+    ids (B, K, T) (or (T, B, K) when time-major), best beam first,
+    back-traced through the parent pointers with gather_tree semantics.
+    """
+    with no_grad():
+        tokens, states, scores, finished = decoder.initialize(inits)
+        b, k = tokens.shape
+        step_tokens, step_parents = [], []
+        for t in range(max_step_num):
+            tokens, parent, states, scores, finished = decoder.step(
+                t, tokens, states, scores, finished, **kwargs)
+            step_tokens.append(tokens)
+            step_parents.append(parent)
+            if finished.all():
+                break
+        T = len(step_tokens)
+        ids = np.stack(step_tokens)                   # (T, B, K)
+        parents = np.stack(step_parents)
+        # host back-trace (same algorithm as F.gather_tree)
+        beams = np.broadcast_to(np.arange(k), (b, k)).copy()
+        out = np.empty_like(ids)
+        for t in range(T - 1, -1, -1):
+            out[t] = np.take_along_axis(ids[t], beams, 1)
+            beams = np.take_along_axis(parents[t], beams, 1)
+        eos = decoder.end_token
+        seq_len = np.full((b, k), T, np.int64)
+        for t in range(T - 1, -1, -1):
+            seq_len = np.where(out[t] == eos, t + 1, seq_len)
+        if not output_time_major:
+            out = out.transpose(1, 2, 0)              # (B, K, T)
+        return to_tensor(out), to_tensor(seq_len)

@@ -18,6 +18,7 @@ __all__ = [
     "softshrink", "tanhshrink", "leaky_relu", "prelu", "rrelu", "tanh",
     "softmax", "log_softmax", "softplus", "softsign", "logsigmoid",
     "maxout", "thresholded_relu", "glu", "gumbel_softmax", "tanh_",
+    "log_sigmoid", "elu_", "softmax_",
 ]
 
 
@@ -26,9 +27,8 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    out = relu(x)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ...framework.core import _rebind
+    return _rebind(x, relu(x))
 
 
 def relu6(x, name=None):
@@ -133,9 +133,8 @@ def tanh(x, name=None):
 
 
 def tanh_(x, name=None):
-    out = tanh(x)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ...framework.core import _rebind
+    return _rebind(x, tanh(x))
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
@@ -201,3 +200,17 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             return onehot + y - jax.lax.stop_gradient(y)
         return y
     return _apply(f, x, op_name="gumbel_softmax")
+
+
+def log_sigmoid(x, name=None):
+    return _apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...framework.core import _rebind
+    return _rebind(x, elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...framework.core import _rebind
+    return _rebind(x, softmax(x, axis, dtype))

@@ -14,6 +14,7 @@ from ...framework.random import split_key
 
 __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "bilinear", "diag_embed", "gather_tree",
     "embedding", "one_hot", "pad", "zeropad2d", "interpolate", "upsample",
     "batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm",
     "normalize", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
@@ -508,3 +509,61 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap = {c: i for i, c in enumerate(sampled)}
     remapped = np.array([remap[v] for v in lab], np.int32)
     return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled.astype(np.int32))))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, k] = x1[b, :] W[k] x2[b, :] (+ bias) (parity:
+    nn/functional/common.py bilinear, BilinearTensorProduct kernel) —
+    one einsum, MXU-friendly."""
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return _apply(f, *args, op_name="bilinear")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched vectors -> batched diagonal matrices (parity:
+    nn/functional/extension.py diag_embed)."""
+    from ...tensor.creation import to_tensor as _tt
+    x = input if hasattr(input, "_value") else _tt(input)
+
+    def f(v):
+        last = v.shape[-1]
+        n = last + abs(offset)
+        out_shape = v.shape[:-1] + (n, n)
+        d = jnp.zeros(out_shape, v.dtype)
+        idx = jnp.arange(last)
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        d = d.at[..., rows, cols].set(v)
+        # move the two diagonal dims into position
+        nd = d.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dest, src in order:
+            perm.insert(dest, src)
+        return jnp.transpose(d, perm)
+    return _apply(f, x, op_name="diag_embed")
+
+
+def gather_tree(ids, parents):
+    """Back-trace beam-search parent pointers into full sequences
+    (parity: operators/gather_tree_op.cc, used by nn.dynamic_decode).
+    ``ids``/``parents``: (T, B, beam)."""
+    def f(i, p):
+        T = i.shape[0]
+
+        def step(carry, xs):
+            beams = carry            # (B, beam) beam indices at t+1
+            ids_t, par_t = xs        # each (B, beam)
+            out = jnp.take_along_axis(ids_t, beams, axis=-1)
+            prev = jnp.take_along_axis(par_t, beams, axis=-1)
+            return prev, out
+        init = jnp.broadcast_to(jnp.arange(i.shape[-1]), i.shape[1:])
+        _, rev = jax.lax.scan(step, init, (i[::-1], p[::-1]))
+        return rev[::-1]
+    return _apply(f, ids, parents, op_name="gather_tree")

@@ -15,6 +15,7 @@ __all__ = [
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
     "log_loss", "npair_loss", "sigmoid_focal_loss", "dice_loss",
+    "hsigmoid_loss",
 ]
 
 
@@ -318,3 +319,64 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(nll / jnp.maximum(tl.astype(nll.dtype), 1.0))
         return _reduce(nll, reduction)
     return _apply(f, log_probs, op_name="ctc_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (parity: operators/hierarchical_sigmoid_op.*
+    and nn/functional/loss.py hsigmoid_loss). Returns (batch, 1) costs.
+
+    Default (complete binary tree over ``num_classes`` in heap layout):
+    each label's root->leaf path derives from its index with a fixed
+    ``ceil(log2(C))`` unroll, so the whole loss is dense gathers + dot
+    products — static shapes, jit-able. A custom tree passes
+    ``path_table``/``path_code`` (batch, path_len), -1 padded.
+    ``is_sparse`` is accepted for config parity (gathers already touch
+    only the rows on the paths).
+    """
+    import math as _math
+    nc = int(num_classes)
+
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid_loss custom-tree mode needs BOTH path_table and "
+            "path_code (got only one)")
+    if path_table is not None:
+        def f(x, tbl, code, w, *rest):
+            b = rest[0] if rest else None
+            valid = tbl >= 0
+            idx = jnp.maximum(tbl, 0)
+            logits = jnp.einsum("bf,blf->bl", x, w[idx])
+            if b is not None:
+                logits = logits + b[idx]
+            t = code.astype(x.dtype)
+            ll = (jnp.maximum(logits, 0) - logits * t
+                  + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+            return jnp.sum(jnp.where(valid, ll, 0.0), -1, keepdims=True)
+        args = (input, path_table, path_code, weight) + (
+            (bias,) if bias is not None else ())
+        return _apply(f, *args, op_name="hsigmoid_loss")
+
+    depth = max(1, _math.ceil(_math.log2(max(nc, 2))))
+
+    def f(x, lb, w, *rest):
+        b = rest[0] if rest else None
+        h = lb.astype(jnp.int32).reshape(-1) + (nc - 1)  # heap leaf
+        total = jnp.zeros((x.shape[0], 1), x.dtype)
+        for _ in range(depth + 1):
+            valid = h > 0
+            parent = jnp.maximum((h - 1) // 2, 0)
+            is_right = (h % 2 == 0)
+            logits = jnp.einsum("bf,bf->b", x, w[parent])
+            if b is not None:
+                logits = logits + b[parent]
+            t = is_right.astype(x.dtype)
+            ll = (jnp.maximum(logits, 0) - logits * t
+                  + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+            total = total + jnp.where(valid, ll, 0.0)[:, None]
+            h = parent
+        return total
+
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return _apply(f, *args, op_name="hsigmoid_loss")

@@ -43,13 +43,22 @@ class Parameter(Tensor):
 
 def create_parameter(shape, dtype="float32", initializer=None,
                      is_bias=False, attr=None, default_initializer=None):
-    import jax.numpy as jnp
-    init = initializer or default_initializer
+    init = (initializer or getattr(attr, "initializer", None)
+            or default_initializer)
     if init is None:
         from ..initializer import Constant, XavierNormal
         init = Constant(0.0) if is_bias else XavierNormal()
     value = init(tuple(int(s) for s in shape), dtypes.to_jax(dtype))
-    return Parameter(value)
+    p = Parameter(value,
+                  trainable=getattr(attr, "trainable", True),
+                  name=getattr(attr, "name", None) or "")
+    # per-parameter optimizer attributes (reference ParamAttr contract):
+    # the optimizer multiplies its lr by optimize_attr["learning_rate"]
+    # and a param-level regularizer overrides the optimizer-level decay
+    p.optimize_attr = {"learning_rate":
+                       getattr(attr, "learning_rate", 1.0)}
+    p.regularizer = getattr(attr, "regularizer", None)
+    return p
 
 
 class Layer:
@@ -139,7 +148,7 @@ class Layer:
 
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
                          default_initializer=None):
-        return create_parameter(shape, dtype or self._dtype,
+        return create_parameter(shape, dtype or self._dtype, attr=attr,
                                 is_bias=is_bias,
                                 default_initializer=default_initializer)
 

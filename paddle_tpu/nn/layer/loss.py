@@ -7,7 +7,7 @@ from .layers import Layer
 __all__ = ["CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
            "L1Loss", "MSELoss", "SmoothL1Loss", "KLDivLoss", "CTCLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
-           "TripletMarginLoss"]
+           "TripletMarginLoss", "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -154,3 +154,38 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (parity: nn/layer/loss.py HSigmoidLoss) —
+    owns the (num_classes-1, feature) internal-node table; see
+    F.hsigmoid_loss for the tree semantics."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for hsigmoid")
+        from .common import _resolve_init
+        from ..initializer import XavierUniform, Constant
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        w_init = _resolve_init(weight_attr, XavierUniform())
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], default_initializer=w_init)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = _resolve_init(bias_attr, Constant(0.0))
+            self.bias = self.create_parameter(
+                [num_classes - 1], default_initializer=b_init,
+                is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and path_table is None:
+            raise ValueError("is_custom=True needs path_table/path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code,
+                               self.is_sparse)

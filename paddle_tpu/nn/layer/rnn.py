@@ -20,8 +20,8 @@ from .. import functional as F
 from ..initializer import Uniform
 from .layers import Layer, Parameter
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
-           "SimpleRNN", "LSTM", "GRU"]
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU"]
 
 
 class RNNCellBase(Layer):

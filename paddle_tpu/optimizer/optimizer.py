@@ -97,21 +97,26 @@ class Optimizer:
             sid = id(p)
             if sid not in self._accumulators:
                 self._accumulators[sid] = self._create_state(p)
+            # ParamAttr contract: per-param lr multiplier; a param-level
+            # regularizer overrides the optimizer-level weight decay
+            lr_mult = getattr(p, "optimize_attr",
+                              {}).get("learning_rate", 1.0)
+            plr = lr if lr_mult == 1.0 else lr * lr_mult
+            decay = getattr(p, "regularizer", None) or self._weight_decay
             if isinstance(g, SelectedRows):
                 sr = g.merge()
                 vals = sr.values
-                if self._weight_decay is not None:
+                if decay is not None:
                     # lazy semantics: decay only the touched rows
-                    vals = self._weight_decay.apply_gradient(
-                        p._value[sr.rows], vals)
+                    vals = decay.apply_gradient(p._value[sr.rows], vals)
                 new_p, new_state = self._sparse_update(
-                    p._value, sr.rows, vals, lr, self._accumulators[sid])
+                    p._value, sr.rows, vals, plr, self._accumulators[sid])
             else:
                 gv = g._value if isinstance(g, Tensor) else g
-                if self._weight_decay is not None:
-                    gv = self._weight_decay.apply_gradient(p._value, gv)
+                if decay is not None:
+                    gv = decay.apply_gradient(p._value, gv)
                 new_p, new_state = self._jit_update(
-                    p._value, gv, lr, self._accumulators[sid])
+                    p._value, gv, plr, self._accumulators[sid])
             p._value = new_p
             self._accumulators[sid] = new_state
         self._global_step += 1

@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import operator as _operator
 
-from ..framework.core import Tensor, to_tensor
+from ..framework.core import Tensor, set_printoptions, to_tensor
 
-from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from . import array, creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from .array import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .linalg import *  # noqa: F401,F403
@@ -23,7 +24,8 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import std, var, nanmean, nansum  # noqa: F401
 
-__all__ = (creation.__all__ + linalg.__all__ + logic.__all__ +
+__all__ = (array.__all__ + ["set_printoptions"] +
+           creation.__all__ + linalg.__all__ + logic.__all__ +
            manipulation.__all__ + math.__all__ + random.__all__ +
            search.__all__ + ["std", "var", "nanmean", "nansum", "einsum"])
 

@@ -1,0 +1,55 @@
+"""LoDTensorArray API (parity: python/paddle/tensor/array.py —
+create_array / array_read / array_write / array_length).
+
+The reference backs these with a C++ LoDTensorArray variable inside the
+Program; eagerly they are just a Python list of Tensors, which is also
+what ``static.nn.while_loop`` carries through ``lax`` loops when every
+write uses a static index (the traced-IR design: an array whose length
+changes data-dependently inside jit must instead be a pre-allocated
+tensor stacked over the loop axis — see ops in lax.scan)."""
+from __future__ import annotations
+
+from ..framework.core import Tensor, to_tensor
+
+__all__ = ["create_array", "array_read", "array_write", "array_length"]
+
+
+def _idx(i) -> int:
+    if isinstance(i, Tensor):
+        import numpy as np
+        return int(np.asarray(i._value))
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = []
+    if initialized_list is not None:
+        for x in initialized_list:
+            arr.append(x if isinstance(x, Tensor) else to_tensor(x))
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    i = _idx(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {i} past the end of the array "
+            f"(len {len(array)}); the reference zero-fills, which hides "
+            f"bugs — write sequentially instead")
+    return array
+
+
+def array_read(array, i):
+    return array[_idx(i)]
+
+
+def array_length(array):
+    import numpy as np
+    return to_tensor(np.int64(len(array)))

@@ -12,7 +12,7 @@ from .math import matmul  # re-export home
 
 __all__ = [
     "matmul", "dot", "bmm", "mm", "t", "norm", "dist", "cond",
-    "cholesky", "inv", "pinv", "det", "slogdet", "matrix_power",
+    "cholesky", "inv", "inverse", "pinv", "det", "slogdet", "matrix_power",
     "matrix_rank", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
     "solve", "triangular_solve", "cholesky_solve", "lstsq", "lu", "mv",
     "multi_dot", "cross", "histogram", "bincount", "corrcoef", "cov",
@@ -215,3 +215,6 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return _apply(lambda v: jnp.cov(v, rowvar=rowvar,
                                     ddof=1 if ddof else 0),
                   _t(x), op_name="cov")
+
+
+inverse = inv  # parity: paddle.inverse

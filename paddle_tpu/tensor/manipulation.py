@@ -20,7 +20,8 @@ from ..framework.core import Tensor, _apply, to_tensor
 __all__ = [
     "reshape", "reshape_", "flatten", "transpose", "squeeze", "unsqueeze",
     "concat", "stack", "split", "chunk", "unstack", "tile", "expand",
-    "expand_as", "broadcast_to", "flip", "roll", "gather", "gather_nd",
+    "expand_as", "broadcast_to", "flip", "reverse", "roll", "gather",
+    "gather_nd", "scatter_", "rank", "shape",
     "scatter", "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
     "take_along_axis", "put_along_axis", "slice", "strided_slice", "crop",
     "unique", "unique_consecutive", "unbind", "repeat_interleave",
@@ -53,9 +54,8 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ..framework.core import _rebind
+    return _rebind(x, reshape(x, shape))
 
 
 def view(x, shape_or_dtype, name=None):
@@ -102,9 +102,8 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    out = squeeze(x, axis)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ..framework.core import _rebind
+    return _rebind(x, squeeze(x, axis))
 
 
 def unsqueeze(x, axis, name=None):
@@ -120,9 +119,8 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ..framework.core import _rebind
+    return _rebind(x, unsqueeze(x, axis))
 
 
 def concat(x, axis=0, name=None):
@@ -412,3 +410,34 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         in_shard = (v // shard_size) == shard_id
         return jnp.where(in_shard, v % shard_size, ignore_value)
     return _apply(f, _t(input), op_name="shard_index")
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (parity: fluid.layers.reverse / paddle.reverse)."""
+    return flip(x, axis, name=name)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (parity: paddle.scatter_) — eager semantics:
+    ``x`` is rebound to the scattered value and returned."""
+    from ..framework.core import _rebind
+    return _rebind(x, scatter(x, index, updates, overwrite=overwrite))
+
+
+def rank(input, name=None):
+    """0-D int32 tensor holding the number of dimensions (parity:
+    paddle.rank / fluid.layers.rank)."""
+    import numpy as np
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return _apply(lambda: jnp.asarray(np.int32(v.ndim)),
+                  op_name="rank")
+
+
+def shape(input, name=None):
+    """1-D int32 tensor holding the (static) shape (parity: paddle.shape
+    — under XLA shapes are compile-time constants, so this is a constant
+    tensor, which is exactly what traced control flow needs)."""
+    import numpy as np
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return _apply(lambda: jnp.asarray(np.asarray(v.shape, np.int32)),
+                  op_name="shape")

@@ -15,7 +15,7 @@ from ..framework import dtype as dtypes
 from ..framework.core import Tensor, _apply, to_tensor
 
 __all__ = [
-    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "floor_mod", "tanh_",
     "remainder", "pow", "matmul", "maximum", "minimum", "fmax", "fmin",
     "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
     "rsqrt", "square", "sign", "floor", "ceil", "round", "trunc",
@@ -58,9 +58,8 @@ def multiply(x, y, name=None):
 
 
 def multiply_(x, y, name=None):
-    out = multiply(x, y)
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ..framework.core import _rebind
+    return _rebind(x, multiply(x, y))
 
 
 def divide(x, y, name=None):
@@ -458,9 +457,8 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    out = _apply(lambda v: v + value, x, op_name="increment")
-    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
-    return x
+    from ..framework.core import _rebind
+    return _rebind(x, _apply(lambda v: v + value, x, op_name="increment"))
 
 
 def multiplex(inputs, index, name=None):
@@ -527,3 +525,12 @@ def lerp(x, y, weight, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+floor_mod = mod  # parity: paddle.floor_mod is an alias of mod/remainder
+
+
+def tanh_(x, name=None):
+    """In-place tanh (parity: paddle.tanh_); eager rebinding semantics."""
+    from ..framework.core import _rebind
+    return _rebind(x, tanh(x))

@@ -1,0 +1,255 @@
+"""Round-2 API-parity additions (found by auditing the reference's
+import surface): beam search decode, hsigmoid, bilinear/diag_embed/
+gather_tree, tensor array ops, inplace variants, ParamAttr and other
+top-level exports."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+# ------------------------------------------------------------- beam search
+class _BigramCell(nn.RNNCellBase):
+    """Deterministic 'LM': logits depend only on the previous token via a
+    fixed bigram table — lets a brute-force search define ground truth."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = paddle.to_tensor(table.astype(np.float32))
+
+    def forward(self, inputs, states=None):
+        import jax.numpy as jnp
+        from paddle_tpu.framework.core import _apply
+        # inputs: (B,) previous token ids; states: (B, 1) dummy
+        out = _apply(lambda t, i: t[i.astype(jnp.int32)], self.table,
+                     inputs, op_name="bigram")
+        return out, states
+
+
+def _brute_force_best(table, start, end, steps):
+    """Exhaustive max-logprob path of length <= steps."""
+    import itertools
+
+    def logp(tok_seq):
+        import scipy.special as sp
+        lp, prev, total = None, start, 0.0
+        for t in tok_seq:
+            row = table[prev]
+            total += row[t] - sp.logsumexp(row)
+            if t == end:
+                break
+            prev = t
+        return total
+    best, best_lp = None, -1e18
+    V = table.shape[1]
+    for seq in itertools.product(range(V), repeat=steps):
+        # truncate at first eos for fairness
+        if end in seq:
+            seq = seq[:seq.index(end) + 1]
+        lp = logp(seq)
+        if lp > best_lp:
+            best_lp, best = lp, seq
+    return list(best)
+
+
+def test_beam_search_matches_brute_force():
+    rng = np.random.default_rng(0)
+    V, start, end = 5, 0, 4
+    table = rng.normal(size=(V, V)).astype(np.float32) * 2.0
+    cell = _BigramCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=start, end_token=end,
+                               beam_size=V)  # full-width = exact search
+    init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+    ids, lens = nn.dynamic_decode(dec, inits=init, max_step_num=3)
+    got = list(np.asarray(ids.numpy())[0, 0, :int(lens.numpy()[0, 0])])
+    want = _brute_force_best(table, start, end, 3)
+    assert got == want, (got, want)
+
+
+def test_dynamic_decode_batch_and_eos_lengths():
+    V, start, end = 4, 0, 3
+    table = np.full((V, V), -5.0, np.float32)
+    table[:, end] = 5.0      # every path wants to emit eos immediately
+    cell = _BigramCell(table)
+    dec = nn.BeamSearchDecoder(cell, start, end, beam_size=2)
+    init = paddle.to_tensor(np.zeros((3, 1), np.float32))
+    ids, lens = nn.dynamic_decode(dec, inits=init, max_step_num=5)
+    assert ids.shape[0] == 3 and ids.shape[1] == 2
+    # best beam emits eos immediately; the runner-up beam (forced to a
+    # different first token by the fan-out) ends one step later
+    lens = np.asarray(lens.numpy())
+    assert (lens[:, 0] == 1).all(), lens
+    assert (lens[:, 1] == 2).all(), lens
+
+
+def test_gather_tree_backtrace():
+    # T=3, B=1, beam=2: parent pointers reorder the history
+    ids = paddle.to_tensor(np.array(
+        [[[2, 3]], [[4, 5]], [[6, 7]]], np.int64))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]], [[1, 0]]], np.int64))
+    out = np.asarray(F.gather_tree(ids, parents).numpy())
+    # beam 0 at t=2 came from beam 1 at t=1, which came from beam 0
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 5, 6])
+    np.testing.assert_array_equal(out[:, 0, 1], [3, 4, 7])
+
+
+# ---------------------------------------------------------------- hsigmoid
+def test_hsigmoid_trains_small_classifier():
+    rng = np.random.default_rng(0)
+    nfeat, ncls = 8, 6
+    layer = nn.HSigmoidLoss(nfeat, ncls)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    x = rng.normal(size=(64, nfeat)).astype(np.float32)
+    y = (np.abs(x[:, :1]).astype(np.int64) * 0 +
+         rng.integers(0, ncls, (64, 1)))
+    # learnable signal: class determined by argmax of first ncls feats
+    y = x[:, :ncls].argmax(1, keepdims=True).astype(np.int64)
+    first = last = None
+    for _ in range(60):
+        loss = layer(paddle.to_tensor(x),
+                     paddle.to_tensor(y)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first * 0.5, (first, last)
+
+
+def test_hsigmoid_custom_path():
+    nfeat, ncls = 4, 4
+    layer = nn.HSigmoidLoss(nfeat, ncls, is_custom=True)
+    x = paddle.to_tensor(np.ones((2, nfeat), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 1), np.int64))
+    table = paddle.to_tensor(np.array([[0, 1, -1], [2, -1, -1]], np.int64))
+    code = paddle.to_tensor(np.array([[1, 0, 0], [0, 0, 0]], np.int64))
+    out = layer(x, y, path_table=table, path_code=code)
+    assert out.shape == [2, 1]
+    with pytest.raises(ValueError, match="path_table"):
+        layer(x, y)
+
+
+# ------------------------------------------------------- small functionals
+def test_bilinear():
+    rng = np.random.default_rng(0)
+    x1 = rng.normal(size=(3, 4)).astype(np.float32)
+    x2 = rng.normal(size=(3, 5)).astype(np.float32)
+    w = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    got = np.asarray(F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                                paddle.to_tensor(w),
+                                paddle.to_tensor(b)).numpy())
+    want = np.einsum("bi,kij,bj->bk", x1, w, x2) + b
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_diag_embed():
+    v = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = np.asarray(F.diag_embed(paddle.to_tensor(v)).numpy())
+    assert out.shape == (2, 3, 3)
+    np.testing.assert_allclose(out[0], np.diag(v[0]))
+    off = np.asarray(F.diag_embed(paddle.to_tensor(v), offset=1).numpy())
+    assert off.shape == (2, 4, 4)
+    np.testing.assert_allclose(off[1], np.diag(v[1], k=1))
+
+
+def test_log_sigmoid_and_inplace_variants():
+    x = np.array([-1.0, 0.0, 2.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.log_sigmoid(paddle.to_tensor(x)).numpy()),
+        np.log(1 / (1 + np.exp(-x))), rtol=1e-5)
+    t = paddle.to_tensor(x.copy())
+    F.softmax_(t)
+    np.testing.assert_allclose(np.asarray(t.numpy()).sum(), 1.0, rtol=1e-5)
+    t2 = paddle.to_tensor(x.copy())
+    F.elu_(t2)
+    assert float(t2.numpy()[0]) < 0
+
+
+# ------------------------------------------------------------ audit gate
+def test_reference_import_surface_nearly_complete():
+    """Mechanical parity gate: names the reference's package __init__
+    imports must exist here, minus documented exclusions."""
+    import ast, os
+
+    EXCLUDED = {
+        # internal monkey-patch machinery, not user API
+        "monkey_patch_math_varbase", "monkey_patch_variable",
+        "print_function",
+        # nn namespace modules that are pure re-export shims upstream
+        "extension", "vision", "weight_norm_hook",
+        # fluid-era in-place that the reference itself removed later
+    }
+
+    def imported(path):
+        names = set()
+        for node in ast.parse(open(path).read()).body:
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name != "*":
+                        names.add(a.asname or a.name)
+        return {n for n in names if not n.startswith("_")}
+
+    ref = "/root/reference/python/paddle"
+    if not os.path.isdir(ref):
+        pytest.skip("reference tree not mounted")
+    import paddle_tpu.tensor
+    for rel, obj in [("__init__.py", paddle),
+                     ("nn/__init__.py", nn),
+                     ("nn/functional/__init__.py", F),
+                     ("tensor/__init__.py", paddle.tensor)]:
+        want = imported(os.path.join(ref, rel)) - EXCLUDED
+        missing = sorted(n for n in want if not hasattr(obj, n))
+        assert not missing, (rel, missing)
+
+
+# ------------------------------------------------------------- param attr
+def test_param_attr_trainable_and_lr_and_regularizer():
+    import paddle_tpu.regularizer as reg
+    frozen = paddle.create_parameter(
+        [2, 2], attr=paddle.ParamAttr(trainable=False))
+    assert frozen.stop_gradient
+    slow = paddle.create_parameter(
+        [1], attr=paddle.ParamAttr(learning_rate=0.1))
+    fast = paddle.create_parameter(
+        [1], attr=paddle.ParamAttr(learning_rate=1.0))
+    import jax.numpy as jnp
+    slow._value = jnp.zeros((1,)); fast._value = jnp.zeros((1,))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[slow, fast])
+    g = paddle.to_tensor(np.ones((1,), np.float32))
+    slow.grad = g; fast.grad = g
+    opt.step()
+    np.testing.assert_allclose(np.asarray(slow._value), [-0.1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fast._value), [-1.0], rtol=1e-6)
+    # param-level regularizer overrides optimizer-level decay
+    p = paddle.create_parameter(
+        [1], attr=paddle.ParamAttr(regularizer=reg.L2Decay(0.5)))
+    p._value = jnp.ones((1,))
+    opt2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                weight_decay=0.0)
+    p.grad = paddle.to_tensor(np.zeros((1,), np.float32))
+    opt2.step()
+    # grad 0 + 0.5 * w decay -> w = 1 - 0.5
+    np.testing.assert_allclose(np.asarray(p._value), [0.5], rtol=1e-6)
+
+
+def test_hsigmoid_missing_path_code_clear_error():
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 1), np.int64))
+    w = paddle.to_tensor(np.ones((3, 4), np.float32))
+    tbl = paddle.to_tensor(np.zeros((2, 2), np.int64))
+    with pytest.raises(ValueError, match="BOTH"):
+        F.hsigmoid_loss(x, y, 4, w, path_table=tbl)
+
+
+def test_set_printoptions_sci_mode():
+    paddle.set_printoptions(sci_mode=True, precision=2)
+    try:
+        assert "e" in repr(paddle.to_tensor([1234.5]))
+    finally:
+        paddle.set_printoptions(sci_mode=False, precision=6)

