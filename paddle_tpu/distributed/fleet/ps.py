@@ -43,8 +43,14 @@ class SparseTable:
                  lr: float = 0.01, seed: int = 0, init_std: float = 0.01,
                  backend: str = "auto", n_shards: int = 32,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-10):
+                 epsilon: float = 1e-10, entry=None):
         self.dim = dim
+        # feature admission (reference entry_attr.py): ids the entry has
+        # not admitted pull zeros and drop their grads — no row memory
+        self._entry = entry
+        self._admitted: set = set()
+        self._admitted_arr = None   # np.int64 snapshot for np.isin
+        self._seen: Dict[int, int] = {}
         self._opt = optimizer
         self._lr = lr
         self._native = None
@@ -85,9 +91,52 @@ class SparseTable:
         import ctypes
         return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
+    def _filter_admitted(self, ids: np.ndarray, counting: bool):
+        """Boolean admitted-mask for ``ids``; pulls count as sightings
+        for count-based entries. Steady state (all ids admitted) is one
+        vectorized np.isin — no per-id Python work on the hot path."""
+        arr = self._admitted_arr
+        if arr is None or arr.size != len(self._admitted):
+            arr = self._admitted_arr = np.fromiter(
+                self._admitted, np.int64, len(self._admitted))
+        mask = np.isin(ids, arr)
+        if mask.all():
+            return mask
+        # count-independent entries (ProbabilityEntry) must not leave
+        # per-id counters behind for permanently rejected ids
+        counting = counting and getattr(self._entry, "needs_count", True)
+        newly = False
+        with self._lock:
+            for i in np.flatnonzero(~mask):
+                k = int(ids[i])
+                if k in self._admitted:    # raced in since isin snapshot
+                    mask[i] = True
+                    continue
+                if counting:
+                    self._seen[k] = self._seen.get(k, 0) + 1
+                if self._entry.admit(k, self._seen.get(k, 0)):
+                    self._admitted.add(k)
+                    self._seen.pop(k, None)
+                    mask[i] = True
+                    newly = True
+        if newly:
+            self._admitted_arr = None   # rebuild the fast-path snapshot
+        return mask
+
     def pull(self, ids: np.ndarray) -> np.ndarray:
         import ctypes
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        if self._entry is not None:
+            mask = self._filter_admitted(ids, counting=True)
+            out = np.zeros((ids.size, self.dim), np.float32)
+            if mask.any():
+                out[mask] = self._pull_admitted(ids[mask])
+            return out
+        return self._pull_admitted(ids)
+
+    def _pull_admitted(self, ids: np.ndarray) -> np.ndarray:
+        import ctypes
+        ids = np.ascontiguousarray(ids, np.int64)
         out = np.empty((ids.size, self.dim), np.float32)
         if self._native is not None:
             self._lib.pts_pull(self._native, self._c(ids, ctypes.c_int64),
@@ -106,6 +155,15 @@ class SparseTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(ids.size, self.dim))
+        if self._entry is not None:
+            # grads for never-admitted ids are dropped (their pulled
+            # zeros carried no signal anyway) — reference show-click
+            # filter semantics; pushes do not count as sightings
+            mask = self._filter_admitted(ids, counting=False)
+            if not mask.any():
+                return
+            if not mask.all():
+                ids, grads = ids[mask], grads[mask]
         if self._native is not None:
             self._lib.pts_push(self._native, self._c(ids, ctypes.c_int64),
                                ids.size, self._c(grads, ctypes.c_float))

@@ -1,0 +1,186 @@
+"""Round-2 static compat surface + sparse-table admission entries.
+
+Parity: python/paddle/static/__init__.py import list (BuildStrategy,
+Scope, Print, py_func, accuracy/auc, gradients/append_backward,
+program save/load) and distributed/entry_attr.py (ProbabilityEntry,
+CountFilterEntry consumed by fleet.ps.SparseTable).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed import CountFilterEntry, ProbabilityEntry
+from paddle_tpu.distributed.fleet.ps import SparseTable
+
+
+# --------------------------------------------------------------- static
+def test_build_and_execution_strategy_holders():
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True   # knob accepted
+    es = static.ExecutionStrategy()
+    es.num_threads = 4
+    pe = static.ParallelExecutor(build_strategy=bs, exec_strategy=es)
+    assert pe.run(fetch_list=[]) == []
+
+
+def test_scope_and_guard():
+    s = static.Scope()
+    v = s.var("x")
+    assert s.find_var("x") is v and s.find_var("missing") is None
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+    assert static.global_scope() is not s
+
+
+def test_variable_is_tensor_alias():
+    assert isinstance(paddle.to_tensor([1.0]), static.Variable)
+
+
+def test_print_passes_value_through(capfd):
+    x = paddle.to_tensor(np.asarray([1.5], np.float32))
+    y = static.Print(x, message="dbg")
+    np.testing.assert_allclose(np.asarray(y.numpy()), [1.5])
+
+
+def test_py_func_eager_and_traced():
+    def host_op(a):
+        return (a * 2).astype(np.float32)
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    tmpl = paddle.to_tensor(np.zeros((3,), np.float32))
+    out = static.py_func(host_op, x, tmpl)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 2 * np.ones(3))
+
+    import jax
+    import jax.numpy as jnp
+    # traced: pure_callback path must compile
+    f = jax.jit(lambda v: static.py_func(
+        host_op, paddle.Tensor(v), tmpl)._value)
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2 * np.ones(3))
+
+
+def test_accuracy_and_auc_ops():
+    pred = paddle.to_tensor(np.asarray(
+        [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+    lab = paddle.to_tensor(np.asarray([[1], [0], [0]], np.int64))
+    acc = float(static.accuracy(pred, lab).numpy())
+    np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
+    auc = float(static.auc(pred, lab).numpy())
+    # class-1 scores: pos {0.9} beats both negs {0.2, 0.7} -> AUC 1.0
+    np.testing.assert_allclose(auc, 1.0, rtol=1e-6)
+    # and a mid case: pos {0.2} beats 0 of 2 negs -> AUC 0.0
+    lab2 = paddle.to_tensor(np.asarray([[0], [1], [0]], np.int64))
+    np.testing.assert_allclose(float(static.auc(pred, lab2).numpy()),
+                               0.0, atol=1e-6)
+
+
+def test_gradients_and_append_backward():
+    x = paddle.to_tensor(np.asarray([2.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 2).sum()
+    (g,) = static.gradients(y, x)
+    np.testing.assert_allclose(np.asarray(g._value), [4.0])
+
+
+def test_gradients_multi_target_sums_per_input():
+    x = paddle.to_tensor(np.asarray([2.0], np.float32),
+                         stop_gradient=False)
+    y1 = (x ** 2).sum()    # d/dx = 4
+    y2 = (3.0 * x).sum()   # d/dx = 3
+    outs = static.gradients([y1, y2], x)
+    assert len(outs) == 1   # ONE grad per input, summed over targets
+    np.testing.assert_allclose(np.asarray(outs[0]._value), [7.0])
+    # per-target seeds
+    outs = static.gradients(
+        [y1, y2], x,
+        target_gradients=[paddle.to_tensor(np.asarray(2.0, np.float32)),
+                          paddle.to_tensor(np.asarray(10.0, np.float32))])
+    np.testing.assert_allclose(np.asarray(outs[0]._value),
+                               [2 * 4.0 + 10 * 3.0])
+    with pytest.raises(ValueError, match="match targets"):
+        static.gradients([y1, y2], x, target_gradients=[
+            paddle.to_tensor(np.asarray(1.0, np.float32))])
+
+
+def test_print_message_with_braces_does_not_crash():
+    x = paddle.to_tensor(np.asarray([1.0], np.float32))
+    y = static.Print(x, message="step {i} {weird}")
+    np.testing.assert_allclose(np.asarray(y.numpy()), [1.0])
+
+
+def test_probability_entry_leaves_no_counters():
+    t = SparseTable(4, backend="python", entry=ProbabilityEntry(0.01))
+    t.pull(np.arange(1000, dtype=np.int64))
+    assert len(t._seen) == 0   # rejected ids must not leak counters
+
+
+def test_program_save_load_roundtrip(tmp_path):
+    import paddle_tpu.static.nn as snn
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = snn.fc(x, size=4, name="fc_rt")
+    params = prog.all_parameters()
+    orig = [np.asarray(p._value).copy() for p in params]
+    static.save(prog, str(tmp_path / "model"))
+    for p in params:   # clobber
+        p._value = p._value * 0.0
+    static.load(prog, str(tmp_path / "model"))
+    for p, o in zip(params, orig):
+        np.testing.assert_allclose(np.asarray(p._value), o)
+
+
+def test_desc_serialization_fails_loudly():
+    with pytest.raises(static.UnsupportedProgramSurgery, match="jit.save"):
+        static.deserialize_program(b"")
+    with pytest.raises(static.UnsupportedProgramSurgery):
+        static.normalize_program(static.Program(), [], [])
+
+
+def test_places():
+    assert len(static.cpu_places(2)) == 2
+
+
+# --------------------------------------------------------------- entries
+def test_count_filter_entry_admits_after_threshold():
+    t = SparseTable(4, backend="python", entry=CountFilterEntry(3),
+                    lr=1.0)
+    ids = np.asarray([7], np.int64)
+    # sightings 1 and 2: zeros, no row storage
+    np.testing.assert_allclose(t.pull(ids), np.zeros((1, 4)))
+    np.testing.assert_allclose(t.pull(ids), np.zeros((1, 4)))
+    assert len(t._rows) == 0
+    # grads before admission are dropped
+    t.push(ids, np.ones((1, 4), np.float32))
+    assert len(t._rows) == 0
+    # 3rd sighting admits: real initialized row appears
+    row = t.pull(ids)
+    assert len(t._rows) == 1
+    t.push(ids, np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(t.pull(ids), row - 1.0, rtol=1e-5)
+
+
+def test_probability_entry_is_deterministic_partition():
+    t0 = SparseTable(4, backend="python", entry=ProbabilityEntry(0.5))
+    t1 = SparseTable(4, backend="python", entry=ProbabilityEntry(0.5))
+    ids = np.arange(400, dtype=np.int64)
+    t0.pull(ids)
+    t1.pull(ids)
+    # deterministic: two tables admit the identical subset
+    assert t0._admitted == t1._admitted
+    # and roughly half of the ids
+    assert 120 < len(t0._admitted) < 280
+    zero = SparseTable(4, backend="python", entry=ProbabilityEntry(0.0))
+    zero.pull(ids)
+    assert len(zero._admitted) == 0
+    full = SparseTable(4, backend="python", entry=ProbabilityEntry(1.0))
+    full.pull(ids)
+    assert len(full._admitted) == 400
+
+
+def test_entry_validation():
+    with pytest.raises(ValueError):
+        ProbabilityEntry(1.5)
+    with pytest.raises(ValueError):
+        CountFilterEntry(-1)
