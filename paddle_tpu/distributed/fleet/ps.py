@@ -92,13 +92,16 @@ class SparseTable:
         return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
     def _filter_admitted(self, ids: np.ndarray, counting: bool):
-        """Boolean admitted-mask for ``ids``; pulls count as sightings
-        for count-based entries. Steady state (all ids admitted) is one
-        vectorized np.isin — no per-id Python work on the hot path."""
-        arr = self._admitted_arr
-        if arr is None or arr.size != len(self._admitted):
-            arr = self._admitted_arr = np.fromiter(
-                self._admitted, np.int64, len(self._admitted))
+        """Boolean admitted-mask for ``ids``; each pull counts as ONE
+        sighting per unique id (a batch with an id repeated k times is
+        one show, and every occurrence gets the same admission verdict
+        so one forward never mixes zeros with a real row for one id).
+        Steady state (all ids admitted) is one vectorized np.isin."""
+        with self._lock:
+            arr = self._admitted_arr
+            if arr is None or arr.size != len(self._admitted):
+                arr = self._admitted_arr = np.fromiter(
+                    self._admitted, np.int64, len(self._admitted))
         mask = np.isin(ids, arr)
         if mask.all():
             return mask
@@ -106,21 +109,28 @@ class SparseTable:
         # per-id counters behind for permanently rejected ids
         counting = counting and getattr(self._entry, "needs_count", True)
         newly = False
+        miss = np.flatnonzero(~mask)
+        uniq = np.unique(ids[miss])
+        verdict = {}
         with self._lock:
-            for i in np.flatnonzero(~mask):
-                k = int(ids[i])
+            for k in uniq.tolist():
+                k = int(k)
                 if k in self._admitted:    # raced in since isin snapshot
-                    mask[i] = True
+                    verdict[k] = True
                     continue
                 if counting:
                     self._seen[k] = self._seen.get(k, 0) + 1
                 if self._entry.admit(k, self._seen.get(k, 0)):
                     self._admitted.add(k)
                     self._seen.pop(k, None)
-                    mask[i] = True
+                    verdict[k] = True
                     newly = True
-        if newly:
-            self._admitted_arr = None   # rebuild the fast-path snapshot
+                else:
+                    verdict[k] = False
+            if newly:
+                self._admitted_arr = None  # rebuild fast-path snapshot
+        for i in miss:
+            mask[i] = verdict[int(ids[i])]
         return mask
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
@@ -200,6 +210,15 @@ class SparseTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         deltas = np.ascontiguousarray(
             np.asarray(deltas, np.float32).reshape(ids.size, self.dim))
+        if self._entry is not None:
+            # the admission invariant holds on every write path: deltas
+            # for never-admitted ids are dropped, no orphan rows
+            mask = self._filter_admitted(ids, counting=False)
+            if not mask.any():
+                return
+            if not mask.all():
+                ids = np.ascontiguousarray(ids[mask])
+                deltas = np.ascontiguousarray(deltas[mask])
         if self._native is not None:
             self._lib.pts_push_delta(
                 self._native, self._c(ids, ctypes.c_int64), ids.size,
@@ -211,6 +230,36 @@ class SparseTable:
                 if row is None:
                     row = self._rows[k] = self._init()
                 row += d
+
+    def _entry_state(self):
+        """Admission state for checkpoints: without it a warm-start would
+        hide every trained row behind re-admission (pull zeros, drop
+        grads) until the entry re-admits the id."""
+        if self._entry is None:
+            return {}
+        with self._lock:
+            adm = np.fromiter(self._admitted, np.int64,
+                              len(self._admitted))
+            seen_ids = np.fromiter(self._seen, np.int64, len(self._seen))
+            seen_cnt = np.asarray([self._seen[int(i)] for i in seen_ids],
+                                  np.int64)
+        return {"admitted": adm, "seen_ids": seen_ids,
+                "seen_counts": seen_cnt}
+
+    def _restore_entry_state(self, d, row_ids):
+        if self._entry is None:
+            return
+        with self._lock:
+            if "admitted" in d:
+                self._admitted = set(d["admitted"].tolist())
+                self._seen = dict(zip(d["seen_ids"].tolist(),
+                                      d["seen_counts"].tolist()))
+            else:
+                # legacy checkpoint without admission state: every saved
+                # row was trained, therefore admitted
+                self._admitted = set(np.asarray(row_ids).tolist())
+                self._seen = {}
+            self._admitted_arr = None
 
     def __len__(self):
         if self._native is not None:
@@ -232,12 +281,12 @@ class SparseTable:
                                          self._c(ids, ctypes.c_int64),
                                          self._c(vals, ctypes.c_float), n)
                 ids, vals = ids[:w], vals[:w]
-            np.savez(path, ids=ids, vals=vals)
+            np.savez(path, ids=ids, vals=vals, **self._entry_state())
             return
         ids = np.fromiter(self._rows, np.int64, len(self._rows))
         vals = np.stack([self._rows[int(i)] for i in ids]) \
             if len(ids) else np.zeros((0, self.dim), np.float32)
-        np.savez(path, ids=ids, vals=vals)
+        np.savez(path, ids=ids, vals=vals, **self._entry_state())
 
     def load(self, path: str):
         import ctypes
@@ -256,12 +305,14 @@ class SparseTable:
             self._lib.pts_clear(self._native)
             self._lib.pts_import(self._native, self._c(ids, ctypes.c_int64),
                                  ids.size, self._c(vals, ctypes.c_float))
+            self._restore_entry_state(d, ids)
             return
         with self._lock:
             self._rows = {int(i): v.copy() for i, v in zip(ids, vals)}
             self._moments.clear()
             self._moments2.clear()
             self._steps.clear()
+        self._restore_entry_state(d, ids)
 
 
 class PSRuntime:

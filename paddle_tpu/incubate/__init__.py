@@ -12,3 +12,52 @@ from ..distributed import fleet  # noqa: F401
 from ..optimizer import LookaheadOptimizer, ModelAverage  # noqa: F401
 
 __all__ = ["fleet", "LookaheadOptimizer", "ModelAverage"]
+
+
+def load_op_library(lib_filename):
+    """Parity: fluid.load_op_library (framework.py) — load a custom-op
+    shared library. Custom ops here are C-ABI libraries built/loaded by
+    utils.cpp_extension; a prebuilt .so loads through the same ctypes
+    path."""
+    import ctypes
+    return ctypes.CDLL(lib_filename)
+
+
+class LayerHelper:
+    """Minimal fluid.layer_helper.LayerHelper for fluid-style custom
+    layers: parameter creation + input normalization (the op-appending
+    half of the reference helper has no desc to append to — ops execute
+    eagerly/traced)."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..nn.layer.layers import create_parameter as _cp
+        return _cp(shape, dtype, attr=attr, is_bias=is_bias,
+                   default_initializer=default_initializer)
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        raise NotImplementedError(
+            "LayerHelper.append_bias_op needs the helper's bias_attr "
+            "machinery; in this shim create the bias explicitly "
+            "(helper.create_parameter(shape=[n], is_bias=True)) and add "
+            "it, or use paddle.nn layers which own their bias")
+
+    def input(self, name):
+        return self.kwargs.get(name)
+
+
+class _ReaderShim:
+    """Parity: fluid.contrib.reader — its distributed readers
+    (ctr_reader) are superseded by paddle_tpu.io.DataLoader +
+    fleet.dataset; kept as an importable namespace."""
+
+    from ..io import DataLoader  # noqa: F401
+
+
+reader = _ReaderShim()
+
+__all__ += ["LayerHelper", "load_op_library", "reader"]

@@ -570,14 +570,53 @@ def set_program_state(program, state_dict):
                                              fromlist=["asarray"]).asarray(v)
 
 
+def _select_vars(program, vars, predicate):
+    params = program.all_parameters()
+    if vars is not None:
+        sel = list(vars)
+    elif predicate is not None:
+        sel = [p for p in params if predicate(p)]
+    else:
+        sel = params
+    by_id = {id(p): i for i, p in enumerate(params)}
+    keys = []
+    for p in sel:
+        i = by_id.get(id(p))    # identity, NOT == (Tensor == is
+        if i is None:           # elementwise)
+            raise ValueError(
+                "save_vars/load_vars: a selected variable is not a "
+                "parameter of the given program")
+        keys.append(i)
+    return sel, keys
+
+
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    load(main_program or default_main_program(), dirname)
+    """Restore ONLY the selected variables (reference static/io.py
+    load_vars contract) — unselected parameters keep their values."""
+    prog = main_program or default_main_program()
+    sel, keys = _select_vars(prog, vars, predicate)
+    from ..framework.io import load as _load
+    state = _load(dirname + ".pdparams")
+    params = prog.all_parameters()
+    for p, i in zip(sel, keys):
+        key = getattr(p, "name", "") or f"param_{i}"
+        if key not in state:
+            raise KeyError(f"load_vars: {key!r} absent from checkpoint")
+        v = state[key]
+        p._value = v._value if hasattr(v, "_value") else v
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    save(main_program or default_main_program(), dirname)
+    """Save ONLY the selected variables (reference static/io.py)."""
+    prog = main_program or default_main_program()
+    sel, keys = _select_vars(prog, vars, predicate)
+    from ..framework.io import save as _save
+    state = {}
+    for p, i in zip(sel, keys):
+        state[getattr(p, "name", "") or f"param_{i}"] = p
+    _save(state, dirname + ".pdparams")
 
 
 def load_from_file(path):

@@ -25,7 +25,7 @@ from ...tensor.creation import to_tensor
 
 __all__ = ["TransformerConfig", "TransformerModel",
            "CrossEntropyCriterion", "transformer_base", "transformer_big",
-           "transformer_tiny", "greedy_translate"]
+           "transformer_tiny", "greedy_translate", "beam_translate"]
 
 
 class TransformerConfig:
@@ -193,6 +193,101 @@ class CrossEntropyCriterion(nn.Layer):
             w = (tg != pad).astype(jnp.float32)
             return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
         return _apply(f, logits, target, op_name="smoothed_ce")
+
+
+def beam_translate(model: TransformerModel, src, beam_size: int = 4,
+                   max_len=None, alpha: float = 0.6):
+    """Beam search with GNMT length penalty ((5+len)/6)^alpha over the
+    incremental KV cache (parity: the reference transformer example's
+    cached beam search / fluid layers beam_search ops).
+
+    The per-step math — embed, decode one token, log-softmax, top-k over
+    beam*vocab, gather caches by parent — stays on device; the host
+    keeps only (B, K) token/parent/score arrays. Returns (B, <=max_len)
+    best-beam ids.
+    """
+    import jax
+    import jax.numpy as jnp
+    c = model.config
+    k = int(beam_size)
+    max_len = min(max_len or c.max_len, c.max_len)
+    was_training = model.training
+    model.eval()
+    try:
+        src = model._truncate(src)
+        b = src.shape[0]
+        src_mask = model._pad_mask(src)
+        memory = model.transformer.encoder(
+            model._embed(model.src_embed, src), src_mask)
+
+        def tile(t):
+            v = t._value if isinstance(t, Tensor) else t
+            return Tensor(jnp.repeat(v, k, axis=0))
+        memory_t, src_mask_t = tile(memory), tile(src_mask)
+        cache = model.transformer.decoder.gen_cache(memory_t)
+
+        tokens = np.full((b, k), c.bos_id, np.int64)
+        scores = np.full((b, k), -1e9, np.float32)
+        scores[:, 0] = 0.0            # fan out from beam 0 at step 1
+        finished = np.zeros((b, k), bool)
+        step_tokens, step_parents = [], []
+        for t in range(max_len - 1):
+            tok = to_tensor(tokens.reshape(-1)[:, None])
+            x = model._embed(model.trg_embed, tok, pos_offset=t)
+            h, cache = model.transformer.decoder(
+                x, memory_t, None, src_mask_t, cache)
+            logits = model._project(h)
+            logp = jax.nn.log_softmax(logits._value[:, -1, :], axis=-1)
+            v = logp.shape[-1]
+            logp = logp.reshape(b, k, v)
+            fin_row = jnp.full((v,), -1e9,
+                               logp.dtype).at[c.eos_id].set(0.0)
+            logp = jnp.where(jnp.asarray(finished)[:, :, None],
+                             fin_row[None, None, :], logp)
+            total = jnp.asarray(scores)[:, :, None] + logp
+            top_scores, top = jax.lax.top_k(total.reshape(b, k * v), k)
+            parent_d = top // v
+            gidx = (jnp.arange(b)[:, None] * k + parent_d).reshape(-1)
+            cache = jax.tree_util.tree_map(
+                lambda s: Tensor(jnp.take(s._value, gidx, axis=0))
+                if isinstance(s, Tensor) else jnp.take(s, gidx, axis=0),
+                cache, is_leaf=lambda s: isinstance(s, Tensor))
+            scores = np.asarray(top_scores)
+            parent = np.asarray(parent_d).astype(np.int64)
+            new_tokens = np.asarray(top % v).astype(np.int64)
+            finished = np.take_along_axis(finished, parent, 1) | (
+                new_tokens == c.eos_id)
+            step_tokens.append(new_tokens)
+            step_parents.append(parent)
+            tokens = new_tokens
+            if finished.all():
+                break
+
+        if not step_tokens:        # max_len=1: nothing decoded
+            return np.zeros((b, 0), np.int64)
+        T = len(step_tokens)
+        ids = np.stack(step_tokens)
+        parents = np.stack(step_parents)
+        beams = np.broadcast_to(np.arange(k), (b, k)).copy()
+        out = np.empty_like(ids)
+        for t in range(T - 1, -1, -1):
+            out[t] = np.take_along_axis(ids[t], beams, 1)
+            beams = np.take_along_axis(parents[t], beams, 1)
+        lens = np.full((b, k), T, np.int64)
+        for t in range(T - 1, -1, -1):
+            lens = np.where(out[t] == c.eos_id, t + 1, lens)
+        # GNMT length penalty at final selection
+        lp = ((5.0 + lens) / 6.0) ** alpha
+        best = np.argmax(scores / lp, axis=1)          # (B,)
+        seqs = out.transpose(1, 2, 0)                  # (B, K, T)
+        picked = seqs[np.arange(b), best]              # (B, T)
+        # pad everything after each sequence's eos
+        cut = lens[np.arange(b), best]
+        mask = np.arange(T)[None, :] < cut[:, None]
+        return np.where(mask, picked, c.pad_id)
+    finally:
+        if was_training:
+            model.train()
 
 
 def greedy_translate(model: TransformerModel, src, max_len=None):

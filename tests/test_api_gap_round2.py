@@ -253,3 +253,24 @@ def test_set_printoptions_sci_mode():
         assert "e" in repr(paddle.to_tensor([1234.5]))
     finally:
         paddle.set_printoptions(sci_mode=False, precision=6)
+
+
+# ----------------------------------------------- finite-difference grads
+def test_new_op_gradients_vs_finite_differences():
+    from op_test import check_grad
+    rng = np.random.default_rng(0)
+    x1 = rng.normal(size=(3, 4)).astype(np.float32)
+    x2 = rng.normal(size=(3, 5)).astype(np.float32)
+    w = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    b = rng.normal(size=(2,)).astype(np.float32)
+    check_grad(F.bilinear, [x1, x2, w, b])
+    v = rng.normal(size=(2, 3)).astype(np.float32)
+    check_grad(F.diag_embed, [v])
+    check_grad(F.log_sigmoid, [rng.normal(size=(6,)).astype(np.float32)])
+
+    # hsigmoid grads w.r.t. input and weight
+    xi = rng.normal(size=(4, 8)).astype(np.float32)
+    wt = rng.normal(size=(5, 8)).astype(np.float32) * 0.3
+    lb = rng.integers(0, 6, (4, 1)).astype(np.int64)
+    check_grad(lambda a, ww: F.hsigmoid_loss(a, paddle.to_tensor(lb), 6,
+                                             ww), [xi, wt])

@@ -184,3 +184,66 @@ def test_entry_validation():
         ProbabilityEntry(1.5)
     with pytest.raises(ValueError):
         CountFilterEntry(-1)
+
+
+def test_entry_state_survives_save_load(tmp_path):
+    t = SparseTable(4, backend="python", entry=CountFilterEntry(2),
+                    lr=1.0)
+    hot = np.asarray([5], np.int64)
+    t.pull(hot); t.pull(hot)              # admitted
+    t.push(hot, np.ones((1, 4), np.float32))
+    trained = t.pull(hot).copy()
+    warm = np.asarray([9], np.int64)
+    t.pull(warm)                          # 1 sighting, not admitted
+    t.save(str(tmp_path / "ck"))
+    t2 = SparseTable(4, backend="python", entry=CountFilterEntry(2),
+                     lr=1.0)
+    t2.load(str(tmp_path / "ck"))
+    # warm-start serves the TRAINED row immediately, no re-admission
+    np.testing.assert_allclose(t2.pull(hot), trained)
+    # sighting counters survive too: one more pull admits id 9
+    t2.pull(warm)
+    assert 9 in t2._admitted
+
+
+def test_push_delta_honors_entry():
+    t = SparseTable(4, backend="python", entry=CountFilterEntry(3))
+    t.push_delta(np.asarray([42], np.int64), np.ones((1, 4), np.float32))
+    assert len(t._rows) == 0 and 42 not in t._admitted
+
+
+def test_duplicate_ids_one_sighting_consistent_rows():
+    t = SparseTable(4, backend="python", entry=CountFilterEntry(3))
+    trip = np.asarray([7, 7, 7], np.int64)
+    out = t.pull(trip)                 # ONE sighting, all-zero verdict
+    np.testing.assert_allclose(out, np.zeros((3, 4)))
+    assert t._seen.get(7) == 1
+    t.pull(trip)
+    out = t.pull(trip)                 # 3rd sighting: admitted, one row
+    assert 7 in t._admitted
+    np.testing.assert_allclose(out[0], out[1])
+    np.testing.assert_allclose(out[0], out[2])
+
+
+def test_save_load_vars_subset(tmp_path):
+    import paddle_tpu.static.nn as snn
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        snn.fc(x, size=4, name="fc_a")
+        snn.fc(x, size=4, name="fc_b")
+    params = prog.all_parameters()
+    subset = params[:2]                     # fc_a's weight+bias
+    orig_all = [np.asarray(p._value).copy() for p in params]
+    static.save_vars(None, str(tmp_path / "sub"), main_program=prog,
+                     vars=subset)
+    for p in params:                        # clobber everything
+        p._value = p._value * 0.0 + 7.0
+    static.load_vars(None, str(tmp_path / "sub"), main_program=prog,
+                     vars=subset)
+    for i, p in enumerate(params):
+        if i < 2:   # restored
+            np.testing.assert_allclose(np.asarray(p._value), orig_all[i])
+        else:       # untouched by the subset restore
+            np.testing.assert_allclose(np.asarray(p._value),
+                                       orig_all[i] * 0.0 + 7.0)

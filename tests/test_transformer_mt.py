@@ -9,8 +9,8 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.text.models.transformer import (
-    CrossEntropyCriterion, TransformerModel, greedy_translate,
-    transformer_big, transformer_tiny)
+    CrossEntropyCriterion, TransformerModel, beam_translate,
+    greedy_translate, transformer_big, transformer_tiny)
 
 
 def _copy_batch(rng, batch, seq, vocab, pad=0, bos=2, eos=3):
@@ -60,6 +60,20 @@ def test_transformer_learns_copy_task():
         hits += (out[i, :k] == src[i, :k]).sum()
         total += k
     assert hits / total > 0.6, (src, out)
+
+    # beam width 1 must agree with greedy token-for-token, and a wider
+    # beam must be at least as accurate on the head tokens
+    b1 = beam_translate(model, paddle.to_tensor(src), beam_size=1,
+                        max_len=13, alpha=0.0)
+    for i in range(4):
+        L = min(len(out[i]), len(b1[i]))
+        np.testing.assert_array_equal(b1[i, :L], out[i, :L])
+    b4 = beam_translate(model, paddle.to_tensor(src), beam_size=4,
+                        max_len=13)
+    hits4 = sum((b4[i, :min(3, int((src[i] != 0).sum()))] ==
+                 src[i, :min(3, int((src[i] != 0).sum()))]).sum()
+                for i in range(4))
+    assert hits4 >= hits, (hits4, hits)
 
 
 def test_weight_sharing_single_parameter():
