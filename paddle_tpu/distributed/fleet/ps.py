@@ -235,31 +235,36 @@ class SparseTable:
         """Admission state for checkpoints: without it a warm-start would
         hide every trained row behind re-admission (pull zeros, drop
         grads) until the entry re-admits the id."""
+        with self._lock:
+            return self._entry_state_locked()
+
+    def _entry_state_locked(self):
         if self._entry is None:
             return {}
-        with self._lock:
-            adm = np.fromiter(self._admitted, np.int64,
-                              len(self._admitted))
-            seen_ids = np.fromiter(self._seen, np.int64, len(self._seen))
-            seen_cnt = np.asarray([self._seen[int(i)] for i in seen_ids],
-                                  np.int64)
+        adm = np.fromiter(self._admitted, np.int64, len(self._admitted))
+        seen_ids = np.fromiter(self._seen, np.int64, len(self._seen))
+        seen_cnt = np.asarray([self._seen[int(i)] for i in seen_ids],
+                              np.int64)
         return {"admitted": adm, "seen_ids": seen_ids,
                 "seen_counts": seen_cnt}
 
-    def _restore_entry_state(self, d, row_ids):
+    def _restore_entry_state_locked(self, d, row_ids):
         if self._entry is None:
             return
+        if "admitted" in d:
+            self._admitted = set(d["admitted"].tolist())
+            self._seen = dict(zip(d["seen_ids"].tolist(),
+                                  d["seen_counts"].tolist()))
+        else:
+            # legacy checkpoint without admission state: every saved
+            # row was trained, therefore admitted
+            self._admitted = set(np.asarray(row_ids).tolist())
+            self._seen = {}
+        self._admitted_arr = None
+
+    def _restore_entry_state(self, d, row_ids):
         with self._lock:
-            if "admitted" in d:
-                self._admitted = set(d["admitted"].tolist())
-                self._seen = dict(zip(d["seen_ids"].tolist(),
-                                      d["seen_counts"].tolist()))
-            else:
-                # legacy checkpoint without admission state: every saved
-                # row was trained, therefore admitted
-                self._admitted = set(np.asarray(row_ids).tolist())
-                self._seen = {}
-            self._admitted_arr = None
+            self._restore_entry_state_locked(d, row_ids)
 
     def __len__(self):
         if self._native is not None:
@@ -283,10 +288,15 @@ class SparseTable:
                 ids, vals = ids[:w], vals[:w]
             np.savez(path, ids=ids, vals=vals, **self._entry_state())
             return
-        ids = np.fromiter(self._rows, np.int64, len(self._rows))
-        vals = np.stack([self._rows[int(i)] for i in ids]) \
-            if len(ids) else np.zeros((0, self.dim), np.float32)
-        np.savez(path, ids=ids, vals=vals, **self._entry_state())
+        with self._lock:
+            # one lock section: the rows snapshot and the admission
+            # state must agree (and concurrent push must not mutate the
+            # dict mid-iteration)
+            ids = np.fromiter(self._rows, np.int64, len(self._rows))
+            vals = np.stack([self._rows[int(i)] for i in ids]) \
+                if len(ids) else np.zeros((0, self.dim), np.float32)
+            entry = self._entry_state_locked()
+        np.savez(path, ids=ids, vals=vals, **entry)
 
     def load(self, path: str):
         import ctypes
@@ -308,11 +318,14 @@ class SparseTable:
             self._restore_entry_state(d, ids)
             return
         with self._lock:
+            # rows and admission state become visible atomically: a
+            # concurrent pull must never see new rows with the stale
+            # admitted set (it would serve zeros for trained ids)
             self._rows = {int(i): v.copy() for i, v in zip(ids, vals)}
             self._moments.clear()
             self._moments2.clear()
             self._steps.clear()
-        self._restore_entry_state(d, ids)
+            self._restore_entry_state_locked(d, ids)
 
 
 class PSRuntime:

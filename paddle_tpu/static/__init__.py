@@ -598,7 +598,6 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     sel, keys = _select_vars(prog, vars, predicate)
     from ..framework.io import load as _load
     state = _load(dirname + ".pdparams")
-    params = prog.all_parameters()
     for p, i in zip(sel, keys):
         key = getattr(p, "name", "") or f"param_{i}"
         if key not in state:

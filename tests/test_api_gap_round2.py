@@ -274,3 +274,22 @@ def test_new_op_gradients_vs_finite_differences():
     lb = rng.integers(0, 6, (4, 1)).astype(np.int64)
     check_grad(lambda a, ww: F.hsigmoid_loss(a, paddle.to_tensor(lb), 6,
                                              ww), [xi, wt])
+
+
+def test_resnet_data_format_nhwc_matches_nchw():
+    # reference vision resnet's data_format knob; eval mode is exactly
+    # layout-invariant (train-mode BN over tiny N*H*W reductions
+    # amplifies float reassociation, so eval is the equality check)
+    from paddle_tpu.vision.models.resnet import ResNet, BasicBlock
+    paddle.seed(0)
+    m1 = ResNet(BasicBlock, depth=18, num_classes=10)
+    paddle.seed(0)
+    m2 = ResNet(BasicBlock, depth=18, num_classes=10,
+                data_format="NHWC")
+    m1.eval(); m2.eval()
+    x = np.random.default_rng(0).normal(size=(2, 3, 32, 32)).astype(
+        np.float32)
+    a = np.asarray(m1(paddle.to_tensor(x)).numpy())
+    b = np.asarray(m2(paddle.to_tensor(
+        x.transpose(0, 2, 3, 1))).numpy())
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

@@ -149,10 +149,14 @@ def test_dispatch_latency_improves():
         _train(steps=20)
         return t_off, time.perf_counter() - t0
 
-    # measured ~3.3x on a quiet host; demand a conservative 1.3x, with
-    # one retry to ride out transient load on a shared CI core
-    for attempt in range(2):
+    # measured ~3.3x on a quiet host; demand a conservative 1.3x over
+    # the MIN of three runs — min is robust to load spikes from
+    # whatever else shares this CI core
+    offs, ons = [], []
+    for attempt in range(3):
         t_off, t_on = measure()
-        if t_on < t_off / 1.3:
+        offs.append(t_off)
+        ons.append(t_on)
+        if min(ons) < min(offs) / 1.3:
             return
-    assert t_on < t_off / 1.3, (t_off, t_on)
+    assert min(ons) < min(offs) / 1.3, (offs, ons)
