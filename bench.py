@@ -115,7 +115,9 @@ def _bench_resnet(smoke, peak_tflops):
     # layouts measured equal end-to-end on a v5e (2078 NCHW vs 2056
     # NHWC img/s): XLA layout assignment already optimizes the whole
     # program, even though a STANDALONE NCHW conv is ~5x slower
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+    if layout not in ("NCHW", "NHWC"):
+        raise SystemExit(f"invalid BENCH_LAYOUT={layout!r}; use NCHW|NHWC")
     paddle.seed(0)
     model = resnet50(num_classes=nclass, data_format=layout)
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from collections.abc import Mapping as _AbcMapping
 from typing import Any, Dict, Mapping, Optional
 
 import jax
@@ -81,10 +82,63 @@ def _barrier(tag: str):
         multihost_utils.sync_global_devices(tag)
 
 
+# state-dict keys legitimately contain dots ("0.weight") and slashes, so
+# nested-dict flattening needs a separator no key can contain
+_NEST_SEP = "||"
+_EMPTY_DICT = "__empty_dict__"   # keeps empty sub-dicts round-tripping
+
+
+def _flatten_state(state, prefix=""):
+    """Nested dicts (model/opt/scheduler state_dicts as the user holds
+    them) flatten to one name->leaf mapping; python scalars ride as 0-d
+    arrays and come back as scalars."""
+    out = {}
+    for k, v in state.items():
+        k = str(k)
+        if _NEST_SEP in k:
+            raise ValueError(
+                f"state key {k!r} contains the reserved nesting "
+                f"separator {_NEST_SEP!r}")
+        key = f"{prefix}{_NEST_SEP}{k}" if prefix else k
+        if isinstance(v, _AbcMapping):
+            if v:
+                out.update(_flatten_state(v, key))
+            else:
+                # an empty state_dict is still a key the restore script
+                # will index; dropping it would turn save-ok into a
+                # restore-time KeyError
+                out[f"{key}{_NEST_SEP}{_EMPTY_DICT}"] = np.zeros(
+                    0, np.int8)
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten_state(flat):
+    if not any(_NEST_SEP in k for k in flat):
+        return dict(flat)
+    out: Dict[str, Any] = {}
+    for k, v in flat.items():
+        parts = k.split(_NEST_SEP)
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        if parts[-1] == _EMPTY_DICT:
+            continue   # marker: the setdefault walk already made the {}
+        if getattr(v, "ndim", None) == 0:
+            # python scalar round-trip (steps, lr values) — jax arrays
+            # included, not just np (the shardings path returns those)
+            v = np.asarray(v).item()
+        cur[parts[-1]] = v
+    return out
+
+
 def save_state_dict(state: Mapping[str, Any], path: str,
                     async_save: bool = False, _on_complete=None):
-    """Write a (possibly sharded) name->array mapping as a sharded
-    checkpoint directory."""
+    """Write a name->array mapping — or an arbitrarily nested dict of
+    state_dicts (``{"model": ..., "opt": ...}``) — as a sharded
+    checkpoint directory; ``load_state_dict`` restores the nesting."""
+    state = _flatten_state(state)
     os.makedirs(path, exist_ok=True)
     entries: Dict[str, dict] = {}
     writes = []  # (filename, host ndarray) — device->host done up front
@@ -239,12 +293,16 @@ def load_state_dict(path: str,
                     names=None) -> Dict[str, Any]:
     """Read a checkpoint. ``shardings``: name -> jax.sharding.Sharding (or
     one sharding for all); arrays come back laid out for THAT sharding,
-    regardless of the topology they were saved from."""
+    regardless of the topology they were saved from. Checkpoints written
+    from nested state dicts come back nested."""
     with open(os.path.join(path, _INDEX)) as f:
         index = json.load(f)["entries"]
     out: Dict[str, Any] = {}
     for name, entry in index.items():
-        if names is not None and name not in names:
+        if names is not None and name not in names and \
+                name.split(_NEST_SEP)[0] not in names:
+            # nested checkpoints: a top-level group name selects the
+            # whole sub-dict (callers never see the internal separator)
             continue
         shape = tuple(entry["shape"])
         if shardings is None:
@@ -260,7 +318,7 @@ def load_state_dict(path: str,
         out[name] = jax.make_array_from_callback(
             shape, sharding,
             lambda idx, e=entry: _read_region(path, e, idx))
-    return out
+    return _unflatten_state(out)
 
 
 class CheckpointManager:

@@ -276,17 +276,24 @@ class SparseTable:
     def save(self, path: str):
         import ctypes
         if self._native is not None:
-            n = int(self._lib.pts_size(self._native))
-            ids = np.empty(n, np.int64)
-            vals = np.empty((n, self.dim), np.float32)
-            if n:
-                # cap=n: the table may grow concurrently; export writes at
-                # most n rows (the snapshot is whatever fit)
-                w = self._lib.pts_export(self._native,
-                                         self._c(ids, ctypes.c_int64),
-                                         self._c(vals, ctypes.c_float), n)
-                ids, vals = ids[:w], vals[:w]
-            np.savez(path, ids=ids, vals=vals, **self._entry_state())
+            with self._lock:
+                # entry state FIRST, then rows: an id admitted during the
+                # export window is then missing from the admitted set
+                # (safe: brief re-admission) instead of admitted with no
+                # row (unsafe: trained id serving fresh-init forever)
+                entry = self._entry_state_locked()
+                n = int(self._lib.pts_size(self._native))
+                ids = np.empty(n, np.int64)
+                vals = np.empty((n, self.dim), np.float32)
+                if n:
+                    # cap=n: the table may grow concurrently; export
+                    # writes at most n rows (the snapshot is whatever fit)
+                    w = self._lib.pts_export(self._native,
+                                             self._c(ids, ctypes.c_int64),
+                                             self._c(vals, ctypes.c_float),
+                                             n)
+                    ids, vals = ids[:w], vals[:w]
+            np.savez(path, ids=ids, vals=vals, **entry)
             return
         with self._lock:
             # one lock section: the rows snapshot and the admission
