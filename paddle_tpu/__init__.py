@@ -17,7 +17,9 @@ changing the import.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+# the version names the API surface implemented (reference
+# parity target ~v2.0), so utils.require_version gates pass
+__version__ = "2.0.0"
 
 from .framework import (  # noqa: F401
     CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,

@@ -309,8 +309,14 @@ def load_state_dict(path: str,
             out[name] = _read_region(
                 path, entry, tuple(slice(0, d) for d in shape))
             continue
-        sharding = shardings.get(name) if hasattr(shardings, "get") \
-            else shardings
+        if hasattr(shardings, "get"):
+            # nested checkpoints: fall back to the user-visible top-level
+            # group name, mirroring the names= filter
+            sharding = shardings.get(name)
+            if sharding is None:
+                sharding = shardings.get(name.split(_NEST_SEP)[0])
+        else:
+            sharding = shardings
         if sharding is None:
             out[name] = _read_region(
                 path, entry, tuple(slice(0, d) for d in shape))

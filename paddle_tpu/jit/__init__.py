@@ -30,7 +30,8 @@ from ..static.input_spec import InputSpec
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "TrainStep", "ignore_module", "enable_to_static",
-           "ProgramTranslator"]
+           "ProgramTranslator", "TracedLayer", "set_code_level",
+           "set_verbosity"]
 
 _TO_STATIC_ENABLED = True
 
@@ -614,3 +615,47 @@ class ProgramTranslator:
     @property
     def enable_to_static(self):
         return _TO_STATIC_ENABLED
+
+
+# dy2static logging knobs (parity: jit/set_code_level, set_verbosity —
+# dygraph_to_static/logging_utils.py)
+_dy2static_verbosity = 0
+_dy2static_code_level = -1
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    global _dy2static_verbosity
+    _dy2static_verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    global _dy2static_code_level
+    _dy2static_code_level = int(level)
+
+
+class TracedLayer:
+    """Legacy fluid.dygraph.TracedLayer surface (program_desc_tracer).
+    Wraps a layer traced at concrete example inputs; ``save_inference_
+    model`` exports the StableHLO bundle like jit.save."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        sf = to_static(layer)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf, inputs)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from ..static.input_spec import InputSpec
+        specs = [InputSpec(list(t.shape), str(t.dtype).rsplit(".", 1)[-1])
+                 for t in self._inputs]
+        save(self._fn, path, input_spec=specs)

@@ -14,7 +14,7 @@ from ...framework.random import split_key
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-           "Assign", "Orthogonal", "Dirac", "calculate_gain"]
+           "Assign", "Orthogonal", "Dirac", "calculate_gain", "Bilinear", "set_global_initializer"]
 
 
 def calculate_gain(nonlinearity, param=None):
@@ -178,3 +178,47 @@ class Dirac(Initializer):
                 idx = (g * (out_ch // self.groups) + i, i, *centers)
                 out[idx] = 1.0
         return jnp.asarray(out, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (parity:
+    nn/initializer/Bilinear, fluid BilinearInitializer) — initializes a
+    (C_out, C_in, k, k) weight so conv_transpose performs bilinear
+    interpolation."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear init needs a 4-D conv weight, got {shape}")
+        import numpy as np
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear init needs square kernels")
+        f = (k + 1) // 2
+        center = f - 1 if k % 2 == 1 else f - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - abs(og[0] - center) / f)
+                * (1 - abs(og[1] - center) / f)).astype(np.float32)
+        # reference BilinearInitializer writes the filter into EVERY
+        # (out, in) pair — the canonical groups=C depthwise upsample
+        # weight (C, 1, k, k) must get the filter in every channel
+        w = np.broadcast_to(filt, shape).copy()
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: nn/initializer/set_global_initializer — the default init
+    layers fall back to when no weight_attr is given. Pass None to
+    reset."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)
+
+
+def _global_default(is_bias):
+    g = _global_initializer
+    if g is None:
+        return None
+    return g[1] if is_bias else g[0]

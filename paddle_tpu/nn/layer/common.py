@@ -14,12 +14,15 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "ChannelShuffle", "LocalResponseNorm", "Bilinear"]
 
 
-def _resolve_init(attr, default):
-    """weight_attr/bias_attr: accept None / False / Initializer / ParamAttr."""
+def _resolve_init(attr, default, is_bias=False):
+    """weight_attr/bias_attr: accept None / False / Initializer / ParamAttr.
+    With no explicit attr, nn.initializer.set_global_initializer's
+    default (if any) wins over the layer's built-in default."""
     if attr is False:
         return None
     if attr is None:
-        return default
+        from ..initializer import _global_default
+        return _global_default(is_bias) or default
     from ..initializer import Initializer
     if isinstance(attr, Initializer):
         return attr
@@ -43,7 +46,7 @@ class Linear(Layer):
         self.out_features = out_features
         w_init = _resolve_init(weight_attr, XavierNormal())
         self.weight = Parameter(w_init((in_features, out_features)))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         if b_init is not None:
             self.bias = Parameter(b_init((out_features,)))
         else:
@@ -88,7 +91,7 @@ class Bilinear(Layer):
         w_init = _resolve_init(weight_attr, XavierNormal())
         self.weight = Parameter(w_init((out_features, in1_features,
                                         in2_features)))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         self.bias = Parameter(b_init((1, out_features))) if b_init else None
 
     def forward(self, x1, x2):

@@ -48,7 +48,7 @@ class _ConvNd(Layer):
         w_init = _resolve_init(weight_attr,
                                KaimingNormal(fan_in=fan_in))
         self.weight = Parameter(w_init(w_shape))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         self.bias = Parameter(b_init((out_channels,))) if b_init else None
 
     def extra_repr(self):

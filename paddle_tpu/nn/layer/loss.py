@@ -178,7 +178,7 @@ class HSigmoidLoss(Layer):
         if bias_attr is False:
             self.bias = None
         else:
-            b_init = _resolve_init(bias_attr, Constant(0.0))
+            b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
             self.bias = self.create_parameter(
                 [num_classes - 1], default_initializer=b_init,
                 is_bias=True)

@@ -28,7 +28,7 @@ class _BatchNormBase(Layer):
         self._data_format = data_format
         self._use_global_stats = use_global_stats
         w_init = _resolve_init(weight_attr, Constant(1.0))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         self.weight = Parameter(w_init((num_features,))) if w_init else None
         self.bias = Parameter(b_init((num_features,))) if b_init else None
         self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
@@ -149,7 +149,7 @@ class LayerNorm(Layer):
         self._normalized_shape = list(normalized_shape)
         self._epsilon = epsilon
         w_init = _resolve_init(weight_attr, Constant(1.0))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         shape = tuple(self._normalized_shape)
         self.weight = Parameter(w_init(shape)) if w_init else None
         self.bias = Parameter(b_init(shape)) if b_init else None
@@ -172,7 +172,7 @@ class GroupNorm(Layer):
         self._epsilon = epsilon
         self._data_format = data_format
         w_init = _resolve_init(weight_attr, Constant(1.0))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         self.weight = Parameter(w_init((num_channels,))) if w_init else None
         self.bias = Parameter(b_init((num_channels,))) if b_init else None
 
@@ -188,7 +188,7 @@ class _InstanceNormBase(Layer):
         super().__init__()
         self._epsilon = epsilon
         w_init = _resolve_init(weight_attr, Constant(1.0))
-        b_init = _resolve_init(bias_attr, Constant(0.0))
+        b_init = _resolve_init(bias_attr, Constant(0.0), is_bias=True)
         self.weight = Parameter(w_init((num_features,))) if w_init else None
         self.bias = Parameter(b_init((num_features,))) if b_init else None
 

@@ -74,3 +74,96 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"(checked one jit step on {dev.platform}:{dev.id})")
     return True
+
+
+# -- unique_name (parity: python/paddle/utils/unique_name.py -> fluid
+#    unique_name generator: generate/guard/switch) --------------------
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_name_generator = _UniqueNameGenerator()
+
+
+class unique_name:
+    @staticmethod
+    def generate(key):
+        return _name_generator(key)
+
+    @staticmethod
+    def switch(new_generator=None):
+        global _name_generator
+        prev = _name_generator
+        _name_generator = new_generator or _UniqueNameGenerator()
+        return prev
+
+    class guard:
+        """with unique_name.guard(): fresh name space for the scope."""
+
+        def __init__(self, new_generator=None):
+            self._new = new_generator
+
+        def __enter__(self):
+            self._prev = unique_name.switch(self._new)
+            return self
+
+        def __exit__(self, *exc):
+            unique_name.switch(self._prev)
+            return False
+
+
+def require_version(min_version, max_version=None):
+    """Parity: paddle.utils.require_version — version gate for scripts.
+    This build tracks the reference's 2.x API surface."""
+    from .. import __version__
+
+    def _tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    cur = _tuple(__version__)
+    if _tuple(min_version) > cur and max_version is None:
+        import warnings
+        warnings.warn(
+            f"require_version({min_version!r}): this TPU-native build "
+            f"reports {__version__} but implements the 2.x surface; "
+            f"continuing")
+    return True
+
+
+# -- legacy profiler API (parity: fluid/profiler.py Profiler) ---------
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+class Profiler:
+    """Legacy profiler facade over utils/profiler.py host-event tracing."""
+
+    def __init__(self, enabled=True, options=None):
+        self._enabled = enabled
+        from . import profiler as _p
+        self._mod = _p
+
+    def __enter__(self):
+        if self._enabled:
+            self._mod.start_profiler("All")
+        return self
+
+    def __exit__(self, *exc):
+        if self._enabled:
+            self._mod.stop_profiler(sorted_key="total")
+        return False
+
+
+def get_profiler(options=None):
+    return Profiler(options=options)
+
+
+def load_op_library(lib_filename):
+    from ..incubate import load_op_library as _l
+    return _l(lib_filename)

@@ -1,0 +1,215 @@
+"""deform_conv2d / DeformConv2D / yolo_loss (parity:
+operators/deformable_conv_op.*, operators/detection/yolov3_loss_op.*).
+Gold checks are analytic: zero-offset deformable conv equals plain
+conv, integer/fractional offsets equal shifted/averaged convs, and the
+yolo loss at a perfect prediction equals its irreducible BCE entropy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision.ops import DeformConv2D, deform_conv2d, yolo_loss
+
+
+def _H(p):
+    return -(p * np.log(p) + (1 - p) * np.log(1 - p))
+
+
+class TestDeformConv2D:
+    def setup_method(self, _):
+        self.rng = np.random.default_rng(0)
+        self.x = self.rng.normal(size=(2, 4, 8, 8)).astype(np.float32)
+        self.w = self.rng.normal(size=(6, 4, 3, 3)).astype(np.float32)
+
+    def test_zero_offset_equals_conv2d(self):
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        a = np.asarray(deform_conv2d(
+            paddle.to_tensor(self.x), paddle.to_tensor(off),
+            paddle.to_tensor(self.w)).numpy())
+        b = np.asarray(F.conv2d(paddle.to_tensor(self.x),
+                                paddle.to_tensor(self.w)).numpy())
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_integer_offset_is_shift(self):
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        off[:, 0::2] = 1.0   # per-tap (dy, dx) pairs: all dy = 1
+        a = np.asarray(deform_conv2d(
+            paddle.to_tensor(self.x), paddle.to_tensor(off),
+            paddle.to_tensor(self.w)).numpy())
+        shifted = np.pad(self.x, ((0, 0), (0, 0), (0, 1), (0, 0)))[
+            :, :, 1:, :]
+        b = np.asarray(F.conv2d(paddle.to_tensor(shifted),
+                                paddle.to_tensor(self.w)).numpy())
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_fractional_offset_bilinear(self):
+        w1 = self.rng.normal(size=(3, 4, 1, 1)).astype(np.float32)
+        off = np.zeros((2, 2, 8, 8), np.float32)
+        off[:, 0] = 0.5
+        a = np.asarray(deform_conv2d(
+            paddle.to_tensor(self.x), paddle.to_tensor(off),
+            paddle.to_tensor(w1)).numpy())
+        xa = (self.x + np.pad(self.x, ((0, 0), (0, 0), (0, 1),
+                                       (0, 0)))[:, :, 1:, :]) / 2
+        b = np.asarray(F.conv2d(paddle.to_tensor(xa),
+                                paddle.to_tensor(w1)).numpy())
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_mask_modulation_and_layer(self):
+        layer = DeformConv2D(4, 3, 1)
+        off = np.zeros((2, 2, 8, 8), np.float32)
+        m = np.full((2, 1, 8, 8), 0.5, np.float32)
+        full = np.asarray(layer(paddle.to_tensor(self.x),
+                                paddle.to_tensor(off)).numpy())
+        half = np.asarray(layer(paddle.to_tensor(self.x),
+                                paddle.to_tensor(off),
+                                mask=paddle.to_tensor(m)).numpy())
+        bias = np.asarray(layer.bias._value)[None, :, None, None]
+        np.testing.assert_allclose(half - bias, (full - bias) * 0.5,
+                                   rtol=1e-4, atol=1e-5)
+        assert len(layer.parameters()) == 2   # weight + bias registered
+
+    def test_gradients_flow(self):
+        from op_test import check_grad
+        x = self.rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+        w = self.rng.normal(size=(2, 2, 2, 2)).astype(np.float32)
+        # bilinear sampling has gradient kinks at integer grid lines;
+        # keep every sampling point >= 0.1 away so central differences
+        # never straddle a kink
+        off = self.rng.uniform(0.1, 0.4, size=(1, 8, 4, 4)).astype(
+            np.float32)
+        check_grad(deform_conv2d, [x, off, w])
+
+
+class TestYoloLoss:
+    ANCHORS = [10, 13, 16, 30, 33, 23]
+    MASK = [0, 1, 2]
+    C, H, W, DS = 3, 4, 4, 32
+
+    def _perfect_head(self, gt, gl):
+        N = gt.shape[0]
+        na, C, H, W, ds = 3, self.C, self.H, self.W, self.DS
+        x = np.full((N, na * (5 + C), H, W), -8.0, np.float32)
+        in_w = W * ds
+        anc = np.asarray(self.ANCHORS).reshape(-1, 2)
+        ws, hs = gt[0, 0, 2] * in_w, gt[0, 0, 3] * in_w
+        ious = [min(ws, a) * min(hs, b)
+                / (ws * hs + a * b - min(ws, a) * min(hs, b))
+                for a, b in anc]
+        best = int(np.argmax(ious))
+        gi, gj = int(gt[0, 0, 0] * W), int(gt[0, 0, 1] * H)
+        tx, ty = gt[0, 0, 0] * W - gi, gt[0, 0, 1] * H - gj
+
+        def logit(p):
+            return np.log(p / (1 - p))
+        base = best * (5 + C)
+        x[:, base + 0, gj, gi] = logit(np.clip(tx, 1e-4, 1 - 1e-4))
+        x[:, base + 1, gj, gi] = logit(np.clip(ty, 1e-4, 1 - 1e-4))
+        x[:, base + 2, gj, gi] = np.log(ws / anc[best, 0])
+        x[:, base + 3, gj, gi] = np.log(hs / anc[best, 1])
+        x[:, base + 4, gj, gi] = 8.0
+        x[:, base + 5 + int(gl[0, 0]), gj, gi] = 8.0
+        return x, base, gi, gj, tx, ty
+
+    def _gt(self):
+        gt = np.zeros((2, 2, 4), np.float32)
+        gt[:, 0] = [0.4, 0.6, 0.2, 0.3]
+        gl = np.zeros((2, 2), np.int64)
+        gl[:, 0] = 1
+        return gt, gl
+
+    def test_perfect_prediction_hits_entropy_floor(self):
+        gt, gl = self._gt()
+        x, base, gi, gj, tx, ty = self._perfect_head(gt, gl)
+        loss = np.asarray(yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt),
+            paddle.to_tensor(gl), self.ANCHORS, self.MASK, self.C,
+            0.7, self.DS, use_label_smooth=False).numpy())
+        # sigmoid-CE at the optimum equals the target entropy (weighted
+        # by the small-box factor 2 - w*h); everything else ~0
+        floor = (2.0 - gt[0, 0, 2] * gt[0, 0, 3]) * (_H(tx) + _H(ty))
+        np.testing.assert_allclose(loss, floor, rtol=0.05)
+
+    def test_wrong_objectness_costs_more(self):
+        gt, gl = self._gt()
+        x, base, gi, gj, *_ = self._perfect_head(gt, gl)
+        x_bad = x.copy()
+        x_bad[:, base + 4, gj, gi] = -8.0
+        args = (paddle.to_tensor(gt), paddle.to_tensor(gl), self.ANCHORS,
+                self.MASK, self.C, 0.7, self.DS)
+        good = np.asarray(yolo_loss(paddle.to_tensor(x), *args).numpy())
+        bad = np.asarray(yolo_loss(paddle.to_tensor(x_bad), *args).numpy())
+        assert (bad > good + 5).all()
+
+    def test_gradients_finite_and_nonzero(self):
+        gt, gl = self._gt()
+        x, *_ = self._perfect_head(gt, gl)
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        yolo_loss(xt, paddle.to_tensor(gt), paddle.to_tensor(gl),
+                  self.ANCHORS, self.MASK, self.C, 0.7,
+                  self.DS).sum().backward()
+        g = np.asarray(xt.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_gt_score_weights_loss(self):
+        gt, gl = self._gt()
+        x, *_ = self._perfect_head(gt, gl)
+        score_half = np.zeros((2, 2), np.float32)
+        score_half[:, 0] = 0.5
+        args = (paddle.to_tensor(gt), paddle.to_tensor(gl), self.ANCHORS,
+                self.MASK, self.C, 0.7, self.DS)
+        full = np.asarray(yolo_loss(
+            paddle.to_tensor(x), *args, use_label_smooth=False).numpy())
+        half = np.asarray(yolo_loss(
+            paddle.to_tensor(x), *args,
+            gt_score=paddle.to_tensor(score_half),
+            use_label_smooth=False).numpy())
+        assert (half < full).all()   # down-weighted positives
+
+
+def test_bilinear_initializer_fills_all_pairs():
+    import paddle_tpu.nn as nn
+    # canonical depthwise-upsample weight (C, 1, k, k): every channel
+    # must carry the filter (reference writes it into every pair)
+    w = np.asarray(nn.initializer.Bilinear()((4, 1, 4, 4)))
+    sums = w.sum(axis=(2, 3)).ravel()
+    np.testing.assert_allclose(sums, 4.0, rtol=1e-5)
+
+
+def test_global_bias_initializer_applies_to_biases():
+    import paddle_tpu.nn as nn
+    nn.initializer.set_global_initializer(
+        nn.initializer.Constant(9.0), nn.initializer.Constant(-3.0))
+    try:
+        l = nn.Linear(2, 2)
+        assert float(np.asarray(l.weight._value)[0, 0]) == 9.0
+        assert float(np.asarray(l.bias._value)[0]) == -3.0
+    finally:
+        nn.initializer.set_global_initializer(None)
+
+
+def test_yolo_loss_scale_x_y_changes_ignore_mask():
+    # a confident objectness at a NON-responsible cell is only forgiven
+    # (ignored) when its decoded box overlaps a gt above ignore_thresh;
+    # scale_x_y moves the decode enough to flip that decision
+    anchors = [10, 13, 16, 30, 33, 23]
+    gt = np.zeros((1, 1, 4), np.float32)
+    gt[:, 0] = [0.625, 0.625, 0.25, 0.25]       # x-range [0.5, 0.75]
+    gl = np.zeros((1, 1), np.int64)
+    x = np.full((1, 3 * 8, 4, 4), -8.0, np.float32)
+    # anchor 0 at cell (gi=1, gj=2): px logit 2 -> sigmoid 0.881;
+    # plain decode centers at 0.470 (IoU~0.23 < 0.3: penalized);
+    # scale_x_y=1.5 decodes 1.071 -> center 0.518 (IoU~0.4: ignored)
+    x[0, 0, 2, 1] = 2.0
+    x[0, 1, 2, 1] = 0.0                          # gy centered
+    x[0, 2, 2, 1] = np.log(0.25 * 128 / 10)      # width 0.25
+    x[0, 3, 2, 1] = np.log(0.25 * 128 / 13)      # height 0.25
+    x[0, 4, 2, 1] = 6.0                          # confident objectness
+    args = (paddle.to_tensor(gt), paddle.to_tensor(gl), anchors,
+            [0, 1, 2], 3, 0.3, 32)
+    a = np.asarray(yolo_loss(paddle.to_tensor(x), *args).numpy())
+    b = np.asarray(yolo_loss(paddle.to_tensor(x), *args,
+                             scale_x_y=1.5).numpy())
+    assert a[0] > b[0] + 3, (a, b)   # penalty forgiven under scale_x_y
