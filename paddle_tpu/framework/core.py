@@ -104,7 +104,8 @@ class GradNode:
     OpBase's saved VariableWrappers).
     """
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "name", "primal_fn")
+    __slots__ = ("vjp_fn", "parents", "out_avals", "name",
+                 "primal_fn", "_vjp_jit_ok")
 
     def __init__(self, vjp_fn, parents: Sequence["Tensor"], out_avals, name="",
                  primal_fn=None):
@@ -532,6 +533,19 @@ def _vjp_cache_poison(fn, vals, diff_pos, kwargs):
         _vjp_poisoned.add(key)
 
 
+_jit_call_vjp_fn = None
+
+
+def _jit_call_vjp(vjp, ct):
+    """Jitted backward invocation (~30x less dispatch overhead than
+    interpreting the Partial op-by-op); jax.tree_util.Partial is a
+    pytree, so jit caches on its structure."""
+    global _jit_call_vjp_fn
+    if _jit_call_vjp_fn is None:
+        _jit_call_vjp_fn = jax.jit(lambda v, c: v(c))
+    return _jit_call_vjp_fn(vjp, ct)
+
+
 def _vjp_cache_stats():
     return dict(_vjp_stats, size=len(_vjp_cache),
                 poisoned=len(_vjp_poisoned))
@@ -590,10 +604,12 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
         return _wrap_outputs(out, None, stop_gradient=True)
 
     out_val = vjp_fn = None
+    from_cache = False
     if cached is not None:
         try:
             out_val, vjp_fn = cached(
                 [v for v in vals if _is_jax_array(v)])
+            from_cache = True
         except _TRACE_FALLBACK_ERRORS:
             _vjp_cache_poison(fn, vals, tuple(diff_pos), kwargs)
     if vjp_fn is None:
@@ -604,6 +620,11 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
     node = GradNode(vjp_fn, parents, out_avals,
                     name=op_name or getattr(fn, "__name__", "op"),
                     primal_fn=closed)
+    # cache-produced vjp_fns share one Partial structure per compiled
+    # forward, so the backward sweep may run them through a jitted
+    # caller (stable jit-cache key); ad-hoc jax.vjp closures would
+    # thrash that cache with fresh identities and must stay raw
+    node._vjp_jit_ok = from_cache
     return _wrap_outputs(out_val, node, stop_gradient=False)
 
 
@@ -695,14 +716,18 @@ def run_backward(t: Tensor, grad_tensor: Optional[Tensor] = None,
         for i, (shape, dt) in enumerate(node.out_avals):
             full.append(buf[i] if buf[i] is not None else jnp.zeros(shape, dt))
         arg = tuple(full) if len(full) > 1 else full[0]
+        use_jit = (getattr(node, "_vjp_jit_ok", False)
+                   and getattr(flags.FLAGS, "eager_vjp_cache", True))
         ev = _backward_event
         if ev is not None:
             # per-grad-op host event, the analog of the reference profiling
             # each backward op in BasicEngine (RecordEvent in RunImpl)
             with ev(f"{node.name}_grad"):
-                in_grads = node.vjp_fn(arg)
+                in_grads = (_jit_call_vjp(node.vjp_fn, arg) if use_jit
+                            else node.vjp_fn(arg))
         else:
-            in_grads = node.vjp_fn(arg)
+            in_grads = (_jit_call_vjp(node.vjp_fn, arg) if use_jit
+                        else node.vjp_fn(arg))
         if not retain_graph:
             node.vjp_fn = None     # free residuals
             node.primal_fn = None  # and the closed-over input values

@@ -136,6 +136,29 @@ def test_autocast_state_keys_the_cache():
     assert str(out_fp32_again.dtype).endswith("float32")
 
 
+def test_backward_jit_only_for_cached_nodes():
+    # cache-produced vjp_fns run through the jitted caller; custom
+    # backward nodes (sparse embedding -> SelectedRows) must stay raw —
+    # their ad-hoc closures would thrash the jit cache and their outputs
+    # are not jax pytrees
+    import paddle_tpu.nn as nn
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    x.stop_gradient = False
+    y = (x * 2.0).sum()
+    assert getattr(y._node, "_vjp_jit_ok", False) in (True, False)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.ones((4, 4)))
+
+    emb = nn.Embedding(10, 4, sparse=True)
+    out = emb(paddle.to_tensor(np.asarray([1, 2], np.int64))).sum()
+    node = out._node
+    # walk to the sparse embedding node: none on the path may claim
+    # jit-ability unless it came from the cache
+    out.backward()
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    assert isinstance(emb.weight.grad, SelectedRows)
+
+
 def test_dispatch_latency_improves():
     def measure():
         paddle.set_flags({"FLAGS_eager_vjp_cache": False})
