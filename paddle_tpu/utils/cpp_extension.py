@@ -45,6 +45,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -103,6 +104,7 @@ class CustomOp:
         self._lib = lib
         self.name = name
         self._grad_fn: Optional[Callable] = None
+        self._warned_host_bwd = False
         # out_spec_fn(*avals) -> ShapeDtypeStruct: the InferShape/InferDtype
         # of the reference custom-op ABI; defaults to "like input 0"
         self._out_spec_fn = out_spec_fn
@@ -132,7 +134,36 @@ class CustomOp:
                 raise NotImplementedError(
                     f"custom op '{self.name}' has no backward; call "
                     f"def_grad(fn) to register one")
-            grads = self._grad_fn(*res, g)
+            from ..framework.core import _TRACE_FALLBACK_ERRORS
+            try:
+                grads = self._grad_fn(*res, g)
+            except _TRACE_FALLBACK_ERRORS:
+                # host/numpy backward kernel (the reference custom-op ABI
+                # allows these, framework/custom_operator.cc): stage it
+                # through pure_callback so it survives any enclosing jit
+                # (including the cached-vjp jitted backward sweep)
+                if not self._warned_host_bwd:
+                    self._warned_host_bwd = True
+                    warnings.warn(
+                        f"custom op '{self.name}': backward is not "
+                        f"jax-traceable; running it as a host callback "
+                        f"(device round-trip per step). Write def_grad "
+                        f"with jax ops for on-device backward.")
+                specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                              for a in res)
+
+                def host(*arrs):
+                    out = self._grad_fn(*[np.asarray(x) for x in arrs])
+                    if not isinstance(out, (tuple, list)):
+                        out = (out,)
+                    if len(out) != len(specs):
+                        raise ValueError(
+                            f"custom op '{self.name}': def_grad returned "
+                            f"{len(out)} gradients for {len(specs)} inputs")
+                    return tuple(np.asarray(o, dtype=s.dtype)
+                                 for o, s in zip(out, specs))
+                grads = jax.pure_callback(host, specs, *res, g,
+                                          vmap_method="sequential")
             if not isinstance(grads, (tuple, list)):
                 grads = (grads,)
             return tuple(grads)

@@ -115,6 +115,24 @@ def test_def_op_shape_inference(lib, tmp_path):
     np.testing.assert_allclose(xg.grad.numpy(), np.ones(4))
 
 
+def test_host_numpy_grad_under_jit(lib):
+    """A host (numpy) def_grad must survive an enclosing jit: _bwd stages
+    it through pure_callback when tracing (custom_operator.cc ABI allows
+    host backward kernels)."""
+    import jax
+    import jax.numpy as jnp
+    relu = lib.elementwise_op("custom_relu_f32", op_name="custom_relu_hj")
+    relu.def_grad(
+        lambda x, g: (np.asarray(g) * (np.asarray(x) > 0)).astype("float32"))
+
+    @jax.jit
+    def loss_grad(a):
+        return jax.grad(lambda v: jnp.sum(relu._jax_fn(v) * 2.0))(a)
+
+    g = loss_grad(jnp.asarray([-2.0, 5.0, 0.5], dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [0.0, 2.0, 2.0])
+
+
 def test_flag_change_rebuilds(lib, tmp_path):
     src = tmp_path / "fl.cc"
     src.write_text(textwrap.dedent("""
