@@ -86,6 +86,7 @@ def _barrier(tag: str):
 # nested-dict flattening needs a separator no key can contain
 _NEST_SEP = "||"
 _EMPTY_DICT = "__empty_dict__"   # keeps empty sub-dicts round-tripping
+_PY_SCALAR = "__pyscalar__"      # key suffix: leaf was a python scalar
 
 
 def _flatten_state(state, prefix=""):
@@ -99,6 +100,10 @@ def _flatten_state(state, prefix=""):
             raise ValueError(
                 f"state key {k!r} contains the reserved nesting "
                 f"separator {_NEST_SEP!r}")
+        if k.endswith(_PY_SCALAR):
+            raise ValueError(
+                f"state key {k!r} ends with the reserved scalar "
+                f"marker {_PY_SCALAR!r}")
         key = f"{prefix}{_NEST_SEP}{k}" if prefix else k
         if isinstance(v, _AbcMapping):
             if v:
@@ -109,15 +114,35 @@ def _flatten_state(state, prefix=""):
                 # restore-time KeyError
                 out[f"{key}{_NEST_SEP}{_EMPTY_DICT}"] = np.zeros(
                     0, np.int8)
+        elif isinstance(v, (bool, int, float)) and not isinstance(
+                v, np.generic):
+            # python scalar (step counts, lr values): tagged at save so
+            # restore converts ONLY these back — a genuine 0-d array
+            # (learnable scalar param) stays an array with its dtype and
+            # sharding-aware layout intact
+            out[f"{key}{_PY_SCALAR}"] = np.asarray(v)
         else:
             out[key] = v
     return out
 
 
-def _unflatten_state(flat):
+def _place_leaf(cur, last, v, legacy_scalars=False):
+    if last.endswith(_PY_SCALAR):
+        cur[last[:-len(_PY_SCALAR)]] = np.asarray(v).item()
+    elif legacy_scalars and getattr(v, "ndim", None) == 0:
+        # v1 checkpoints stored python scalars as untagged 0-d arrays
+        cur[last] = np.asarray(v).item()
+    else:
+        cur[last] = v
+
+
+def _unflatten_state(flat, legacy_scalars=False):
     if not any(_NEST_SEP in k for k in flat):
-        return dict(flat)
-    out: Dict[str, Any] = {}
+        out: Dict[str, Any] = {}
+        for k, v in flat.items():
+            _place_leaf(out, k, v, legacy_scalars)
+        return out
+    out = {}
     for k, v in flat.items():
         parts = k.split(_NEST_SEP)
         cur = out
@@ -125,11 +150,7 @@ def _unflatten_state(flat):
             cur = cur.setdefault(p, {})
         if parts[-1] == _EMPTY_DICT:
             continue   # marker: the setdefault walk already made the {}
-        if getattr(v, "ndim", None) == 0:
-            # python scalar round-trip (steps, lr values) — jax arrays
-            # included, not just np (the shardings path returns those)
-            v = np.asarray(v).item()
-        cur[parts[-1]] = v
+        _place_leaf(cur, parts[-1], v, legacy_scalars)
     return out
 
 
@@ -211,7 +232,9 @@ def save_state_dict(state: Mapping[str, Any], path: str,
                 merged = entries
             tmp = os.path.join(path, _INDEX + ".tmp")
             with open(tmp, "w") as f:
-                json.dump({"version": 1, "entries": merged}, f, indent=1)
+                # version 2: python scalars are tagged with _PY_SCALAR;
+                # v1 loaders stored them as untagged 0-d arrays
+                json.dump({"version": 2, "entries": merged}, f, indent=1)
             os.replace(tmp, os.path.join(path, _INDEX))
         # second barrier: no rank may report the checkpoint complete (or
         # exit, tearing down coordination) until the index is readable
@@ -271,6 +294,11 @@ def _read_region(path, entry, region):
         if any(a >= b for a, b in zip(ilo, ihi)):
             continue  # shard does not intersect the requested region
         data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+        if data.dtype != out.dtype and data.dtype.itemsize == \
+                out.dtype.itemsize:
+            # np.save stores extension dtypes (bfloat16, fp8) as raw
+            # void bytes; reinterpret against the manifest's dtype
+            data = data.view(out.dtype)
         src = tuple(slice(a - l, b - l) for a, b, l in zip(ilo, ihi, lo))
         dst = tuple(slice(a - s, b - s) for a, b, s in zip(ilo, ihi, starts))
         out[dst] = data[src]
@@ -296,7 +324,9 @@ def load_state_dict(path: str,
     regardless of the topology they were saved from. Checkpoints written
     from nested state dicts come back nested."""
     with open(os.path.join(path, _INDEX)) as f:
-        index = json.load(f)["entries"]
+        manifest = json.load(f)
+    index = manifest["entries"]
+    legacy_scalars = manifest.get("version", 1) < 2
     out: Dict[str, Any] = {}
     for name, entry in index.items():
         if names is not None and name not in names and \
@@ -324,7 +354,7 @@ def load_state_dict(path: str,
         out[name] = jax.make_array_from_callback(
             shape, sharding,
             lambda idx, e=entry: _read_region(path, e, idx))
-    return _unflatten_state(out)
+    return _unflatten_state(out, legacy_scalars=legacy_scalars)
 
 
 class CheckpointManager:

@@ -25,6 +25,7 @@ reference's sync communicator mode).
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Iterable, Optional
@@ -79,6 +80,13 @@ class DeviceCachedTable:
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._free = list(range(self._cap - 1, -1, -1))
         self.hits = self.misses = self.evictions = 0
+        # HeterTrainer's async pipeline calls pull(i+1) from a pool
+        # thread while push(i) is still pending on another: all state
+        # mutation is serialized by _lock, and pulls made with pin=True
+        # keep their slots un-evictable until the matching push lands
+        # (plain pulls keep pure LRU semantics for pull-only use).
+        self._lock = threading.RLock()
+        self._pins: Dict[tuple, list] = {}   # uniq-ids key -> [slots, n]
 
     # -- admission / eviction -----------------------------------------
     def _admit(self, miss_ids: np.ndarray, pinned: set) -> np.ndarray:
@@ -86,22 +94,31 @@ class DeviceCachedTable:
         by the current batch), pull rows from the host table, install."""
         import jax.numpy as jnp
         n = len(miss_ids)
-        slots = np.empty(n, np.int64)
+        # plan the whole admission BEFORE mutating: raising mid-loop
+        # would orphan already-evicted slots (gone from _lru/_slot_of,
+        # never returned to _free)
         evict = []
-        for j in range(n):
-            if self._free:
-                s = self._free.pop()
-            else:
-                s = next((k for k in self._lru if k not in pinned), None)
-                if s is None:
-                    raise RuntimeError(
-                        f"device cache thrashing: batch needs more unique "
-                        f"rows than capacity={self._cap}")
-                del self._lru[s]
-                evict.append(s)
-                del self._slot_of[int(self._id_of[s])]
-                self.evictions += 1
-            slots[j] = s
+        if len(self._free) < n:
+            live = pinned.union(
+                *(p[0] for p in self._pins.values())) \
+                if self._pins else pinned
+            for k in self._lru:
+                if len(self._free) + len(evict) >= n:
+                    break
+                if k not in live:
+                    evict.append(k)
+            if len(self._free) + len(evict) < n:
+                raise RuntimeError(
+                    f"device cache thrashing: current batch plus "
+                    f"in-flight (unpushed) batches pin more unique "
+                    f"rows than capacity={self._cap}")
+        for s in evict:
+            del self._lru[s]
+            del self._slot_of[int(self._id_of[s])]
+            self.evictions += 1
+        slots = np.asarray(
+            [self._free.pop() for _ in range(n - len(evict))] + evict,
+            np.int64)
         if evict:
             self._write_back(np.asarray(evict, np.int64))
         rows = self._table.pull(miss_ids)
@@ -128,28 +145,38 @@ class DeviceCachedTable:
         self._dirty[d] = False
 
     # -- SparseTable-compatible surface --------------------------------
-    def pull(self, ids: np.ndarray):
-        """Device rows for ``ids`` (duplicates allowed) — one HBM gather."""
+    def pull(self, ids: np.ndarray, pin: bool = False):
+        """Device rows for ``ids`` (duplicates allowed) — one HBM gather.
+
+        ``pin=True`` (used by HeterTrainer's async pipeline) keeps the
+        batch's slots un-evictable until the matching :meth:`push` lands,
+        so a concurrent pull for the next batch cannot evict rows whose
+        gradients are still in flight."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
         uniq, inverse = np.unique(ids, return_inverse=True)
-        slots = np.empty(len(uniq), np.int64)
-        miss_j = []
-        for j, i in enumerate(uniq.tolist()):
-            s = self._slot_of.get(i)
-            if s is None:
-                miss_j.append(j)
-            else:
-                slots[j] = s
-                self._lru.move_to_end(s)
-                self.hits += 1
-        if miss_j:
-            self.misses += len(miss_j)
-            missing = set(miss_j)
-            pinned = {int(s) for j, s in enumerate(slots)
-                      if j not in missing}
-            slots[miss_j] = self._admit(uniq[miss_j], pinned)
-        self._last = (uniq, slots)   # push() fast path for the same batch
-        return self._buf[np.asarray(slots)[inverse]]
+        with self._lock:
+            slots = np.empty(len(uniq), np.int64)
+            miss_j = []
+            for j, i in enumerate(uniq.tolist()):
+                s = self._slot_of.get(i)
+                if s is None:
+                    miss_j.append(j)
+                else:
+                    slots[j] = s
+                    self._lru.move_to_end(s)
+                    self.hits += 1
+            if miss_j:
+                self.misses += len(miss_j)
+                missing = set(miss_j)
+                pinned = {int(s) for j, s in enumerate(slots)
+                          if j not in missing}
+                slots[miss_j] = self._admit(uniq[miss_j], pinned)
+            if pin:
+                ent = self._pins.setdefault(uniq.tobytes(), [set(), 0])
+                ent[0] = {int(s) for s in slots}
+                ent[1] += 1
+            self._last = (uniq, slots)  # push() fast path, same batch
+            return self._buf[np.asarray(slots)[inverse]]
 
     def push(self, ids: np.ndarray, grads):
         """Apply the optimizer on device to the rows of ``ids``;
@@ -158,28 +185,47 @@ class DeviceCachedTable:
         import jax.numpy as jnp
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
         uniq, inverse = np.unique(ids, return_inverse=True)
-        last = getattr(self, "_last", None)
-        if last is not None and np.array_equal(last[0], uniq):
-            slots = last[1]
-        else:
-            slots = np.asarray([self._slot_of[i] for i in uniq.tolist()],
-                               np.int64)
-        g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
-                                jnp.asarray(inverse),
-                                num_segments=len(uniq))
-        sl = jnp.asarray(slots)
-        if self._opt == "adagrad":
-            self._acc = self._acc.at[sl].add(g * g)
-            step = g / (jnp.sqrt(self._acc[sl]) + self._eps)
-        else:
-            step = g
-        self._buf = self._buf.at[sl].add(-self._lr * step)
-        self._dirty[slots] = True
+        with self._lock:
+            last = getattr(self, "_last", None)
+            if last is not None and np.array_equal(last[0], uniq):
+                slots = last[1]
+            else:
+                slots = np.asarray(
+                    [self._slot_of[i] for i in uniq.tolist()], np.int64)
+            g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
+                                    jnp.asarray(inverse),
+                                    num_segments=len(uniq))
+            sl = jnp.asarray(slots)
+            if self._opt == "adagrad":
+                self._acc = self._acc.at[sl].add(g * g)
+                step = g / (jnp.sqrt(self._acc[sl]) + self._eps)
+            else:
+                step = g
+            self._buf = self._buf.at[sl].add(-self._lr * step)
+            self._dirty[slots] = True
+            self._unpin(uniq)
+
+    def _unpin(self, uniq: np.ndarray):
+        key = uniq.tobytes()
+        ent = self._pins.get(key)
+        if ent is not None:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del self._pins[key]
+
+    def release(self, ids: np.ndarray):
+        """Release the pin of a ``pull(..., pin=True)`` whose push will
+        never come (frozen table, failed step) — eviction may then
+        reclaim the slots."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        with self._lock:
+            self._unpin(np.unique(ids))
 
     def flush(self):
         """Write every dirty row back to the host table (the reference's
         PSGPUWrapper::EndPass)."""
-        self._write_back(np.flatnonzero(self._dirty).astype(np.int64))
+        with self._lock:
+            self._write_back(np.flatnonzero(self._dirty).astype(np.int64))
 
     end_pass = flush
 
@@ -205,15 +251,37 @@ class HeterTrainer:
 
     # -- lanes ---------------------------------------------------------
     def _pull(self, ids_map: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        return {name: self._tables[name].pull(
-                    np.ascontiguousarray(np.asarray(ids), np.int64))
-                for name, ids in ids_map.items()}
+        out = {}
+        for name, ids in ids_map.items():
+            t = self._tables[name]
+            ids = np.ascontiguousarray(np.asarray(ids), np.int64)
+            # async mode: pin cached rows until this batch's push lands,
+            # so pull(i+1)'s eviction can't claim batch i's slots
+            if not self._sync and isinstance(t, DeviceCachedTable):
+                out[name] = t.pull(ids, pin=True)
+            else:
+                out[name] = t.pull(ids)
+        return out
 
     def _push(self, ids_map, grads: Dict[str, np.ndarray]):
         for name, g in grads.items():
             self._tables[name].push(
                 np.ascontiguousarray(np.asarray(ids_map[name]), np.int64),
                 np.asarray(g))
+        for name in ids_map.keys() - grads.keys():
+            # pulled but no grad (frozen/eval-only table): the pin from
+            # the async pull must still come off or it leaks forever
+            self._release(name, ids_map[name])
+
+    def _release(self, name, ids):
+        t = self._tables[name]
+        if isinstance(t, DeviceCachedTable):
+            t.release(np.ascontiguousarray(np.asarray(ids), np.int64))
+
+    def _release_all(self, ids_map):
+        if not self._sync:
+            for name, ids in ids_map.items():
+                self._release(name, ids)
 
     def _drain_pushes(self, keep: int = 0):
         while len(self._pending_push) > keep:
@@ -228,6 +296,11 @@ class HeterTrainer:
         Pipeline: pull(i+1) on host threads overlaps the TPU dense step
         on batch i; pushes are fire-and-forget futures drained with one
         batch of lag (async mode) or inline (sync mode).
+
+        Async mode over a :class:`DeviceCachedTable` pins batch i's rows
+        until its push lands, so the cache capacity must cover TWO
+        consecutive batches' unique rows; a tighter cache raises the
+        thrashing error instead of silently corrupting in-flight rows.
         """
         it = iter(batches)
         try:
@@ -251,7 +324,11 @@ class HeterTrainer:
                 # async-communicator semantics of the reference
                 self._drain_pushes(keep=0)
                 pull_f = self._pool.submit(self._pull, nxt_ids)
-            result, grads = self._dense_step(emb, batch)   # TPU lane
+            try:
+                result, grads = self._dense_step(emb, batch)  # TPU lane
+            except BaseException:
+                self._release_all(ids)   # a retry must not inherit pins
+                raise
             if self._sync:
                 self._push(ids, grads)
             else:

@@ -368,13 +368,29 @@ class PSClient:
         while not self._beat_stop.wait(interval):
             if self._stop.is_set():
                 return
-            for s in self._beat_socks:
+            for i, s in enumerate(self._beat_socks):
+                if s is None:   # broken last beat: fresh connection
+                    try:
+                        h, p = self._eps[i]
+                        s = socket.create_connection((h, p), timeout=2.0)
+                        s.settimeout(2.0)
+                        self._beat_socks[i] = s
+                    except OSError:
+                        continue
                 try:
                     _send_msg(s, {"op": "heartbeat",
                                   "worker": self.worker_id})
                 except (OSError, socket.timeout):
-                    continue  # one dead server must not stop beats to
-                              # the healthy ones
+                    # a timed-out sendall may have left a PARTIAL frame:
+                    # reusing this socket would garble the length-prefixed
+                    # stream and get a live worker falsely evicted. Drop
+                    # it; reconnect on the next beat. One dead server must
+                    # not stop beats to the healthy ones either.
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    self._beat_socks[i] = None
 
     def _shard(self, ids: np.ndarray) -> np.ndarray:
         return np.asarray(ids) % len(self._socks)
@@ -526,6 +542,8 @@ class PSClient:
         self._stop.set()
         self._beat_stop.set()
         for s in self._socks + self._beat_socks:
+            if s is None:
+                continue
             try:
                 s.close()
             except OSError:

@@ -433,12 +433,27 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
     import jax.numpy as jnp
 
     def f(pred, lab):
+        import jax as _jax
         score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
             else pred.reshape(-1)
         lab_f = lab.reshape(-1).astype(jnp.float32)
+        n = score.shape[0]
+        if n == 0:   # static shape: empty batch short-circuits cleanly
+            return jnp.float32(0.0)
         order = jnp.argsort(score)
-        ranks = jnp.empty_like(order).at[order].set(
-            jnp.arange(1, score.shape[0] + 1))
+        srt = score[order]
+        raw = jnp.arange(1, n + 1, dtype=jnp.float32)
+        # tied scores take their group's AVERAGE rank (the reference's
+        # thresholded buckets handle ties the same way); raw argsort
+        # order would make equal-score batches order-dependent
+        grp = jnp.cumsum(jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             (srt[1:] != srt[:-1]).astype(jnp.int32)]))
+        gsum = _jax.ops.segment_sum(raw, grp, num_segments=n)
+        gcnt = _jax.ops.segment_sum(jnp.ones(n, jnp.float32), grp,
+                                    num_segments=n)
+        avg = (gsum / jnp.maximum(gcnt, 1.0))[grp]
+        ranks = jnp.zeros(n, jnp.float32).at[order].set(avg)
         pos = jnp.sum(lab_f)
         neg = lab_f.shape[0] - pos
         s = jnp.sum(ranks * lab_f)

@@ -126,12 +126,17 @@ def require_version(min_version, max_version=None):
     def _tuple(v):
         return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
     cur = _tuple(__version__)
-    if _tuple(min_version) > cur and max_version is None:
-        import warnings
+    import warnings
+    if _tuple(min_version) > cur:
         warnings.warn(
-            f"require_version({min_version!r}): this TPU-native build "
-            f"reports {__version__} but implements the 2.x surface; "
-            f"continuing")
+            f"require_version(min={min_version!r}): this TPU-native "
+            f"build reports {__version__} but implements the 2.x "
+            f"surface; continuing")
+    if max_version is not None and cur > _tuple(max_version):
+        warnings.warn(
+            f"require_version(max={max_version!r}): this TPU-native "
+            f"build reports {__version__}, above the requested "
+            f"ceiling; continuing")
     return True
 
 

@@ -129,3 +129,21 @@ def test_temporal_shift_validation():
         F.temporal_shift(paddle.to_tensor(np.ones((8, 4, 1, 1),
                                                   np.float32)),
                          seg_num=4, shift_ratio=0.6)
+
+
+def test_require_version_warns_both_bounds():
+    # ADVICE r2: max_version used to disable ALL checking
+    import warnings
+    from paddle_tpu.utils import require_version
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert require_version("9.0", "10.0") is True
+    assert any("min=" in str(x.message) for x in w)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert require_version("0.1", "0.2") is True
+    assert any("max=" in str(x.message) for x in w)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert require_version("0.1") is True
+    assert not w

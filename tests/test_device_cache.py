@@ -124,3 +124,88 @@ def test_heter_trainer_over_device_cache():
     ids = np.arange(12, dtype=np.int64)
     np.testing.assert_allclose(table.pull(ids), np.asarray(
         cache.pull(ids)), rtol=1e-5, atol=1e-6)
+
+
+def test_pinned_pull_blocks_eviction_until_push():
+    # ADVICE r2 (medium): async pipeline could evict batch-i rows before
+    # push(i) landed. pin=True holds slots; push releases them.
+    table, cache = _mk(capacity=2)
+    cache.pull(np.array([1, 2], np.int64), pin=True)
+    with pytest.raises(RuntimeError, match="in-flight"):
+        cache.pull(np.array([3], np.int64))        # both slots pinned
+    cache.push(np.array([1, 2], np.int64), np.zeros((2, 4), np.float32))
+    cache.pull(np.array([3], np.int64))            # pins released -> evicts
+    assert 3 in cache._slot_of
+
+
+def test_async_trainer_eviction_pressure_exact():
+    # disjoint 4-id batches through a capacity-8 cache in ASYNC mode:
+    # pull(i+1) must evict only batch i-1 (push landed), never batch i
+    # (pinned). Exactness vs direct-table training proves no row was
+    # dropped or double-applied.
+    dim = 4
+    table = SparseTable(dim, optimizer="sgd", lr=1.0)
+    ref = SparseTable(dim, optimizer="sgd", lr=1.0)
+    all_ids = np.arange(16, dtype=np.int64)
+    table.pull(all_ids); ref.pull(all_ids)
+    cache = DeviceCachedTable(table, capacity=8, lr=0.25)
+
+    def dense_step(emb, batch):
+        rows = emb["emb"]
+        grads = {"emb": np.ones_like(np.asarray(rows))}
+        return 0.0, grads
+
+    tr = HeterTrainer({"emb": cache}, dense_step, sync_mode=False)
+    batches = [all_ids[(4 * i) % 16:(4 * i) % 16 + 4] for i in range(12)]
+    steps = tr.run(batches, lambda b: {"emb": b})
+    tr.shutdown()
+    cache.flush()
+    assert steps == 12
+    assert cache.evictions > 0                     # pressure was real
+    assert not cache._pins                         # all pins released
+    for b in batches:                              # same math, direct
+        ref.push_delta(b, -0.25 * np.ones((4, dim), np.float32))
+    np.testing.assert_allclose(table.pull(all_ids), ref.pull(all_ids),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pin_released_when_no_grads_or_step_raises():
+    # review r3: a pulled-but-never-pushed table must not leak pins.
+    # capacity 8 holds two 4-id batches (the async pipeline's working
+    # set); without release-on-no-grads the pins accumulate and batch 3
+    # thrashes.
+    table, cache = _mk(capacity=8)
+
+    def no_grad_step(emb, batch):
+        return 0.0, {}                         # frozen embedding
+
+    tr = HeterTrainer({"emb": cache}, no_grad_step, sync_mode=False)
+    batches = [np.arange(4 * i, 4 * i + 4, dtype=np.int64)
+               for i in range(6)]
+    tr.run(batches, lambda b: {"emb": b})      # previously thrashed
+    tr.shutdown()
+    assert not cache._pins
+
+    table2, cache2 = _mk(capacity=4)
+
+    def boom(emb, batch):
+        raise RuntimeError("boom")
+
+    tr2 = HeterTrainer({"emb": cache2}, boom, sync_mode=False)
+    with pytest.raises(RuntimeError, match="boom"):
+        tr2.run([np.arange(4, dtype=np.int64)], lambda b: {"emb": b})
+    tr2.shutdown()
+    assert not cache2._pins
+
+
+def test_admit_failure_leaves_cache_consistent():
+    # review r3: a thrashing raise must not orphan evicted slots
+    table, cache = _mk(capacity=4)
+    cache.pull(np.array([0, 1, 2], np.int64), pin=True)
+    with pytest.raises(RuntimeError, match="thrashing"):
+        cache.pull(np.array([10, 11, 12], np.int64))
+    # slot bookkeeping intact: all 4 slots still reachable
+    assert len(cache._free) + len(cache._lru) == 4
+    cache.push(np.array([0, 1, 2], np.int64), np.zeros((3, 4), np.float32))
+    cache.pull(np.array([10, 11, 12], np.int64))   # now fine
+    assert 10 in cache._slot_of

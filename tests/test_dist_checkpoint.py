@@ -140,3 +140,18 @@ def test_restore_into_training_step(tmp_path):
     out = step(restored, np.ones((2, 8), np.float32))
     np.testing.assert_allclose(np.asarray(out), np.ones((2, 8)) @ w,
                                rtol=1e-5)
+
+
+def test_zero_d_array_vs_python_scalar_roundtrip(tmp_path):
+    # ADVICE r2: a saved 0-d ARRAY must come back as an array (dtype
+    # kept); only genuine python scalars come back as scalars
+    import jax.numpy as jnp
+    state = {"opt": {"step": 7, "lr": 0.125,
+                     "temperature": jnp.asarray(1.5, jnp.bfloat16)}}
+    ckpt.save_state_dict(state, str(tmp_path / "ck"))
+    back = ckpt.load_state_dict(str(tmp_path / "ck"))
+    assert back["opt"]["step"] == 7 and isinstance(back["opt"]["step"], int)
+    assert isinstance(back["opt"]["lr"], float)
+    t = back["opt"]["temperature"]
+    assert getattr(t, "ndim", None) == 0 and t.dtype == jnp.bfloat16
+    np.testing.assert_allclose(float(t), 1.5)

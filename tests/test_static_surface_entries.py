@@ -247,3 +247,20 @@ def test_save_load_vars_subset(tmp_path):
         else:       # untouched by the subset restore
             np.testing.assert_allclose(np.asarray(p._value),
                                        orig_all[i] * 0.0 + 7.0)
+
+
+def test_auc_tie_handling():
+    # ADVICE r2: tied scores must take averaged ranks — an all-equal
+    # score vector is pure chance, AUC 0.5 regardless of label order
+    pred = paddle.to_tensor(np.full((6,), 0.5, np.float32))
+    for labels in ([1, 0, 1, 0, 0, 1], [0, 0, 0, 1, 1, 1]):
+        lab = paddle.to_tensor(np.asarray(labels, np.int64).reshape(-1, 1))
+        np.testing.assert_allclose(
+            float(static.auc(pred, lab).numpy()), 0.5, atol=1e-6)
+    # scipy-style check: ties only among part of the scores
+    pred2 = paddle.to_tensor(np.asarray([0.1, 0.4, 0.4, 0.8], np.float32))
+    lab2 = paddle.to_tensor(np.asarray([[0], [0], [1], [1]], np.int64))
+    # pos ranks avg: 0.4 ties (ranks 2,3 -> 2.5 each), 0.8 -> 4
+    # U = (2.5 + 4) - 2*3/2 = 3.5 ; AUC = 3.5 / (2*2) = 0.875
+    np.testing.assert_allclose(float(static.auc(pred2, lab2).numpy()),
+                               0.875, atol=1e-6)
