@@ -40,7 +40,8 @@ def _sample_key(seed: int):
     """paddle sample(seed=...) semantics: seed=0 means draw from the
     global stream; a nonzero seed gives a reproducible standalone draw."""
     if seed:
-        return jax.random.PRNGKey(seed)
+        from .framework.random import make_key
+        return make_key(seed)
     return split_key(1)
 
 

@@ -283,6 +283,8 @@ class Predictor:
 
         self._params = {k: _put(v) for k, v in blob["params"].items()}
         self._buffers = {k: _put(v) for k, v in blob["buffers"].items()}
+        # exported artifacts bake the key SHAPE in at save time:
+        # stay on portable threefry regardless of FLAGS_rng_impl
         self._rng = jax.random.PRNGKey(0)
         if bf16:
             exported_call = self._exported.call

@@ -50,7 +50,8 @@ def randn(shape, dtype="float32", name=None):
 
 
 def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
-    key = jax.random.PRNGKey(seed) if seed else split_key()
+    from ..framework.random import make_key
+    key = make_key(seed) if seed else split_key()
     return Tensor(jax.random.uniform(key, _shape(shape),
                                      dtypes.to_jax(dtype), min, max))
 

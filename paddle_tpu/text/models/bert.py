@@ -232,9 +232,20 @@ class BertForPretraining(Layer):
         self.nsp = Linear(c.hidden_size, 2, weight_attr=init)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, masked_positions=None):
+        """``masked_positions`` [B, M] int32: decode MLM logits only at
+        those positions (the reference PretrainModelLayer's ``mask_pos``
+        input, bert_dygraph_model.py — it gathers before the decoder so
+        the [B, S, V] logits tensor never exists). ``None`` decodes every
+        position."""
         seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
                                 attention_mask)
+        if masked_positions is not None:
+            def gather_pos(hv, pos):
+                return jnp.take_along_axis(
+                    hv, pos[:, :, None].astype(jnp.int32), axis=1)
+            seq = _apply(gather_pos, seq, masked_positions,
+                         op_name="gather_masked")
         h = self.mlm_norm(self.act(self.mlm_transform(seq)))
         w = self.bert.embeddings.word_embeddings.weight  # [V, H]
 

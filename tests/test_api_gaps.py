@@ -147,3 +147,37 @@ def test_require_version_warns_both_bounds():
         warnings.simplefilter("always")
         assert require_version("0.1") is True
     assert not w
+
+
+def test_rng_impl_flag_typed_keys():
+    # FLAGS_rng_impl=rbg mints typed keys that split/draw consistently
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.framework import flags
+    from paddle_tpu.framework.random import make_key
+    old = flags.get_flags("FLAGS_rng_impl")["FLAGS_rng_impl"]
+    try:
+        flags.set_flags({"FLAGS_rng_impl": "rbg"})
+        k = make_key(7)
+        k1, k2 = jax.random.split(k)
+        a = jax.random.bernoulli(k1, 0.5, (128,))
+        assert a.dtype == jnp.bool_
+        flags.set_flags({"FLAGS_rng_impl": "threefry2x32"})
+        kt = make_key(7)
+        b1 = jax.random.uniform(jax.random.split(kt)[0], (4,))
+        b2 = jax.random.uniform(jax.random.split(make_key(7))[0], (4,))
+        assert (jnp.asarray(b1) == jnp.asarray(b2)).all()   # reproducible
+    finally:
+        flags.set_flags({"FLAGS_rng_impl": old})
+
+
+def test_rng_state_serializable_roundtrip(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    st = paddle.get_cuda_rng_state()
+    arr = np.asarray(st)           # must be numpy-convertible
+    np.save(tmp_path / "rng.npy", arr)
+    before = paddle.rand([4]).numpy()
+    paddle.set_cuda_rng_state(np.load(tmp_path / "rng.npy"))
+    after = paddle.rand([4]).numpy()
+    np.testing.assert_allclose(before, after)
