@@ -344,3 +344,55 @@ class TestReviewRegressionsNN:
         y3, _ = lstm(x)
         y4, _ = lstm(x)
         np.testing.assert_allclose(y3.numpy(), y4.numpy())
+
+
+def test_batch_norm_closed_form_grads_match_autodiff():
+    # r3 perf rewrite: closed-form BN/LN backward must equal autodiff of
+    # the naive two-pass formulation
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.nn.functional.common import _norm_train, _ln_train
+
+    rng = np.random.RandomState(0)
+    v = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32))
+    w = jnp.asarray(rng.rand(6).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(6).astype(np.float32))
+    red = (0, 2, 3)
+
+    def naive(v, w, b):
+        m = jnp.mean(v, axis=red)
+        va = jnp.var(v, axis=red)
+        sh = (1, 6, 1, 1)
+        out = (v - m.reshape(sh)) * jax.lax.rsqrt(va.reshape(sh) + 1e-5)
+        return out * w.reshape(sh) + b.reshape(sh)
+
+    def ours(v, w, b):
+        return _norm_train(v, w, b, red, 1e-5)[0]
+
+    g = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32))
+    o1, vjp1 = jax.vjp(naive, v, w, b)
+    o2, vjp2 = jax.vjp(ours, v, w, b)
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+    for a, c in zip(vjp1(g), vjp2(g)):
+        np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-4)
+
+    # layer norm: params live on the normalized axes
+    v2 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    w2 = jnp.asarray(rng.rand(16).astype(np.float32) + 0.5)
+    b2 = jnp.asarray(rng.randn(16).astype(np.float32))
+
+    def naive_ln(v, w, b):
+        m = jnp.mean(v, axis=-1, keepdims=True)
+        va = jnp.var(v, axis=-1, keepdims=True)
+        return (v - m) * jax.lax.rsqrt(va + 1e-5) * w + b
+
+    def ours_ln(v, w, b):
+        return _ln_train(v, w, b, 1, 1e-5)
+
+    g2 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    o1, vjp1 = jax.vjp(naive_ln, v2, w2, b2)
+    o2, vjp2 = jax.vjp(ours_ln, v2, w2, b2)
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+    for a, c in zip(vjp1(g2), vjp2(g2)):
+        np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-4)
