@@ -193,6 +193,80 @@ def _bench_bert(smoke, peak_tflops):
                     batch=batch, seq_len=seq, masked_per_seq=n_mask)
 
 
+def _bench_llama(smoke, peak_tflops):
+    """Llama-proxy decoder pretrain: seq 2048 causal, bf16, scanned
+    layers + per-layer remat, Pallas flash attention on the hot path
+    (BASELINE north-star family; the 2021 reference has no Llama, so the
+    proxy documents absolute tokens/sec/chip)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+    batch = int(os.environ.get("BENCH_BATCH", "2" if smoke else "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "10"))
+    seq = 64 if smoke else 2048
+
+    paddle.seed(0)
+    if smoke:
+        cfg = llama_tiny(scan_layers=True, remat=True,
+                         max_position_embeddings=seq)
+    else:
+        # ~470M-param proxy: big enough that matmuls dominate, small
+        # enough for f32 master params + AdamW moments on one chip
+        cfg = llama_tiny(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=seq,
+            scan_layers=True, remat=True)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    flash_info = {}
+    if not smoke:
+        # on-chip parity: the exact kernel the model dispatches to at
+        # seq 2048 vs the XLA softmax composition
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.attention import _sdpa_ref
+        from paddle_tpu.ops.flash_attention import (flash_attention_bhsd,
+                                                    flash_eligible)
+        assert flash_eligible(seq, cfg.head_dim), \
+            "flash kernel must be live on the llama bench path"
+        rng = np.random.RandomState(0)
+        qkv = [jnp.asarray(rng.randn(1, 4, seq, cfg.head_dim),
+                           jnp.bfloat16) for _ in range(3)]
+        fo = flash_attention_bhsd(*qkv, causal=True)
+        ro = _sdpa_ref(jnp.swapaxes(qkv[0], 1, 2),
+                       jnp.swapaxes(qkv[1], 1, 2),
+                       jnp.swapaxes(qkv[2], 1, 2), None, 0.0, True, None)
+        err = float(jnp.max(jnp.abs(fo.astype(jnp.float32)
+                                    - jnp.swapaxes(ro, 1, 2)
+                                    .astype(jnp.float32))))
+        assert err < 3e-2, f"flash-vs-ref parity failed on chip: {err}"
+        flash_info = {"flash_parity_max_abs_err": round(err, 6),
+                      "flash_kernel": "pallas"}
+
+    def loss_fn(ids, labels):
+        loss, _ = model(ids, labels=labels)
+        return loss
+
+    step = _make_step(model, loss_fn, opt, smoke)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+
+    nparams = sum(int(np.prod(p.shape)) for p in model.parameters())
+    analytic = 6.0 * nparams * batch * seq \
+        + 12.0 * cfg.num_hidden_layers * batch * seq * seq \
+        * cfg.hidden_size  # causal attn ~1/2 of full, fwd+bwd
+    return _measure(step, (ids, ids), steps, batch * seq,
+                    "llama_proxy_pretrain_throughput", "tokens/sec/chip",
+                    analytic, peak_tflops, batch=batch, seq_len=seq,
+                    n_params=nparams, **flash_info)
+
+
 def main():
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     if smoke:
@@ -200,14 +274,17 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS", DEFAULT_PEAK_TFLOPS))
     which = [w.strip() for w in
-             os.environ.get("BENCH_METRICS", "resnet,bert").split(",")]
-    which = [w for w in which if w] or ["resnet", "bert"]
+             os.environ.get("BENCH_METRICS",
+                            "resnet,bert,llama").split(",")]
+    which = [w for w in which if w] or ["resnet", "bert", "llama"]
 
     results = []
     if "resnet" in which:
         results.append(_bench_resnet(smoke, peak))
     if "bert" in which:
         results.append(_bench_bert(smoke, peak))
+    if "llama" in which:
+        results.append(_bench_llama(smoke, peak))
     if not results:  # unknown names: still honor the one-JSON-line contract
         results.append(_bench_resnet(smoke, peak))
 

@@ -57,15 +57,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             use_flash = flash_eligible(qv.shape[1], qv.shape[3],
                                        has_mask=attn_mask is not None,
                                        dropout=drop)
+        if use_flash and attn_mask is not None:
+            mv = attn_mask._value
+            # only additive [B,1,1,S] rows stream through the kernel
+            use_flash = (mv.ndim == 4 and mv.shape[1] == 1
+                         and mv.shape[2] == 1
+                         and jnp.issubdtype(mv.dtype, jnp.floating))
     except Exception:
         use_flash = False
 
     if use_flash:
         from ...ops.flash_attention import flash_attention as _fa
 
-        def f(q, k, v):
-            return _fa(q, k, v, causal=is_causal, scale=scale)
-        return _apply(f, query, key, value, op_name="flash_attention")
+        if attn_mask is None:
+            def f(q, k, v):
+                return _fa(q, k, v, causal=is_causal, scale=scale)
+            return _apply(f, query, key, value,
+                          op_name="flash_attention")
+
+        def f(q, k, v, m):
+            return _fa(q, k, v, bias=m.astype(q.dtype), causal=is_causal,
+                       scale=scale)
+        return _apply(f, query, key, value, attn_mask,
+                      op_name="flash_attention")
 
     dk = split_key() if drop > 0.0 else None
     if attn_mask is not None:

@@ -40,8 +40,13 @@ __all__ = ["flash_attention", "flash_attention_bhsd"]
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float, seq_k: int, block_q: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
+                scale: float, seq_k: int, block_q: int, has_bias: bool):
+    if has_bias:
+        bias_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+        bias_ref = None
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, d)
 
@@ -63,6 +68,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
         v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if has_bias:
+            # additive [B, 1, 1, S_k] bias (padding masks): one row per
+            # batch, broadcast over heads and queries
+            bv = bias_ref[0, 0, 0, pl.dslice(kb * block_k, block_k)]
+            s = s + bv.astype(jnp.float32)[None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -82,7 +92,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
+                    interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -90,30 +101,39 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (b, h, sq // block_q)
 
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_k=sk, block_q=block_q)
+                               scale=scale, seq_k=sk, block_q=block_q,
+                               has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, h_, q_: (b_, h_, q_, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, 1, sk),
+                                     lambda b_, h_, q_: (b_, 0, 0, 0)))
+        args.append(bias)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, q_: (b_, h_, q_, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, q_: (b_, h_, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b_, h_, q_: (b_, h_, q_, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _ref_chunked(q, k, v, causal, scale, chunk=512):
+def _ref_chunked(q, k, v, bias, causal, scale, chunk=512):
     """Blockwise-recompute attention in plain XLA (used for backward)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
 
     def one_chunk(qc, q0):
         s = jnp.einsum("bhqd,bhkd->bhqk", qc * scale, k)
+        if bias is not None:
+            s = s + bias.astype(s.dtype)
         if causal:
             q_pos = q0 + jnp.arange(qc.shape[2])[:, None]
             k_pos = jnp.arange(sk)[None, :]
@@ -128,29 +148,42 @@ def _ref_chunked(q, k, v, causal, scale, chunk=512):
     return jnp.concatenate(outs, axis=2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention_bhsd(q, k, v, causal=False, scale=None, block_q=512,
-                         block_k=512, interpret=False):
-    """Flash attention on (B, H, S, D) tensors."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_bhsd(q, k, v, bias=None, causal=False, scale=None,
+                         block_q=512, block_k=512, interpret=False):
+    """Flash attention on (B, H, S, D) tensors.
+
+    ``bias``: optional additive [B, 1, 1, S_k] tensor (padding masks as
+    0/-inf rows), added to the scores before softmax — streamed into the
+    Pallas kernel one batch-row at a time, so the [B, H, S, S] score
+    tensor still never materializes."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    if bias is not None and tuple(bias.shape) != (q.shape[0], 1, 1, sk):
+        return _ref_chunked(q, k, v, bias, causal, scale)
     if sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0:
-        return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
-    return _ref_chunked(q, k, v, causal, scale)
+        return _pallas_forward(q, k, v, bias, causal, scale, block_q,
+                               block_k, interpret)
+    return _ref_chunked(q, k, v, bias, causal, scale)
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention_bhsd(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
-    return out, (q, k, v)
+def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out = flash_attention_bhsd(q, k, v, bias, causal, scale, block_q,
+                               block_k, interpret)
+    return out, (q, k, v, bias)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, bias = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(lambda q_, k_, v_: _ref_chunked(q_, k_, v_, causal, s),
-                     q, k, v)
+    if bias is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _ref_chunked(q_, k_, v_, None, causal, s),
+            q, k, v)
+        return (*vjp(g), None)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, b_: _ref_chunked(q_, k_, v_, b_, causal, s),
+        q, k, v, bias)
     return vjp(g)
 
 
@@ -160,20 +193,20 @@ flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
                    dropout: float = 0.0) -> bool:
     """Single source of truth for Pallas flash-attention dispatch: long
-    sequences with MXU-friendly head dims on TPU, no additive mask or
-    dropout (those go through the XLA softmax composition)."""
+    sequences with MXU-friendly head dims on TPU. Additive [B,1,1,S]
+    masks stream through the kernel; dropout still goes through the XLA
+    softmax composition."""
     import jax
     return (jax.default_backend() == "tpu" and seq_len >= 1024
-            and head_dim in (64, 128, 256) and not has_mask
-            and dropout == 0.0)
+            and head_dim in (64, 128, 256) and dropout == 0.0)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512, interpret=False):
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    block_q=512, block_k=512, interpret=False):
     """Flash attention on paddle-layout (B, S, H, D) tensors."""
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    out = flash_attention_bhsd(qh, kh, vh, causal, scale, block_q, block_k,
-                               interpret)
+    out = flash_attention_bhsd(qh, kh, vh, bias, causal, scale, block_q,
+                               block_k, interpret)
     return jnp.swapaxes(out, 1, 2)

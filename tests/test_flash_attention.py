@@ -27,7 +27,8 @@ def test_flash_matches_reference(causal):
     v = rng.randn(2, 2, 256, 64).astype(np.float32)
     scale = 1.0 / np.sqrt(64)
     out = flash_attention_bhsd(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
-                               causal, None, 128, 128, True)  # interpret
+                               causal=causal, block_q=128, block_k=128,
+                               interpret=True)  # interpret
     ref = _ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
@@ -40,7 +41,8 @@ def test_flash_gradients_match_reference():
     v = jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
 
     def loss_flash(q, k, v):
-        return flash_attention_bhsd(q, k, v, True, None, 64, 64, True).sum()
+        return flash_attention_bhsd(q, k, v, causal=True, block_q=64,
+                                    block_k=64, interpret=True).sum()
 
     def loss_ref(q, k, v):
         return _ref(q, k, v, True, 1.0 / np.sqrt(64)).sum()
@@ -55,7 +57,38 @@ def test_flash_gradients_match_reference():
 def test_non_divisible_seq_falls_back():
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(1, 1, 100, 32).astype(np.float32))
-    out = flash_attention_bhsd(q, q, q, False, None, 64, 64, True)
+    out = flash_attention_bhsd(q, q, q, block_q=64, block_k=64,
+                               interpret=True)
     ref = _ref(q, q, q, False, 1.0 / np.sqrt(32))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
                                atol=2e-3)
+
+
+def test_flash_additive_bias_matches_reference():
+    # r3: padding masks stream through the kernel as [B,1,1,S] rows
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 2, 128, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 2, 128, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 2, 128, 64).astype(np.float32))
+    keep = rng.rand(2, 128) > 0.3
+    bias = jnp.asarray(np.where(keep, 0.0, -1e30)
+                       .astype(np.float32))[:, None, None, :]
+    out = flash_attention_bhsd(q, k, v, bias=bias, block_q=64, block_k=64,
+                               interpret=True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q / np.sqrt(64), k) + bias
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    # grads flow through the masked path too
+    def loss(q, k, v):
+        return flash_attention_bhsd(q, k, v, bias=bias, block_q=64,
+                                    block_k=64, interpret=True).sum()
+    def loss_ref(q, k, v):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q / np.sqrt(64), k) + bias
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(sc, axis=-1), v).sum()
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
