@@ -212,7 +212,8 @@ def _bench_llama(smoke, peak_tflops):
         cfg = llama_tiny(scan_layers=True, remat=True,
                          max_position_embeddings=seq)
     else:
-        # ~470M-param proxy: big enough that matmuls dominate, small
+        # ~536M-param proxy (incl. 65.5M embeddings): big enough that
+        # matmuls dominate, small
         # enough for f32 master params + AdamW moments on one chip
         cfg = llama_tiny(
             vocab_size=32000, hidden_size=2048, intermediate_size=5504,
@@ -258,9 +259,11 @@ def _bench_llama(smoke, peak_tflops):
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
 
     nparams = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # attention term: full bidirectional train would be 12*L*B*S^2*H;
+    # causal halves the score/PV work -> coefficient 6
     analytic = 6.0 * nparams * batch * seq \
-        + 12.0 * cfg.num_hidden_layers * batch * seq * seq \
-        * cfg.hidden_size  # causal attn ~1/2 of full, fwd+bwd
+        + 6.0 * cfg.num_hidden_layers * batch * seq * seq \
+        * cfg.hidden_size
     return _measure(step, (ids, ids), steps, batch * seq,
                     "llama_proxy_pretrain_throughput", "tokens/sec/chip",
                     analytic, peak_tflops, batch=batch, seq_len=seq,

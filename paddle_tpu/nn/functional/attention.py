@@ -54,15 +54,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...ops.flash_attention import flash_eligible
         qv = query._value
         if qv.ndim == 4:
-            use_flash = flash_eligible(qv.shape[1], qv.shape[3],
-                                       has_mask=attn_mask is not None,
-                                       dropout=drop)
-        if use_flash and attn_mask is not None:
-            mv = attn_mask._value
-            # only additive [B,1,1,S] rows stream through the kernel
-            use_flash = (mv.ndim == 4 and mv.shape[1] == 1
-                         and mv.shape[2] == 1
-                         and jnp.issubdtype(mv.dtype, jnp.floating))
+            mv = attn_mask._value if attn_mask is not None else None
+            use_flash = flash_eligible(
+                qv.shape[1], qv.shape[3],
+                has_mask=mv is not None, dropout=drop,
+                mask_shape=None if mv is None else tuple(mv.shape),
+                mask_dtype=None if mv is None else mv.dtype)
     except Exception:
         use_flash = False
 

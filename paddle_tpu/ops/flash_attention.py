@@ -191,14 +191,25 @@ flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
-                   dropout: float = 0.0) -> bool:
+                   dropout: float = 0.0, mask_shape=None,
+                   mask_dtype=None) -> bool:
     """Single source of truth for Pallas flash-attention dispatch: long
     sequences with MXU-friendly head dims on TPU. Additive [B,1,1,S]
-    masks stream through the kernel; dropout still goes through the XLA
-    softmax composition."""
+    float masks stream through the kernel (pass mask_shape/mask_dtype to
+    vet them); any other mask, and dropout, go through the XLA softmax
+    composition."""
     import jax
-    return (jax.default_backend() == "tpu" and seq_len >= 1024
-            and head_dim in (64, 128, 256) and dropout == 0.0)
+    if not (jax.default_backend() == "tpu" and seq_len >= 1024
+            and head_dim in (64, 128, 256) and dropout == 0.0):
+        return False
+    if not has_mask and mask_shape is None:
+        return True
+    if mask_shape is None:      # mask present but un-vettable
+        return False
+    return (len(mask_shape) == 4 and mask_shape[1] == 1
+            and mask_shape[2] == 1
+            and (mask_dtype is None
+                 or jnp.issubdtype(mask_dtype, jnp.floating)))
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
