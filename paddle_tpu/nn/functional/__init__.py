@@ -6,12 +6,13 @@ from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .crf import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
 
-from . import (activation, attention, common, conv, loss, pooling,  # noqa: F401
-               sequence, vision)
+from . import (activation, attention, common, conv, crf,  # noqa: F401
+               loss, pooling, sequence, vision)
 
 __all__ = (activation.__all__ + attention.__all__ + common.__all__ +
-           conv.__all__ + loss.__all__ + pooling.__all__ +
+           conv.__all__ + crf.__all__ + loss.__all__ + pooling.__all__ +
            sequence.__all__ + vision.__all__)

@@ -1,0 +1,403 @@
+"""Sequence-labeling op family: linear-chain CRF, Viterbi decode, edit
+distance, CTC greedy decode, chunk evaluation.
+
+Parity targets:
+- linear_chain_crf  — fluid/layers/nn.py:726, operators/linear_chain_crf_op.{cc,h}
+- crf_decoding      — fluid/layers/nn.py:853, operators/crf_decoding_op.h
+  (with Label given, 1 marks a CORRECT position, crf_decoding_op.h:109)
+- edit_distance     — fluid/layers/loss.py:360, operators/edit_distance_op.cc
+- ctc_greedy_decoder — fluid/layers/nn.py:5267, operators/ctc_align_op.cc
+- chunk_eval        — fluid/layers/nn.py:1069, operators/chunk_eval_op.cc
+
+TPU-native shape contract: LoD sequences become padded [N, S] + lengths
+(the framework-wide convention, nn/functional/sequence.py). The CRF
+recursions are ``lax.scan`` over time — static shapes, jit/grad-safe; the
+transition parameter keeps the reference's [num_tags + 2, num_tags]
+layout (row 0 start weights, row 1 stop weights, rows 2: the square
+transition matrix) so checkpoints translate 1:1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, _apply
+
+__all__ = ["linear_chain_crf", "crf_decoding", "viterbi_decode",
+           "edit_distance", "ctc_greedy_decoder", "chunk_eval"]
+
+
+def _split_transition(transition):
+    """[T+2, T] -> (start[T], stop[T], trans[T, T]) per the reference
+    layout (linear_chain_crf_op.h: w[0]=a, w[1]=b, w[2:]=square)."""
+    return transition[0], transition[1], transition[2:]
+
+
+def _mask_from_length(length, n, s):
+    if length is None:
+        return jnp.ones((n, s), jnp.float32)
+    t = jnp.arange(s)[None, :]
+    return (t < jnp.reshape(length, (-1, 1))).astype(jnp.float32)
+
+
+def linear_chain_crf(input, label, transition, length=None):
+    """Negative log-likelihood of ``label`` paths under a linear-chain
+    CRF — the quantity the reference's crf_cost minimizes.
+
+    Args: input [N, S, T] emissions; label [N, S] int; transition
+    [T+2, T] (learnable); length [N] optional valid lengths.
+    Returns [N, 1] float32 NLL (differentiable w.r.t. input/transition).
+    """
+    args = [input, label, transition] + ([length] if length is not None
+                                         else [])
+
+    def f(em, lab, w, *rest):
+        ln = rest[0] if rest else None
+        em = em.astype(jnp.float32)
+        n, s, t = em.shape
+        start, stop, trans = _split_transition(w.astype(jnp.float32))
+        mask = _mask_from_length(ln, n, s)
+        lab = lab.astype(jnp.int32)
+        lens = (jnp.full((n,), s, jnp.int32) if ln is None
+                else jnp.reshape(ln, (-1,)).astype(jnp.int32))
+
+        # ---- numerator: score of the labeled path -------------------
+        em_path = jnp.take_along_axis(em, lab[:, :, None],
+                                      axis=2)[..., 0]          # [N,S]
+        num = jnp.sum(em_path * mask, axis=1)
+        num = num + start[lab[:, 0]]
+        last = jnp.take_along_axis(lab, (lens - 1)[:, None],
+                                   axis=1)[:, 0]
+        num = num + stop[last]
+        pair = trans[lab[:, :-1], lab[:, 1:]]                  # [N,S-1]
+        num = num + jnp.sum(pair * mask[:, 1:], axis=1)
+
+        # ---- denominator: log Z via the alpha recursion -------------
+        alpha0 = start[None, :] + em[:, 0]                     # [N,T]
+
+        def step(alpha, inp):
+            e_t, m_t = inp
+            nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None],
+                                   axis=1) + e_t
+            alpha = jnp.where(m_t[:, None] > 0, nxt, alpha)
+            return alpha, None
+
+        xs = (jnp.swapaxes(em[:, 1:], 0, 1),
+              jnp.swapaxes(mask[:, 1:], 0, 1))
+        alpha, _ = jax.lax.scan(step, alpha0, xs)
+        logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+        return (logz - num)[:, None]
+
+    return _apply(f, *args, op_name="linear_chain_crf")
+
+
+def crf_decoding(input, transition, label=None, length=None):
+    """Viterbi decode. Without ``label``: the best path [N, S] int64
+    (positions past ``length`` are 0). With ``label``: a [N, S] 0/1
+    tensor where **1 marks a correct position** (crf_decoding_op.h:109).
+    """
+    args = [input, transition] + ([label] if label is not None else []) \
+        + ([length] if length is not None else [])
+    # close over plain bools, not the optional Tensors — a Tensor in a
+    # closure cell makes the eager vjp-cache key unhashable (core.py
+    # _key_scalar) and every call would re-trace the Viterbi scan
+    has_label, has_len = label is not None, length is not None
+
+    def f(em, w, *rest):
+        rest = list(rest)
+        lab = rest.pop(0) if has_label else None
+        ln = rest.pop(0) if has_len else None
+        em = em.astype(jnp.float32)
+        n, s, t = em.shape
+        start, stop, trans = _split_transition(w.astype(jnp.float32))
+        mask = _mask_from_length(ln, n, s)
+        lens = (jnp.full((n,), s, jnp.int32) if ln is None
+                else jnp.reshape(ln, (-1,)).astype(jnp.int32))
+
+        alpha0 = start[None, :] + em[:, 0]
+
+        def fwd(alpha, inp):
+            e_t, m_t = inp
+            scores = alpha[:, :, None] + trans[None]          # [N,T,T]
+            bp = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [N,T]
+            nxt = jnp.max(scores, axis=1) + e_t
+            alpha_new = jnp.where(m_t[:, None] > 0, nxt, alpha)
+            # frozen rows carry identity backpointers so traceback
+            # walks through padding unchanged
+            bp = jnp.where(m_t[:, None] > 0, bp,
+                           jnp.arange(t, dtype=jnp.int32)[None, :])
+            return alpha_new, bp
+
+        xs = (jnp.swapaxes(em[:, 1:], 0, 1),
+              jnp.swapaxes(mask[:, 1:], 0, 1))
+        alpha, bps = jax.lax.scan(fwd, alpha0, xs)            # [S-1,N,T]
+        best_last = jnp.argmax(alpha + stop[None, :],
+                               axis=1).astype(jnp.int32)      # [N]
+
+        def back(tag, bp):
+            # emit the PREDECESSOR: at reverse step k the emitted value
+            # is path[k] = bp_k[path[k+1]] (emitting the carry instead
+            # would drop path[0] and duplicate the last tag)
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, best_last, bps, reverse=True)
+        path = jnp.concatenate(
+            [path_rev, best_last[None]], axis=0)               # [S,N]
+        path = jnp.swapaxes(path, 0, 1).astype(jnp.int64)      # [N,S]
+        path = jnp.where(mask > 0, path, 0)
+        if lab is None:
+            return path
+        return ((path == lab.astype(jnp.int64)) & (mask > 0)) \
+            .astype(jnp.int64)
+
+    return _apply(f, *args, op_name="crf_decoding")
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Convenience wrapper over :func:`crf_decoding` returning
+    ``(scores, path)``. NOTE: the 2021-era reference exposes only the
+    crf_decoding op (no ``paddle.text.viterbi_decode``); this helper
+    exists for decode-score consumers. ``include_bos_eos_tag=True``
+    expects our reference-layout ``[T+2, T]`` transitions; ``False``
+    takes the square ``[T, T]`` matrix (start/stop weights zero)."""
+    tp = transition_params
+    if not include_bos_eos_tag:
+        t = tp.shape[-1] if hasattr(tp, "shape") else tp._value.shape[-1]
+        zeros = Tensor(jnp.zeros((2, t), jnp.float32))
+        from ...tensor.manipulation import concat
+        tp = concat([zeros, tp], axis=0)
+    path = crf_decoding(potentials, tp, length=lengths)
+
+    def score_of(em, w, p, *rest):
+        ln = rest[0] if rest else None
+        em = em.astype(jnp.float32)
+        n, s, t = em.shape
+        start, stop, trans = _split_transition(w.astype(jnp.float32))
+        mask = _mask_from_length(ln, n, s)
+        lens = (jnp.full((n,), s, jnp.int32) if ln is None
+                else jnp.reshape(ln, (-1,)).astype(jnp.int32))
+        p32 = p.astype(jnp.int32)
+        em_path = jnp.take_along_axis(em, p32[:, :, None],
+                                      axis=2)[..., 0]
+        sc = jnp.sum(em_path * mask, axis=1) + start[p32[:, 0]]
+        last = jnp.take_along_axis(p32, (lens - 1)[:, None],
+                                   axis=1)[:, 0]
+        sc = sc + stop[last]
+        sc = sc + jnp.sum(trans[p32[:, :-1], p32[:, 1:]] * mask[:, 1:],
+                          axis=1)
+        return sc
+
+    args = [potentials, tp, path] + ([lengths] if lengths is not None
+                                     else [])
+    scores = _apply(score_of, *args, op_name="viterbi_score")
+    return scores, path
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance between token sequences (reference
+    operators/edit_distance_op.cc). Inputs are padded [N, S] int with
+    optional lengths. Returns (distance [N, 1] float32, sequence_num).
+    """
+    args = [input, label] \
+        + ([input_length] if input_length is not None else []) \
+        + ([label_length] if label_length is not None else [])
+    has_hl, has_rl = input_length is not None, label_length is not None
+
+    def f(hyp, ref, *rest):
+        rest = list(rest)
+        hl = rest.pop(0) if has_hl else None
+        rl = rest.pop(0) if has_rl else None
+        hyp = hyp.astype(jnp.int32)
+        ref = ref.astype(jnp.int32)
+        n, sh = hyp.shape
+        sr = ref.shape[1]
+        hlen = (jnp.full((n,), sh, jnp.int32) if hl is None
+                else jnp.reshape(hl, (-1,)).astype(jnp.int32))
+        rlen = (jnp.full((n,), sr, jnp.int32) if rl is None
+                else jnp.reshape(rl, (-1,)).astype(jnp.int32))
+        if ignored_tokens:
+            # drop ignored tokens by compacting valid entries left
+            for tok in ignored_tokens:
+                keep_h = (hyp != tok) & (jnp.arange(sh)[None, :]
+                                         < hlen[:, None])
+                order = jnp.argsort(~keep_h, axis=1, stable=True)
+                hyp = jnp.take_along_axis(hyp, order, axis=1)
+                hlen = keep_h.sum(axis=1).astype(jnp.int32)
+                keep_r = (ref != tok) & (jnp.arange(sr)[None, :]
+                                         < rlen[:, None])
+                order = jnp.argsort(~keep_r, axis=1, stable=True)
+                ref = jnp.take_along_axis(ref, order, axis=1)
+                rlen = keep_r.sum(axis=1).astype(jnp.int32)
+
+        # DP over ref positions; row = distances over hyp prefix [0..sh]
+        big = jnp.float32(1e9)
+        row0 = jnp.minimum(jnp.arange(sh + 1, dtype=jnp.float32),
+                           hlen[:, None].astype(jnp.float32))
+        # row0[j] = min(j, hlen): j>hlen is clamped (those cells are
+        # never read for the final answer)
+        row0 = jnp.broadcast_to(row0, (n, sh + 1))
+
+        def step(row, inp):
+            # classic row relax: new[k+1] = min(row[k+1]+1 (delete),
+            # new[k]+1 (insert), row[k]+sub[k] (substitute)); columns
+            # past hlen and rows past rlen freeze so the final read at
+            # (rlen, hlen) is exact
+            j, r_j = inp      # 1-based ref index, ref tokens [N]
+            valid_r = (j <= rlen)
+            sub = (hyp != r_j[:, None]).astype(jnp.float32)    # [N,sh]
+
+            def relax(new_prev, k):
+                cand = jnp.minimum(
+                    jnp.minimum(row[:, k + 1] + 1.0, new_prev + 1.0),
+                    row[:, k] + sub[:, k])
+                cand = jnp.where(k < hlen, cand, new_prev)
+                return cand, cand
+            new0 = jnp.minimum(jnp.float32(j),
+                               rlen.astype(jnp.float32))
+            new0 = jnp.broadcast_to(new0, (n,))
+            _, cols = jax.lax.scan(relax, new0, jnp.arange(sh))
+            new = jnp.concatenate(
+                [new0[None], cols], axis=0)                    # [sh+1,N]
+            new = jnp.swapaxes(new, 0, 1)
+            new = jnp.where(valid_r[:, None], new, row)
+            return new, None
+
+        xs = (jnp.arange(1, sr + 1), jnp.swapaxes(ref, 0, 1))
+        row, _ = jax.lax.scan(step, row0, xs)
+        d = jnp.take_along_axis(row, hlen[:, None], axis=1)[:, 0]
+        if normalized:
+            d = d / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+        return d[:, None]
+
+    dist = _apply(f, *args, op_name="edit_distance")
+    n = input._value.shape[0] if isinstance(input, Tensor) \
+        else np.asarray(input).shape[0]
+    return dist, Tensor(jnp.asarray([n], jnp.int64))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0):
+    """Greedy CTC decode: argmax per frame, merge repeats, drop blanks
+    (reference operators/ctc_align_op.cc). Static-shape output: decoded
+    [N, S] int64 padded with ``padding_value`` (default 0, matching
+    fluid.layers.ctc_greedy_decoder) + lengths [N, 1]."""
+    args = [input] + ([input_length] if input_length is not None else [])
+
+    def f(logits, *rest):
+        ln = rest[0] if rest else None
+        n, s = logits.shape[0], logits.shape[1]
+        ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [N,S]
+        frame_ok = _mask_from_length(ln, n, s) > 0
+        prev = jnp.concatenate(
+            [jnp.full((n, 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+        keep = frame_ok & (ids != blank) & (ids != prev)
+        # compact kept tokens left (stable argsort of drop flags)
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        toks = jnp.take_along_axis(ids, order, axis=1).astype(jnp.int64)
+        cnt = keep.sum(axis=1).astype(jnp.int64)
+        pos_ok = jnp.arange(s)[None, :] < cnt[:, None]
+        toks = jnp.where(pos_ok, toks, padding_value)
+        return toks, cnt[:, None]
+
+    out = _apply(f, *args, op_name="ctc_greedy_decoder")
+    return out[0], out[1]
+
+
+def _extract_chunks(tags, length, scheme, num_chunk_types,
+                    excluded=frozenset()):
+    """Host-side chunk extraction for one sequence (list of label ids)."""
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    chunks = []
+    start = None
+    cur_type = None
+
+    def flush(end):
+        nonlocal start, cur_type
+        if start is not None and cur_type is not None \
+                and cur_type not in excluded:
+            chunks.append((start, end, cur_type))
+        start, cur_type = None, None
+
+    for i in range(length):
+        lab = int(tags[i])
+        if lab < 0 or lab >= n_tag * num_chunk_types:
+            flush(i - 1)        # out-of-range (e.g. O tag id) ends chunk
+            continue
+        tag = lab % n_tag
+        ctype = lab // n_tag
+        if scheme == "plain":
+            # every in-range token is its own single-token chunk
+            # (chunk_eval_op.cc plain scheme)
+            flush(i - 1)
+            start, cur_type = i, ctype
+            flush(i)
+        elif scheme == "IOB":   # tag 0 = B, 1 = I
+            if tag == 0 or cur_type != ctype:
+                flush(i - 1)
+                start, cur_type = i, ctype
+        elif scheme == "IOE":   # tag 0 = I, 1 = E
+            if cur_type != ctype:
+                flush(i - 1)
+                start, cur_type = i, ctype
+            if tag == 1:        # E closes the chunk at i
+                flush(i)
+        elif scheme == "IOBES":  # 0=B 1=I 2=E 3=S
+            if tag == 3:
+                flush(i - 1)
+                start, cur_type = i, ctype
+                flush(i)
+            elif tag == 2:
+                # E closes the running same-type chunk, or is a
+                # single-token chunk when nothing matching is open
+                if cur_type == ctype and start is not None:
+                    flush(i)
+                else:
+                    flush(i - 1)
+                    start, cur_type = i, ctype
+                    flush(i)
+            elif tag == 0 or cur_type != ctype:
+                flush(i - 1)
+                start, cur_type = i, ctype
+    flush(length - 1)
+    return set(chunks)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level precision/recall/F1 (reference chunk_eval_op.cc) —
+    host-side metric (the reference op is CPU-only too). Label→(tag,
+    type) mapping follows the reference: tag = label % num_tag_types,
+    type = label // num_tag_types.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) as Tensors."""
+    pred = np.asarray(input.numpy() if isinstance(input, Tensor)
+                      else input)
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor)
+                     else label)
+    if pred.ndim == 1:
+        pred, lab = pred[None], lab[None]
+    n, s = pred.shape
+    lens = (np.full((n,), s, np.int64) if seq_length is None else
+            np.asarray(seq_length.numpy() if isinstance(
+                seq_length, Tensor) else seq_length).reshape(-1))
+    excluded = frozenset(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for i in range(n):
+        pi = _extract_chunks(pred[i], int(lens[i]), chunk_scheme,
+                             num_chunk_types, excluded)
+        li = _extract_chunks(lab[i], int(lens[i]), chunk_scheme,
+                             num_chunk_types, excluded)
+        n_inf += len(pi)
+        n_lab += len(li)
+        n_cor += len(pi & li)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v, dt: Tensor(jnp.asarray([v], dt))  # noqa: E731
+    return (mk(p, jnp.float32), mk(r, jnp.float32), mk(f1, jnp.float32),
+            mk(n_inf, jnp.int64), mk(n_lab, jnp.int64),
+            mk(n_cor, jnp.int64))

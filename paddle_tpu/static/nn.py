@@ -299,3 +299,9 @@ from ..nn.functional.sequence import (  # noqa: E402,F401
     sequence_concat, sequence_expand, sequence_first_step,
     sequence_last_step, sequence_mask, sequence_pad, sequence_pool,
     sequence_reverse, sequence_slice, sequence_softmax, sequence_unpad)
+
+# sequence-labeling family (reference fluid.layers.linear_chain_crf /
+# crf_decoding / edit_distance / ctc_greedy_decoder / chunk_eval)
+from ..nn.functional.crf import (  # noqa: E402,F401
+    chunk_eval, crf_decoding, ctc_greedy_decoder, edit_distance,
+    linear_chain_crf)

@@ -1,0 +1,256 @@
+"""Sequence-labeling op family vs brute-force numpy references.
+
+Parity: linear_chain_crf / crf_decoding (operators/linear_chain_crf_op,
+crf_decoding_op), edit_distance (edit_distance_op), ctc_greedy_decoder
+(ctc_align_op), chunk_eval (chunk_eval_op). The CRF numerics are checked
+against exhaustive path enumeration (small tag/seq counts make that
+exact), gradients against finite differences, and the whole family
+against an end-to-end BiLSTM-CRF tagger that trains and decodes.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np_path_score(em, labels, w):
+    start, stop, trans = w[0], w[1], w[2:]
+    s = start[labels[0]] + em[np.arange(len(labels)), labels].sum() \
+        + stop[labels[-1]]
+    for a, b in zip(labels[:-1], labels[1:]):
+        s += trans[a, b]
+    return s
+
+
+def _np_crf_nll(em, labels, w):
+    """Exhaustive logZ - path score."""
+    L, T = em.shape
+    scores = [_np_path_score(em, list(p), w)
+              for p in itertools.product(range(T), repeat=L)]
+    m = max(scores)
+    logz = m + np.log(np.sum(np.exp(np.asarray(scores) - m)))
+    return logz - _np_path_score(em, list(labels), w)
+
+
+def test_linear_chain_crf_matches_enumeration():
+    rng = np.random.RandomState(0)
+    N, S, T = 3, 4, 3
+    em = rng.randn(N, S, T).astype(np.float32)
+    w = rng.randn(T + 2, T).astype(np.float32)
+    lab = rng.randint(0, T, (N, S))
+    out = F.linear_chain_crf(paddle.to_tensor(em),
+                             paddle.to_tensor(lab.astype("int64")),
+                             paddle.to_tensor(w)).numpy()
+    for i in range(N):
+        np.testing.assert_allclose(
+            out[i, 0], _np_crf_nll(em[i], lab[i], w), rtol=1e-4,
+            atol=1e-4)
+
+
+def test_linear_chain_crf_lengths():
+    rng = np.random.RandomState(1)
+    N, S, T = 2, 5, 3
+    em = rng.randn(N, S, T).astype(np.float32)
+    w = rng.randn(T + 2, T).astype(np.float32)
+    lab = rng.randint(0, T, (N, S))
+    lens = np.asarray([3, 5], np.int64)
+    out = F.linear_chain_crf(paddle.to_tensor(em),
+                             paddle.to_tensor(lab.astype("int64")),
+                             paddle.to_tensor(w),
+                             length=paddle.to_tensor(lens)).numpy()
+    for i in range(N):
+        li = int(lens[i])
+        np.testing.assert_allclose(
+            out[i, 0], _np_crf_nll(em[i, :li], lab[i, :li], w),
+            rtol=1e-4, atol=1e-4)
+
+
+def test_linear_chain_crf_fd_gradients():
+    rng = np.random.RandomState(2)
+    N, S, T = 2, 3, 3
+    em = rng.randn(N, S, T).astype(np.float32)
+    w = (rng.randn(T + 2, T) * 0.5).astype(np.float32)
+    lab = rng.randint(0, T, (N, S)).astype("int64")
+
+    em_t = paddle.to_tensor(em, stop_gradient=False)
+    w_t = paddle.to_tensor(w, stop_gradient=False)
+    loss = F.linear_chain_crf(em_t, paddle.to_tensor(lab), w_t).sum()
+    loss.backward()
+
+    def num_loss(emv, wv):
+        return sum(_np_crf_nll(emv[i], lab[i], wv) for i in range(N))
+
+    eps = 1e-3
+    for idx in [(0, 0, 0), (1, 2, 1), (0, 1, 2)]:
+        ep = em.copy(); ep[idx] += eps
+        en = em.copy(); en[idx] -= eps
+        fd = (num_loss(ep, w) - num_loss(en, w)) / (2 * eps)
+        np.testing.assert_allclose(em_t.grad.numpy()[idx], fd,
+                                   rtol=2e-2, atol=2e-2)
+    for idx in [(0, 1), (2, 0), (4, 2)]:
+        wp = w.copy(); wp[idx] += eps
+        wn = w.copy(); wn[idx] -= eps
+        fd = (num_loss(em, wp) - num_loss(em, wn)) / (2 * eps)
+        np.testing.assert_allclose(w_t.grad.numpy()[idx], fd,
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_crf_decoding_matches_enumeration():
+    rng = np.random.RandomState(3)
+    N, S, T = 3, 4, 3
+    em = rng.randn(N, S, T).astype(np.float32)
+    w = rng.randn(T + 2, T).astype(np.float32)
+    path = F.crf_decoding(paddle.to_tensor(em),
+                          paddle.to_tensor(w)).numpy()
+    for i in range(N):
+        best = max(itertools.product(range(T), repeat=S),
+                   key=lambda p: _np_path_score(em[i], list(p), w))
+        np.testing.assert_array_equal(path[i], np.asarray(best))
+    # with labels: 1 marks a CORRECT position (crf_decoding_op.h:109)
+    lab = paddle.to_tensor(path.astype("int64"))
+    hit = F.crf_decoding(paddle.to_tensor(em), paddle.to_tensor(w),
+                         label=lab).numpy()
+    np.testing.assert_array_equal(hit, np.ones_like(path))
+
+
+def test_viterbi_decode_surface():
+    rng = np.random.RandomState(4)
+    em = rng.randn(2, 4, 3).astype(np.float32)
+    w = rng.randn(5, 3).astype(np.float32)
+    scores, path = F.viterbi_decode(paddle.to_tensor(em),
+                                    paddle.to_tensor(w))
+    for i in range(2):
+        best = max(itertools.product(range(3), repeat=4),
+                   key=lambda p: _np_path_score(em[i], list(p), w))
+        np.testing.assert_array_equal(path.numpy()[i], np.asarray(best))
+        np.testing.assert_allclose(
+            scores.numpy()[i], _np_path_score(em[i], list(best), w),
+            rtol=1e-5)
+
+
+def _np_edit(a, b):
+    d = np.zeros((len(b) + 1, len(a) + 1))
+    d[:, 0] = np.arange(len(b) + 1)
+    d[0, :] = np.arange(len(a) + 1)
+    for j in range(1, len(b) + 1):
+        for k in range(1, len(a) + 1):
+            d[j, k] = min(d[j - 1, k] + 1, d[j, k - 1] + 1,
+                          d[j - 1, k - 1] + (a[k - 1] != b[j - 1]))
+    return d[len(b), len(a)]
+
+
+def test_edit_distance_against_numpy():
+    rng = np.random.RandomState(5)
+    N, SH, SR = 4, 6, 5
+    hyp = rng.randint(0, 5, (N, SH))
+    ref = rng.randint(0, 5, (N, SR))
+    hl = rng.randint(1, SH + 1, (N,))
+    rl = rng.randint(1, SR + 1, (N,))
+    d, seq_num = F.edit_distance(
+        paddle.to_tensor(hyp.astype("int64")),
+        paddle.to_tensor(ref.astype("int64")), normalized=False,
+        input_length=paddle.to_tensor(hl.astype("int64")),
+        label_length=paddle.to_tensor(rl.astype("int64")))
+    assert int(seq_num.numpy()[0]) == N
+    for i in range(N):
+        np.testing.assert_allclose(
+            d.numpy()[i, 0],
+            _np_edit(list(hyp[i, :hl[i]]), list(ref[i, :rl[i]])))
+    # normalized divides by ref length
+    dn, _ = F.edit_distance(
+        paddle.to_tensor(hyp.astype("int64")),
+        paddle.to_tensor(ref.astype("int64")), normalized=True,
+        input_length=paddle.to_tensor(hl.astype("int64")),
+        label_length=paddle.to_tensor(rl.astype("int64")))
+    np.testing.assert_allclose(dn.numpy()[:, 0],
+                               d.numpy()[:, 0] / np.maximum(rl, 1),
+                               rtol=1e-6)
+
+
+def test_ctc_greedy_decoder():
+    # frames argmax to [1,1,blank,2,2,blank,3] -> merged [1,2,3]
+    T, C, blank = 7, 4, 3
+    ids = [1, 1, 3, 2, 2, 3, 0]
+    logits = np.full((1, T, C), -5.0, np.float32)
+    for t, i in enumerate(ids):
+        logits[0, t, i] = 5.0
+    toks, lens = F.ctc_greedy_decoder(paddle.to_tensor(logits), blank,
+                                      padding_value=-1)
+    assert int(lens.numpy()[0, 0]) == 3
+    np.testing.assert_array_equal(toks.numpy()[0, :3], [1, 2, 0])
+    assert (toks.numpy()[0, 3:] == -1).all()
+    # fluid default pads with 0
+    toks0, _ = F.ctc_greedy_decoder(paddle.to_tensor(logits), blank)
+    assert (toks0.numpy()[0, 3:] == 0).all()
+
+
+def test_chunk_eval_iob():
+    # chunk ids: label = type * num_tags + tag ; IOB: tag 0=B, 1=I
+    # types: PER=0, ORG=1 -> B-PER=0 I-PER=1 B-ORG=2 I-ORG=3, O=6 (out
+    # of range -> outside)
+    lab = np.asarray([[0, 1, 6, 2, 3, 3]], np.int64)     # PER(0-1) ORG(3-5)
+    pred = np.asarray([[0, 1, 6, 2, 3, 6]], np.int64)    # PER(0-1) ORG(3-4)
+    p, r, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(pred), paddle.to_tensor(lab),
+        chunk_scheme="IOB", num_chunk_types=3)
+    assert int(ni.numpy()[0]) == 2 and int(nl.numpy()[0]) == 2
+    assert int(nc.numpy()[0]) == 1          # PER matches, ORG spans differ
+    np.testing.assert_allclose(p.numpy()[0], 0.5)
+    np.testing.assert_allclose(r.numpy()[0], 0.5)
+    np.testing.assert_allclose(f1.numpy()[0], 0.5)
+
+
+def test_bilstm_crf_tagger_trains_and_decodes():
+    """End-to-end: emissions from a BiLSTM, CRF NLL loss, Viterbi decode
+    recovers the synthetic tagging rule after training."""
+    paddle.seed(7)
+    rng = np.random.RandomState(7)
+    V, T, S, N = 20, 3, 8, 32
+    # synthetic rule: tag = token % 3
+    xs = rng.randint(0, V, (N, S)).astype("int64")
+    ys = (xs % T).astype("int64")
+
+    emb = nn.Embedding(V, 16)
+    lstm = nn.LSTM(16, 16, direction="bidirect")
+    proj = nn.Linear(32, T)
+    crf_w = paddle.create_parameter([T + 2, T], "float32")
+    params = (list(emb.parameters()) + list(lstm.parameters())
+              + list(proj.parameters()) + [crf_w])
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=params)
+
+    x_t = paddle.to_tensor(xs)
+    y_t = paddle.to_tensor(ys)
+    first = None
+    for step in range(60):
+        h, _ = lstm(emb(x_t))
+        em = proj(h)
+        nll = F.linear_chain_crf(em, y_t, crf_w).mean()
+        if first is None:
+            first = float(nll.numpy())
+        nll.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(nll.numpy()) < 0.25 * first
+    h, _ = lstm(emb(x_t))
+    path = F.crf_decoding(proj(h), crf_w).numpy()
+    acc = (path == ys).mean()
+    assert acc > 0.95, acc
+
+
+def test_chunk_eval_plain_and_iobes_edge():
+    # plain: every in-range token is its own chunk (chunk_eval_op.cc)
+    lab = paddle.to_tensor(np.asarray([[2, 2]], np.int64))
+    p, r, f1, ni, nl, nc = F.chunk_eval(lab, lab, chunk_scheme="plain",
+                                        num_chunk_types=3)
+    assert int(nl.numpy()[0]) == 2 and int(nc.numpy()[0]) == 2
+    # IOBES: an E with no open chunk is a single-token chunk; a
+    # following same-type I starts a NEW chunk
+    # tag order B=0 I=1 E=2 S=3; ORG type 1 -> E-ORG=6, I-ORG=5
+    seq = paddle.to_tensor(np.asarray([[6, 5]], np.int64))
+    _, _, _, ni, nl, nc = F.chunk_eval(seq, seq, chunk_scheme="IOBES",
+                                       num_chunk_types=3)
+    assert int(nl.numpy()[0]) == 2, int(nl.numpy()[0])
