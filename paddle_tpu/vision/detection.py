@@ -1,0 +1,504 @@
+"""Two-stage detection op family (Faster-RCNN / SSD infrastructure).
+
+Parity targets (fluid/layers/detection.py + operators/detection/*):
+- anchor_generator            — detection.py:2399, anchor_generator_op.cc
+- density_prior_box           — detection.py:1925, density_prior_box_op.cc
+- bipartite_match             — detection.py:1317, bipartite_match_op.cc
+- detection_output            — detection.py:621  (SSD post-processing)
+- generate_proposals          — detection.py:2894, generate_proposals_op.cc
+- box_clip                    — detection.py:3043, box_clip_op.cc
+- distribute_fpn_proposals    — detection.py:3673
+- collect_fpn_proposals       — detection.py:3871
+- deformable_psroi_pooling    — deformable_psroi_pooling_op.cc
+
+TPU-native shape contract: the reference emits LoD tensors with
+data-dependent row counts; XLA needs static shapes, so every op here
+returns FIXED-size tensors (padded) plus explicit counts — top-k and
+masks instead of dynamic filtering. The numerics over the valid prefix
+match the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+
+def _t(x):
+    from .ops import _t as _t_impl
+    return _t_impl(x)
+
+
+def _iou_matrix(a, b):
+    from .ops import _iou_matrix as _impl
+    return _impl(a, b)
+
+__all__ = ["anchor_generator", "density_prior_box", "bipartite_match",
+           "detection_output", "generate_proposals", "box_clip",
+           "distribute_fpn_proposals", "collect_fpn_proposals",
+           "deformable_psroi_pooling"]
+
+
+# ---------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    """Anchors for every feature-map position (anchor_generator_op.cc).
+    Returns (anchors [H, W, A, 4] xyxy in input pixels, variances
+    [H, W, A, 4]); A = len(anchor_sizes) * len(aspect_ratios), aspect
+    ratios iterate fastest, matching the reference order."""
+    anchor_sizes = [float(s) for s in (anchor_sizes or [64., 128., 256.])]
+    aspect_ratios = [float(r) for r in (aspect_ratios or [0.5, 1.0, 2.0])]
+    if stride is None:
+        raise ValueError("anchor_generator requires stride, e.g. [16, 16]")
+    sw, sh = float(stride[0]), float(stride[1])
+    xv = _t(input)._value
+    H, W = xv.shape[2], xv.shape[3]
+
+    ws, hs = [], []
+    for size in anchor_sizes:
+        for ratio in aspect_ratios:
+            # reference: area = size^2; h/w = ratio
+            w = size / np.sqrt(ratio)
+            h = size * np.sqrt(ratio)
+            ws.append(w)
+            hs.append(h)
+    ws = jnp.asarray(ws, jnp.float32)                      # [A]
+    hs = jnp.asarray(hs, jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw  # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh  # [H]
+    x0 = cx[None, :, None] - 0.5 * ws[None, None, :]
+    x1 = cx[None, :, None] + 0.5 * ws[None, None, :]
+    y0 = cy[:, None, None] - 0.5 * hs[None, None, :]
+    y1 = cy[:, None, None] + 0.5 * hs[None, None, :]
+    anchors = jnp.stack([
+        jnp.broadcast_to(x0, (H, W, len(ws))),
+        jnp.broadcast_to(y0, (H, W, len(ws))),
+        jnp.broadcast_to(x1, (H, W, len(ws))),
+        jnp.broadcast_to(y1, (H, W, len(ws)))], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           anchors.shape)
+    return Tensor(anchors), Tensor(var)
+
+
+def density_prior_box(input, image=None, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """SSD density prior boxes (density_prior_box_op.cc): for each
+    (density d, fixed_size s) pair, a d x d grid of centers inside each
+    step cell, one box per fixed_ratio. Output normalized to [0, 1] by
+    the image size; [H, W, P, 4] (or [HWP, 4] with flatten_to_2d)."""
+    densities = [int(d) for d in (densities or [])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [1.0])]
+    if len(densities) != len(fixed_sizes):
+        raise ValueError("densities and fixed_sizes must pair up")
+    xv = _t(input)._value
+    H, W = xv.shape[2], xv.shape[3]
+    iv = _t(image)._value
+    img_h, img_w = float(iv.shape[2]), float(iv.shape[3])
+    step_w = float(steps[0]) or img_w / W
+    step_h = float(steps[1]) or img_h / H
+
+    boxes_per_pos = []
+    for d, size in zip(densities, fixed_sizes):
+        shift = step_w / d
+        for r in fixed_ratios:
+            bw = size * np.sqrt(r)
+            bh = size / np.sqrt(r)
+            for di in range(d):
+                for dj in range(d):
+                    # center offsets inside the cell, reference order
+                    ox = (dj + 0.5) * shift - step_w / 2.0
+                    oy = (di + 0.5) * (step_h / d) - step_h / 2.0
+                    boxes_per_pos.append((ox, oy, bw, bh))
+    P = len(boxes_per_pos)
+    off = jnp.asarray([(b[0], b[1]) for b in boxes_per_pos], jnp.float32)
+    wh = jnp.asarray([(b[2], b[3]) for b in boxes_per_pos], jnp.float32)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h  # [H]
+    ctr_x = cx[None, :, None] + off[None, None, :, 0]          # [1,W,P]
+    ctr_y = cy[:, None, None] + off[None, None, :, 1]          # [H,1,P]
+    x0 = (ctr_x - wh[None, None, :, 0] / 2) / img_w
+    x1 = (ctr_x + wh[None, None, :, 0] / 2) / img_w
+    y0 = (ctr_y - wh[None, None, :, 1] / 2) / img_h
+    y1 = (ctr_y + wh[None, None, :, 1] / 2) / img_h
+    boxes = jnp.stack([jnp.broadcast_to(x0, (H, W, P)),
+                       jnp.broadcast_to(y0, (H, W, P)),
+                       jnp.broadcast_to(x1, (H, W, P)),
+                       jnp.broadcast_to(y1, (H, W, P))], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(boxes), Tensor(var)
+
+
+# ---------------------------------------------------------------------
+# matching / clipping
+# ---------------------------------------------------------------------
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly
+    take the globally largest entry, match that (row, col) pair, and
+    retire both. ``match_type='per_prediction'`` additionally matches
+    each still-unmatched column to its argmax row when the distance
+    >= dist_threshold. Input [R, C] (one batch) or [B, R, C]; returns
+    (match_indices int32, match_distance float32) of shape [B?, C] with
+    -1 for unmatched columns."""
+    dv = _t(dist_matrix)._value.astype(jnp.float32)
+    batched = dv.ndim == 3
+    if not batched:
+        dv = dv[None]
+    B, R, C = dv.shape
+    NEG = jnp.float32(-1e30)
+
+    def one(mat):
+        def body(_, carry):
+            m, idx, dist = carry
+            flat = jnp.argmax(m)
+            r, c = flat // C, flat % C
+            best = m[r, c]
+            ok = best > NEG / 2
+            idx = jnp.where(ok, idx.at[c].set(r.astype(jnp.int32)), idx)
+            dist = jnp.where(ok, dist.at[c].set(best), dist)
+            m = jnp.where(ok, m.at[r, :].set(NEG).at[:, c].set(NEG), m)
+            return m, idx, dist
+
+        idx0 = jnp.full((C,), -1, jnp.int32)
+        dist0 = jnp.zeros((C,), jnp.float32)
+        _, idx, dist = jax.lax.fori_loop(0, min(R, C), body,
+                                         (mat, idx0, dist0))
+        if match_type == "per_prediction":
+            thr = 0.5 if dist_threshold is None else float(dist_threshold)
+            best_r = jnp.argmax(mat, axis=0).astype(jnp.int32)
+            best_d = jnp.max(mat, axis=0)
+            extra = (idx < 0) & (best_d >= thr)
+            idx = jnp.where(extra, best_r, idx)
+            dist = jnp.where(extra, best_d, dist)
+        return idx, dist
+
+    idx, dist = jax.vmap(one)(dv)
+    if not batched:
+        idx, dist = idx[0], dist[0]
+    return Tensor(idx), Tensor(dist)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to the image (box_clip_op.cc): im_info rows are
+    (height, width, scale); the valid range is [0, dim/scale - 1]."""
+    bv = _t(input)._value
+    iv = _t(im_info)._value.astype(bv.dtype)
+    if bv.ndim == 2:            # [M, 4] + one im_info row
+        row = iv.reshape(-1)[:3]
+        hmax = row[0] / row[2] - 1.0
+        wmax = row[1] / row[2] - 1.0
+        out = jnp.stack([jnp.clip(bv[:, 0], 0, wmax),
+                         jnp.clip(bv[:, 1], 0, hmax),
+                         jnp.clip(bv[:, 2], 0, wmax),
+                         jnp.clip(bv[:, 3], 0, hmax)], axis=-1)
+        return Tensor(out)
+    hmax = (iv[:, 0] / iv[:, 2] - 1.0)[:, None]
+    wmax = (iv[:, 1] / iv[:, 2] - 1.0)[:, None]
+    out = jnp.stack([jnp.clip(bv[..., 0], 0, wmax),
+                     jnp.clip(bv[..., 1], 0, hmax),
+                     jnp.clip(bv[..., 2], 0, wmax),
+                     jnp.clip(bv[..., 3], 0, hmax)], axis=-1)
+    return Tensor(out)
+
+
+# ---------------------------------------------------------------------
+# proposal generation / SSD output
+# ---------------------------------------------------------------------
+
+def _decode_center_size(anchors, var, deltas):
+    """box_coder decode_center_size with per-anchor variance."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    dx, dy, dw, dh = (deltas[:, 0] * var[:, 0], deltas[:, 1] * var[:, 1],
+                      deltas[:, 2] * var[:, 2], deltas[:, 3] * var[:, 3])
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
+    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _nms_keep_mask(boxes, scores, iou_threshold, valid):
+    """Static-shape greedy NMS: returns (keep mask over the SORTED
+    order, sort order) — no host round-trip, jit-safe."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    v = valid[order]
+    iou = _iou_matrix(b, b)
+
+    def body(i, keep):
+        ok = v[i] & ~jnp.any(jnp.where(jnp.arange(n) < i,
+                                       (iou[i] > iou_threshold) & keep,
+                                       False))
+        return keep.at[i].set(ok)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    return keep, order
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (generate_proposals_op.cc): decode
+    bbox_deltas against anchors, clip to the image, drop boxes smaller
+    than min_size, pre-NMS top-k, NMS, post-NMS top-k.
+
+    Static-shape output: rois [N, post_nms_top_n, 4] zero-padded (the
+    reference emits a LoD tensor of dynamic length) and, with
+    ``return_rois_num``, the per-image valid counts [N]."""
+    sv = _t(scores)._value.astype(jnp.float32)    # [N, A, H, W]
+    dv = _t(bbox_deltas)._value.astype(jnp.float32)
+    iv = _t(im_info)._value.astype(jnp.float32)
+    av = _t(anchors)._value.reshape(-1, 4).astype(jnp.float32)  # [HWA,4]
+    vv = _t(variances)._value.reshape(-1, 4).astype(jnp.float32)
+    N, A = sv.shape[0], sv.shape[1]
+    H, W = sv.shape[2], sv.shape[3]
+    K = A * H * W
+    pre_n = int(min(pre_nms_top_n, K))
+    post_n = int(post_nms_top_n)
+
+    # anchors arrive [H, W, A, 4]; scores are [A, H, W] — align to HWA
+    def one(sc, dl, info):
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)          # [HWA]
+        d = dl.reshape(A, 4, H, W)
+        d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)     # [HWA,4]
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        boxes = _decode_center_size(av[top_i], vv[top_i], d[top_i])
+        hmax = info[0] / info[2] - 1.0
+        wmax = info[1] / info[2] - 1.0
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, wmax),
+                           jnp.clip(boxes[:, 1], 0, hmax),
+                           jnp.clip(boxes[:, 2], 0, wmax),
+                           jnp.clip(boxes[:, 3], 0, hmax)], axis=-1)
+        ms = min_size * info[2]
+        big = ((boxes[:, 2] - boxes[:, 0] + 1.0 >= ms)
+               & (boxes[:, 3] - boxes[:, 1] + 1.0 >= ms))
+        keep, order = _nms_keep_mask(boxes, jnp.where(big, top_s, -1e30),
+                                     nms_thresh, big)
+        # compact kept rows to the front in score order
+        rank = jnp.where(keep, jnp.cumsum(keep) - 1, K + 1)
+        out = jnp.zeros((post_n, 4), jnp.float32)
+        src = boxes[order]
+        sel = jnp.where(rank[:, None] < post_n, src, 0.0)
+        out = out.at[jnp.clip(rank, 0, post_n - 1)].add(
+            jnp.where((rank < post_n)[:, None], sel, 0.0))
+        cnt = jnp.minimum(keep.sum(), post_n).astype(jnp.int32)
+        return out, cnt
+
+    rois, counts = jax.vmap(one)(sv, dv, iv)
+    if return_rois_num:
+        return Tensor(rois), Tensor(counts)
+    return Tensor(rois)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False, name=None):
+    """SSD detection post-processing (detection.py:621): decode loc
+    against priors, per-class NMS (background skipped), global top-k.
+
+    Static-shape output: [N, keep_top_k, 6] rows (label, score, x0, y0,
+    x1, y1), padded with label -1, plus per-image counts [N]."""
+    lv = _t(loc)._value.astype(jnp.float32)       # [N, M, 4]
+    sv = _t(scores)._value.astype(jnp.float32)    # [N, M, C]
+    pb = _t(prior_box)._value.astype(jnp.float32)
+    pv = _t(prior_box_var)._value.astype(jnp.float32)
+    N, M, C = sv.shape
+    keep_k = int(keep_top_k)
+
+    def per_image(l, s):
+        # per-class NMS in ONE sweep: offset each class to a disjoint
+        # coordinate island (same trick as ops.nms category_idxs)
+        boxes = _decode_center_size(pb, pv, l)                # [M,4]
+        cls_scores = s.T                                      # [C,M]
+        span = jnp.max(jnp.abs(boxes)) + 1.0
+        offs = jnp.arange(C, dtype=jnp.float32) * 2.0 * span
+        bb = (boxes[None] + offs[:, None, None]).reshape(-1, 4)
+        ss = cls_scores.reshape(-1)
+        labels = jnp.repeat(jnp.arange(C), M)
+        valid = (labels != background_label) & (ss > score_threshold)
+        keep, order = _nms_keep_mask(bb, jnp.where(valid, ss, -1e30),
+                                     nms_threshold, valid)
+        kept_scores = jnp.where(keep, ss[order], -1e30)
+        top_s, top_j = jax.lax.top_k(kept_scores, keep_k)
+        sel = order[top_j]
+        ok = top_s > -1e29
+        out = jnp.concatenate([
+            jnp.where(ok, labels[sel], -1).astype(jnp.float32)[:, None],
+            jnp.where(ok, ss[sel], 0.0)[:, None],
+            jnp.where(ok[:, None], boxes.reshape(-1, 4)[sel % M], 0.0),
+        ], axis=1)
+        return out, ok.sum().astype(jnp.int32), sel % M
+
+    outs, counts, idxs = jax.vmap(per_image)(lv, sv)
+    if return_index:
+        return Tensor(outs), Tensor(counts), Tensor(idxs)
+    return Tensor(outs), Tensor(counts)
+
+
+# ---------------------------------------------------------------------
+# FPN routing
+# ---------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route each RoI to its FPN level (detection.py:3673):
+    level = floor(log2(sqrt(area) / refer_scale) + refer_level), clipped
+    to [min_level, max_level].
+
+    Static-shape output: per-level [R, 4] tensors with that level's rois
+    compacted to the front (rest zero), per-level counts, and
+    restore_ind [R, 1] such that concat(levels' valid rows)[restore_ind]
+    recovers the input order."""
+    rv = _t(fpn_rois)._value.astype(jnp.float32)
+    R = rv.shape[0]
+    nlev = max_level - min_level + 1
+    w = jnp.maximum(rv[:, 2] - rv[:, 0], 0.0)
+    h = jnp.maximum(rv[:, 3] - rv[:, 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(jnp.maximum(scale, 1e-6) / refer_scale)
+                    + refer_level)
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+    outs: List[Tensor] = []
+    counts = []
+    for L in range(min_level, max_level + 1):
+        m = lvl == L
+        order = jnp.argsort(~m, stable=True)
+        rows = jnp.where((jnp.arange(R) < m.sum())[:, None],
+                         rv[order], 0.0)
+        outs.append(Tensor(rows))
+        counts.append(m.sum().astype(jnp.int32))
+    # restore_ind[j] = position of original roi j in the level concat,
+    # so concat[restore_ind] recovers the input order
+    level_order = jnp.argsort(lvl, stable=True)     # original idx by lvl
+    restore_ind = jnp.zeros((R,), jnp.int32).at[level_order].set(
+        jnp.arange(R, dtype=jnp.int32))
+    return (outs, Tensor(restore_ind[:, None]),
+            Tensor(jnp.stack(counts)))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level RPN outputs and keep the global score top-k
+    (detection.py:3871). Inputs are the per-level padded [R_l, 4] rois
+    and [R_l] scores (zero/neg padding beyond the valid count — pass
+    ``rois_num_per_level`` to mask exactly). Output [post_nms_top_n, 4]
+    + valid count."""
+    rois = jnp.concatenate([_t(r)._value.astype(jnp.float32)
+                            for r in multi_rois], axis=0)
+    scores = jnp.concatenate([_t(s)._value.reshape(-1).astype(jnp.float32)
+                              for s in multi_scores], axis=0)
+    if rois_num_per_level is not None:
+        masks = []
+        for r, n in zip(multi_rois, rois_num_per_level):
+            rl = _t(r)._value.shape[0]
+            nv = _t(n)._value.reshape(())
+            masks.append(jnp.arange(rl) < nv)
+        valid = jnp.concatenate(masks)
+        scores = jnp.where(valid, scores, -1e30)
+    k = int(min(post_nms_top_n, scores.shape[0]))
+    top_s, top_i = jax.lax.top_k(scores, k)
+    out = jnp.where((top_s > -1e29)[:, None], rois[top_i], 0.0)
+    return Tensor(out), Tensor((top_s > -1e29).sum().astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------
+# deformable PS-RoI pooling
+# ---------------------------------------------------------------------
+
+def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
+                             spatial_scale=1.0, group_size=1,
+                             pooled_height=7, pooled_width=7,
+                             part_size=None, sample_per_part=4,
+                             trans_std=0.1, position_sensitive=True,
+                             name=None):
+    """Deformable position-sensitive RoI pooling
+    (deformable_psroi_pooling_op.cc): each output bin (i, j) average-
+    pools bilinear samples from ITS OWN channel group, with a learned
+    (dx, dy) offset per part shifting the bin window.
+
+    input [N, C, H, W] with C = out_c * ph * pw when position_sensitive;
+    rois [K, 5] rows (batch_idx, x0, y0, x1, y1); trans [K, 2, ph, pw].
+    Returns [K, out_c, ph, pw]."""
+    xv = _t(input)._value.astype(jnp.float32)
+    rv = _t(rois)._value.astype(jnp.float32)
+    N, C, H, W = xv.shape
+    ph, pw = int(pooled_height), int(pooled_width)
+    out_c = C // (ph * pw) if position_sensitive else C
+    K = rv.shape[0]
+    if trans is None or no_trans:
+        tv = jnp.zeros((K, 2, ph, pw), jnp.float32)
+    else:
+        tv = _t(trans)._value.astype(jnp.float32) * trans_std
+    s = int(sample_per_part)
+
+    def one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        r = roi[1:] * spatial_scale
+        x0, y0 = r[0], r[1]
+        rw = jnp.maximum(r[2] - r[0], 0.1)
+        rh = jnp.maximum(r[3] - r[1], 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        img = xv[b]
+
+        def bin_val(ci, i, j):
+            # channel group of bin (i, j) for output channel ci
+            if position_sensitive:
+                ch = ci * ph * pw + i * pw + j
+            else:
+                ch = ci
+            dx = tr[0, i, j] * rw
+            dy = tr[1, i, j] * rh
+            fy = (jnp.arange(s) + 0.5) / s
+            ys = y0 + (i + fy) * bin_h + dy          # [s]
+            xs = x0 + (j + fy) * bin_w + dx
+            yy = jnp.clip(ys, 0, H - 1)
+            xx = jnp.clip(xs, 0, W - 1)
+            yf = jnp.floor(yy).astype(jnp.int32)
+            xf = jnp.floor(xx).astype(jnp.int32)
+            y1c = jnp.clip(yf + 1, 0, H - 1)
+            x1c = jnp.clip(xf + 1, 0, W - 1)
+            wy = yy - yf
+            wx = xx - xf
+            plane = img[ch]
+            v = (plane[yf][:, xf] * (1 - wy)[:, None] * (1 - wx)[None]
+                 + plane[yf][:, x1c] * (1 - wy)[:, None] * wx[None]
+                 + plane[y1c][:, xf] * wy[:, None] * (1 - wx)[None]
+                 + plane[y1c][:, x1c] * wy[:, None] * wx[None])
+            return v.mean()
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                              indexing="ij")
+        flat = jax.vmap(lambda c: jax.vmap(
+            lambda i, j: bin_val(c, i, j))(ii.reshape(-1), jj.reshape(-1))
+        )(jnp.arange(out_c))
+        return flat.reshape(out_c, ph, pw)
+
+    out = jax.vmap(one)(rv, tv)
+    return Tensor(out)

@@ -605,3 +605,11 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             axis=(1, 2, 3, 4, 5))
         return loss_xy + loss_wh + loss_obj + loss_cls
     return _apply(f, *args, op_name="yolo_loss")
+
+
+# two-stage detection family lives in vision/detection.py; re-exported
+# here so paddle.vision.ops mirrors the reference surface
+# (detection.__all__ is the single source of truth)
+from . import detection as _detection  # noqa: E402
+from .detection import *  # noqa: E402,F401,F403
+__all__ += _detection.__all__

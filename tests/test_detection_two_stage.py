@@ -1,0 +1,242 @@
+"""Two-stage detection ops vs numpy references + Faster-RCNN-style
+composition (anchors -> proposals -> FPN routing -> RoI pooling).
+
+Parity: fluid/layers/detection.py:621/1317/1925/2399/2894/3043/3673/3871
+and operators/detection/*; static-shape TPU formulations are padded +
+counts, but the valid prefixes must match the reference math.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_anchor_generator_matches_numpy():
+    x = paddle.to_tensor(np.zeros((1, 8, 3, 4), np.float32))
+    anchors, var = V.anchor_generator(
+        x, anchor_sizes=[64.0], aspect_ratios=[1.0, 2.0],
+        stride=[16.0, 16.0], offset=0.5)
+    a = anchors.numpy()
+    assert a.shape == (3, 4, 2, 4)
+    # position (0,0), ratio 1.0: 64x64 box centered at (8, 8)
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 32, 8 - 32, 8 + 32, 8 + 32])
+    # ratio 2.0 (h/w): w = 64/sqrt(2), h = 64*sqrt(2)
+    w, h = 64 / np.sqrt(2), 64 * np.sqrt(2)
+    np.testing.assert_allclose(
+        a[0, 0, 1], [8 - w / 2, 8 - h / 2, 8 + w / 2, 8 + h / 2],
+        rtol=1e-5)
+    # anchors shift by the stride across positions
+    np.testing.assert_allclose(a[0, 1, 0] - a[0, 0, 0], [16, 0, 16, 0])
+    np.testing.assert_allclose(var.numpy()[2, 3, 1],
+                               [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box_counts_and_range():
+    x = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, var = V.density_prior_box(
+        x, img, densities=[2], fixed_sizes=[32.0], fixed_ratios=[1.0],
+        clip=False, steps=[16.0, 16.0])
+    b = boxes.numpy()
+    assert b.shape == (4, 4, 4, 4)      # 2^2 densities x 1 ratio
+    # centers of the 2x2 sub-grid differ by shift/img = 8/64
+    c0 = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    c1 = (b[0, 0, 1, 0] + b[0, 0, 1, 2]) / 2
+    np.testing.assert_allclose(c1 - c0, 8.0 / 64.0, atol=1e-6)
+    bc, _ = V.density_prior_box(
+        x, img, densities=[2], fixed_sizes=[32.0], fixed_ratios=[1.0],
+        clip=True, steps=[16.0, 16.0])
+    v = bc.numpy()
+    assert (v >= 0).all() and (v <= 1).all()
+
+
+def test_bipartite_match_greedy():
+    d = np.asarray([[0.9, 0.1, 0.3],
+                    [0.8, 0.7, 0.2]], np.float32)
+    idx, dist = V.bipartite_match(paddle.to_tensor(d))
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(idx.numpy(), [0, 1, -1])
+    np.testing.assert_allclose(dist.numpy(), [0.9, 0.7, 0.0])
+    # per_prediction: col 2 gets its argmax row if >= threshold
+    idx2, dist2 = V.bipartite_match(paddle.to_tensor(d),
+                                    match_type="per_prediction",
+                                    dist_threshold=0.25)
+    np.testing.assert_array_equal(idx2.numpy(), [0, 1, 0])
+    np.testing.assert_allclose(dist2.numpy(), [0.9, 0.7, 0.3])
+
+
+def test_box_clip():
+    boxes = np.asarray([[-5.0, -3.0, 120.0, 40.0]], np.float32)
+    im = np.asarray([[50.0, 100.0, 1.0]], np.float32)  # h=50, w=100
+    out = V.box_clip(paddle.to_tensor(boxes), paddle.to_tensor(im))
+    np.testing.assert_allclose(out.numpy()[0], [0, 0, 99, 40])
+
+
+def _np_decode(anchor, var, delta):
+    aw, ah = anchor[2] - anchor[0], anchor[3] - anchor[1]
+    acx, acy = anchor[0] + aw / 2, anchor[1] + ah / 2
+    cx = delta[0] * var[0] * aw + acx
+    cy = delta[1] * var[1] * ah + acy
+    w = np.exp(delta[2] * var[2]) * aw
+    h = np.exp(delta[3] * var[3]) * ah
+    return [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+
+
+def test_generate_proposals_decode_and_nms():
+    # 1x1 feature map, 3 anchors: check decode + suppression orders
+    H = W = 1
+    A = 3
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    anchors[0, 0, 0] = [0, 0, 10, 10]
+    anchors[0, 0, 1] = [1, 1, 11, 11]     # overlaps anchor 0 heavily
+    anchors[0, 0, 2] = [30, 30, 50, 50]
+    var = np.full((H, W, A, 4), 1.0, np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32).reshape(1, A, 1, 1)
+    deltas = np.zeros((1, 4 * A, 1, 1), np.float32)
+    im_info = np.asarray([[60.0, 60.0, 1.0]], np.float32)
+    rois, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(im_info), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=3, post_nms_top_n=3,
+        nms_thresh=0.5, min_size=1.0, return_rois_num=True)
+    assert int(num.numpy()[0]) == 2      # anchor 1 suppressed by 0
+    np.testing.assert_allclose(rois.numpy()[0, 0], [0, 0, 10, 10])
+    np.testing.assert_allclose(rois.numpy()[0, 1], [30, 30, 50, 50])
+    # non-zero deltas decode like box_coder center-size
+    deltas2 = np.zeros((1, 4 * A, 1, 1), np.float32)
+    deltas2[0, 0:4, 0, 0] = [0.1, 0.2, 0.1, -0.1]
+    rois2 = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas2),
+        paddle.to_tensor(im_info), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=3, post_nms_top_n=3,
+        nms_thresh=0.99, min_size=0.0)
+    want = _np_decode(anchors[0, 0, 0], var[0, 0, 0],
+                      [0.1, 0.2, 0.1, -0.1])
+    np.testing.assert_allclose(rois2.numpy()[0, 0], want, rtol=1e-5)
+
+
+def test_detection_output_ssd():
+    M, C = 2, 3     # 2 priors, 3 classes (0 = background)
+    priors = np.asarray([[0, 0, 10, 10], [20, 20, 40, 40]], np.float32)
+    pvar = np.full((M, 4), 1.0, np.float32)
+    loc = np.zeros((1, M, 4), np.float32)
+    scores = np.zeros((1, M, C), np.float32)
+    scores[0, 0] = [0.1, 0.8, 0.1]      # prior 0 -> class 1
+    scores[0, 1] = [0.2, 0.1, 0.7]      # prior 1 -> class 2
+    out, counts = V.detection_output(
+        paddle.to_tensor(loc), paddle.to_tensor(scores),
+        paddle.to_tensor(priors), paddle.to_tensor(pvar),
+        keep_top_k=4, score_threshold=0.5)
+    assert int(counts.numpy()[0]) == 2
+    o = out.numpy()[0]
+    assert o[0, 0] == 1.0 and abs(o[0, 1] - 0.8) < 1e-6
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 10, 10], atol=1e-5)
+    assert o[1, 0] == 2.0
+    np.testing.assert_allclose(o[1, 2:], [20, 20, 40, 40], atol=1e-5)
+    assert (o[2:, 0] == -1).all()       # padding rows flagged
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.asarray([[0, 0, 224, 224],      # scale 224 -> level 4
+                       [0, 0, 56, 56],        # scale 56  -> level 2
+                       [0, 0, 112, 112],      # scale 112 -> level 3
+                       [0, 0, 448, 448]],     # scale 448 -> level 5
+                      np.float32)
+    outs, restore, counts = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5,
+        refer_level=4, refer_scale=224)
+    np.testing.assert_array_equal(counts.numpy(), [1, 1, 1, 1])
+    np.testing.assert_allclose(outs[0].numpy()[0], rois[1])  # lvl2
+    np.testing.assert_allclose(outs[2].numpy()[0], rois[0])  # lvl4
+    # restore indices rebuild the original order from the level concat
+    concat = np.concatenate([o.numpy()[:int(c)] for o, c in
+                             zip(outs, counts.numpy())], axis=0)
+    np.testing.assert_allclose(concat[restore.numpy()[:, 0]], rois)
+
+    # collect: global top-k by score across levels
+    scores = [paddle.to_tensor(np.asarray(s, np.float32))
+              for s in ([0.3, 0, 0, 0], [0.9, 0, 0, 0],
+                        [0.5, 0, 0, 0], [0.7, 0, 0, 0])]
+    kept, n = V.collect_fpn_proposals(
+        outs, scores, 2, 5, post_nms_top_n=2,
+        rois_num_per_level=[paddle.to_tensor(np.int64(1))] * 4)
+    assert int(n.numpy()) == 2
+    # per-level scores: lvl2=0.3, lvl3=0.9, lvl4=0.5, lvl5=0.7 — the
+    # top-2 are the lvl3 (112) and lvl5 (448) rois
+    np.testing.assert_allclose(kept.numpy()[0], rois[2])   # score 0.9
+    np.testing.assert_allclose(kept.numpy()[1], rois[3])   # score 0.7
+
+
+def test_deformable_psroi_pooling_zero_offset_matches_psroi():
+    # with zero trans, deformable PS-RoI == plain PS-RoI average pool
+    np.random.seed(0)
+    ph = pw = 2
+    out_c = 3
+    x = np.random.randn(1, out_c * ph * pw, 8, 8).astype(np.float32)
+    rois = np.asarray([[0, 0, 0, 4, 4]], np.float32)  # batch 0, 4x4 box
+    out = V.deformable_psroi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois), no_trans=True,
+        spatial_scale=1.0, pooled_height=ph, pooled_width=pw,
+        sample_per_part=2)
+    assert out.numpy().shape == (1, out_c, ph, pw)
+    # bin (0,0) of channel c samples channel c*4+0 inside [0,2)x[0,2)
+    # with 2x2 midpoint samples at (0.5, 1.5)
+    for c in range(out_c):
+        plane = x[0, c * 4]
+        ys = xs = np.asarray([0.5, 1.5])
+        vals = []
+        for yy in ys:
+            for xx in xs:
+                y0, x0 = int(yy), int(xx)
+                wy, wx = yy - y0, xx - x0
+                v = (plane[y0, x0] * (1 - wy) * (1 - wx)
+                     + plane[y0, x0 + 1] * (1 - wy) * wx
+                     + plane[y0 + 1, x0] * wy * (1 - wx)
+                     + plane[y0 + 1, x0 + 1] * wy * wx)
+                vals.append(v)
+        np.testing.assert_allclose(out.numpy()[0, c, 0, 0],
+                                   np.mean(vals), rtol=1e-5)
+    # a non-zero offset shifts the sampling window
+    trans = np.zeros((1, 2, ph, pw), np.float32)
+    trans[0, 0, 0, 0] = 2.5     # dx = 2.5 * trans_std * roi_w = 1.0
+    out2 = V.deformable_psroi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        trans=paddle.to_tensor(trans), spatial_scale=1.0,
+        pooled_height=ph, pooled_width=pw, sample_per_part=2,
+        trans_std=0.1)
+    assert abs(out2.numpy()[0, 0, 0, 0] - out.numpy()[0, 0, 0, 0]) > 1e-6
+
+
+def test_faster_rcnn_style_head_composes():
+    """anchors -> RPN proposals -> FPN routing -> RoI align -> head:
+    the full two-stage pipeline runs end-to-end with static shapes."""
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 3, 8, 8
+    feat = paddle.to_tensor(rng.randn(N, 16, H, W).astype(np.float32))
+    anchors, var = V.anchor_generator(
+        feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0, 2.0],
+        stride=[8.0, 8.0])
+    A6 = 6
+    scores = paddle.to_tensor(
+        rng.rand(N, A6, H, W).astype(np.float32))
+    deltas = paddle.to_tensor(
+        (rng.randn(N, 4 * A6, H, W) * 0.1).astype(np.float32))
+    im_info = paddle.to_tensor(np.asarray([[64.0, 64.0, 1.0]],
+                                          np.float32))
+    rois, num = V.generate_proposals(
+        scores, deltas, im_info, anchors, var, pre_nms_top_n=64,
+        post_nms_top_n=16, nms_thresh=0.7, min_size=2.0,
+        return_rois_num=True)
+    n0 = int(num.numpy()[0])
+    assert n0 > 0
+    outs, restore, counts = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois.numpy()[0]), min_level=2, max_level=3,
+        refer_level=2, refer_scale=28)
+    assert int(counts.numpy().sum()) == 16   # every padded slot routed
+    pooled = V.roi_align(feat, paddle.to_tensor(rois.numpy()[0]),
+                         paddle.to_tensor(np.asarray([16], np.int64)),
+                         output_size=4, spatial_scale=H / 64.0)
+    assert pooled.numpy().shape == (16, 16, 4, 4)
+    assert np.isfinite(pooled.numpy()).all()
