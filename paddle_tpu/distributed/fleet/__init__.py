@@ -35,3 +35,8 @@ __all__ = [
     "DistributedTrainStep", "Fleet", "PSRuntime", "SparseTable", "utils",
     "recompute", "meta_parallel",
 ]
+from . import data_generator  # noqa: F401,E402
+from .data_generator import (  # noqa: F401,E402
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+__all__ += ["data_generator", "DataGenerator", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator"]

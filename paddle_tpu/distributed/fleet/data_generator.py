@@ -1,0 +1,132 @@
+"""User-side authoring API for PS training data.
+
+Parity: python/paddle/distributed/fleet/data_generator/data_generator.py
+— users subclass :class:`DataGenerator`, implement ``generate_sample``
+(and optionally ``generate_batch``), then ``run_from_stdin`` /
+``run_from_memory`` emit MultiSlot text lines:
+
+    <len> v1 ... vlen <len> v1 ...        (slots in sample order)
+
+which is exactly what ``native/datafeed.cc`` parses (and the reference's
+MultiSlotDataFeed reads via the dataset pipe_command).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, Optional
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base class; ``generate_sample(line)`` must return a callable (or
+    generator function) yielding ``(slot_name, [values])`` pairs —
+    the reference's contract (data_generator.py:19)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    # -- user configuration -------------------------------------------
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = int(batch_size)
+
+    # -- user hooks ----------------------------------------------------
+    def generate_sample(self, line: Optional[str]):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator factory of "
+            "[(slot_name, [values]), ...]")
+
+    def generate_batch(self, samples):
+        """Optional batch-level processing; default passes samples
+        through one by one."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- serialization --------------------------------------------------
+    def _gen_str(self, line) -> str:
+        """[(name, [v, ...]), ...] -> '<len> v1 .. vlen ...' MultiSlot
+        text (values stringified; the reference's MultiSlot generator
+        accepts ints/floats/strings alike)."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of generate_sample() must yield a list or "
+                "tuple like [('words', [1926, 8, 17]), ('label', [1])], "
+                f"got {type(line).__name__}")
+        parts = []
+        for item in line:
+            if not isinstance(item, (list, tuple)) or len(item) != 2:
+                raise ValueError(
+                    f"each slot must be a (name, values) pair, got "
+                    f"{item!r}")
+            _name, elements = item
+            if not isinstance(elements, (list, tuple)) \
+                    or len(elements) == 0:
+                raise ValueError(
+                    f"slot {_name!r} must carry a non-empty value list")
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+    # -- drivers -------------------------------------------------------
+    def _run(self, lines: Iterable[Optional[str]], out) -> int:
+        n = 0
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    for s in self.generate_batch(batch)():
+                        out.write(self._gen_str(s))
+                        n += 1
+                    batch = []
+        if batch:
+            for s in self.generate_batch(batch)():
+                out.write(self._gen_str(s))
+                n += 1
+        return n
+
+    def run_from_stdin(self, out=None) -> int:
+        """Feed stdin lines through generate_sample/generate_batch and
+        print MultiSlot lines (the dataset pipe_command entry point)."""
+        return self._run(sys.stdin, out or sys.stdout)
+
+    def run_from_memory(self, out=None) -> int:
+        """No input lines: generate_sample(None) produces the samples
+        (the reference's run_from_memory)."""
+        return self._run([None], out or sys.stdout)
+
+    def run_from_file(self, path: str, out=None) -> int:
+        """Convenience driver over a file (one generate_sample per
+        line) — same output contract as run_from_stdin."""
+        with open(path) as f:
+            return self._run(f, out or sys.stdout)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric-value generator (the reference subclass that validates
+    values are int/float before stringifying)."""
+
+    def _gen_str(self, line) -> str:
+        for item in line:
+            if isinstance(item, (list, tuple)) and len(item) == 2:
+                for e in item[1]:
+                    if not isinstance(e, (int, float)):
+                        raise ValueError(
+                            f"MultiSlotDataGenerator values must be "
+                            f"int/float, got {type(e).__name__} in slot "
+                            f"{item[0]!r}")
+        return super()._gen_str(line)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-valued generator (feasigns already stringified)."""
+    pass

@@ -267,9 +267,273 @@ class Grayscale(BaseTransform):
     def _apply_image(self, img):
         arr = np.asarray(img).astype(np.float32)
         if arr.ndim == 2:
-            g = arr
+            g = _gray(arr)
         else:
             g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
         if self.num_output_channels == 3:
             return np.stack([g] * 3, -1)
         return g[..., None]
+
+
+# ---------------------------------------------------------------------
+# color family (reference vision/transforms/functional.py:356 ff. +
+# transforms.py:847 ColorJitter; numpy implementations of the PIL math)
+# ---------------------------------------------------------------------
+
+def _as_float_rgb(img):
+    arr = np.asarray(img)
+    was_uint8 = arr.dtype == np.uint8
+    return arr.astype(np.float32), was_uint8
+
+
+def _restore(arr, was_uint8):
+    if was_uint8:
+        return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+    return arr.astype(np.float32)
+
+
+def adjust_brightness(img, brightness_factor):
+    """out = img * factor (functional.py adjust_brightness)."""
+    arr, u8 = _as_float_rgb(img)
+    return _restore(arr * brightness_factor, u8)
+
+
+def _gray(arr):
+    # ITU-R 601-2 luma, the PIL convert('L') weights; a 2D array is
+    # already grayscale
+    if arr.ndim == 2:
+        return arr
+    return (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+            + arr[..., 2] * 0.114)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the image's mean gray (functional.py adjust_contrast:
+    PIL uses the mean of the L-converted image)."""
+    arr, u8 = _as_float_rgb(img)
+    if u8:
+        mean = np.mean(np.round(_gray(arr)).clip(0, 255).astype(
+            np.uint8).astype(np.float32))
+    else:
+        mean = np.mean(_gray(arr))
+    out = (1.0 - contrast_factor) * mean + contrast_factor * arr
+    if arr.ndim == 3 and arr.shape[-1] > 3:
+        out[..., 3:] = arr[..., 3:]      # alpha rides through untouched
+    return _restore(out, u8)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the per-pixel grayscale (functional.py
+    adjust_saturation)."""
+    arr, u8 = _as_float_rgb(img)
+    g = _gray(arr)[..., None]
+    if u8:
+        g = np.round(g).clip(0, 255)
+    out = (1.0 - saturation_factor) * g + saturation_factor * arr
+    if arr.ndim == 3 and arr.shape[-1] > 3:
+        out[..., 3:] = arr[..., 3:]      # alpha rides through untouched
+    return _restore(out, u8)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by ``hue_factor`` (in [-0.5, 0.5] turns) through HSV,
+    the PIL 0..255 H-channel arithmetic (functional.py adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    u8 = arr.dtype == np.uint8
+    f = arr.astype(np.float32) / (255.0 if u8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx = np.max(f[..., :3], axis=-1)
+    mn = np.min(f[..., :3], axis=-1)
+    c = mx - mn
+    safe = np.where(c == 0, 1.0, c)
+    h = np.where(mx == r, ((g - b) / safe) % 6.0,
+                 np.where(mx == g, (b - r) / safe + 2.0,
+                          (r - g) / safe + 4.0))
+    h = np.where(c == 0, 0.0, h) / 6.0          # [0,1) turns
+    # PIL quantizes H to uint8 before the shift: match that exactly
+    h8 = np.round(h * 255.0).astype(np.int16)
+    h8 = (h8 + int(round(hue_factor * 255.0))) % 256
+    h = h8.astype(np.float32) / 255.0
+    s = np.where(mx == 0, 0.0, c / np.where(mx == 0, 1.0, mx))
+    v = mx
+    i = np.floor(h * 6.0) % 6
+    frac = h * 6.0 - np.floor(h * 6.0)
+    p = v * (1 - s)
+    q = v * (1 - s * frac)
+    t = v * (1 - s * (1 - frac))
+    r2 = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g2 = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b2 = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if arr.shape[-1] > 3:
+        out = np.concatenate([out, f[..., 3:]], axis=-1)
+    out = out * (255.0 if u8 else 1.0)
+    return _restore(out, u8)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees about ``center``
+    (functional.py rotate): inverse affine map + nearest/bilinear
+    sampling, constant fill outside."""
+    arr = np.asarray(img)
+    u8 = arr.dtype == np.uint8
+    f = arr.astype(np.float32)
+    if f.ndim == 2:
+        f = f[:, :, None]
+    h, w = f.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        corners = np.asarray([[-cx, -cy], [w - 1 - cx, -cy],
+                              [-cx, h - 1 - cy], [w - 1 - cx, h - 1 - cy]])
+        rot = np.stack([corners[:, 0] * cos - corners[:, 1] * sin,
+                        corners[:, 0] * sin + corners[:, 1] * cos], 1)
+        out_w = int(np.ceil(rot[:, 0].max() - rot[:, 0].min() + 1))
+        out_h = int(np.ceil(rot[:, 1].max() - rot[:, 1].min() + 1))
+        ocx, ocy = (out_w - 1) / 2.0, (out_h - 1) / 2.0
+    else:
+        out_h, out_w, ocx, ocy = h, w, cx, cy
+    yy, xx = np.meshgrid(np.arange(out_h, dtype=np.float32),
+                         np.arange(out_w, dtype=np.float32),
+                         indexing="ij")
+    dx, dy = xx - ocx, yy - ocy
+    # inverse rotation back into source coords; screen coords have y
+    # DOWN, so a visually counter-clockwise rotation (PIL's convention)
+    # is R(-angle) in math coords and the inverse map is R(+angle)
+    sx = dx * cos - dy * sin + cx
+    sy = dx * sin + dy * cos + cy
+    fill_vec = np.broadcast_to(
+        np.asarray(fill, np.float32).reshape(-1), (f.shape[2],)) \
+        if np.ndim(fill) else np.full((f.shape[2],), float(fill),
+                                      np.float32)
+    if interpolation == "nearest":
+        sxr = np.round(sx).astype(np.int64)
+        syr = np.round(sy).astype(np.int64)
+        inside = (sxr >= 0) & (sxr < w) & (syr >= 0) & (syr < h)
+        out = np.broadcast_to(fill_vec, (out_h, out_w, f.shape[2])).copy()
+        out[inside] = f[syr[inside], sxr[inside]]
+    else:   # bilinear
+        x0 = np.clip(np.floor(sx), 0, w - 1).astype(np.int64)
+        y0 = np.clip(np.floor(sy), 0, h - 1).astype(np.int64)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        wx = np.clip(sx, 0, w - 1) - x0
+        wy = np.clip(sy, 0, h - 1) - y0
+        out = (f[y0, x0] * ((1 - wy) * (1 - wx))[..., None]
+               + f[y0, x1] * ((1 - wy) * wx)[..., None]
+               + f[y1, x0] * (wy * (1 - wx))[..., None]
+               + f[y1, x1] * (wy * wx)[..., None])
+        inside = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) \
+            & (sy <= h - 0.5)
+        out = np.where(inside[..., None], out, fill_vec)
+    if arr.ndim == 2:
+        out = out[:, :, 0]
+    return _restore(out, u8)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_contrast(img,
+                               1 + random.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_saturation(
+            img, 1 + random.uniform(-self.value, self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue in random
+    order (reference transforms.py:847)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        if not 0 <= hue <= 0.5:
+            raise ValueError("ColorJitter hue must be in [0, 0.5], got "
+                             f"{hue}")
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            b = self.brightness
+            ops.append(lambda im: adjust_brightness(
+                im, random.uniform(max(0, 1 - b), 1 + b)))
+        if self.contrast:
+            c = self.contrast
+            ops.append(lambda im: adjust_contrast(
+                im, random.uniform(max(0, 1 - c), 1 + c)))
+        if self.saturation:
+            s = self.saturation
+            ops.append(lambda im: adjust_saturation(
+                im, random.uniform(max(0, 1 - s), 1 + s)))
+        if self.hue:
+            hmag = self.hue
+            ops.append(lambda im: adjust_hue(
+                im, random.uniform(-hmag, hmag)))
+        random.shuffle(ops)
+        out = img
+        for op in ops:
+            out = op(out)
+        return np.asarray(out)
+
+
+class RandomRotation(BaseTransform):
+    """Rotate by a random angle from degrees (reference
+    transforms.py RandomRotation)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+__all__ += ["adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue", "rotate", "ColorJitter", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "RandomRotation"]
