@@ -129,6 +129,9 @@ class Config:
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         # API-compat name; selects the accelerator (TPU). Memory pool
         # size is meaningless under XLA's allocator — recorded only.
+        _warn_inert("enable_use_gpu",
+                    "maps to the TPU accelerator; memory_pool_init_size"
+                    "_mb is ignored (XLA owns device memory)")
         self._use_accelerator = True
         self._device_id = device_id
 
@@ -146,6 +149,8 @@ class Config:
         return self._device_id
 
     def set_cpu_math_library_num_threads(self, n):
+        _warn_inert("set_cpu_math_library_num_threads",
+                    "recorded only; XLA owns host threading")
         self._cpu_math_threads = int(n)
 
     # -- precision / optimization ------------------------------------
@@ -156,19 +161,28 @@ class Config:
     enable_mkldnn_bfloat16 = enable_bf16   # reference API name
 
     def switch_ir_optim(self, flag=True):
+        if not flag:
+            _warn_inert("switch_ir_optim",
+                        "False has no effect; XLA always optimizes the "
+                        "compiled program")
         self._ir_optim = bool(flag)
 
     def ir_optim(self):
         return self._ir_optim
 
     def enable_memory_optim(self, flag=True):
+        _warn_inert("enable_memory_optim",
+                    "recorded only; XLA's buffer assignment already "
+                    "reuses memory")
         self._memory_optim = bool(flag)
 
     def enable_profile(self):
         self._enable_profile = True
 
     def switch_use_feed_fetch_ops(self, flag):
-        pass    # no feed/fetch ops exist under XLA — zero-copy always
+        _warn_inert("switch_use_feed_fetch_ops",
+                    "no feed/fetch ops exist under XLA — zero-copy "
+                    "always")
 
     def switch_specify_input_names(self, flag=True):
         pass
@@ -176,6 +190,10 @@ class Config:
     def enable_tensorrt_engine(self, *a, **kw):
         # The TensorRT subgraph role (fused low-precision serving) is
         # XLA compilation itself; bf16 covers the Half precision mode.
+        _warn_inert("enable_tensorrt_engine",
+                    "TensorRT does not exist on TPU; Half/Int8 "
+                    "precision modes map to bf16 XLA compilation, other "
+                    "arguments are ignored")
         prec = kw.get("precision_mode", PrecisionType.Float32)
         if prec in (PrecisionType.Half, PrecisionType.Int8):
             self._precision = PrecisionType.Bfloat16
@@ -187,6 +205,20 @@ class Config:
         return ("Config(model=%s, accelerator=%s, precision=%s, "
                 "ir_optim=%s)" % (self._path_prefix(), self._use_accelerator,
                                   self._precision, self._ir_optim))
+
+
+
+def _warn_inert(knob: str, detail: str):
+    """One warning per inert reference knob (the fleet strategy surface
+    does the same via warn_noop_toggles — silent divergence from user
+    intent is worse than noise)."""
+    import warnings
+    if knob not in _warned_knobs:
+        _warned_knobs.add(knob)
+        warnings.warn(f"inference.Config.{knob}: {detail}", stacklevel=3)
+
+
+_warned_knobs: set = set()
 
 
 class Tensor:

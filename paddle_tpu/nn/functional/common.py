@@ -21,7 +21,7 @@ __all__ = [
     "batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm",
     "normalize", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
     "channel_shuffle", "unfold", "fold", "label_smooth", "class_center_sample",
-    "pairwise_distance",
+    "pairwise_distance", "cos_sim", "data_norm",
 ]
 
 
@@ -696,3 +696,38 @@ def gather_tree(ids, parents):
         _, rev = jax.lax.scan(step, init, (i[::-1], p[::-1]))
         return rev[::-1]
     return _apply(f, ids, parents, op_name="gather_tree")
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (reference fluid/layers/nn.py:921,
+    operators/cos_sim_op.*): Y broadcasts when it has one row. Returns
+    [N, 1]."""
+    def f(x, y):
+        if y.shape[0] == 1 and x.shape[0] != 1:
+            y = jnp.broadcast_to(y, x.shape)
+        num = jnp.sum(x * y, axis=-1)
+        den = jnp.sqrt(jnp.sum(x * x, axis=-1)) \
+            * jnp.sqrt(jnp.sum(y * y, axis=-1))
+        return (num / jnp.maximum(den, 1e-12))[:, None]
+    return _apply(f, X, Y, op_name="cos_sim")
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, scale_w=None,
+              bias=None, epsilon=1e-4, name=None):
+    """The PS-CTR running normalizer (reference operators/
+    data_norm_op.cc:302): means = batch_sum / batch_size, scales =
+    sqrt(batch_size / batch_square_sum); out = (x - means) * scales
+    (optionally folded with scale_w/bias)."""
+    args = [x, batch_size, batch_sum, batch_square_sum]
+    has_affine = scale_w is not None
+    if has_affine:
+        args += [scale_w, bias]
+
+    def f(xv, bsz, bsum, bsq, *affine):
+        means = bsum / bsz
+        scales = jnp.sqrt(bsz / jnp.maximum(bsq, epsilon))
+        out = (xv - means[None, :]) * scales[None, :]
+        if affine:
+            out = out * affine[0][None, :] + affine[1][None, :]
+        return out
+    return _apply(f, *args, op_name="data_norm")

