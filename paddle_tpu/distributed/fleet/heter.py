@@ -70,8 +70,14 @@ class DeviceCachedTable:
         self._opt = optimizer
         self._lr = lr
         self._eps = eps
-        self._buf = jnp.zeros((self._cap, self._dim), jnp.float32)
-        self._acc = (jnp.zeros((self._cap, self._dim), jnp.float32)
+        # one extra SCRATCH row at index cap: variable-length device ops
+        # (install/write-back/push) pad their index vectors to power-of-2
+        # buckets pointing at it, so every op reuses a handful of
+        # compiled shapes — without this, each batch's unique-id count
+        # produced a fresh XLA compile (measured seconds per step
+        # through the single-tenant TPU tunnel)
+        self._buf = jnp.zeros((self._cap + 1, self._dim), jnp.float32)
+        self._acc = (jnp.zeros((self._cap + 1, self._dim), jnp.float32)
                      if optimizer == "adagrad" else None)
         self._orig = np.zeros((self._cap, self._dim), np.float32)
         self._slot_of: Dict[int, int] = {}
@@ -87,6 +93,21 @@ class DeviceCachedTable:
         # (plain pulls keep pure LRU semantics for pull-only use).
         self._lock = threading.RLock()
         self._pins: Dict[tuple, list] = {}   # uniq-ids key -> [slots, n]
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    def _pad_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Pad a slot-index vector to its power-of-2 bucket with the
+        scratch row (index cap) so device scatter/gather shapes repeat."""
+        b = self._bucket(max(len(slots), 1))
+        out = np.full(b, self._cap, np.int64)
+        out[:len(slots)] = slots
+        return out
 
     # -- admission / eviction -----------------------------------------
     def _admit(self, miss_ids: np.ndarray, pinned: set) -> np.ndarray:
@@ -122,9 +143,12 @@ class DeviceCachedTable:
         if evict:
             self._write_back(np.asarray(evict, np.int64))
         rows = self._table.pull(miss_ids)
-        self._buf = self._buf.at[jnp.asarray(slots)].set(jnp.asarray(rows))
+        sp = self._pad_slots(slots)
+        rows_p = np.zeros((len(sp), self._dim), np.float32)
+        rows_p[:len(slots)] = rows
+        self._buf = self._buf.at[jnp.asarray(sp)].set(jnp.asarray(rows_p))
         if self._acc is not None:
-            self._acc = self._acc.at[jnp.asarray(slots)].set(0.0)
+            self._acc = self._acc.at[jnp.asarray(sp)].set(0.0)
         self._orig[slots] = rows
         self._id_of[slots] = miss_ids
         self._dirty[slots] = False
@@ -136,10 +160,12 @@ class DeviceCachedTable:
     def _write_back(self, slots: np.ndarray):
         """Exact sync of dirty rows to the host table: push the value
         delta accumulated since admission (push_delta adds raw)."""
+        import jax.numpy as jnp
         d = slots[self._dirty[slots]]
         if d.size == 0:
             return
-        vals = np.asarray(self._buf[d])
+        dp = self._pad_slots(d)
+        vals = np.asarray(self._buf[jnp.asarray(dp)])[:d.size]
         self._table.push_delta(self._id_of[d], vals - self._orig[d])
         self._orig[d] = vals
         self._dirty[d] = False
@@ -192,10 +218,11 @@ class DeviceCachedTable:
             else:
                 slots = np.asarray(
                     [self._slot_of[i] for i in uniq.tolist()], np.int64)
+            nseg = self._bucket(max(len(uniq), 1))
             g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
                                     jnp.asarray(inverse),
-                                    num_segments=len(uniq))
-            sl = jnp.asarray(slots)
+                                    num_segments=nseg)
+            sl = jnp.asarray(self._pad_slots(np.asarray(slots, np.int64)))
             if self._opt == "adagrad":
                 self._acc = self._acc.at[sl].add(g * g)
                 step = g / (jnp.sqrt(self._acc[sl]) + self._eps)

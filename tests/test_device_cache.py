@@ -209,3 +209,25 @@ def test_admit_failure_leaves_cache_consistent():
     cache.push(np.array([0, 1, 2], np.int64), np.zeros((3, 4), np.float32))
     cache.pull(np.array([10, 11, 12], np.int64))   # now fine
     assert 10 in cache._slot_of
+
+
+def test_variable_batch_shapes_reuse_buckets():
+    # r3 perf: device ops pad to power-of-2 buckets aimed at the scratch
+    # row, so varying unique counts do not mint fresh compile shapes
+    table, cache = _mk(capacity=8)
+    assert cache._bucket(1) == 1 and cache._bucket(5) == 8
+    p = cache._pad_slots(np.asarray([2, 4, 5], np.int64))
+    assert len(p) == 4 and p[-1] == cache._cap      # scratch row
+    # scratch row never holds real data: exactness across ragged batches
+    base = table.pull(np.arange(8, dtype=np.int64)).copy()
+    for ids in ([0, 1, 2], [3], [0, 4, 5, 6], [7, 1]):
+        cache.pull(np.asarray(ids, np.int64))
+        cache.push(np.asarray(ids, np.int64),
+                   np.ones((len(ids), 4), np.float32))
+    cache.flush()
+    got = table.pull(np.arange(8, dtype=np.int64))
+    # rows pushed twice moved twice as far (delta vs initial rows)
+    n_push = {0: 2, 1: 2, 2: 1, 3: 1, 4: 1, 5: 1, 6: 1, 7: 1}
+    for i, n in n_push.items():
+        np.testing.assert_allclose(got[i] - base[i], -0.5 * n * np.ones(4),
+                                   rtol=1e-5, atol=1e-6)
