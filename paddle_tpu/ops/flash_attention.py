@@ -41,12 +41,18 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
-                scale: float, seq_k: int, block_q: int, has_bias: bool):
+                scale: float, seq_k: int, block_q: int, has_bias: bool,
+                with_lse: bool = False):
     if has_bias:
-        bias_ref, o_ref = rest
+        bias_ref, *outs = rest
     else:
-        (o_ref,) = rest
         bias_ref = None
+        outs = list(rest)
+    if with_lse:
+        o_ref, lse_ref = outs
+    else:
+        (o_ref,) = outs
+        lse_ref = None
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, d)
 
@@ -90,10 +96,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
     m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)[:, None]
     o_ref[0, 0] = out.astype(o_ref.dtype)
+    if with_lse:
+        lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
 def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, with_lse=False):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -102,7 +110,8 @@ def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
 
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=sk, block_q=block_q,
-                               has_bias=bias is not None)
+                               has_bias=bias is not None,
+                               with_lse=with_lse)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda b_, h_, q_: (b_, h_, q_, 0)),
@@ -114,15 +123,162 @@ def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
         in_specs.append(pl.BlockSpec((1, 1, 1, sk),
                                      lambda b_, h_, q_: (b_, 0, 0, 0)))
         args.append(bias)
+    out_specs = pl.BlockSpec((1, 1, block_q, d),
+                             lambda b_, h_, q_: (b_, h_, q_, 0))
+    out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if with_lse:
+        # trailing singleton keeps the last-two-dims TPU tiling rule
+        # satisfied ((block_q, 1): 8-divisible x equal-to-array)
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 1, block_q, 1),
+                                  lambda b_, h_, q_: (b_, h_, q_, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)]
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b_, h_, q_: (b_, h_, q_, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(*args)
+
+
+# ---------------------------------------------------------------------
+# Pallas backward (FlashAttention-2 style): dKV and dQ kernels over the
+# saved logsumexp; delta = rowsum(dO * O) precomputed in plain XLA.
+# ---------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    causal: bool, scale: float, seq_q: int):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)          # (block_k, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    num_qb = seq_q // block_q
+    qb0 = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(qb * block_q, block_q)] \
+            .astype(jnp.float32)
+        do = do_ref[0, 0, pl.dslice(qb * block_q, block_q)] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q), 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])            # (block_q, block_k)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (zeros, zeros))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
+                   dq_ref, *, block_q: int, block_k: int, causal: bool,
+                   scale: float, seq_k: int):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)          # (block_q, d)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    num_kb = seq_k // block_k
+    if causal:
+        num_kb_eff = jnp.minimum(
+            num_kb, (qi * block_q + block_q + block_k - 1) // block_k)
+    else:
+        num_kb_eff = num_kb
+
+    def body(kb, dq):
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)] \
+            .astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)] \
+            .astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb_eff, body,
+                           jnp.zeros_like(q))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, do, causal, scale, block_q,
+                     block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)      # [B,H,Sq,1]
+
+    whole_seq = lambda b_, h_, i: (b_, h_, 0, 0)   # noqa: E731
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          seq_q=sq),
+        grid=(b, h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), whole_seq),
+            pl.BlockSpec((1, 1, sq, d), whole_seq),
+            pl.BlockSpec((1, 1, sq, 1), whole_seq),
+            pl.BlockSpec((1, 1, sq, 1), whole_seq),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, 1, block_k, d),
+                                lambda b_, h_, i: (b_, h_, i, 0))] * 2,
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(q, do, lse, delta, k, v)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          seq_k=sk),
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, sk, d), whole_seq),
+            pl.BlockSpec((1, 1, sk, d), whole_seq),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(k, v, do, lse, delta, q)
+    return dq, dk, dv
 
 
 def _ref_chunked(q, k, v, bias, causal, scale, chunk=512):
@@ -161,21 +317,37 @@ def flash_attention_bhsd(q, k, v, bias=None, causal=False, scale=None,
     sq, sk = q.shape[2], k.shape[2]
     if bias is not None and tuple(bias.shape) != (q.shape[0], 1, 1, sk):
         return _ref_chunked(q, k, v, bias, causal, scale)
-    if sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0:
+    if _blocks_ok(sq, sk, block_q, block_k):
         return _pallas_forward(q, k, v, bias, causal, scale, block_q,
                                block_k, interpret)
     return _ref_chunked(q, k, v, bias, causal, scale)
 
 
+def _blocks_ok(sq, sk, block_q, block_k):
+    return (sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0)
+
+
 def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    sq, sk = q.shape[2], k.shape[2]
+    if bias is None and _blocks_ok(sq, sk, block_q, block_k):
+        # fused path: forward also emits the logsumexp rows the Pallas
+        # backward kernels need (FlashAttention-2 recomputation scheme)
+        out, lse = _pallas_forward(q, k, v, None, causal, sc, block_q,
+                                   block_k, interpret, with_lse=True)
+        return out, (q, k, v, bias, out, lse)
     out = flash_attention_bhsd(q, k, v, bias, causal, scale, block_q,
                                block_k, interpret)
-    return out, (q, k, v, bias)
+    return out, (q, k, v, bias, None, None)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, bias = res
+    q, k, v, bias, out, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if lse is not None:
+        dq, dk, dv = _pallas_backward(q, k, v, out, lse, g, causal, s,
+                                      block_q, block_k, interpret)
+        return dq, dk, dv, None
     if bias is None:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _ref_chunked(q_, k_, v_, None, causal, s),
