@@ -366,69 +366,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return _apply(f, *args, op_name="batch_norm")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ln_train(v, w, b, n_norm, eps):
-    return _ln_train_fwd(v, w, b, n_norm, eps)[0]
-
-
-def _bcast_norm(t, ref_ndim, n_norm):
-    """Reshape an affine param (shape = normalized trailing dims) to
-    broadcast over the leading row dims."""
-    return t.reshape((1,) * (ref_ndim - n_norm) + t.shape)
-
-
-def _ln_train_fwd(v, w, b, n_norm, eps):
-    axes = tuple(range(v.ndim - n_norm, v.ndim))
-    vf = v.astype(jnp.float32)
-    m, var, n = _moments(vf, axes)
-    rstd = jax.lax.rsqrt(var + eps)
-    mk = _keep(m, v.ndim, axes)
-    rk = _keep(rstd, v.ndim, axes)
-    xhat = (vf - mk) * rk
-    out = xhat
-    if w is not None:
-        out = out * _bcast_norm(w.astype(jnp.float32), v.ndim, n_norm) \
-            + _bcast_norm(b.astype(jnp.float32), v.ndim, n_norm)
-    return out.astype(v.dtype), (v, w, m, rstd, n)
-
-
-def _ln_train_bwd(n_norm, eps, res, g):
-    v, w, m, rstd, n = res
-    axes = tuple(range(v.ndim - n_norm, v.ndim))
-    lead = tuple(range(v.ndim - n_norm))
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    mk = _keep(m, v.ndim, axes)
-    rk = _keep(rstd, v.ndim, axes)
-    xhat = (vf - mk) * rk
-    if w is not None:
-        gy = gf * _bcast_norm(w.astype(jnp.float32), v.ndim, n_norm)
-        dw = jnp.sum(gf * xhat, axis=lead).astype(w.dtype)
-        db = jnp.sum(gf, axis=lead).astype(w.dtype)
-    else:
-        gy, dw, db = gf, None, None
-    sum_gy = jnp.sum(gy, axis=axes)
-    sum_gy_xhat = jnp.sum(gy * xhat, axis=axes)
-    dx = (rk / n) * (n * gy - _keep(sum_gy, v.ndim, axes)
-                     - xhat * _keep(sum_gy_xhat, v.ndim, axes))
-    return dx.astype(v.dtype), dw, db
-
-
-_ln_train.defvjp(_ln_train_fwd, _ln_train_bwd)
-
-
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                name=None):
-    """Reference: operators/layer_norm_op.* — single-pass f32 moments +
-    closed-form backward (the grad kernel's two sums + one elementwise
-    pass), same structure as the reference's layer_norm_grad kernel."""
+    """Reference: operators/layer_norm_op.* — inline f32 moments inside
+    one fused XLA expression. Deliberately NOT the custom-vjp core BN
+    uses: a custom_vjp boundary blocks XLA's cross-op fusion and costs
+    ~3% of a BERT-base train step on a v5e (A/B in PERF.md), while
+    autodiff of this form compiles to the same closed-form passes."""
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     n_norm = len(list(normalized_shape))
 
     def f(v, *params):
-        w, b = (params[0], params[1]) if params else (None, None)
-        return _ln_train(v, w, b, n_norm, epsilon)
+        axes = tuple(range(v.ndim - n_norm, v.ndim))
+        vf = v.astype(jnp.float32)       # f32 stats even under bf16 AMP
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        va = jnp.mean((vf - m) * (vf - m), axis=axes, keepdims=True)
+        out = (vf - m) * jax.lax.rsqrt(va + epsilon)
+        if params:
+            out = out * params[0].astype(jnp.float32) \
+                + params[1].astype(jnp.float32)
+        return out.astype(v.dtype)
     if weight is not None:
         return _apply(f, x, weight, bias, op_name="layer_norm")
     return _apply(f, x, op_name="layer_norm")

@@ -40,20 +40,40 @@ __all__ = ["flash_attention", "flash_attention_bhsd"]
 NEG_INF = -1e30
 
 
+def _keep_mask(seed_ref, mask_ref, b, h, qb, kb, block_q, block_k,
+               dropout_p):
+    """Dropout keep-mask for score block (qb, kb) — either regenerated
+    from the on-chip PRNG seeded by (seed, b, h, qb, kb) so forward and
+    backward agree bit-exactly, or (tests / interpret mode) read from an
+    injected full [B, H, Sq, Sk] mask."""
+    if mask_ref is not None:
+        return mask_ref[0, 0, pl.dslice(qb * block_q, block_q),
+                        pl.dslice(kb * block_k, block_k)] > 0
+    # Mosaic accepts at most two seed words: pack the block coordinates
+    # into one (8 bits each for h/qb/kb, the rest for b — ample for any
+    # shape this kernel accepts)
+    idx = ((b * 256 + h) * 256 + qb) * 256 + kb
+    pltpu.prng_seed(seed_ref[0], idx)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    thresh = jnp.uint32(int(dropout_p * float(2 ** 32)) & 0xFFFFFFFF)
+    return pltpu.bitcast(bits, jnp.uint32) >= thresh
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
                 scale: float, seq_k: int, block_q: int, has_bias: bool,
-                with_lse: bool = False):
-    if has_bias:
-        bias_ref, *outs = rest
-    else:
-        bias_ref = None
-        outs = list(rest)
+                with_lse: bool = False, dropout_p: float = 0.0,
+                has_mask_in: bool = False):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    seed_ref = rest.pop(0) if dropout_p > 0.0 and not has_mask_in \
+        else None
+    mask_ref = rest.pop(0) if has_mask_in else None
     if with_lse:
-        o_ref, lse_ref = outs
+        o_ref, lse_ref = rest
     else:
-        (o_ref,) = outs
+        (o_ref,) = rest
         lse_ref = None
-    qi = pl.program_id(2)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, d)
 
     m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
@@ -88,7 +108,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
         m_new = jnp.maximum(m, s.max(axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
+        # the normalizer accumulates the UNdropped probabilities (the
+        # reference applies dropout to the normalized softmax), only the
+        # value accumulation sees the mask
         l_new = l * alpha + p.sum(axis=1)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, mask_ref, bi, hi, qi, kb,
+                              block_q, block_k, dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_new = acc * alpha[:, None] + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
@@ -100,8 +127,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
         lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
+def _mask_specs_args(in_specs, args, seed, test_mask, sq, sk):
+    """Thread the dropout seed (SMEM scalar) or an injected full keep
+    mask into a pallas_call's inputs."""
+    if test_mask is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, sq, sk), lambda b_, h_, i_: (b_, h_, 0, 0)))
+        args.append(test_mask)
+    elif seed is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+
+
 def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
-                    interpret, with_lse=False):
+                    interpret, with_lse=False, dropout_p=0.0, seed=None,
+                    test_mask=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -111,7 +151,8 @@ def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=sk, block_q=block_q,
                                has_bias=bias is not None,
-                               with_lse=with_lse)
+                               with_lse=with_lse, dropout_p=dropout_p,
+                               has_mask_in=test_mask is not None)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda b_, h_, q_: (b_, h_, q_, 0)),
@@ -123,6 +164,8 @@ def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
         in_specs.append(pl.BlockSpec((1, 1, 1, sk),
                                      lambda b_, h_, q_: (b_, 0, 0, 0)))
         args.append(bias)
+    if dropout_p > 0.0:
+        _mask_specs_args(in_specs, args, seed, test_mask, sq, sk)
     out_specs = pl.BlockSpec((1, 1, block_q, d),
                              lambda b_, h_, q_: (b_, h_, q_, 0))
     out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
@@ -150,9 +193,15 @@ def _pallas_forward(q, k, v, bias, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                    dk_ref, dv_ref, *, block_q: int, block_k: int,
-                    causal: bool, scale: float, seq_q: int):
-    ki = pl.program_id(2)
+                    *rest, block_q: int, block_k: int,
+                    causal: bool, scale: float, seq_q: int,
+                    dropout_p: float = 0.0, has_mask_in: bool = False):
+    rest = list(rest)
+    seed_ref = rest.pop(0) if dropout_p > 0.0 and not has_mask_in \
+        else None
+    mask_ref = rest.pop(0) if has_mask_in else None
+    dk_ref, dv_ref = rest
+    bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)          # (block_k, d)
     v = v_ref[0, 0].astype(jnp.float32)
     num_qb = seq_q // block_q
@@ -174,8 +223,17 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])            # (block_q, block_k)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # regenerate the forward's exact mask: same (seed,b,h,qb,kb)
+            keep = _keep_mask(seed_ref, mask_ref, bi, hi, qb, ki,
+                              block_q, block_k, dropout_p)
+            p_drop = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        else:
+            p_drop = p
+        dv = dv + jnp.dot(p_drop.T, do,
+                          preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
@@ -187,9 +245,15 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
-                   dq_ref, *, block_q: int, block_k: int, causal: bool,
-                   scale: float, seq_k: int):
-    qi = pl.program_id(2)
+                   *rest, block_q: int, block_k: int, causal: bool,
+                   scale: float, seq_k: int, dropout_p: float = 0.0,
+                   has_mask_in: bool = False):
+    rest = list(rest)
+    seed_ref = rest.pop(0) if dropout_p > 0.0 and not has_mask_in \
+        else None
+    mask_ref = rest.pop(0) if has_mask_in else None
+    (dq_ref,) = rest
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)          # (block_q, d)
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0, :, 0]
@@ -215,6 +279,10 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, mask_ref, bi, hi, qi, kb,
+                              block_q, block_k, dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta[:, None]) * scale
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -224,7 +292,8 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
 
 
 def _pallas_backward(q, k, v, out, lse, do, causal, scale, block_q,
-                     block_k, interpret):
+                     block_k, interpret, dropout_p=0.0, seed=None,
+                     test_mask=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -233,51 +302,62 @@ def _pallas_backward(q, k, v, out, lse, do, causal, scale, block_q,
                     axis=-1, keepdims=True)      # [B,H,Sq,1]
 
     whole_seq = lambda b_, h_, i: (b_, h_, 0, 0)   # noqa: E731
+    has_mask_in = test_mask is not None
 
+    dkv_specs = [
+        pl.BlockSpec((1, 1, sq, d), whole_seq),
+        pl.BlockSpec((1, 1, sq, d), whole_seq),
+        pl.BlockSpec((1, 1, sq, 1), whole_seq),
+        pl.BlockSpec((1, 1, sq, 1), whole_seq),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+    ]
+    dkv_args = [q, do, lse, delta, k, v]
+    if dropout_p > 0.0:
+        _mask_specs_args(dkv_specs, dkv_args, seed, test_mask, sq, sk)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, causal=causal, scale=scale,
-                          seq_q=sq),
+                          seq_q=sq, dropout_p=dropout_p,
+                          has_mask_in=has_mask_in),
         grid=(b, h, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, sq, d), whole_seq),
-            pl.BlockSpec((1, 1, sq, d), whole_seq),
-            pl.BlockSpec((1, 1, sq, 1), whole_seq),
-            pl.BlockSpec((1, 1, sq, 1), whole_seq),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[pl.BlockSpec((1, 1, block_k, d),
                                 lambda b_, h_, i: (b_, h_, i, 0))] * 2,
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=interpret,
-    )(q, do, lse, delta, k, v)
+    )(*dkv_args)
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, sk, d), whole_seq),
+        pl.BlockSpec((1, 1, sk, d), whole_seq),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, h_, i: (b_, h_, i, 0)),
+    ]
+    dq_args = [k, v, do, lse, delta, q]
+    if dropout_p > 0.0:
+        _mask_specs_args(dq_specs, dq_args, seed, test_mask, sq, sk)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, causal=causal, scale=scale,
-                          seq_k=sk),
+                          seq_k=sk, dropout_p=dropout_p,
+                          has_mask_in=has_mask_in),
         grid=(b, h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, sk, d), whole_seq),
-            pl.BlockSpec((1, 1, sk, d), whole_seq),
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b_, h_, i: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(k, v, do, lse, delta, q)
+    )(*dq_args)
     return dq, dk, dv
 
 
@@ -304,59 +384,108 @@ def _ref_chunked(q, k, v, bias, causal, scale, chunk=512):
     return jnp.concatenate(outs, axis=2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def flash_attention_bhsd(q, k, v, bias=None, causal=False, scale=None,
-                         block_q=512, block_k=512, interpret=False):
+def _blocks_ok(sq, sk, block_q, block_k):
+    return (sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0)
+
+
+def _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
+                        block_k, bias=None):
+    if dropout_p > 0.0:
+        if bias is not None:
+            raise ValueError(
+                "flash attention dropout does not compose with an "
+                "additive bias (the fused backward has no dbias path "
+                "and the fallback backward would silently ignore the "
+                "dropout)")
+        if seed is None and test_mask is None:
+            raise ValueError(
+                "flash attention dropout needs a seed (int32 [1] array) "
+                "or an injected test mask")
+        if not _blocks_ok(sq, sk, block_q, block_k):
+            raise ValueError(
+                "flash attention dropout requires block-divisible "
+                f"sequence lengths, got sq={sq} sk={sk}")
+        n_blk = max(sq // min(block_q, sq), sk // min(block_k, sk))
+        if n_blk > 256:
+            raise ValueError(
+                "flash attention dropout packs block coordinates into "
+                "8 bits each for the PRNG stream; use larger blocks "
+                f"(got {n_blk} blocks on one axis, max 256)")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def flash_attention_bhsd(q, k, v, bias=None, seed=None, test_mask=None,
+                         causal=False, scale=None, block_q=512,
+                         block_k=512, interpret=False, dropout_p=0.0):
     """Flash attention on (B, H, S, D) tensors.
 
     ``bias``: optional additive [B, 1, 1, S_k] tensor (padding masks as
     0/-inf rows), added to the scores before softmax — streamed into the
     Pallas kernel one batch-row at a time, so the [B, H, S, S] score
-    tensor still never materializes."""
+    tensor still never materializes.
+
+    ``dropout_p`` applies dropout to the normalized attention weights
+    INSIDE the kernel: the keep mask is regenerated from the on-chip
+    PRNG seeded with (``seed``, batch, head, q-block, k-block), so no
+    [B, H, S, S] mask tensor exists and forward/backward agree
+    bit-exactly. ``test_mask`` (a full uint8 keep mask) replaces the
+    PRNG for parity tests / interpret mode, where the TPU PRNG
+    primitives don't lower."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
+                        block_k, bias)
     if bias is not None and tuple(bias.shape) != (q.shape[0], 1, 1, sk):
         return _ref_chunked(q, k, v, bias, causal, scale)
     if _blocks_ok(sq, sk, block_q, block_k):
         return _pallas_forward(q, k, v, bias, causal, scale, block_q,
-                               block_k, interpret)
+                               block_k, interpret, dropout_p=dropout_p,
+                               seed=seed, test_mask=test_mask)
     return _ref_chunked(q, k, v, bias, causal, scale)
 
 
-def _blocks_ok(sq, sk, block_q, block_k):
-    return (sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0)
-
-
-def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, bias, seed, test_mask, causal, scale, block_q,
+            block_k, interpret, dropout_p):
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    # custom_vjp skips the primal under differentiation: validate here
+    # too or dropout misuse surfaces as opaque unpack errors / silently
+    # dropout-free gradients
+    _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
+                        block_k, bias)
     if bias is None and _blocks_ok(sq, sk, block_q, block_k):
         # fused path: forward also emits the logsumexp rows the Pallas
         # backward kernels need (FlashAttention-2 recomputation scheme)
         out, lse = _pallas_forward(q, k, v, None, causal, sc, block_q,
-                                   block_k, interpret, with_lse=True)
-        return out, (q, k, v, bias, out, lse)
-    out = flash_attention_bhsd(q, k, v, bias, causal, scale, block_q,
-                               block_k, interpret)
-    return out, (q, k, v, bias, None, None)
+                                   block_k, interpret, with_lse=True,
+                                   dropout_p=dropout_p, seed=seed,
+                                   test_mask=test_mask)
+        return out, (q, k, v, bias, seed, test_mask, out, lse)
+    out = flash_attention_bhsd(q, k, v, bias, seed, test_mask, causal,
+                               scale, block_q, block_k, interpret,
+                               dropout_p)
+    return out, (q, k, v, bias, seed, test_mask, None, None)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, bias, out, lse = res
+def _fa_bwd(causal, scale, block_q, block_k, interpret, dropout_p, res,
+            g):
+    q, k, v, bias, seed, test_mask, out, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if lse is not None:
         dq, dk, dv = _pallas_backward(q, k, v, out, lse, g, causal, s,
-                                      block_q, block_k, interpret)
-        return dq, dk, dv, None
+                                      block_q, block_k, interpret,
+                                      dropout_p=dropout_p, seed=seed,
+                                      test_mask=test_mask)
+        return dq, dk, dv, None, None, None
     if bias is None:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _ref_chunked(q_, k_, v_, None, causal, s),
             q, k, v)
-        return (*vjp(g), None)
+        return (*vjp(g), None, None, None)
     _, vjp = jax.vjp(
         lambda q_, k_, v_, b_: _ref_chunked(q_, k_, v_, b_, causal, s),
         q, k, v, bias)
-    return vjp(g)
+    return (*vjp(g), None, None)
 
 
 flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
@@ -368,12 +497,18 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
     """Single source of truth for Pallas flash-attention dispatch: long
     sequences with MXU-friendly head dims on TPU. Additive [B,1,1,S]
     float masks stream through the kernel (pass mask_shape/mask_dtype to
-    vet them); any other mask, and dropout, go through the XLA softmax
-    composition."""
+    vet them). With dropout > 0 the kernel applies it to the normalized
+    weights via the on-chip PRNG — long sequences only (measured on a
+    v5e at seq 128/BERT-base geometry the fused kernel LOSES to XLA's
+    composition, 112k vs 166k tok/s: tiny per-(batch,head) programs pay
+    more in launch overhead than the mask/RNG traffic they save) and
+    only without a mask (the fused backward has no dbias path)."""
     import jax
-    if not (jax.default_backend() == "tpu" and seq_len >= 1024
-            and head_dim in (64, 128, 256) and dropout == 0.0):
+    if not (jax.default_backend() == "tpu"
+            and head_dim in (64, 128, 256) and seq_len >= 1024):
         return False
+    if dropout > 0.0:
+        return not has_mask and mask_shape is None
     if not has_mask and mask_shape is None:
         return True
     if mask_shape is None:      # mask present but un-vettable
@@ -385,11 +520,14 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    block_q=512, block_k=512, interpret=False):
+                    block_q=512, block_k=512, interpret=False,
+                    dropout_p=0.0, seed=None):
     """Flash attention on paddle-layout (B, S, H, D) tensors."""
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    out = flash_attention_bhsd(qh, kh, vh, bias, causal, scale, block_q,
-                               block_k, interpret)
+    out = flash_attention_bhsd(qh, kh, vh, bias=bias, seed=seed,
+                               causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret, dropout_p=dropout_p)
     return jnp.swapaxes(out, 1, 2)

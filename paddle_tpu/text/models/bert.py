@@ -144,9 +144,16 @@ class BertSelfAttention(Layer):
             qh = mesh_mod.constrain_dim(qh, 2, "tp")
             from ...nn.functional.attention import _sdpa_ref
             from ...ops.flash_attention import flash_attention, flash_eligible
-            if mask is None and drop_p == 0.0 and \
-                    flash_eligible(S, c.head_dim):
-                o = flash_attention(qh, kh, vh, causal=False)
+            if mask is None and flash_eligible(S, c.head_dim,
+                                               dropout=drop_p):
+                seed = None
+                if drop_p > 0.0:
+                    import jax as _jax
+                    seed = _jax.lax.bitcast_convert_type(
+                        _jax.random.key_data(drop_key).reshape(-1)[:1],
+                        jnp.int32)
+                o = flash_attention(qh, kh, vh, causal=False,
+                                    dropout_p=drop_p, seed=seed)
             else:
                 m = None
                 if mask is not None:

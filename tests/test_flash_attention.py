@@ -119,3 +119,44 @@ def test_pallas_backward_kernels_match_autodiff(causal, sq, sk):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-3, atol=3e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_dropout_matches_masked_reference(causal):
+    """In-kernel attention dropout (injected keep mask; the on-chip PRNG
+    path reuses the identical masking math, validated by the bench's
+    TPU-side parity check). Reference: dropout applied to the NORMALIZED
+    softmax weights, inverted scaling — fwd and all three grads."""
+    rng = np.random.RandomState(9)
+    B, H, S, D, p_drop = 2, 2, 128, 64, 0.3
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    g = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    keep = jnp.asarray((rng.rand(B, H, S, S) > p_drop).astype(np.uint8))
+
+    def flash(q, k, v):
+        return flash_attention_bhsd(q, k, v, test_mask=keep,
+                                    causal=causal, block_q=64,
+                                    block_k=64, interpret=True,
+                                    dropout_p=p_drop)
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q / np.sqrt(D), k)
+        if causal:
+            m = np.tril(np.ones((S, S), bool))
+            s = jnp.where(jnp.asarray(m), s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1)
+        probs = probs * keep / (1.0 - p_drop)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(ref(q, k, v)),
+                               rtol=3e-3, atol=3e-3)
+    g1 = jax.grad(lambda *a: (flash(*a) * g).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    g2 = jax.grad(lambda *a: (ref(*a) * g).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)

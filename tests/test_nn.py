@@ -352,7 +352,7 @@ def test_batch_norm_closed_form_grads_match_autodiff():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from paddle_tpu.nn.functional.common import _norm_train, _ln_train
+    from paddle_tpu.nn.functional.common import _norm_train
 
     rng = np.random.RandomState(0)
     v = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32))
@@ -375,24 +375,4 @@ def test_batch_norm_closed_form_grads_match_autodiff():
     o2, vjp2 = jax.vjp(ours, v, w, b)
     np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
     for a, c in zip(vjp1(g), vjp2(g)):
-        np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-4)
-
-    # layer norm: params live on the normalized axes
-    v2 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
-    w2 = jnp.asarray(rng.rand(16).astype(np.float32) + 0.5)
-    b2 = jnp.asarray(rng.randn(16).astype(np.float32))
-
-    def naive_ln(v, w, b):
-        m = jnp.mean(v, axis=-1, keepdims=True)
-        va = jnp.var(v, axis=-1, keepdims=True)
-        return (v - m) * jax.lax.rsqrt(va + 1e-5) * w + b
-
-    def ours_ln(v, w, b):
-        return _ln_train(v, w, b, 1, 1e-5)
-
-    g2 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
-    o1, vjp1 = jax.vjp(naive_ln, v2, w2, b2)
-    o2, vjp2 = jax.vjp(ours_ln, v2, w2, b2)
-    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
-    for a, c in zip(vjp1(g2), vjp2(g2)):
         np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-4)
