@@ -135,3 +135,59 @@ def get_cuda_rng_state():
 def set_cuda_rng_state(state):
     from .framework import random as _r
     _r.set_rng_state(state)
+
+
+# ---------------------------------------------------------------------
+# legacy compat surface (reference python/paddle/__init__.py exports)
+# ---------------------------------------------------------------------
+VarBase = Tensor   # pre-2.0 name for the eager tensor (imperative/层)
+
+
+def in_dygraph_mode() -> bool:
+    """Always True: this framework is eager-first (jit/to_static trace
+    on demand), the reference's dygraph mode."""
+    return True
+
+
+def enable_dygraph(place=None):
+    """No-op: dygraph is the only eager mode here."""
+    return None
+
+
+def disable_dygraph():
+    """No-op with a loud contract: static-graph building collapses into
+    tracing shims (paddle_tpu.static); there is no global mode bit."""
+    return None
+
+
+def monkey_patch_math_varbase():
+    """No-op (reference patches Tensor operators at import; ours are
+    defined directly on the class)."""
+    return None
+
+
+def monkey_patch_variable():
+    """No-op (static Variable shims already carry the tensor surface)."""
+    return None
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Reference fluid.layers.crop_tensor (operators/crop_tensor_op.cc):
+    slice ``shape``-sized region starting at ``offsets`` (defaults 0)."""
+    import numpy as _np
+    v = x._value if isinstance(x, Tensor) else _np.asarray(x)
+    nd = v.ndim
+    if shape is None:
+        shape = list(v.shape)
+    shape = [int(s.numpy()) if isinstance(s, Tensor) else int(s)
+             for s in (shape.numpy() if isinstance(shape, Tensor)
+                       else shape)]
+    offsets = [0] * nd if offsets is None else [
+        int(o.numpy()) if isinstance(o, Tensor) else int(o)
+        for o in (offsets.numpy() if isinstance(offsets, Tensor)
+                  else offsets)]
+    shape = [v.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    import builtins
+    sl = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[sl] if isinstance(x, Tensor) else Tensor(v[sl])

@@ -37,3 +37,6 @@ __all__ = (["Layer", "Parameter", "create_parameter",
             "BeamSearchDecoder", "Decoder", "dynamic_decode", "functional",
             "initializer", "ClipGradByGlobalNorm", "ClipGradByNorm",
             "ClipGradByValue"] + _a + _c + _ct + _cv + _l + _n + _p + _r + _t)
+
+# compat: reference exposes nn.extension as a submodule
+from .functional import extension  # noqa: F401,E402

@@ -1,0 +1,6 @@
+"""Compat alias module (reference python/paddle/nn/functional/extension.py
+exposes diag_embed and friends as a submodule import target)."""
+from .common import diag_embed, gather_tree  # noqa: F401
+from .sequence import sequence_mask  # noqa: F401
+
+__all__ = ["diag_embed", "gather_tree", "sequence_mask"]

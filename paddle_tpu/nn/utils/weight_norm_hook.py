@@ -1,0 +1,5 @@
+"""Compat alias (reference python/paddle/nn/utils/weight_norm_hook.py —
+the module path some user code imports weight_norm from)."""
+from . import remove_weight_norm, weight_norm  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm"]

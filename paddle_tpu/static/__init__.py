@@ -363,6 +363,11 @@ def cpu_places(device_count=None):
     return [CPUPlace() for _ in range(n)]
 
 
+def xpu_places(device_ids=None):
+    """Compat: XPU collapses into the accelerator list (TPU devices)."""
+    return cuda_places(device_ids)
+
+
 def cuda_places(device_ids=None):
     """Reference returns CUDAPlaces; here the accelerator is the TPU."""
     import jax
@@ -675,7 +680,8 @@ from ..framework.core import Tensor as Variable  # noqa: E402
 __all__ += [
     "BuildStrategy", "ExecutionStrategy", "ParallelExecutor", "Scope",
     "Variable", "WeightNormParamAttr", "Print", "accuracy", "auc",
-    "append_backward", "cpu_places", "cuda_places", "create_global_var",
+    "append_backward", "cpu_places", "cuda_places", "xpu_places",
+    "create_global_var",
     "create_parameter", "device_guard", "global_scope", "scope_guard",
     "gradients", "load", "save", "load_program_state", "set_program_state",
     "load_vars", "save_vars", "load_from_file", "save_to_file", "py_func",

@@ -181,3 +181,24 @@ def test_rng_state_serializable_roundtrip(tmp_path):
     paddle.set_cuda_rng_state(np.load(tmp_path / "rng.npy"))
     after = paddle.rand([4]).numpy()
     np.testing.assert_allclose(before, after)
+
+
+def test_round3_legacy_compat_surface():
+    import numpy as np
+    import paddle_tpu as paddle
+    assert paddle.VarBase is paddle.Tensor
+    assert paddle.in_dygraph_mode() is True
+    paddle.enable_dygraph(); paddle.disable_dygraph()
+    paddle.monkey_patch_math_varbase(); paddle.monkey_patch_variable()
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    c = paddle.crop_tensor(x, shape=[1, 2, 2], offsets=[1, 0, 1])
+    np.testing.assert_array_equal(
+        c.numpy(), np.arange(24).reshape(2, 3, 4)[1:2, 0:2, 1:3])
+    import paddle_tpu.nn.functional.extension as ext
+    assert hasattr(ext, "diag_embed")
+    import paddle_tpu.nn.utils.weight_norm_hook as wnh
+    assert hasattr(wnh, "weight_norm")
+    from paddle_tpu import static
+    assert static.xpu_places() == static.cuda_places()
+    import paddle_tpu.nn as nn
+    assert hasattr(nn, "extension")
