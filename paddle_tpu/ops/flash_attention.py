@@ -74,7 +74,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
         (o_ref,) = rest
         lse_ref = None
     bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, d)
+    # dots run in the INPUT dtype (bf16 on the hot path) with f32
+    # accumulation via preferred_element_type — upcasting q/k/v first
+    # halves MXU throughput (measured ~2x on the fwd+bwd microbench)
+    q = q_ref[0, 0]                              # (block_q, d)
 
     m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
     l0 = jnp.zeros((q.shape[0],), jnp.float32)
@@ -91,9 +94,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)]
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if has_bias:
             # additive [B, 1, 1, S_k] bias (padding masks): one row per
             # batch, broadcast over heads and queries
@@ -117,7 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
                               block_q, block_k, dropout_p)
             p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
@@ -202,17 +205,15 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     mask_ref = rest.pop(0) if has_mask_in else None
     dk_ref, dv_ref = rest
     bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)          # (block_k, d)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]                              # (block_k, d)
+    v = v_ref[0, 0]
     num_qb = seq_q // block_q
     qb0 = (ki * block_k) // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.dslice(qb * block_q, block_q)] \
-            .astype(jnp.float32)
-        do = do_ref[0, 0, pl.dslice(qb * block_q, block_q)] \
-            .astype(jnp.float32)
+        q = q_ref[0, 0, pl.dslice(qb * block_q, block_q)]
+        do = do_ref[0, 0, pl.dslice(qb * block_q, block_q)]
         lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q), 0]
         delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q), 0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
@@ -232,9 +233,9 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         else:
             p_drop = p
-        dv = dv + jnp.dot(p_drop.T, do,
+        dv = dv + jnp.dot(p_drop.astype(do.dtype).T, do,
                           preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -254,8 +255,8 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
     mask_ref = rest.pop(0) if has_mask_in else None
     (dq_ref,) = rest
     bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)          # (block_q, d)
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]                              # (block_q, d)
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, 0]
     delta = delta_ref[0, 0, :, 0]
     num_kb = seq_k // block_k
@@ -266,10 +267,8 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
         num_kb_eff = num_kb
 
     def body(kb, dq):
-        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)] \
-            .astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)] \
-            .astype(jnp.float32)
+        k = k_ref[0, 0, pl.dslice(kb * block_k, block_k)]
+        v = v_ref[0, 0, pl.dslice(kb * block_k, block_k)]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -283,11 +282,12 @@ def _bwd_dq_kernel(k_ref, v_ref, do_ref, lse_ref, delta_ref, q_ref,
             keep = _keep_mask(seed_ref, mask_ref, bi, hi, qi, kb,
                               block_q, block_k, dropout_p)
             dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_kb_eff, body,
-                           jnp.zeros_like(q))
+    dq = jax.lax.fori_loop(
+        0, num_kb_eff, body,
+        jnp.zeros((q.shape[0], q.shape[1]), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
