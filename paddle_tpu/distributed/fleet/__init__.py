@@ -40,3 +40,8 @@ from .data_generator import (  # noqa: F401,E402
     DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
 __all__ += ["data_generator", "DataGenerator", "MultiSlotDataGenerator",
             "MultiSlotStringDataGenerator"]
+from . import metrics  # noqa: F401,E402
+from .role_maker import (  # noqa: F401,E402
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker, UtilBase)
+__all__ += ["metrics", "PaddleCloudRoleMaker", "Role",
+            "UserDefinedRoleMaker", "UtilBase"]

@@ -64,6 +64,25 @@ class Fleet:
         self._role_maker: Optional[_RoleMaker] = None
         self._strategy: Optional[DistributedStrategy] = None
         self._ps_runtime = None
+        self._util = None
+        self._util_stamp = None
+
+    @property
+    def util(self):
+        """The fleet UtilBase (reference fleet.util): PS-backed
+        all_reduce/all_gather/barrier + file sharding. Rebuilt whenever
+        the role maker or PS client changes, so an access before
+        fleet.init() cannot pin a stale single-worker world."""
+        from .role_maker import UtilBase
+        client = getattr(self._ps_runtime, "_client", None) \
+            if self._ps_runtime is not None else None
+        stamp = (id(self._role_maker), id(client))
+        if self._util is None or self._util_stamp != stamp:
+            self._util = UtilBase(self._role_maker)
+            if client is not None:
+                self._util._set_ps_client(client)
+            self._util_stamp = stamp
+        return self._util
 
     # -- lifecycle -----------------------------------------------------
     def init(self, role_maker=None, is_collective=False, strategy=None):

@@ -202,15 +202,15 @@ class PSServer:
                             self._ever_registered.add(w)
                     self.monitor.touch(w)
                 if op == "pull":
-                    t = self._tables[msg["table"]]
+                    t = self._table(msg["table"])
                     _send_msg(conn, {"vals": t.pull(msg["ids"])})
                 elif op == "push":
-                    t = self._tables[msg["table"]]
+                    t = self._table(msg["table"])
                     t.push(msg["ids"], msg["grads"])
                     if msg.get("sync"):
                         _send_msg(conn, {"ok": True})
                 elif op == "push_delta":  # geo mode: raw delta add
-                    t = self._tables[msg["table"]]
+                    t = self._table(msg["table"])
                     t.push_delta(msg["ids"], msg["deltas"])
                     if msg.get("sync"):
                         _send_msg(conn, {"ok": True})
@@ -234,6 +234,21 @@ class PSServer:
                     break
         finally:
             conn.close()
+
+    def _table(self, name: str):
+        """Reserved "__util" tables auto-vivify as zero-initialized
+        dim-1 accumulators — the reduction scratch space UtilBase's
+        PS-backed all_reduce/all_gather ride (base/util_factory.py's
+        Gloo worlds collapse onto the PS service here)."""
+        t = self._tables.get(name)
+        if t is None and name.startswith("__util"):
+            from .ps import SparseTable
+            t = self._tables.setdefault(
+                name, SparseTable(1, init_std=0.0, optimizer="sgd",
+                                  lr=0.0))
+        if t is None:
+            raise KeyError(name)
+        return t
 
     def _worker_barrier(self, worker: str, timeout: Optional[float]):
         """Block this connection thread until every live worker arrives.
@@ -432,6 +447,27 @@ class PSClient:
             self._q.put((table, ids, grads))
             return
         self._push_now(table, ids, grads, sync=True)
+
+    def push_delta(self, table: str, ids, deltas, sync: bool = True):
+        """Raw additive push (server-side push_delta), sharded like
+        pull — the primitive UtilBase's collectives build on."""
+        ids = np.asarray(ids).reshape(-1)
+        deltas = np.asarray(deltas, np.float32)
+        deltas = deltas.reshape(len(ids), -1) if ids.size \
+            else deltas.reshape(0, 1)
+        if len(self._socks) == 1 or ids.size == 0:
+            self._rpc(0, {"op": "push_delta", "table": table,
+                          "ids": ids, "deltas": deltas, "sync": sync},
+                      reply=sync)
+            return
+        shard = self._shard(ids)
+        for r in range(len(self._socks)):
+            m = shard == r
+            if not m.any():
+                continue
+            self._rpc(r, {"op": "push_delta", "table": table,
+                          "ids": ids[m], "deltas": deltas[m],
+                          "sync": sync}, reply=sync)
 
     def flush_deltas(self):
         """Send accumulated geo deltas to the servers (push_delta adds

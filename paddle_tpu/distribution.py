@@ -216,3 +216,67 @@ class Categorical(Distribution):
 def kl_divergence(p: Distribution, q: Distribution):
     """paddle.distribution.kl_divergence dispatch."""
     return p.kl_divergence(q)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference
+    fluid/layers/distributions.py:531 — loc [..., D] mean and scale
+    [..., D] diagonal standard deviations)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = _sample_key(seed)
+        shape = tuple(shape)
+
+        def fn(m, s):
+            eps = jax.random.normal(key, shape + m.shape, m.dtype)
+            return m + s * eps
+        return _apply(fn, self.loc, self.scale, op_name="mvn_sample")
+
+    def log_prob(self, value):
+        def fn(m, s, v):
+            z = (v - m) / s
+            return (-0.5 * (z * z).sum(-1)
+                    - jnp.log(s).sum(-1)
+                    - 0.5 * m.shape[-1] * jnp.log(2 * jnp.pi))
+        return _apply(fn, self.loc, self.scale, _t(value),
+                      op_name="mvn_log_prob")
+
+    def entropy(self):
+        def fn(m, s):
+            d = m.shape[-1]
+            return 0.5 * d * (1.0 + jnp.log(2 * jnp.pi)) \
+                + jnp.log(s).sum(-1)
+        return _apply(fn, self.loc, self.scale, op_name="mvn_entropy")
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        def fn(m0, s0, m1, s1):
+            v0, v1 = s0 * s0, s1 * s1
+            return 0.5 * ((v0 / v1).sum(-1)
+                          + (((m1 - m0) ** 2) / v1).sum(-1)
+                          - m0.shape[-1]
+                          + jnp.log(v1).sum(-1) - jnp.log(v0).sum(-1))
+        return _apply(fn, self.loc, self.scale, other.loc, other.scale,
+                      op_name="mvn_kl")
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """Sample one index per row from row-probability matrix ``x``
+    (reference fluid/layers/nn.py:10673, operators/sampling_id_op.cc:
+    u ~ U(min, max) compared directly against the row cumsum, result
+    clamped to the last index)."""
+    key = _sample_key(seed)
+
+    def fn(p):
+        c = jnp.cumsum(p, axis=-1)
+        u = jax.random.uniform(key, p.shape[:-1] + (1,), p.dtype,
+                               minval=min, maxval=max)
+        idx = (u > c).sum(-1)
+        return jnp.clip(idx, 0, p.shape[-1] - 1).astype(dtype)
+    return _apply(fn, _t(x), op_name="sampling_id")
+
+
+__all__ += ["MultivariateNormalDiag", "sampling_id"]

@@ -659,3 +659,5 @@ class TracedLayer:
         specs = [InputSpec(list(t.shape), str(t.dtype).rsplit(".", 1)[-1])
                  for t in self._inputs]
         save(self._fn, path, input_spec=specs)
+
+from . import dy2static  # noqa: F401,E402  (submodule surface)

@@ -175,3 +175,5 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+from . import metrics  # noqa: F401,E402  (submodule compat)

@@ -1,0 +1,5 @@
+"""Compat submodule (the reference implements paddle.metric's classes in
+python/paddle/metric/metrics.py and re-exports them at package level)."""
+from . import Accuracy, Auc, Metric, Precision, Recall, accuracy  # noqa: F401
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]

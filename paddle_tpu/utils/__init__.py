@@ -172,3 +172,28 @@ def get_profiler(options=None):
 def load_op_library(lib_filename):
     from ..incubate import load_op_library as _l
     return _l(lib_filename)
+
+
+class OpLastCheckpointChecker:
+    """Compat shim (reference utils/op_version.py:50): queries op version
+    checkpoints out of the C++ registry. Ops here have no versioned
+    ProgramDesc attributes — every query reports the default."""
+
+    def __init__(self):
+        self.raw_version_map = {}
+
+    def check_modify_attr(self, op_name, attr_name, default):
+        return default
+
+    def check_new_attr(self, op_name, attr_name, default):
+        return default
+
+
+def dump_config(config, path=None):
+    """Compat: serialize a config-like object to readable text."""
+    txt = "\n".join(f"{k}={v}" for k, v in sorted(
+        (config if isinstance(config, dict) else vars(config)).items()))
+    if path:
+        with open(path, "w") as f:
+            f.write(txt + "\n")
+    return txt

@@ -1,0 +1,106 @@
+"""Role makers, UtilBase collectives over the PS service, and
+fleet.metrics aggregation (reference base/role_maker.py,
+base/util_factory.py, metrics/metric.py)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import metrics
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSClient, PSServer
+from paddle_tpu.distributed.fleet.role_maker import (
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker, UtilBase)
+
+
+def test_paddle_cloud_role_maker_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "a:1,b:2,c:3,d:4")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    assert rm.get_trainer_endpoints() == ["a:1", "b:2", "c:3", "d:4"]
+    assert not rm.is_first_worker()
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_PORT", "6200")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.1:6200,10.0.0.2:6200")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_server() and rm.server_index() == 1
+    monkeypatch.setenv("TRAINING_ROLE", "NONSENSE")
+    with pytest.raises(ValueError, match="TRAINING_ROLE"):
+        PaddleCloudRoleMaker()
+
+
+def test_user_defined_role_maker_and_file_shard():
+    rm = UserDefinedRoleMaker(current_id=1, role=Role.WORKER,
+                              worker_num=3)
+    u = UtilBase(rm)
+    files = [f"f{i}" for i in range(8)]   # 8 over 3 -> 3,3,2
+    assert u.get_file_shard(files) == ["f3", "f4", "f5"]
+    u0 = UtilBase(UserDefinedRoleMaker(current_id=0, worker_num=3))
+    assert u0.get_file_shard(files) == ["f0", "f1", "f2"]
+    u2 = UtilBase(UserDefinedRoleMaker(current_id=2, worker_num=3))
+    assert u2.get_file_shard(files) == ["f6", "f7"]
+
+
+def test_util_collectives_over_ps_two_workers():
+    tables = {"emb": SparseTable(4)}
+    srv = PSServer(tables, host="127.0.0.1", heartbeat_timeout=5.0)
+    srv.start()
+    eps = [f"127.0.0.1:{srv.port}"]
+    results = {}
+
+    def worker(rank):
+        cli = PSClient(eps, mode="sync", worker_id=f"w{rank}")
+        u = UtilBase(UserDefinedRoleMaker(current_id=rank, worker_num=2))
+        u._set_ps_client(cli)
+        x = np.asarray([1.0 + rank, 10.0 * (rank + 1)], np.float32)
+        results[f"sum{rank}"] = u.all_reduce(x, mode="sum")
+        results[f"max{rank}"] = u.all_reduce(x, mode="max")
+        results[f"gather{rank}"] = u.all_gather(x)
+        # metrics ride the same util
+        results[f"acc{rank}"] = metrics.acc(
+            np.asarray([2.0 + rank]), np.asarray([4.0]), util=u)
+        cli.leave()
+        cli.close()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    try:
+        np.testing.assert_allclose(results["sum0"], [3.0, 30.0])
+        np.testing.assert_allclose(results["sum1"], [3.0, 30.0])
+        np.testing.assert_allclose(results["max0"], [2.0, 20.0])
+        g = sorted(np.asarray(v).tolist() for v in results["gather0"])
+        assert g == [[1.0, 10.0], [2.0, 20.0]]
+        # correct = 2 + 3 = 5 over total = 8
+        assert abs(results["acc0"] - 5.0 / 8.0) < 1e-6
+        assert abs(results["acc1"] - 5.0 / 8.0) < 1e-6
+    finally:
+        srv.stop()
+
+
+def test_metrics_single_process_identity():
+    u = UtilBase()
+    np.testing.assert_allclose(
+        metrics.sum(np.asarray([1.0, 2.0]), util=u), [1.0, 2.0])
+    assert metrics.mae(np.asarray([3.0]), np.asarray([6.0]),
+                       util=u) == 0.5
+    assert metrics.rmse(np.asarray([8.0]), np.asarray([2.0]),
+                        util=u) == 2.0
+    # auc from bucket stats: perfect separation -> 1.0
+    pos = np.zeros(10); pos[9] = 5
+    neg = np.zeros(10); neg[0] = 5
+    assert metrics.auc(pos, neg, util=u) == 1.0
+    # chance: same buckets -> 0.5
+    pos2 = np.zeros(10); pos2[4] = 5
+    neg2 = np.zeros(10); neg2[4] = 5
+    assert abs(metrics.auc(pos2, neg2, util=u) - 0.5) < 1e-6
