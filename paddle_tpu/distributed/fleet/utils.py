@@ -77,3 +77,33 @@ class LocalSGDStepper:
             p._value = jax.device_put(
                 v, mesh_mod.named_sharding(
                     PartitionSpec(*([None] * v.ndim)), mesh))
+
+
+# -- reference fleet.utils surface re-exports --------------------------
+from .fs import HDFSClient, LocalFS  # noqa: F401,E402
+
+
+class DistributedInfer:
+    """PS inference helper (reference fleet/utils/ps_util.py:28): pulls
+    the sparse rows a batch needs from the live tables so workers can
+    run inference against the latest server state."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._tables = None
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        from .fleet_base import _fleet
+        rt = _fleet._ps_runtime
+        self._tables = getattr(rt, "_tables", None) if rt else None
+
+    def get_dist_infer_program(self):
+        return None   # programs collapse into traced callables here
+
+    def pull(self, table: str, ids):
+        if not self._tables or table not in self._tables:
+            raise RuntimeError(
+                "DistributedInfer: call init_distributed_infer_env "
+                "under a live fleet PS runtime first")
+        import numpy as np
+        return self._tables[table].pull(np.asarray(ids, np.int64))

@@ -6,3 +6,5 @@ from .model import Model  # noqa: F401
 from .model_summary import summary  # noqa: F401
 
 __all__ = ["Model", "summary", "flops", "callbacks", "Callback"]
+
+from . import logger  # noqa: F401,E402

@@ -1,0 +1,22 @@
+"""hapi logging setup (reference python/paddle/hapi/logger.py)."""
+import logging
+import sys
+
+__all__ = ["setup_logger"]
+
+
+def setup_logger(output=None, name="paddle_tpu", log_level=logging.INFO):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    logger.propagate = False
+    if not logger.handlers:
+        h = logging.StreamHandler(stream=sys.stdout)
+        h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+        logger.addHandler(h)
+    if output is not None:
+        fn = output if output.endswith((".txt", ".log")) \
+            else output + "/log.txt"
+        fh = logging.FileHandler(fn)
+        fh.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+        logger.addHandler(fh)
+    return logger

@@ -1,0 +1,22 @@
+"""paddle_tpu.nn.layer — layer submodule package (reference
+python/paddle/nn/layer/__init__.py re-exports every layer class here as
+well as at the nn top level)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .container import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .layers import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .moe import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403
+
+from . import (activation, common, container, conv, layers,  # noqa: F401
+               loss, moe, norm, pooling, rnn, transformer)
+
+# reference keeps PairwiseDistance in nn/layer/distance.py
+import sys as _sys
+from . import common as distance  # noqa: F401,E402
+_sys.modules[__name__ + ".distance"] = distance

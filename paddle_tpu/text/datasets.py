@@ -373,3 +373,11 @@ class FakeTextDataset(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+# -- submodule-path compat (reference has one module per dataset) ------
+import sys as _sys
+for _n in ("conll05", "imdb", "imikolov", "movielens", "uci_housing",
+           "wmt14", "wmt16"):
+    globals()[_n] = _sys.modules[__name__]
+    _sys.modules[f"{__name__}.{_n}"] = _sys.modules[__name__]

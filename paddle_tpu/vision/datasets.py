@@ -320,3 +320,10 @@ class VOC2012(Dataset):
         if self.transform:
             img = self.transform(img)
         return img, boxes, labels, difficult
+
+
+# -- submodule-path compat (reference has one module per dataset) ------
+import sys as _sys
+for _n in ("cifar", "flowers", "folder", "mnist", "voc2012"):
+    globals()[_n] = _sys.modules[__name__]
+    _sys.modules[f"{__name__}.{_n}"] = _sys.modules[__name__]

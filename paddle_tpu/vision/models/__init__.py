@@ -12,3 +12,12 @@ __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
            "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
            "VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"]
+
+
+# -- submodule-path compat (reference one-module-per-family) -----------
+import sys as _sys
+from . import lenet, mobilenet, resnet, vgg, vit  # noqa: F401
+mobilenetv1 = mobilenet
+mobilenetv2 = mobilenet
+_sys.modules[__name__ + ".mobilenetv1"] = mobilenet
+_sys.modules[__name__ + ".mobilenetv2"] = mobilenet

@@ -537,3 +537,12 @@ class RandomRotation(BaseTransform):
 __all__ += ["adjust_brightness", "adjust_contrast", "adjust_saturation",
             "adjust_hue", "rotate", "ColorJitter", "ContrastTransform",
             "SaturationTransform", "HueTransform", "RandomRotation"]
+
+
+# -- submodule-path compat (reference splits this surface over
+#    vision/transforms/{transforms,functional}.py) ---------------------
+import sys as _sys
+functional = _sys.modules[__name__]
+transforms = _sys.modules[__name__]
+_sys.modules[__name__ + ".functional"] = functional
+_sys.modules[__name__ + ".transforms"] = transforms

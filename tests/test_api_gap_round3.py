@@ -155,7 +155,15 @@ def test_subpackage_surface_sweep_clean():
             ("optimizer", "paddle_tpu.optimizer"),
             ("amp", "paddle_tpu.amp"),
             ("regularizer", "paddle_tpu.regularizer"),
-            ("distributed/fleet", "paddle_tpu.distributed.fleet")]:
+            ("distributed/fleet", "paddle_tpu.distributed.fleet"),
+            ("hapi", "paddle_tpu.hapi"),
+            ("vision/models", "paddle_tpu.vision.models"),
+            ("vision/transforms", "paddle_tpu.vision.transforms"),
+            ("vision/datasets", "paddle_tpu.vision.datasets"),
+            ("text/datasets", "paddle_tpu.text.datasets"),
+            ("nn/layer", "paddle_tpu.nn.layer"),
+            ("distributed/fleet/utils",
+             "paddle_tpu.distributed.fleet.utils")]:
         names = (ref_imports(f"{refroot}/{sub}/__init__.py")
                  | ref_imports(f"{refroot}/{sub}.py")) - ignore
         mod = importlib.import_module(modname)
