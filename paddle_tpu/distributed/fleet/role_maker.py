@@ -185,8 +185,10 @@ class UtilBase:
         self._ps_client.push_delta("__util_ar__", ids, flat[:, None])
         self._ps_client.worker_barrier()
         out = self._ps_client.pull("__util_ar__", ids)[:, 0]
-        # return the slot to zero so its reuse _AR_SLOTS rounds later
-        # (every intervening round has a barrier, so this lands first)
+        # second barrier: nobody may zero the slot while a peer is
+        # still pulling it; then return the rows to zero so the slot's
+        # reuse _AR_SLOTS rounds later starts clean
+        self._ps_client.worker_barrier()
         self._ps_client.push_delta("__util_ar__", ids, -flat[:, None])
         return out.reshape(arr.shape)
 
@@ -207,6 +209,8 @@ class UtilBase:
             ids = (base + r * arr.size
                    + np.arange(arr.size)).astype(np.int64)
             out.append(self._ps_client.pull("__util_ar__", ids)[:, 0])
+        # see all_reduce: peers must finish pulling before the cleanup
+        self._ps_client.worker_barrier()
         self._ps_client.push_delta("__util_ar__", my_ids, -arr[:, None])
         return out
 

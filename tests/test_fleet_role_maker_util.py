@@ -51,7 +51,10 @@ def test_user_defined_role_maker_and_file_shard():
 
 def test_util_collectives_over_ps_two_workers():
     tables = {"emb": SparseTable(4)}
-    srv = PSServer(tables, host="127.0.0.1", heartbeat_timeout=5.0)
+    # expected_workers guards launch skew: the first barrier must not
+    # complete before both workers have ever registered
+    srv = PSServer(tables, host="127.0.0.1", heartbeat_timeout=5.0,
+                   expected_workers=2)
     srv.start()
     eps = [f"127.0.0.1:{srv.port}"]
     results = {}
