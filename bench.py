@@ -17,8 +17,9 @@ Prints exactly ONE JSON line.  Primary metric fields at top level
 (driver contract); the second metric rides in ``"extra_metrics"``.
 
 Env knobs: BENCH_SMOKE=1 (tiny shapes on CPU), BENCH_BATCH, BENCH_STEPS,
-BENCH_AMP=0/1, BENCH_PEAK_TFLOPS (plausibility bound, default 460 —
-above any plausible single chip's bf16 peak), BENCH_METRICS=resnet,bert.
+BENCH_AMP=0/1, BENCH_PEAK_TFLOPS (plausibility bound override; by
+default detected from the chip's device_kind, e.g. 197 for a v5e),
+BENCH_METRICS=resnet,bert.
 """
 from __future__ import annotations
 
@@ -26,9 +27,37 @@ import json
 import os
 import time
 
-# bf16 peak of the fastest plausible single chip this could run on
-# (v5p ~459 TFLOP/s); sustained throughput above this is impossible.
+# Nominal per-chip bf16 peaks by device kind.  The plausibility bound
+# must be the peak of the chip the bench ACTUALLY ran on — a generic
+# upper bound (e.g. v5p's 459) would accept numbers 2.3x beyond what a
+# v5e can physically do, defeating the anti-fake gate.
+CHIP_PEAK_TFLOPS = {
+    "v2": 46.0, "v3": 123.0, "v4": 275.0,
+    "v5 lite": 197.0, "v5litepod": 197.0, "v5e": 197.0,
+    "v5": 459.0, "v5p": 459.0,
+    "v6 lite": 918.0, "v6e": 918.0,
+}
+# fallback when the chip kind is unrecognized (fastest plausible chip)
 DEFAULT_PEAK_TFLOPS = 460.0
+
+
+def _detect_peak_tflops():
+    """Per-chip bf16 peak for the device the bench runs on.
+
+    BENCH_PEAK_TFLOPS overrides; otherwise the bound comes from
+    ``jax.devices()[0].device_kind`` so the plausibility gate is tight
+    for the real hardware (a v5e claiming 300 TFLOP/s must be flagged).
+    """
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env), "env"
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in sorted(CHIP_PEAK_TFLOPS.items(),
+                            key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return peak, kind
+    return DEFAULT_PEAK_TFLOPS, f"unknown:{kind}"
 
 
 def _measure(step, args, steps, items_per_step, metric, unit,
@@ -77,6 +106,8 @@ def _measure(step, args, steps, items_per_step, metric, unit,
         "flops_source": src,
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "peak_tflops_bound": peak_tflops,
+        "mfu_nominal": (round(achieved / peak_tflops, 4)
+                        if achieved else None),
         "plausible": plausible,
         "suspect_reason": reason,
         "steps": steps,
@@ -395,7 +426,7 @@ def main():
     if smoke:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", DEFAULT_PEAK_TFLOPS))
+    peak, peak_src = _detect_peak_tflops()
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS",
                             "resnet,bert,llama,wide_deep").split(",")]
@@ -414,9 +445,9 @@ def main():
     if not results:  # unknown names: still honor the one-JSON-line contract
         results.append(_bench_resnet(smoke, peak))
 
-    primary = results[0]
+    primary = dict(results[0])
+    primary["peak_tflops_source"] = peak_src
     if len(results) > 1:
-        primary = dict(primary)
         primary["extra_metrics"] = results[1:]
     print(json.dumps(primary))
 

@@ -164,6 +164,17 @@ class UtilBase:
         self._ps_client = client
 
     # -- collectives ----------------------------------------------------
+    def _check_stride(self, id_footprint: int):
+        """A round's ids must fit its slot's id block: spilling into the
+        next slot would silently corrupt a reduction _AR_SLOTS rounds
+        away (cleanup for this round would also zero a live slot)."""
+        if id_footprint > self._AR_STRIDE:
+            raise ValueError(
+                f"UtilBase collective needs {id_footprint} ids but the "
+                f"per-round id block is {self._AR_STRIDE}; reduce the "
+                "array (elements x worker_num for all_gather) or raise "
+                "UtilBase._AR_STRIDE")
+
     def all_reduce(self, input, mode: str = "sum",
                    comm_world: str = "worker"):
         arr = np.asarray(input, np.float32)
@@ -179,6 +190,7 @@ class UtilBase:
             raise ValueError(f"all_reduce mode must be sum|max|min, "
                              f"got {mode!r}")
         flat = arr.reshape(-1)
+        self._check_stride(flat.size)
         self._round += 1
         base = (self._round % self._AR_SLOTS) * self._AR_STRIDE
         ids = (base + np.arange(flat.size)).astype(np.int64)
@@ -198,6 +210,7 @@ class UtilBase:
         arr = np.asarray(input, np.float32).reshape(-1)
         rank = max(self._role_maker.worker_index(), 0)
         n = max(self._role_maker.worker_num(), 1)
+        self._check_stride(n * arr.size)
         self._round += 1
         base = (self._round % self._AR_SLOTS) * self._AR_STRIDE
         my_ids = (base + rank * arr.size

@@ -59,7 +59,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 qv.shape[1], qv.shape[3],
                 has_mask=mv is not None, dropout=drop,
                 mask_shape=None if mv is None else tuple(mv.shape),
-                mask_dtype=None if mv is None else mv.dtype)
+                mask_dtype=None if mv is None else mv.dtype,
+                kv_seq_len=key._value.shape[1])
     except Exception:
         use_flash = False
 
@@ -67,6 +68,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...ops.flash_attention import flash_attention as _fa
 
         if attn_mask is None:
+            if drop > 0.0:
+                # flash_eligible only admits dropout>0 mask-free, where
+                # the kernel applies it via the on-chip PRNG — seed
+                # minted per call from the framework RNG chain so it
+                # advances like the XLA path's key.  The seed rides as
+                # an OPERAND (keyed by aval in the eager vjp cache, so
+                # repeat steps stay cached) rather than a closure cell
+                # (unhashable -> full Pallas re-trace every call).
+                from ...ops.flash_attention import dropout_seed
+                seed = dropout_seed(split_key())
+
+                def f(q, k, v, s):
+                    return _fa(q, k, v, causal=is_causal, scale=scale,
+                               dropout_p=drop, seed=s)
+                return _apply(f, query, key, value, seed,
+                              op_name="flash_attention")
+
             def f(q, k, v):
                 return _fa(q, k, v, causal=is_causal, scale=scale)
             return _apply(f, query, key, value,

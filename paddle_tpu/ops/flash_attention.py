@@ -388,6 +388,26 @@ def _blocks_ok(sq, sk, block_q, block_k):
     return (sq % min(block_q, sq) == 0 and sk % min(block_k, sk) == 0)
 
 
+def _dropout_blocks_ok(sq, sk, block_q, block_k):
+    """Shapes the kernel's dropout path can take: block-divisible seqs
+    and <=256 blocks per side (the PRNG packs block coordinates into 8
+    bits).  ONE predicate shared by flash_eligible (dispatch) and
+    _check_dropout_args (kernel entry) so they cannot drift — dispatch
+    saying yes while the kernel raises was advisor finding r4."""
+    if not _blocks_ok(sq, sk, block_q, block_k):
+        return False
+    return max(sq // min(block_q, sq), sk // min(block_k, sk)) <= 256
+
+
+def dropout_seed(key):
+    """Kernel seed-format contract: first word of ``jax.random.key_data``
+    bitcast to an int32 ``[1]`` array — the one definition every
+    dropout-capable call site (sdpa dispatch, bert attention) shares."""
+    import jax
+    return jax.lax.bitcast_convert_type(
+        jax.random.key_data(key).reshape(-1)[:1], jnp.int32)
+
+
 def _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
                         block_k, bias=None):
     if dropout_p > 0.0:
@@ -401,16 +421,12 @@ def _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
             raise ValueError(
                 "flash attention dropout needs a seed (int32 [1] array) "
                 "or an injected test mask")
-        if not _blocks_ok(sq, sk, block_q, block_k):
+        if not _dropout_blocks_ok(sq, sk, block_q, block_k):
             raise ValueError(
                 "flash attention dropout requires block-divisible "
-                f"sequence lengths, got sq={sq} sk={sk}")
-        n_blk = max(sq // min(block_q, sq), sk // min(block_k, sk))
-        if n_blk > 256:
-            raise ValueError(
-                "flash attention dropout packs block coordinates into "
-                "8 bits each for the PRNG stream; use larger blocks "
-                f"(got {n_blk} blocks on one axis, max 256)")
+                "sequence lengths with <=256 blocks per side (PRNG "
+                f"packs block coords into 8 bits), got sq={sq} sk={sk} "
+                f"blocks=({block_q},{block_k})")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
@@ -493,7 +509,7 @@ flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
 
 def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
                    dropout: float = 0.0, mask_shape=None,
-                   mask_dtype=None) -> bool:
+                   mask_dtype=None, kv_seq_len=None) -> bool:
     """Single source of truth for Pallas flash-attention dispatch: long
     sequences with MXU-friendly head dims on TPU. Additive [B,1,1,S]
     float masks stream through the kernel (pass mask_shape/mask_dtype to
@@ -508,7 +524,14 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
             and head_dim in (64, 128, 256) and seq_len >= 1024):
         return False
     if dropout > 0.0:
-        return not has_mask and mask_shape is None
+        if has_mask or mask_shape is not None:
+            return False
+        # dropout runs ONLY in the fused kernel (the chunked reference
+        # fallback has no dropout path), so the kernel's block
+        # constraints gate dispatch here — shapes the kernel would
+        # reject must fall back to the XLA composition, not raise
+        sk = kv_seq_len if kv_seq_len is not None else seq_len
+        return _dropout_blocks_ok(seq_len, sk, 512, 512)
     if not has_mask and mask_shape is None:
         return True
     if mask_shape is None:      # mask present but un-vettable

@@ -148,10 +148,8 @@ class BertSelfAttention(Layer):
                                                dropout=drop_p):
                 seed = None
                 if drop_p > 0.0:
-                    import jax as _jax
-                    seed = _jax.lax.bitcast_convert_type(
-                        _jax.random.key_data(drop_key).reshape(-1)[:1],
-                        jnp.int32)
+                    from ...ops.flash_attention import dropout_seed
+                    seed = dropout_seed(drop_key)
                 o = flash_attention(qh, kh, vh, causal=False,
                                     dropout_p=drop_p, seed=seed)
             else:

@@ -19,6 +19,7 @@ match the reference.
 """
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Sequence
 
 import jax
@@ -233,8 +234,12 @@ def _decode_center_size(anchors, var, deltas):
                       deltas[:, 2] * var[:, 2], deltas[:, 3] * var[:, 3])
     cx = dx * aw + acx
     cy = dy * ah + acy
-    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
-    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    # clip at log(1000/16) like the reference's kBBoxClipDefault
+    # (detection/bbox_util.h) — saturated deltas must not blow boxes up
+    # hundreds of times beyond what the trainer ever produced
+    clip = math.log(1000.0 / 16.0)
+    w = jnp.exp(jnp.minimum(dw, clip)) * aw
+    h = jnp.exp(jnp.minimum(dh, clip)) * ah
     return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
                      axis=-1)
 

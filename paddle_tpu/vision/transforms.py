@@ -16,6 +16,13 @@ __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "crop", "center_crop", "pad"]
 
 
+def _jitter_factor(value):
+    """Random color-jitter factor in [max(0, 1-value), 1+value] — the
+    reference transform range; an unclamped 1+-value draw could go
+    negative for value>1 and invert the image."""
+    return random.uniform(max(0.0, 1 - value), 1 + value)
+
+
 def _chw(img):
     """HWC uint8/float -> CHW float32 [0,1]."""
     arr = np.asarray(img)
@@ -217,8 +224,8 @@ class BrightnessTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        factor = 1 + random.uniform(-self.value, self.value)
-        arr = np.asarray(img).astype(np.float32) * factor
+        arr = (np.asarray(img).astype(np.float32)
+               * _jitter_factor(self.value))
         return np.clip(arr, 0, 255 if np.asarray(img).dtype == np.uint8 else None)
 
 
@@ -446,8 +453,7 @@ class ContrastTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        return adjust_contrast(img,
-                               1 + random.uniform(-self.value, self.value))
+        return adjust_contrast(img, _jitter_factor(self.value))
 
 
 class SaturationTransform(BaseTransform):
@@ -457,8 +463,7 @@ class SaturationTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        return adjust_saturation(
-            img, 1 + random.uniform(-self.value, self.value))
+        return adjust_saturation(img, _jitter_factor(self.value))
 
 
 class HueTransform(BaseTransform):
@@ -492,15 +497,15 @@ class ColorJitter(BaseTransform):
         if self.brightness:
             b = self.brightness
             ops.append(lambda im: adjust_brightness(
-                im, random.uniform(max(0, 1 - b), 1 + b)))
+                im, _jitter_factor(b)))
         if self.contrast:
             c = self.contrast
             ops.append(lambda im: adjust_contrast(
-                im, random.uniform(max(0, 1 - c), 1 + c)))
+                im, _jitter_factor(c)))
         if self.saturation:
             s = self.saturation
             ops.append(lambda im: adjust_saturation(
-                im, random.uniform(max(0, 1 - s), 1 + s)))
+                im, _jitter_factor(s)))
         if self.hue:
             hmag = self.hue
             ops.append(lambda im: adjust_hue(

@@ -1,0 +1,136 @@
+"""Round-4 advisor-finding regression tests.
+
+1. scaled_dot_product_attention must NOT drop dropout on the flash
+   path (attention.py flash branch now threads dropout_p + a PRNG seed
+   into the kernel).
+2. box decode clamps dw/dh at log(1000/16) like the reference's
+   kBBoxClipDefault (detection/bbox_util.h), not 10.0.
+3. Brightness/Contrast/Saturation transforms sample factors from
+   [max(0, 1-v), 1+v] — never negative.
+4. UtilBase collectives raise when a round's id footprint exceeds the
+   per-slot id block instead of silently corrupting a later slot.
+"""
+import math
+
+import numpy as np
+import pytest
+
+
+def test_sdpa_flash_branch_threads_dropout(monkeypatch):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import importlib
+    fa_mod = importlib.import_module("paddle_tpu.ops.flash_attention")
+
+    captured = {}
+
+    def fake_eligible(seq, hd, **kw):
+        return True
+
+    def fake_flash(q, k, v, bias=None, causal=False, scale=None,
+                   dropout_p=0.0, seed=None, **kw):
+        captured["dropout_p"] = dropout_p
+        captured["seed"] = seed
+        return q
+
+    monkeypatch.setattr(fa_mod, "flash_eligible", fake_eligible)
+    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
+
+    q = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 16, 4, 8).astype("float32"))
+    F.scaled_dot_product_attention(q, q, q, dropout_p=0.3, training=True)
+    assert captured["dropout_p"] == pytest.approx(0.3), \
+        "flash path silently dropped attention dropout"
+    assert captured["seed"] is not None, \
+        "flash dropout needs a PRNG seed minted from the RNG chain"
+
+    # eval mode: dropout off, no seed minted
+    captured.clear()
+    F.scaled_dot_product_attention(q, q, q, dropout_p=0.3, training=False)
+    assert captured["dropout_p"] == 0.0 and captured["seed"] is None
+
+
+def test_flash_eligible_gates_dropout_block_constraints(monkeypatch):
+    """Dropout runs only in the fused kernel, so flash_eligible (the
+    dispatch source of truth) must reject shapes the kernel's dropout
+    path cannot take — previously those raised downstream instead of
+    falling back to the XLA composition."""
+    import importlib
+
+    import jax
+
+    fa_mod = importlib.import_module("paddle_tpu.ops.flash_attention")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    assert fa_mod.flash_eligible(2048, 64, dropout=0.1)
+    # 1280 >= 1024 but not 512-divisible: kernel dropout would raise
+    assert not fa_mod.flash_eligible(1280, 64, dropout=0.1)
+    # kv side must satisfy the same constraint
+    assert not fa_mod.flash_eligible(2048, 64, dropout=0.1,
+                                     kv_seq_len=1280)
+    # dropout-free non-divisible is fine (falls back to chunked ref)
+    assert fa_mod.flash_eligible(1280, 64)
+    # >256 k-blocks: PRNG coordinate packing limit
+    assert not fa_mod.flash_eligible(512 * 300, 64, dropout=0.1)
+    assert fa_mod.flash_eligible(512 * 300, 64)
+
+
+def test_box_decode_clip_matches_reference():
+    import jax.numpy as jnp
+
+    from paddle_tpu.vision.detection import _decode_center_size
+
+    anchors = jnp.asarray([[0.0, 0.0, 16.0, 16.0]])
+    var = jnp.ones((1, 4))
+    # saturated regression delta: decoded width must clamp at
+    # exp(log(1000/16)) * aw = 1000, not exp(10) * 16 ~ 352k
+    deltas = jnp.asarray([[0.0, 0.0, 50.0, 50.0]])
+    out = np.asarray(_decode_center_size(anchors, var, deltas))
+    w = out[0, 2] - out[0, 0]
+    assert w == pytest.approx(16.0 * math.exp(math.log(1000.0 / 16.0)),
+                              rel=1e-5)
+    assert w == pytest.approx(1000.0, rel=1e-5)
+
+
+@pytest.mark.parametrize("cls_name", ["BrightnessTransform",
+                                      "ContrastTransform",
+                                      "SaturationTransform"])
+def test_color_transform_factor_never_negative(monkeypatch, cls_name):
+    import random as pyrandom
+
+    from paddle_tpu.vision import transforms as T
+
+    lows = []
+    real_uniform = pyrandom.uniform
+
+    def spy_uniform(a, b):
+        lows.append(a)
+        return real_uniform(a, b)
+
+    monkeypatch.setattr(T.random, "uniform", spy_uniform)
+    img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
+    t = getattr(T, cls_name)(3.0)     # value > 1: old code could go < 0
+    t._apply_image(img)
+    assert lows and min(lows) >= 0.0, \
+        f"{cls_name} sampled a factor below 0 with value=3.0"
+
+
+def test_utilbase_stride_overflow_raises():
+    from paddle_tpu.distributed.fleet.role_maker import (
+        UserDefinedRoleMaker, UtilBase)
+
+    class _FakeClient:
+        def push_delta(self, *a, **k):
+            raise AssertionError("must raise before touching the PS")
+
+        pull = worker_barrier = push_delta
+
+    util = UtilBase(UserDefinedRoleMaker(worker_num=4, current_id=0))
+    util._set_ps_client(_FakeClient())
+    big = np.zeros(UtilBase._AR_STRIDE + 1, np.float32)
+    with pytest.raises(ValueError, match="id block"):
+        util.all_reduce(big)
+    # all_gather footprint is worker_num * size
+    med = np.zeros(UtilBase._AR_STRIDE // 2, np.float32)
+    with pytest.raises(ValueError, match="id block"):
+        util.all_gather(med)
