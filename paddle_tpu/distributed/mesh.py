@@ -119,15 +119,25 @@ def named_sharding(spec: PartitionSpec,
     return NamedSharding(mesh or get_mesh(), spec)
 
 
-def constrain_dim(x, dim: int, axis: str):
-    """Constrain ONE dim of an activation to a mesh axis, leaving every
-    other dim UNCONSTRAINED. A full PartitionSpec with None entries would
+def constrain_dim(x, dim: int, axis):
+    """Constrain ONE dim of an activation to a mesh axis (or tuple of
+    axes, e.g. ``('dp','fsdp')`` for a batch dim), leaving every other
+    dim UNCONSTRAINED. A full PartitionSpec with None entries would
     force those dims to replicated — clobbering the batch's dp/fsdp
     sharding and making XLA emit an involuntary full reshard (all-gather
     + re-slice) around the constraint. UNCONSTRAINED lets the partitioner
     keep whatever layout is already flowing."""
     mesh = get_mesh(create=False)
-    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+    if isinstance(axis, (tuple, list)):
+        axis = tuple(a for a in axis
+                     if mesh is not None and mesh.shape.get(a, 1) > 1)
+        if not axis:
+            return x
+        if len(axis) == 1:
+            axis = axis[0]
+    elif mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return x
+    if mesh is None:
         return x
     try:
         if isinstance(x, jax.core.Tracer):

@@ -37,6 +37,8 @@ def mark_sharding(param: Parameter, spec: P) -> Parameter:
     """Attach a PartitionSpec to a parameter and, when a mesh is live,
     immediately lay the value out accordingly (eager ops then run SPMD)."""
     param.dist_spec = spec
+    if isinstance(param._value, jax.ShapeDtypeStruct):
+        return param   # meta-init param: spec recorded, nothing to place
     mesh = mesh_mod.get_mesh(create=False)
     if mesh is not None and any(s is not None for s in spec):
         try:

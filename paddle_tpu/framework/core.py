@@ -38,7 +38,8 @@ flags.define_flag("FLAGS_eager_vjp_cache", True,
 
 __all__ = [
     "Tensor", "to_tensor", "no_grad", "enable_grad", "set_grad_enabled",
-    "is_grad_enabled", "GradNode", "set_printoptions",
+    "is_grad_enabled", "GradNode", "set_printoptions", "abstract_init",
+    "is_abstract_init",
 ]
 
 # parity: paddle.set_printoptions (fluid/framework.py set_printoptions)
@@ -89,6 +90,34 @@ class enable_grad(contextlib.ContextDecorator):
 
     def __exit__(self, *exc):
         set_grad_enabled(self._prev)
+        return False
+
+
+def is_abstract_init() -> bool:
+    return getattr(_state, "abstract_init", False)
+
+
+class abstract_init(contextlib.ContextDecorator):
+    """Meta-device parameter creation (torch meta / flax lazy-init
+    analog): under this context ``nn.Layer.create_parameter`` skips the
+    initializer and backs each Parameter with a ``jax.ShapeDtypeStruct``
+    — shape and dtype with NO storage.  A model too large to materialize
+    on the host (e.g. Llama-2-7B, 27 GB of f32 params before optimizer
+    moments) can then be constructed for AOT work:
+    ``DistributedTrainStep.compile_abstract`` lowers and compiles the
+    full sharded training step from the avals alone, so XLA's memory
+    analysis can prove per-device HBM fits the chip before any weight
+    exists.  Such a model cannot run eagerly; materialize-by-loading a
+    checkpoint (set_state_dict replaces ``_value`` wholesale) to use it.
+    """
+
+    def __enter__(self):
+        self._prev = is_abstract_init()
+        _state.abstract_init = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.abstract_init = self._prev
         return False
 
 
@@ -559,6 +588,22 @@ def _vjp_cache_clear():
             _vjp_stats[k] = 0
 
 
+class _LazyVjp:
+    """Deferred-linearization vjp for ops recorded under an outer jax
+    trace (see the tracer branch in ``_apply_impl``): calling it runs
+    ``jax.vjp`` over the stored primal inputs at backward time."""
+
+    __slots__ = ("fn", "prim")
+
+    def __init__(self, fn, prim):
+        self.fn = fn
+        self.prim = prim
+
+    def __call__(self, cot):
+        _, vjp = jax.vjp(self.fn, *self.prim)
+        return vjp(cot)
+
+
 def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
                 **kwargs) -> Any:
     """Execute ``fn`` over the jax values of ``args``; record a GradNode.
@@ -590,7 +635,14 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
             v[p] = dv
         return fn(*v, **kwargs)
 
-    cached = _vjp_cache_lookup(fn, vals, tuple(diff_pos), kwargs)
+    # Under an OUTER jax trace the cache must NOT serve: cache keys
+    # treat tracers like any aval-keyed array, and invoking a cached
+    # jitted (out, vjp_fn) builder with tracers inlines jax.vjp into
+    # the trace — consuming jax.checkpoint regions exactly like the
+    # eager-vjp path the tracer branch below exists to avoid.
+    under_trace = any(isinstance(v, jax.core.Tracer) for v in vals)
+    cached = (None if under_trace
+              else _vjp_cache_lookup(fn, vals, tuple(diff_pos), kwargs))
 
     if not diff_pos:
         if cached is not None:
@@ -613,7 +665,24 @@ def _apply_impl(fn: Callable, *args, op_name: str = "", n_outputs: int = 1,
         except _TRACE_FALLBACK_ERRORS:
             _vjp_cache_poison(fn, vals, tuple(diff_pos), kwargs)
     if vjp_fn is None:
-        out_val, vjp_fn = jax.vjp(closed, *[vals[p] for p in diff_pos])
+        diff_vals = [vals[p] for p in diff_pos]
+        if under_trace:
+            # Under an OUTER jax trace (jit/grad/vmap — e.g. the
+            # DistributedTrainStep loss or a to_static body), emit the
+            # PLAIN forward and defer linearization.  An eager jax.vjp
+            # here would partial-eval the op at trace time, CONSUMING
+            # any jax.checkpoint region inside it — the outer
+            # value_and_grad then differentiates the already-unzipped
+            # primal with the remat annotation gone, stashing every
+            # per-layer intermediate through lax.scan (measured: the
+            # scanned Llama decoder kept [L,B,H,S,S] softmax scores
+            # stacked over layers with remat=True silently ignored).
+            # The rare backward() INSIDE a traced region linearizes
+            # lazily instead (trace-time-only recompute; XLA CSEs it).
+            out_val = closed(*diff_vals)
+            vjp_fn = _LazyVjp(closed, diff_vals)
+        else:
+            out_val, vjp_fn = jax.vjp(closed, *diff_vals)
     parents = [args[p] for p in diff_pos]
     outs = out_val if isinstance(out_val, (tuple, list)) else (out_val,)
     out_avals = [(o.shape, o.dtype) for o in outs]

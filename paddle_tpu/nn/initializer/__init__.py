@@ -41,6 +41,16 @@ def _fans(shape):
 
 class Initializer:
     def __call__(self, shape, dtype=jnp.float32):
+        shape = tuple(int(d) for d in shape)
+        from ...framework.core import is_abstract_init
+        if is_abstract_init():
+            # meta-device creation (framework.core.abstract_init): aval
+            # only, no storage and no RNG draw — abstract models are for
+            # AOT geometry work, never for training from this "init"
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+        return self._generate(shape, dtype)
+
+    def _generate(self, shape, dtype):
         raise NotImplementedError
 
 
@@ -48,7 +58,7 @@ class Constant(Initializer):
     def __init__(self, value=0.0):
         self.value = value
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         return jnp.full(shape, self.value, dtype)
 
 
@@ -56,7 +66,7 @@ class Normal(Initializer):
     def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         return (jax.random.normal(split_key(), shape, dtype) * self.std
                 + self.mean)
 
@@ -65,7 +75,7 @@ class TruncatedNormal(Initializer):
     def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         return (jax.random.truncated_normal(split_key(), -2.0, 2.0, shape,
                                             dtype) * self.std + self.mean)
 
@@ -74,7 +84,7 @@ class Uniform(Initializer):
     def __init__(self, low=-1.0, high=1.0, name=None):
         self.low, self.high = low, high
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         return jax.random.uniform(split_key(), shape, dtype, self.low,
                                   self.high)
 
@@ -83,7 +93,7 @@ class XavierNormal(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         fi, fo = _fans(shape)
         fi = self.fan_in or fi
         fo = self.fan_out or fo
@@ -95,7 +105,7 @@ class XavierUniform(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         fi, fo = _fans(shape)
         fi = self.fan_in or fi
         fo = self.fan_out or fo
@@ -110,7 +120,7 @@ class KaimingNormal(Initializer):
         self.negative_slope = negative_slope
         self.nonlinearity = nonlinearity
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         fi, _ = _fans(shape)
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
@@ -125,7 +135,7 @@ class KaimingUniform(Initializer):
         self.negative_slope = negative_slope
         self.nonlinearity = nonlinearity
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         fi, _ = _fans(shape)
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
@@ -137,7 +147,7 @@ class Assign(Initializer):
     def __init__(self, value, name=None):
         self.value = value
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         from ...framework.core import Tensor
         v = self.value
         if isinstance(v, Tensor):
@@ -152,7 +162,7 @@ class Orthogonal(Initializer):
     def __init__(self, gain=1.0, name=None):
         self.gain = gain
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         rows = shape[0]
         cols = int(np.prod(shape[1:]))
         flat = jax.random.normal(split_key(), (max(rows, cols),
@@ -168,7 +178,7 @@ class Dirac(Initializer):
     def __init__(self, groups=1, name=None):
         self.groups = groups
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         out = np.zeros(shape, np.float32)
         out_ch, in_ch = shape[0], shape[1]
         mins = min(out_ch // self.groups, in_ch)
@@ -186,7 +196,7 @@ class Bilinear(Initializer):
     (C_out, C_in, k, k) weight so conv_transpose performs bilinear
     interpolation."""
 
-    def __call__(self, shape, dtype=jnp.float32):
+    def _generate(self, shape, dtype=jnp.float32):
         if len(shape) != 4:
             raise ValueError(
                 f"Bilinear init needs a 4-D conv weight, got {shape}")

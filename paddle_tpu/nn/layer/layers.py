@@ -48,7 +48,15 @@ def create_parameter(shape, dtype="float32", initializer=None,
     if init is None:
         from ..initializer import Constant, XavierNormal
         init = Constant(0.0) if is_bias else XavierNormal()
-    value = init(tuple(int(s) for s in shape), dtypes.to_jax(dtype))
+    from ...framework.core import is_abstract_init
+    if is_abstract_init():
+        # meta-device creation (framework.core.abstract_init): aval only,
+        # for AOT compile/memory analysis of models too big to hold
+        import jax
+        value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                     dtypes.to_jax(dtype))
+    else:
+        value = init(tuple(int(s) for s in shape), dtypes.to_jax(dtype))
     p = Parameter(value,
                   trainable=getattr(attr, "trainable", True),
                   name=getattr(attr, "name", None) or "")

@@ -362,12 +362,21 @@ def _pallas_backward(q, k, v, out, lse, do, causal, scale, block_q,
 
 
 def _ref_chunked(q, k, v, bias, causal, scale, chunk=512):
-    """Blockwise-recompute attention in plain XLA (used for backward)."""
+    """Blockwise-RECOMPUTE attention in plain XLA: queries processed in
+    chunks with ``jax.checkpoint`` per chunk, so neither forward nor
+    backward ever holds more than one chunk's ``[B, H, chunk, S_k]``
+    score block (without the checkpoint, AD would stash every chunk's
+    softmax — same total memory as the naive composition).  The
+    memory-efficient fallback wherever the Pallas kernel cannot run:
+    flash-ineligible shapes, and CPU-mesh dryruns of long-sequence
+    models (the 7B geometry proof compiles through this path)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
 
-    def one_chunk(qc, q0):
-        s = jnp.einsum("bhqd,bhkd->bhqk", qc * scale, k)
+    @jax.checkpoint
+    def one_chunk(qc, q0, kv):
+        kk, vv = kv
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc * scale, kk)
         if bias is not None:
             s = s + bias.astype(s.dtype)
         if causal:
@@ -375,13 +384,37 @@ def _ref_chunked(q, k, v, bias, causal, scale, chunk=512):
             k_pos = jnp.arange(sk)[None, :]
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv)
 
-    n = max(1, sq // chunk)
-    chunk = sq // n
-    outs = [one_chunk(jax.lax.dynamic_slice_in_dim(q, i * chunk, chunk, 2),
-                      i * chunk) for i in range(n)]
-    return jnp.concatenate(outs, axis=2)
+    # chunk must DIVIDE sq (the lax.map reshape is exact): largest
+    # divisor <= the requested chunk; degenerate divisors (tiny chunks
+    # on near-prime lengths) fall back to a single block
+    c = min(chunk, sq)
+    while c > 1 and sq % c:
+        c -= 1
+    chunk = c if c >= 128 else sq
+    n = sq // chunk
+    if n == 1:
+        return one_chunk(q, jnp.asarray(0), (k, v))
+    # lax.map (a scan) SERIALIZES the chunks: a python loop would hand
+    # XLA n independent score blocks whose live ranges overlap, putting
+    # peak memory right back at the naive composition's
+    qs = jnp.moveaxis(q.reshape(b, h, n, chunk, d), 2, 0)
+    q0s = jnp.arange(n) * chunk
+    outs = jax.lax.map(lambda qc_q0: one_chunk(qc_q0[0], qc_q0[1],
+                                               (k, v)), (qs, q0s))
+    return jnp.moveaxis(outs, 0, 2).reshape(b, h, sq, d)
+
+
+def chunked_attention(q, k, v, bias=None, causal=False, scale=None,
+                      chunk=512):
+    """Memory-efficient XLA attention on paddle-layout (B, S, H, D)
+    tensors — the non-Pallas long-sequence fallback (see _ref_chunked)."""
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out = _ref_chunked(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                       jnp.swapaxes(v, 1, 2), bias, causal, sc,
+                       chunk=chunk)
+    return jnp.swapaxes(out, 1, 2)
 
 
 def _blocks_ok(sq, sk, block_q, block_k):

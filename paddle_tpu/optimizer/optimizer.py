@@ -22,6 +22,24 @@ from .lr import LRScheduler
 __all__ = ["Optimizer"]
 
 
+class _AbstractParamView:
+    """Stand-in handed to ``_create_state`` when shape-tracing slots for
+    a meta-init parameter (see Optimizer.opt_state): ``_value`` is the
+    eval_shape tracer; every other attribute forwards to the real
+    parameter.  Caveat: ``id(view) != id(param)``, so id-keyed per-param
+    flags (AdamW no-decay, Lamb exclusions) fall back to their defaults —
+    harmless here because only slot SHAPES survive eval_shape."""
+
+    __slots__ = ("_p", "_value")
+
+    def __init__(self, p, value):
+        object.__setattr__(self, "_p", p)
+        object.__setattr__(self, "_value", value)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_p"), name)
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -174,7 +192,15 @@ class Optimizer:
         states = []
         for p in self._parameter_list:
             if id(p) not in self._accumulators:
-                self._accumulators[id(p)] = self._create_state(p)
+                if isinstance(p._value, jax.ShapeDtypeStruct):
+                    # meta-init param (framework.core.abstract_init):
+                    # derive slot AVALS by shape-tracing _create_state —
+                    # a 7B model's moments must never materialize here
+                    self._accumulators[id(p)] = jax.eval_shape(
+                        lambda v, _p=p: self._create_state(
+                            _AbstractParamView(_p, v)), p._value)
+                else:
+                    self._accumulators[id(p)] = self._create_state(p)
             states.append(self._accumulators[id(p)])
         return states
 

@@ -189,6 +189,13 @@ class LlamaAttention(Layer):
                 o = cp(qh, kh, vh, causal=True)
             elif flash_eligible(S, c.head_dim):
                 o = _fa_t(qh, kh, vh, causal=True)
+            elif S >= 1024:
+                # flash-ineligible long sequence (odd head dims, or a
+                # CPU-mesh dryrun): query-chunked attention with
+                # per-chunk remat bounds the score block to
+                # [B, H, chunk, S] instead of [B, H, S, S]
+                from ...ops.flash_attention import chunked_attention
+                o = chunked_attention(qh, kh, vh, causal=True)
             else:
                 o = _sdpa_ref(qh, kh, vh, None, 0.0, True, None)
             return o.reshape(B, S, c.num_attention_heads * c.head_dim)
@@ -325,7 +332,13 @@ class StackedLlamaDecoder(Layer):
         from ...distributed.meta_parallel import mark_sharding
         for n in self._names:
             vals = [dict(l.named_parameters())[n]._value for l in layers]
-            stacked = Parameter(jnp.stack(vals))
+            if isinstance(vals[0], jax.ShapeDtypeStruct):
+                # meta-init construction (framework.core.abstract_init):
+                # stack avals, not storage
+                stacked = Parameter(jax.ShapeDtypeStruct(
+                    (len(vals),) + tuple(vals[0].shape), vals[0].dtype))
+            else:
+                stacked = Parameter(jnp.stack(vals))
             ann = getattr(dict(proto.named_parameters())[n], "dist_spec",
                           None)
             spec = P("pp", *(tuple(ann) if ann is not None

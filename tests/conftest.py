@@ -22,3 +22,17 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # The axon TPU plugin forces jax_platforms='axon,cpu' at import, overriding
 # the env var; pin it back so tests never touch the (single-tenant) TPU.
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """A test that installs a global mesh must not leak it into the next
+    test: eager ops consult the mesh (constrain_dim lays values out
+    SPMD), so a stale 8-device mesh changes single-device numerics —
+    an ordering-dependent flake (surfaced by running test_pipeline
+    before test_llama)."""
+    yield
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh_mod.set_mesh(None)

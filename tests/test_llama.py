@@ -39,6 +39,19 @@ def test_loss_and_grads():
 
 
 def test_remat_matches_no_remat():
+    # bit-parity comparison: run with the eager vjp cache OFF — cached
+    # (jitted) vs raw vjp paths reassociate f32 math by ~1 ulp, and
+    # which ops are cache-warm depends on test ORDER (the documented
+    # cache numeric behavior; this test asserts remat-vs-plain grad
+    # identity, so both models must take the same dispatch path)
+    paddle.set_flags({"FLAGS_eager_vjp_cache": False})
+    try:
+        _remat_parity_body()
+    finally:
+        paddle.set_flags({"FLAGS_eager_vjp_cache": True})
+
+
+def _remat_parity_body():
     cfg = llama_tiny(remat=False)
     cfg2 = llama_tiny(remat=True)
     m1 = LlamaForCausalLM(cfg)

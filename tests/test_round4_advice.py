@@ -134,3 +134,43 @@ def test_utilbase_stride_overflow_raises():
     med = np.zeros(UtilBase._AR_STRIDE // 2, np.float32)
     with pytest.raises(ValueError, match="id block"):
         util.all_gather(med)
+
+
+def test_vjp_cache_never_serves_under_trace():
+    """A cached (eagerly-built) jitted vjp pair must NOT be invoked with
+    tracer operands: that inlines jax.vjp into the outer trace and
+    consumes jax.checkpoint regions — the exact remat bug the round-4
+    lazy-vjp fix removed (review finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.core import (Tensor, _apply, _vjp_cache,
+                                           _vjp_stats)
+
+    @jax.checkpoint
+    def inner(v):
+        return jnp.tanh(v) * 2.0
+
+    def op(v):
+        return inner(v)
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    x.stop_gradient = False
+    # eager call: populates the cache (hashable key)
+    _apply(op, x, op_name="remat_probe")
+    base_hits = _vjp_stats["hits"]
+
+    def traced(v):
+        t = Tensor(v)
+        t.stop_gradient = False
+        out = _apply(op, t, op_name="remat_probe")
+        return out._value.sum()
+
+    jaxpr = jax.make_jaxpr(jax.grad(traced))(np.ones((4,), np.float32))
+    # the remat region must SURVIVE into the outer trace
+    assert "remat" in str(jaxpr) or "checkpoint" in str(jaxpr), \
+        "jax.checkpoint region consumed at trace time (cache served a " \
+        "jitted vjp under tracers)"
+    assert _vjp_stats["hits"] == base_hits, \
+        "vjp cache hit under an outer trace"
