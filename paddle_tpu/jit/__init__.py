@@ -158,9 +158,10 @@ class StaticFunction:
     """
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 layer=None):
+                 layer=None, full_graph=False):
         self._fn = fn
         self._input_spec = input_spec
+        self._full_graph = bool(full_graph)
         self._layer = layer if layer is not None else getattr(fn, "__self__",
                                                               None)
         self._compiled: Dict[Any, Callable] = {}
@@ -191,7 +192,7 @@ class StaticFunction:
         # range) into runtime-dispatched lax.cond/while_loop combinators
         # (the reference's dygraph_to_static compiler, program_translator
         # .py:233); non-convertible functions pass through unchanged
-        fn = convert_func(self._fn)
+        fn = convert_func(self._fn, strict=self._full_graph)
 
         def traced(param_vals, buf_vals, key, leaf_vals):
             args = _fill_args(skeleton, leaf_vals)
@@ -312,17 +313,23 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """Decorator/wrapper: compile an eager function or Layer with XLA."""
+              backend=None, full_graph=False, **kwargs):
+    """Decorator/wrapper: compile an eager function or Layer with XLA.
+
+    ``full_graph=True``: control flow the dy2static converter cannot
+    stage raises loudly instead of silently running as plain Python
+    (reference: program_translator.py's error-on-partial-conversion
+    mode)."""
     from ..nn.layer.layers import Layer
 
     def wrap(fn):
         if isinstance(fn, Layer):
             sf = StaticFunction(fn.forward, input_spec, build_strategy,
-                                layer=fn)
+                                layer=fn, full_graph=full_graph)
             fn.forward = sf
             return fn
-        return StaticFunction(fn, input_spec, build_strategy)
+        return StaticFunction(fn, input_spec, build_strategy,
+                              full_graph=full_graph)
 
     if function is not None:
         return wrap(function)

@@ -13,12 +13,22 @@ non-tensor control flow is untouched in behavior.
 
 Scope (documented contract, mirrors the reference's supported subset):
   * ``if``/``elif``/``else`` on tensor predicates — including branches
-    that both end in ``return``;
-  * ``while`` with tensor conditions;
-  * ``for <name> in range(...)`` with tensor bounds;
-  * statements containing ``break``/``continue``/mid-branch ``return``,
-    ``global``/``nonlocal``, or loop ``else`` clauses are left as plain
-    Python (they convert only if their predicates stay concrete).
+    that return (a return-residualization pass folds the statements
+    after an early-returning ``if`` into the non-returning side, the
+    analog of the reference's return_transformer.py, so returns become
+    tail-position and stage as ``lax.cond`` branches);
+  * ``while`` with tensor conditions, including ``break``/``continue``
+    and loop ``else``: break/continue rewrite to boolean mask flags
+    (``brk``/``cont``) carried through ``lax.while_loop`` with the
+    remaining statements guarded — the reference's
+    break_continue_transformer.py as a mask-carry pattern;
+  * ``for <name> in range(...)`` with tensor bounds, same break/continue
+    support;
+  * ``return`` inside a loop body, ``global``/``nonlocal``, and
+    break/continue escaping ``try`` or a nested loop's ``else`` are NOT
+    converted: they run as plain Python (fine when predicates are
+    concrete) and are reported loudly under ``to_static(...,
+    full_graph=True)``.
 Conversion failures (no source, exotic constructs) fall back to the
 original function — tracing then fails only where it would have anyway.
 """
@@ -117,19 +127,52 @@ def convert_while(cond_fn, body_fn, args, names=()):
     count inside to_static), which must keep eager semantics — including
     variables first assigned inside the body.
     """
-    probe = cond_fn(*args)
-    if _is_traced(probe):
-        _check_defined(args, names, "entering a while loop")
-        from ..static.nn import while_loop
-        out = while_loop(cond_fn, body_fn, list(args))
-        return tuple(out)
     vals = list(args)
-    keep = bool(probe)
-    while keep:
+    while True:
+        probe = cond_fn(*vals)
+        if _is_traced(probe):
+            # traced from the start, or tracedness ARISING mid-loop (a
+            # concrete trip count whose body set a traced break flag):
+            # the concrete iterations already ran unrolled; stage the
+            # rest as lax.while_loop from the current carried values
+            _check_defined(vals, names, "entering a while loop")
+            from ..static.nn import while_loop
+            out = while_loop(cond_fn, body_fn, list(vals))
+            return tuple(out)
+        if not bool(probe):
+            return tuple(vals)
         out = body_fn(*vals)
         vals = list(out) if isinstance(out, (tuple, list)) else [out]
-        keep = bool(cond_fn(*vals))
-    return tuple(vals)
+
+
+def _bool_val(v):
+    from ..framework.core import Tensor
+    return v._value if isinstance(v, Tensor) else v
+
+
+def loop_and_not(test, flag):
+    """Loop-continue predicate ``test and not flag`` for break-flagged
+    loops — jnp logical ops when either side is traced (python ``and``
+    would force a concrete bool out of a tracer)."""
+    t, f = _bool_val(test), _bool_val(flag)
+    if _is_traced(test) or _is_traced(flag):
+        import jax.numpy as jnp
+        return jnp.logical_and(jnp.asarray(t), jnp.logical_not(
+            jnp.asarray(f)))
+    return bool(t) and not f
+
+
+def no_flag(*flags):
+    """True while no break/continue flag is set (guard predicate for the
+    statements following a potential flag assignment)."""
+    vals = [_bool_val(f) for f in flags]
+    if any(_is_traced(f) for f in flags):
+        import jax.numpy as jnp
+        out = jnp.logical_not(jnp.asarray(vals[0]))
+        for v in vals[1:]:
+            out = jnp.logical_and(out, jnp.logical_not(jnp.asarray(v)))
+        return out
+    return not any(bool(v) for v in vals)
 
 
 def normalize_range(*args):
@@ -257,14 +300,97 @@ def _has_scope_decl(stmts) -> bool:
     return _scan(stmts, (ast.Global, ast.Nonlocal), loop_barrier=False)
 
 
-def _filter_carried(names) -> List[str]:
+def _filter_carried(names, keep_ret: Optional[str] = None) -> List[str]:
     """Drop generated helper bindings (branch fns, range temps) from a
     carried-variable set — they are always local to one statement group.
-    ``__dy2st_ret_*`` stays: trailing-return conversion reads it after
-    the merge."""
+    ``__dy2st_brk_*``/``__dy2st_cont_*`` stay (break/continue mask flags
+    carried through the loop).  Of the ``__dy2st_ret_*`` names only the
+    CURRENT if's own (``keep_ret``) stays: an inner converted if's ret
+    var is consumed by the enclosing branch's tail assign and must not
+    leak into the outer carried set (it is bound on one side only)."""
     return sorted(
         n for n in names
-        if not n.startswith("__dy2st_") or n.startswith("__dy2st_ret_"))
+        if (not n.startswith("__dy2st_")
+            or n.startswith(("__dy2st_brk_", "__dy2st_cont_"))
+            or (keep_ret is not None and n == keep_ret)))
+
+
+def _always_returns(stmts) -> bool:
+    """Every path through the block ends in ``return`` (conservative)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _always_returns(last.body) \
+            and _always_returns(last.orelse)
+    return False
+
+
+def _return_in_loop_or_try(stmts) -> bool:
+    """A return nested under a loop/try/with cannot residualize."""
+    for s in stmts:
+        if isinstance(s, _SCOPES):
+            continue
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor, ast.Try,
+                          ast.With, ast.AsyncWith)):
+            if _has_return([s]):
+                return True
+        elif isinstance(s, ast.If):
+            if _return_in_loop_or_try(s.body) \
+                    or _return_in_loop_or_try(s.orelse):
+                return True
+    return False
+
+
+def _residualize(stmts):
+    """Fold the statements after a maybe-returning ``if`` into its
+    non-returning side(s), so every ``return`` ends up in tail position
+    of its block (the reference return_transformer.py analog — but
+    instead of threading a return flag, restructure to nested if/else,
+    which stages directly as lax.cond branches).  Statements after a
+    bare ``return`` (dead code) are dropped."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(s)
+            return out                      # rest is dead code
+        if isinstance(s, ast.If) and (_has_return(s.body)
+                                      or _has_return(s.orelse)):
+            body = _residualize(s.body)
+            orelse = _residualize(s.orelse)
+            rest = stmts[idx + 1:]
+            if rest:
+                if not _always_returns(body):
+                    body = _residualize(body + rest)
+                if not _always_returns(orelse):
+                    orelse = _residualize((orelse or []) + rest)
+            s2 = ast.copy_location(
+                ast.If(test=s.test, body=body, orelse=orelse), s)
+            out.append(s2)
+            return out                      # rest folded into branches
+        out.append(s)
+    return out
+
+
+def _bc_convertible(body) -> bool:
+    """break/continue rewrite handles flags reached through plain
+    statements and if/else; escaping a try/with or a NESTED loop's else
+    clause is out of scope (rare, and Python fallback still runs it)."""
+    for s in body:
+        if isinstance(s, _SCOPES):
+            continue
+        if isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+            if _has_break_continue([s]):
+                return False
+        elif isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+            if _has_break_continue([s]):   # only its else can carry ours
+                return False
+        elif isinstance(s, ast.If):
+            if not _bc_convertible(s.body) or not _bc_convertible(s.orelse):
+                return False
+    return True
 
 
 # ----------------------------------------------------------------------
@@ -315,6 +441,60 @@ def _unpack_assign(names: List[str], value):
     return ast.Assign(targets=[tgt], value=value)
 
 
+def _assign_bool(name: str, val: bool):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(val))
+
+
+def _rewrite_tail_returns(stmts, ret_name: str):
+    """Replace the tail-position returns of an always-returning block
+    with assignments to ``ret_name`` (after residualization every return
+    sits in tail position of its block or of a nested trailing if)."""
+    out = list(stmts)
+    last = out[-1]
+    if isinstance(last, ast.Return):
+        out[-1] = ast.copy_location(ast.Assign(
+            targets=[_name(ret_name, ast.Store())],
+            value=last.value or ast.Constant(None)), last)
+    elif isinstance(last, ast.If):
+        out[-1] = ast.copy_location(ast.If(
+            test=last.test,
+            body=_rewrite_tail_returns(last.body, ret_name),
+            orelse=_rewrite_tail_returns(last.orelse, ret_name)), last)
+    return out
+
+
+def _rewrite_break_continue(stmts, brk: str, cont: str):
+    """Replace ``break``/``continue`` bound to the current loop with mask
+    flag assignments; statements following a potential flag-set are
+    guarded under ``if _jst.no_flag(brk, cont)`` (the reference
+    break_continue_transformer.py as a mask-carry rewrite).  Dead code
+    after a bare break/continue is dropped."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(ast.copy_location(_assign_bool(brk, True), s))
+            return out
+        if isinstance(s, ast.Continue):
+            out.append(ast.copy_location(_assign_bool(cont, True), s))
+            return out
+        if isinstance(s, ast.If) and _has_break_continue([s]):
+            s2 = ast.copy_location(ast.If(
+                test=s.test,
+                body=_rewrite_break_continue(s.body, brk, cont),
+                orelse=_rewrite_break_continue(s.orelse, brk, cont)), s)
+            out.append(s2)
+            rest = stmts[idx + 1:]
+            if rest:
+                out.append(ast.copy_location(ast.If(
+                    test=_jst_call("no_flag", [_name(brk), _name(cont)]),
+                    body=_rewrite_break_continue(rest, brk, cont),
+                    orelse=[]), s))
+            return out
+        out.append(s)
+    return out
+
+
 # ----------------------------------------------------------------------
 # the transformer
 # ----------------------------------------------------------------------
@@ -323,39 +503,63 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
         self.changed = False
+        self.notes: List[str] = []   # unconverted constructs, for
+                                     # to_static(full_graph=True)
 
     def _uid(self):
         self.counter += 1
         return self.counter
 
+    def _note(self, node, reason: str):
+        self.notes.append(f"line {getattr(node, 'lineno', '?')}: {reason}")
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+        return out
+
     # -- if ------------------------------------------------------------
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
         both = node.body + node.orelse
-        if _has_break_continue(both) or _has_scope_decl(both):
+        if _has_break_continue(both):
+            # the enclosing loop's flag rewrite turns these into plain
+            # assignments first; an if reached here still holding a
+            # break/continue belongs to an unconvertible loop
+            return node
+        if _has_scope_decl(both):
+            self._note(node, "global/nonlocal inside an if on a "
+                             "potentially traced predicate")
             return node
         trailing_return = False
-        if _has_return(node.body) or _has_return(node.orelse):
-            # only the symmetric trailing-return form converts
-            if (node.orelse and isinstance(node.body[-1], ast.Return)
-                    and isinstance(node.orelse[-1], ast.Return)
-                    and not _has_return(node.body[:-1])
-                    and not _has_return(node.orelse[:-1])):
-                trailing_return = True
-            else:
+        body, orelse = list(node.body), list(node.orelse)
+        if _has_return(body) or _has_return(orelse):
+            if _return_in_loop_or_try(body) or _return_in_loop_or_try(orelse):
+                self._note(node, "return nested in a loop/try/with "
+                                 "inside an if")
+                return node
+            # the residualizer has folded trailing statements in, so a
+            # convertible shape has BOTH sides always returning (the
+            # merged value is then defined on every path)
+            if not (_always_returns(body) and orelse
+                    and _always_returns(orelse)):
+                self._note(node, "if where one path returns and the "
+                                 "other neither returns nor continues")
                 return node
         i = self._uid()
-        body, orelse = list(node.body), list(node.orelse)
         ret_name = f"__dy2st_ret_{i}"
-        if trailing_return:
-            body[-1] = ast.Assign(
-                targets=[_name(ret_name, ast.Store())],
-                value=body[-1].value or ast.Constant(None))
-            orelse[-1] = ast.Assign(
-                targets=[_name(ret_name, ast.Store())],
-                value=orelse[-1].value or ast.Constant(None))
-        carried = _filter_carried(_assigned_names(body)
-                                  | _assigned_names(orelse))
+        if _has_return(body) or _has_return(orelse):
+            trailing_return = True
+            body = _rewrite_tail_returns(body, ret_name)
+            orelse = _rewrite_tail_returns(orelse, ret_name)
+        carried = _filter_carried(
+            _assigned_names(body) | _assigned_names(orelse),
+            keep_ret=ret_name if trailing_return else None)
         if not carried:
             return node
         tname, fname = f"__dy2st_true_{i}", f"__dy2st_false_{i}"
@@ -375,14 +579,65 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while ---------------------------------------------------------
     def visit_While(self, node: ast.While):
+        pre: list = []
+        post: list = []
+        has_bc = _has_break_continue(node.body)
+        has_break = _scan(node.body, ast.Break, loop_barrier=True)
+        if (has_bc or node.orelse) and not _has_return(node.body):
+            if has_bc and not _bc_convertible(node.body):
+                self._note(node, "break/continue escaping a try/with or "
+                                 "a nested loop's else clause")
+                self.generic_visit(node)
+                return node
+            if (node.orelse and has_break
+                    and not _filter_carried(_assigned_names(node.orelse))):
+                # the post-loop guard `if no_flag(brk)` only converts
+                # when the else body binds variables; a side-effect-only
+                # else next to a (possibly traced) break cannot stage —
+                # leave the whole loop to plain Python rather than emit
+                # a guard that crashes on a tracer
+                self._note(node, "loop else-clause that binds no "
+                                 "variables alongside a break")
+                self.generic_visit(node)
+                return node
+            # mask-carry rewrite: break/continue become flags carried
+            # through the loop; the loop predicate picks up `not brk`;
+            # the else clause runs iff the loop exited without break —
+            # all semantics-preserving in plain Python too, so a later
+            # conversion bail still runs correctly eagerly
+            i = self._uid()
+            brk, cont = f"__dy2st_brk_{i}", f"__dy2st_cont_{i}"
+            new_body = ([ast.copy_location(_assign_bool(cont, False), node)]
+                        + _rewrite_break_continue(list(node.body), brk,
+                                                  cont))
+            pre = [ast.copy_location(_assign_bool(brk, False), node),
+                   ast.copy_location(_assign_bool(cont, False), node)]
+            if node.orelse and has_break:
+                post = [ast.copy_location(ast.If(
+                    test=_jst_call("no_flag", [_name(brk)]),
+                    body=list(node.orelse), orelse=[]), node)]
+            elif node.orelse:
+                # no break in the loop: the else clause ALWAYS runs —
+                # plain trailing statements, no (possibly traced) guard
+                post = list(node.orelse)
+            node = ast.copy_location(ast.While(
+                test=_jst_call("loop_and_not", [node.test, _name(brk)]),
+                body=new_body, orelse=[]), node)
+            ast.fix_missing_locations(node)
+            self.changed = True
         self.generic_visit(node)
-        if (node.orelse or _has_return(node.body)
-                or _has_break_continue(node.body)
-                or _has_scope_decl(node.body)):
-            return node
+        post = self._visit_block([ast.fix_missing_locations(p)
+                                  for p in post])
+        if _has_return(node.body):
+            self._note(node, "return inside a while body")
+            return pre + [node] + post if (pre or post) else node
+        if _has_break_continue(node.body) or _has_scope_decl(node.body):
+            if _has_scope_decl(node.body):
+                self._note(node, "global/nonlocal inside a while body")
+            return pre + [node] + post if (pre or post) else node
         carried = _filter_carried(_assigned_names(node.body))
         if not carried:
-            return node
+            return pre + [node] + post if (pre or post) else node
         i = self._uid()
         cname, bname = f"__dy2st_wcond_{i}", f"__dy2st_wbody_{i}"
         cdef = _fn_def(cname, carried, [], [])
@@ -394,22 +649,51 @@ class _ControlFlowTransformer(ast.NodeTransformer):
              ast.Tuple([_get_expr(n) for n in carried], ast.Load())],
             names=carried)
         self.changed = True
-        out = [cdef, bdef, _unpack_assign(carried, call)]
+        out = pre + [cdef, bdef, _unpack_assign(carried, call)] + post
         return [ast.copy_location(ast.fix_missing_locations(s), node)
                 for s in out]
 
     # -- for over range() ---------------------------------------------
     def visit_For(self, node: ast.For):
-        self.generic_visit(node)
-        if (node.orelse or not isinstance(node.target, ast.Name)
+        if (not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range"
-                or node.iter.keywords
-                or _has_return(node.body)
-                or _has_break_continue(node.body)
-                or _has_scope_decl(node.body)):
+                or node.iter.keywords):
+            self.generic_visit(node)
+            return node      # non-range iteration: plain Python
+        if _has_return(node.body) or _has_scope_decl(node.body):
+            self._note(node, "return or global/nonlocal inside a "
+                             "for-range body")
+            self.generic_visit(node)
             return node
+        has_bc = _has_break_continue(node.body)
+        if has_bc and not _bc_convertible(node.body):
+            self._note(node, "break/continue escaping a try/with or a "
+                             "nested loop's else clause")
+            self.generic_visit(node)
+            return node
+        flags = None
+        has_break = _scan(node.body, ast.Break, loop_barrier=True)
+        if (node.orelse and has_break
+                and not _filter_carried(_assigned_names(node.orelse))):
+            self._note(node, "loop else-clause that binds no variables "
+                             "alongside a break")
+            self.generic_visit(node)
+            return node
+        if has_bc or node.orelse:
+            # mask-carry rewrite fused into the range->while conversion
+            # (a plain Python for cannot consult a break flag in its
+            # header, so flags only appear on the converted path)
+            fi = self._uid()
+            brk, cont = f"__dy2st_brk_{fi}", f"__dy2st_cont_{fi}"
+            node.body = (
+                [ast.copy_location(_assign_bool(cont, False), node)]
+                + _rewrite_break_continue(list(node.body), brk, cont))
+            flags = (brk, cont, list(node.orelse))
+            node.orelse = []
+            ast.fix_missing_locations(node)
+        self.generic_visit(node)
         i = self._uid()
         tgt = node.target.id
         start, stop, step = (f"__dy2st_start_{i}", f"__dy2st_stop_{i}",
@@ -422,12 +706,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # to start so a zero-trip traced loop still has a bound value
         init_tgt = ast.Assign(targets=[_name(tgt, ast.Store())],
                               value=_name(start))
-        carried = _filter_carried(_assigned_names(node.body) | {tgt})
+        names_in_body = _assigned_names(node.body) | {tgt}
+        if flags is not None:
+            names_in_body |= {flags[0], flags[1]}
+        carried = _filter_carried(names_in_body)
         params = [idx] + carried
         cname, bname = f"__dy2st_fcond_{i}", f"__dy2st_fbody_{i}"
         cdef = _fn_def(cname, params, [], [])
-        cdef.body = [ast.Return(_jst_call(
-            "range_cond", [_name(idx), _name(stop), _name(step)]))]
+        cond_expr = _jst_call(
+            "range_cond", [_name(idx), _name(stop), _name(step)])
+        if flags is not None:
+            cond_expr = _jst_call("loop_and_not",
+                                  [cond_expr, _name(flags[0])])
+        cdef.body = [ast.Return(cond_expr)]
         bbody = [ast.Assign(targets=[_name(tgt, ast.Store())],
                             value=_name(idx))] + list(node.body)
         bnext = ast.BinOp(left=_name(idx), op=ast.Add(), right=_name(step))
@@ -447,7 +738,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                          names=[idx] + carried)
         assign = _unpack_assign([idx] + carried, call)
         self.changed = True
-        out = [norm, init_tgt, cdef, bdef, assign]
+        pre, post = [], []
+        if flags is not None:
+            brk, cont, orelse = flags
+            pre = [_assign_bool(brk, False), _assign_bool(cont, False)]
+            if orelse and has_break:
+                post = self._visit_block([ast.fix_missing_locations(
+                    ast.copy_location(ast.If(
+                        test=_jst_call("no_flag", [_name(brk)]),
+                        body=orelse, orelse=[]), node))])
+            elif orelse:
+                # no break: else always runs, no guard needed
+                post = self._visit_block(orelse)
+        out = [norm, init_tgt] + pre + [cdef, bdef, assign] + post
         return [ast.copy_location(ast.fix_missing_locations(s), node)
                 for s in out]
 
@@ -456,25 +759,37 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # driver
 # ----------------------------------------------------------------------
 
-_CONVERTED: Dict[Any, Callable] = {}
+_CONVERTED: Dict[Any, Any] = {}   # f -> (converted_fn, notes)
 
 
-def convert_func(fn: Callable) -> Callable:
+def convert_func(fn: Callable, strict: bool = False) -> Callable:
     """AST-convert ``fn`` (or the underlying function of a bound method);
-    returns ``fn`` unchanged when conversion is unnecessary/impossible."""
+    returns ``fn`` unchanged when conversion is unnecessary/impossible.
+
+    ``strict`` (``to_static(full_graph=True)``): any control-flow
+    construct left unconverted — which would silently fall back to plain
+    Python and fail to stage on a traced predicate — raises instead of
+    passing through.
+    """
     bound_self = getattr(fn, "__self__", None)
     f = fn.__func__ if inspect.ismethod(fn) else fn
     if f in _CONVERTED:
-        conv = _CONVERTED[f]
+        conv, notes = _CONVERTED[f]
     else:
         try:
-            conv = _do_convert(f)
-        except Exception:
-            conv = f
+            conv, notes = _do_convert(f)
+        except Exception as e:
+            conv, notes = f, [f"source conversion failed: {e}"]
         try:
-            _CONVERTED[f] = conv
+            _CONVERTED[f] = (conv, notes)
         except TypeError:
             pass
+    if strict and notes:
+        raise ValueError(
+            f"to_static(full_graph=True): {getattr(f, '__qualname__', f)} "
+            "contains control flow the dy2static converter cannot stage "
+            "(it would run as plain Python and break on traced "
+            "predicates):\n  - " + "\n  - ".join(notes))
     if conv is f:
         return fn
     if bound_self is not None:
@@ -482,19 +797,29 @@ def convert_func(fn: Callable) -> Callable:
     return conv
 
 
-def _do_convert(f: Callable) -> Callable:
+def _do_convert(f: Callable):
     import types
 
     src = textwrap.dedent(inspect.getsource(f))
     tree = ast.parse(src)
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        return f
+        return f, []
     fdef.decorator_list = []
+    if _has_return(fdef.body):
+        # make the implicit fall-off-the-end None-return explicit, then
+        # fold post-if statements into the non-returning branches so
+        # every return is tail-position (return_transformer.py analog)
+        body = list(fdef.body)
+        if not _always_returns(body):
+            body = body + [ast.copy_location(
+                ast.Return(ast.Constant(None)), fdef.body[-1])]
+        fdef.body = _residualize(body)
+        ast.fix_missing_locations(tree)
     tr = _ControlFlowTransformer()
     tree = tr.visit(tree)
     if not tr.changed:
-        return f
+        return f, tr.notes
 
     # compile inside a factory whose params mirror the original free
     # variables, so the converted code object keeps them as freevars; the
@@ -522,9 +847,10 @@ def _do_convert(f: Callable) -> Callable:
     import paddle_tpu.jit.dy2static as _jst_mod
     glb = getattr(f, "__globals__", None)
     if glb is None:
-        return f
+        return f, tr.notes
     if glb.get(_JST_NAME, _jst_mod) is not _jst_mod:
-        return f  # user global with our name: don't clobber, don't convert
+        # user global with our name: don't clobber, don't convert
+        return f, tr.notes + ["module global shadows the converter"]
     glb[_JST_NAME] = _jst_mod
 
     cellmap = dict(zip(freevars, f.__closure__ or ()))
@@ -535,4 +861,4 @@ def _do_convert(f: Callable) -> Callable:
     new.__dict__.update(getattr(f, "__dict__", {}))
     new.__qualname__ = f.__qualname__
     new.__wrapped_dy2static__ = f
-    return new
+    return new, tr.notes

@@ -1,0 +1,231 @@
+"""dy2static break/continue + mid-branch-return conversion (round 4).
+
+Mirrors the reference's dygraph_to_static test shapes
+(`unittests/dygraph_to_static/test_break_continue.py`, `test_return.py`):
+every function runs twice — eager (ground truth is plain Python) and
+under ``paddle.jit.to_static`` with a TRACED tensor predicate — and the
+two must agree.  Staging is verified by running the converted function
+inside ``jax.jit`` where a Python-level break on a tensor predicate
+would raise a TracerBoolConversionError.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_func
+
+
+def _check_traced(fn, *args, expect=None):
+    """convert + run eagerly, then run the CONVERTED fn under jax.jit
+    (forcing every tensor predicate to be a tracer)."""
+    import jax
+
+    conv = convert_func(fn)
+    eager = fn(*[paddle.to_tensor(a) for a in args])
+    got = conv(*[paddle.to_tensor(a) for a in args])
+    np.testing.assert_allclose(np.asarray(got._value),
+                               np.asarray(eager._value), rtol=1e-6)
+
+    def jitted(*vals):
+        out = conv(*[paddle.Tensor(v) for v in vals])
+        return out._value
+
+    stag = jax.jit(jitted)(*[np.asarray(a) for a in args])
+    np.testing.assert_allclose(np.asarray(stag),
+                               np.asarray(eager._value), rtol=1e-6)
+    if expect is not None:
+        np.testing.assert_allclose(np.asarray(stag), expect, rtol=1e-6)
+    return conv
+
+
+# -- break ------------------------------------------------------------
+
+def test_break_in_while_on_tensor_pred():
+    def f(x):
+        i = paddle.to_tensor(np.int64(0))
+        while i < 10:
+            if x + i > 7:       # tensor-dependent break
+                break
+            x = x + 1
+            i = i + 1
+        return x
+
+    _check_traced(f, np.int64(3))          # 3,4,5 -> breaks at x=6,i=3? -> runs
+
+
+def test_continue_in_while():
+    def f(x):
+        i = paddle.to_tensor(np.int64(0))
+        s = paddle.to_tensor(np.int64(0))
+        while i < 6:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            s = s + i           # odd i only: 1 + 3 + 5
+        return s + x
+
+    _check_traced(f, np.int64(0), expect=9)
+
+
+def test_break_in_for_range():
+    def f(x):
+        for i in range(10):
+            if x > 5:
+                break
+            x = x + 1
+        return x
+
+    _check_traced(f, np.int64(0), expect=6)
+
+
+def test_continue_in_for_range():
+    def f(x):
+        s = x * 0
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+
+    _check_traced(f, np.int64(0), expect=9)
+
+
+def test_break_after_statements_guarded():
+    """Statements after the breaking if must not run once the flag is
+    set — the guard wraps the remainder of the body."""
+    def f(x):
+        for i in range(5):
+            if x > 2:
+                break
+            x = x + 1
+            x = x + 10 * (x > 100)   # never fires; placement probe
+        return x
+
+    _check_traced(f, np.int64(0), expect=3)
+
+
+def test_while_else_runs_without_break():
+    def f(x):
+        i = paddle.to_tensor(np.int64(0))
+        while i < 3:
+            i = i + 1
+        else:
+            x = x + 100
+        return x + i
+
+    _check_traced(f, np.int64(0), expect=103)
+
+
+def test_for_else_skipped_on_break():
+    def f(x):
+        for i in range(5):
+            if i >= x:          # tensor break -> else must be skipped
+                break
+        else:
+            x = x + 100
+        return x
+
+    _check_traced(f, np.int64(2), expect=2)
+
+
+def test_nested_loop_inner_break_binds_inner():
+    def f(x):
+        s = x * 0
+        for i in range(3):
+            j = paddle.to_tensor(np.int64(0))
+            while j < 10:
+                if j >= i:
+                    break
+                j = j + 1
+            s = s + j           # j == i each round: 0 + 1 + 2
+        return s
+
+    _check_traced(f, np.int64(0), expect=3)
+
+
+# -- mid-branch return ------------------------------------------------
+
+def test_early_return_folds_rest():
+    def f(x):
+        if x > 5:
+            return x * 2
+        x = x + 1
+        return x * 3
+
+    _check_traced(f, np.int64(7), expect=14)
+    _check_traced(f, np.int64(1), expect=6)
+
+
+def test_early_return_without_trailing_return():
+    def f(x):
+        if x > 5:
+            return x * 2
+        x = x + 1
+        return x
+
+    _check_traced(f, np.int64(1), expect=2)
+
+
+def test_nested_early_returns():
+    def f(x):
+        if x > 10:
+            if x > 20:
+                return x
+            return x + 1
+        x = x + 2
+        return x
+
+    _check_traced(f, np.int64(25), expect=25)
+    _check_traced(f, np.int64(15), expect=16)
+    _check_traced(f, np.int64(1), expect=3)
+
+
+def test_return_in_one_branch_only():
+    def f(x):
+        if x > 5:
+            return x * 2
+        else:
+            x = x + 1
+        return x + 10
+
+    _check_traced(f, np.int64(7), expect=14)
+    _check_traced(f, np.int64(1), expect=12)
+
+
+# -- full_graph loudness ----------------------------------------------
+
+def test_full_graph_raises_on_return_in_loop():
+    def f(x):
+        for i in range(5):
+            if x > 2:
+                return x        # unconvertible: return inside loop
+            x = x + 1
+        return x
+
+    with pytest.raises(ValueError, match="full_graph"):
+        convert_func(f, strict=True)
+    # non-strict: still callable as plain python
+    out = convert_func(f)(paddle.to_tensor(np.int64(0)))
+    assert int(out._value) == 3
+
+
+def test_full_graph_ok_on_convertible():
+    def f(x):
+        for i in range(4):
+            if i % 2 == 0:
+                continue
+            x = x + i
+        return x
+
+    conv = convert_func(f, strict=True)
+    assert int(conv(paddle.to_tensor(np.int64(0)))._value) == 4
+
+
+def test_to_static_full_graph_kwarg():
+    def g(x):
+        while x < 3:
+            return x            # return in while: unconvertible
+
+    sf = paddle.jit.to_static(g, full_graph=True)
+    with pytest.raises(ValueError, match="full_graph"):
+        sf(paddle.to_tensor(np.int64(0)))
