@@ -26,6 +26,7 @@ from .parallel import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import transpiler  # noqa: F401
 from .entry import CountFilterEntry, ProbabilityEntry  # noqa: F401
 from .spawn import spawn  # noqa: F401
 

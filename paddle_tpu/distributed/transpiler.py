@@ -1,0 +1,88 @@
+"""Legacy DistributeTranspiler surface — LOUD compatibility boundary.
+
+The reference's DistributeTranspiler
+(fluid/transpiler/distribute_transpiler.py:256) rewrites a static
+Program into trainer/pserver Programs by splitting vars and inserting
+send/recv ops.  It was superseded IN THE REFERENCE by the fleet API
+(fleet.init + fleet.distributed_optimizer drive the same PS runtime),
+and this framework has no mutable Program graph to transpile — the PS
+runtime is native (fleet/ps.py, native/ps_core.cc) and SPMD collective
+training is one jitted program (fleet/dist_step.py).
+
+These shims make the boundary explicit: constructing the config works
+(scripts often build it unconditionally), but asking for a transpile
+raises with the migration path instead of an ImportError.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin"]
+
+
+class DistributeTranspilerConfig:
+    """Config container (reference distribute_transpiler.py:171) —
+    attribute-compatible; consumed only by the error message below."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+    sync_mode = None
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+    runtime_split_send_recv = False
+
+
+class HashName:
+    """Placement hash (reference ps_dispatcher.py) — retained for
+    config-compat; the native PS shards by id hash internally."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name if hasattr(v, "name") else str(v))
+                          % len(self._eps)] for v in varlist]
+
+
+class RoundRobin(HashName):
+    def dispatch(self, varlist):
+        return [self._eps[i % len(self._eps)]
+                for i, _ in enumerate(varlist)]
+
+
+class DistributeTranspiler:
+    def __init__(self, config: DistributeTranspilerConfig = None):
+        self._config = config or DistributeTranspilerConfig()
+
+    def _unsupported(self, what: str):
+        raise NotImplementedError(
+            f"DistributeTranspiler.{what}: the legacy Program-transpile "
+            "PS path is not part of the TPU-native build (the reference "
+            "itself superseded it with fleet). Use "
+            "paddle.distributed.fleet: fleet.init(role_maker), "
+            "strategy.a_sync/… toggles, and "
+            "fleet.distributed_optimizer(opt, strategy) — the same "
+            "sync/async/geo PS modes run on the native PS runtime "
+            "(fleet/ps.py + native/ps_core.cc).")
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6170",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6170"):
+        self._unsupported("transpile")
+
+    def get_trainer_program(self, wait_port=True):
+        self._unsupported("get_trainer_program")
+
+    def get_pserver_program(self, endpoint):
+        self._unsupported("get_pserver_program")
+
+    def get_pserver_programs(self, endpoint):
+        self._unsupported("get_pserver_programs")
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        self._unsupported("get_startup_program")

@@ -60,4 +60,10 @@ class _ReaderShim:
 
 reader = _ReaderShim()
 
-__all__ += ["LayerHelper", "load_op_library", "reader"]
+from . import layers  # noqa: F401,E402
+from .layers import (  # noqa: F401,E402
+    match_matrix_tensor, search_pyramid_hash, tree_conv, var_conv_2d)
+
+__all__ += ["LayerHelper", "load_op_library", "reader", "layers",
+            "match_matrix_tensor", "var_conv_2d", "tree_conv",
+            "search_pyramid_hash"]

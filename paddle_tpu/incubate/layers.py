@@ -1,0 +1,320 @@
+"""Niche contrib op families (reference ``fluid/contrib/layers/nn.py``).
+
+The last distance to full §2.3 op coverage: the text-matching /
+tree-structured / hashed-embedding ops used by the reference's search
+and NLP stacks.  LoD inputs follow this repo's dense convention
+(``nn/functional/sequence.py``): padded ``[batch, maxlen, ...]`` plus a
+lengths vector — masked dense computation with static shapes instead of
+ragged offsets (ragged dims cannot tile onto the MXU).
+
+- ``match_matrix_tensor`` — reference operators/match_matrix_tensor_op.cc
+- ``var_conv_2d``         — reference operators/var_conv_2d_op.cc
+- ``tree_conv``           — reference operators/tree_conv_op.cc +
+                            operators/math/tree2col.cc (TBCNN continuous
+                            binary tree convolution)
+- ``search_pyramid_hash`` — reference operators/pyramid_hash_op.cc
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _apply, to_tensor
+
+__all__ = ["match_matrix_tensor", "var_conv_2d", "tree_conv",
+           "search_pyramid_hash"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _lens(v):
+    return v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def match_matrix_tensor(x, y, w, x_lens, y_lens, act=None, name=None):
+    """Semantic matching matrix of two variable-length sequences
+    (reference contrib.layers.match_matrix_tensor,
+    operators/match_matrix_tensor_op.cc: ``out = A @ W @ B.T`` per
+    channel).
+
+    Args:
+        x: ``[B, Sx, h]`` padded query sequences.
+        y: ``[B, Sy, h]`` padded title sequences.
+        w: ``[h, C, h]`` learnable channel tensor (C = channel_num).
+        x_lens, y_lens: ``[B]`` valid lengths.
+
+    Returns:
+        (out ``[B, C, Sx, Sy]`` masked to zero beyond the valid
+        lengths — the dense analog of the reference's per-pair
+        ``x_len*y_len*dim_t`` LoD rows — and tmp ``[B, Sx, C, h]``,
+        the reference's ``Tmp`` = x·W intermediate).
+    """
+    def fn(xv, yv, wv, xl, yl):
+        tmp = jnp.einsum("bsh,hcg->bscg", xv, wv)        # x @ W
+        out = jnp.einsum("bscg,btg->bcst", tmp, yv)      # (xW) @ y.T
+        mx = (jnp.arange(xv.shape[1])[None, :] < xl[:, None])
+        my = (jnp.arange(yv.shape[1])[None, :] < yl[:, None])
+        mask = (mx[:, None, :, None] & my[:, None, None, :])
+        out = jnp.where(mask, out, 0.0)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return out, tmp
+
+    return _apply(fn, _t(x), _t(y), _t(w), _t(x_lens), _t(y_lens),
+                  op_name="match_matrix_tensor", n_outputs=2)
+
+
+def var_conv_2d(input, w, row_lens, col_lens, input_channel,
+                output_channel, filter_size, stride=1, act=None,
+                name=None):
+    """Conv2d over a batch of variable-size images (reference
+    contrib.layers.var_conv_2d, operators/var_conv_2d_op.cc).
+
+    The reference packs per-example ``in_c x H_i x W_i`` images into one
+    flat LoD row; dense analog: ``input [B, in_c, Hmax, Wmax]`` with
+    per-example valid ``row_lens``/``col_lens``.  SAME padding with
+    stride (out H = (H-1)//stride + 1, matching the reference's
+    ``(H - 1) / stride + 1``); positions beyond an example's valid
+    extent are zeroed in both input and output.
+
+    ``w``: ``[output_channel, input_channel*kh*kw]`` (the reference's
+    filter layout).
+    """
+    ks = ((filter_size, filter_size) if isinstance(filter_size, int)
+          else tuple(filter_size))
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+
+    def fn(xv, wv, rl, cl):
+        B, Cin, H, W = xv.shape
+        rmask = (jnp.arange(H)[None, :] < rl[:, None])   # [B, H]
+        cmask = (jnp.arange(W)[None, :] < cl[:, None])   # [B, W]
+        m = (rmask[:, None, :, None] & cmask[:, None, None, :])
+        xv = jnp.where(m, xv, 0.0)
+        wk = wv.reshape(output_channel, Cin, ks[0], ks[1])
+        out = jax.lax.conv_general_dilated(
+            xv, wk, window_strides=st, padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        oH = (rl - 1) // st[0] + 1
+        oW = (cl - 1) // st[1] + 1
+        om = ((jnp.arange(out.shape[2])[None, :] < oH[:, None])
+              [:, None, :, None]
+              & (jnp.arange(out.shape[3])[None, :] < oW[:, None])
+              [:, None, None, :])
+        out = jnp.where(om, out, 0.0)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return out
+
+    return _apply(fn, _t(input), _t(w), _t(row_lens), _t(col_lens),
+                  op_name="var_conv_2d")
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2, act="tanh",
+              bias=None, name=None):
+    """Tree-based convolution over continuous binary trees (TBCNN;
+    reference fluid.contrib.layers.tree_conv, operators/tree_conv_op.cc
+    + math/tree2col.cc).
+
+    For each node ``u``, the patch gathers every descendant ``v`` within
+    ``max_depth`` (``depth(u,v) < max_depth``) weighted by the three
+    continuous-position coefficients of math/tree2col.h TreeNode:
+
+        eta_t = (d_f - depth) / d_f
+        eta_l = (1 - eta_t) * (0.5 if pclen == 1 else (idx-1)/(pclen-1))
+        eta_r = (1 - eta_t) * (1 - eta_l)
+
+    where ``idx``/``pclen`` are the node's 1-based position among its
+    siblings and the sibling count (the root uses idx = pclen = 1).
+    The patch ``[F*3]`` (feature-major, (l, r, t) per feature — the
+    reference's interleaved layout) multiplies ``filter`` reshaped to
+    ``[F*3, out*nf]``.
+
+    Args:
+        nodes_vector: ``[B, N, F]`` node features (1-indexed nodes; row
+            0 is the null/padding node).
+        edge_set: ``[B, E, 2]`` int directional (parent, child) edges;
+            rows of zeros are padding.
+        filter: ``[F, 3, output_size, num_filters]``.
+    Returns:
+        ``[B, N, output_size, num_filters]``.
+    """
+    md = float(max_depth)
+
+    def fn(feats, edges, wv, *maybe_b):
+        B, N, F = feats.shape
+        edges = edges.astype(jnp.int32)
+        par, chd = edges[..., 0], edges[..., 1]
+        valid = (par > 0) & (chd > 0)                    # [B, E]
+        # adjacency [B, N+1, N+1] (1-indexed; 0 = null)
+        A = jnp.zeros((B, N + 1, N + 1), jnp.float32)
+        bidx = jnp.arange(B)[:, None].repeat(par.shape[1], 1)
+        A = A.at[bidx, par, chd].add(jnp.where(valid, 1.0, 0.0))
+        A = jnp.minimum(A, 1.0)
+        # per-node sibling position/count from the edge ORDER under its
+        # parent (the reference's tr[u] preserves edge order)
+        order = jnp.cumsum(jnp.where(valid, 1.0, 0.0), axis=1)
+        # index within parent's child list = count of prior edges with
+        # the same parent
+        same_par = (par[:, :, None] == par[:, None, :]) & \
+            valid[:, :, None] & valid[:, None, :]
+        before = jnp.tril(jnp.ones((par.shape[1], par.shape[1])), -1)
+        idx_in_par = jnp.einsum("bej,ej->be", same_par.astype(jnp.float32),
+                                before) + 1.0            # 1-based
+        n_sib = jnp.sum(same_par, axis=2).astype(jnp.float32)
+        node_idx = jnp.ones((B, N + 1), jnp.float32)
+        node_pclen = jnp.ones((B, N + 1), jnp.float32)
+        node_idx = node_idx.at[bidx, chd].set(
+            jnp.where(valid, idx_in_par, 1.0))
+        node_pclen = node_pclen.at[bidx, chd].set(
+            jnp.where(valid, n_sib, 1.0))
+        # depth matrix D[u, v] = path length u->v (trees: unique), as
+        # successive powers of A; reach within depth < max_depth
+        eye = jnp.eye(N + 1)[None].repeat(B, 0)
+        depth = jnp.where(eye > 0, 0.0, jnp.inf)
+        Ak = eye
+        for d in range(1, int(max_depth)):
+            Ak = jnp.einsum("bij,bjk->bik", Ak, A)
+            depth = jnp.where((Ak > 0) & jnp.isinf(depth),
+                              float(d), depth)
+        reach = ~jnp.isinf(depth)
+        dsafe = jnp.where(reach, depth, 0.0)
+        eta_t = (md - dsafe) / md
+        temp = jnp.where(node_pclen == 1.0, 0.5,
+                         (node_idx - 1.0)
+                         / jnp.maximum(node_pclen - 1.0, 1e-9))
+        # the root of each patch (depth 0) uses idx=pclen=1 -> temp=0.5
+        temp_uv = jnp.where(dsafe == 0.0, 0.5, temp[:, None, :])
+        eta_l = (1.0 - eta_t) * temp_uv
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        zero = jnp.zeros_like(eta_t)
+        el = jnp.where(reach, eta_l, zero)
+        er = jnp.where(reach, eta_r, zero)
+        et = jnp.where(reach, eta_t, zero)
+        f1 = jnp.concatenate(
+            [jnp.zeros((B, 1, F), feats.dtype), feats], axis=1)
+        patch_l = jnp.einsum("buv,bvf->buf", el, f1)
+        patch_r = jnp.einsum("buv,bvf->buf", er, f1)
+        patch_t = jnp.einsum("buv,bvf->buf", et, f1)
+        # reference layout: per feature the 3 slots are (l, r, t)
+        patch = jnp.stack([patch_l, patch_r, patch_t],
+                          axis=-1).reshape(B, N + 1, F * 3)[:, 1:]
+        wm = wv.reshape(F * 3, -1)
+        out = patch @ wm
+        out = out.reshape(B, N, wv.shape[2], wv.shape[3])
+        if maybe_b:
+            out = out + maybe_b[0]
+        if act == "tanh":
+            out = jnp.tanh(out)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        elif act is not None:
+            raise ValueError(f"unsupported act {act!r}")
+        return out
+
+    args = [_t(nodes_vector), _t(edge_set), _t(filter)]
+    if bias is not None:
+        args.append(_t(bias))
+    return _apply(fn, *args, op_name="tree_conv")
+
+
+def _fnv1a(data: np.ndarray, seed: int) -> int:
+    """Deterministic n-gram hash.  The reference uses XXH32
+    (pyramid_hash_op.cc:229) over the ids reinterpreted as floats; the
+    CONTRACT is any fixed deterministic hash of (ngram, seed) — bit
+    parity with xxhash is not part of the op's semantics (embeddings
+    are random projections either way)."""
+    h = (0xcbf29ce484222325 ^ (seed * 0x9e3779b9 + 1)) & 0xffffffffffffffff
+    for v in data:
+        h = ((h ^ (int(v) & 0xffffffffffffffff))
+             * 0x100000001b3) & 0xffffffffffffffff
+    return h & 0x7fffffff
+
+
+def search_pyramid_hash(input, w, lengths, num_emb, space_len,
+                        pyramid_layer, rand_len, drop_out_percent=0.0,
+                        is_training=False, seed=1, white_list=None,
+                        black_list=None, name=None):
+    """Pyramid hash embedding (reference contrib.layers.
+    search_pyramid_hash, operators/pyramid_hash_op.cc).
+
+    For every n-gram of length 2..pyramid_layer in each sequence, hash
+    the id-span with per-block seeds and gather ``rand_len`` consecutive
+    rows of ``w`` per block to form a ``num_emb``-wide embedding
+    (``num_emb % rand_len == 0`` blocks).  Output rows are per-n-gram,
+    like the reference's LoD output; dense analog: ``[B, G, num_emb]``
+    padded over the max n-gram count plus a per-example count vector.
+
+    ``white_list``/``black_list``: optional id sets (the reference's
+    bloom filters); an n-gram is kept iff its hash is in the white list
+    (when given) and not in the black list.  Training dropout keeps an
+    n-gram with probability ``1 - drop_out_percent`` (host RNG seeded
+    with ``seed``, like the reference's rand_r chain).
+
+    Host-side op (hashing is inherently scalar); the embedding GATHER
+    runs on device.  Not differentiable w.r.t. ``w`` by design parity:
+    the reference sets ``w.stop_gradient = True``.
+    """
+    if num_emb % rand_len:
+        raise ValueError(f"num_emb {num_emb} must be divisible by "
+                         f"rand_len {rand_len}")
+    ids = np.asarray(input._value if isinstance(input, Tensor) else input)
+    ls = np.asarray(_lens(lengths))
+    B, S = ids.shape
+    rng = np.random.RandomState(seed)
+    wl = set(int(x) for x in np.asarray(white_list).reshape(-1)) \
+        if white_list is not None else None
+    bl = set(int(x) for x in np.asarray(black_list).reshape(-1)) \
+        if black_list is not None else None
+
+    grams, counts = [], []
+    for b in range(B):
+        rows = []
+        wlen = int(ls[b])
+        if wlen >= 2:
+            for ilayer in range(1, min(pyramid_layer, wlen)):
+                for l in range(wlen - ilayer):
+                    span = ids[b, l:l + ilayer + 1]
+                    key = _fnv1a(span, 777)
+                    if wl is not None and key % (1 << 20) not in wl:
+                        continue
+                    if bl is not None and key % (1 << 20) in bl:
+                        continue
+                    if is_training and drop_out_percent > 0.0 and \
+                            rng.rand() < drop_out_percent:
+                        continue
+                    pos = [_fnv1a(span, j) % space_len
+                           for j in range(0, num_emb, rand_len)]
+                    rows.append(pos)
+        counts.append(len(rows))
+        grams.append(rows)
+    G = max(max(counts), 1)
+    pos_arr = np.zeros((B, G, num_emb // rand_len), np.int32)
+    for b, rows in enumerate(grams):
+        for g, pos in enumerate(rows):
+            pos_arr[b, g] = pos
+
+    def fn(wv, posv, cnts):
+        # gather rand_len consecutive rows of w per block and flatten
+        offs = jnp.arange(rand_len)
+        rows = wv[:, 0][posv[..., None] + offs[None, None, None, :]]
+        out = rows.reshape(rows.shape[0], rows.shape[1], num_emb)
+        keep = (jnp.arange(out.shape[1])[None, :] < cnts[:, None])
+        return jnp.where(keep[..., None], out, 0.0)
+
+    out = _apply(fn, _t(w), Tensor(jnp.asarray(pos_arr)),
+                 Tensor(jnp.asarray(np.asarray(counts, np.int32))),
+                 op_name="search_pyramid_hash")
+    return out, to_tensor(np.asarray(counts, np.int64))

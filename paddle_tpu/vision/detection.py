@@ -41,7 +41,7 @@ def _iou_matrix(a, b):
 __all__ = ["anchor_generator", "density_prior_box", "bipartite_match",
            "detection_output", "generate_proposals", "box_clip",
            "distribute_fpn_proposals", "collect_fpn_proposals",
-           "deformable_psroi_pooling"]
+           "deformable_psroi_pooling", "psroi_pool", "detection_map"]
 
 
 # ---------------------------------------------------------------------
@@ -507,3 +507,210 @@ def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
 
     out = jax.vmap(one)(rv, tv)
     return Tensor(out)
+
+
+# ---------------------------------------------------------------------
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None):
+    """Position-sensitive RoI average pooling (R-FCN; parity:
+    paddle.vision.ops.psroi_pool, operators/psroi_pool_op.h — the PLAIN
+    variant; the deformable one is ``deformable_psroi_pooling``).
+
+    Input channels must equal ``output_channels * ph * pw``; output bin
+    ``(c, i, j)`` averages input channel ``(c*ph + i)*pw + j`` over the
+    integer bin window of the rounded, scaled roi (exact reference bin
+    arithmetic: round ends, +1 on the far corner, floor/ceil bins,
+    clipped to the map; empty bins yield 0).
+
+    Args:
+        x: ``[N, C, H, W]``; boxes ``[R, 4]`` (x1, y1, x2, y2);
+        boxes_num ``[N]`` rois per image.
+    Returns:
+        ``[R, output_channels, pooled_height, pooled_width]``.
+    """
+    from .ops import _rois_with_batch
+    xt, bt = _t(x), _t(boxes)
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+    if xt.shape[1] != oc * ph * pw:
+        raise ValueError(
+            f"psroi_pool: input channels {xt.shape[1]} != "
+            f"output_channels*ph*pw = {oc}*{ph}*{pw}")
+    roi_batch = _rois_with_batch(bt, boxes_num, xt.shape[0])
+
+    def fn(xv, rv):
+        N, C, H, W = xv.shape
+        sw = jnp.round(rv[:, 0]) * spatial_scale
+        sh = jnp.round(rv[:, 1]) * spatial_scale
+        ew = (jnp.round(rv[:, 2]) + 1.0) * spatial_scale
+        eh = (jnp.round(rv[:, 3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(eh - sh, 0.1)
+        rw = jnp.maximum(ew - sw, 0.1)
+        bh = rh / ph
+        bw = rw / pw
+        iy = jnp.arange(ph, dtype=xv.dtype)
+        ix = jnp.arange(pw, dtype=xv.dtype)
+        hstart = jnp.clip(jnp.floor(iy[None, :] * bh[:, None]
+                                    + sh[:, None]), 0, H)
+        hend = jnp.clip(jnp.ceil((iy[None, :] + 1) * bh[:, None]
+                                 + sh[:, None]), 0, H)
+        wstart = jnp.clip(jnp.floor(ix[None, :] * bw[:, None]
+                                    + sw[:, None]), 0, W)
+        wend = jnp.clip(jnp.ceil((ix[None, :] + 1) * bw[:, None]
+                                 + sw[:, None]), 0, W)
+        hh = jnp.arange(H, dtype=xv.dtype)
+        ww = jnp.arange(W, dtype=xv.dtype)
+        mh = ((hh[None, None, :] >= hstart[:, :, None])
+              & (hh[None, None, :] < hend[:, :, None])).astype(xv.dtype)
+        mw = ((ww[None, None, :] >= wstart[:, :, None])
+              & (ww[None, None, :] < wend[:, :, None])).astype(xv.dtype)
+        xg = xv[roi_batch].reshape(rv.shape[0], oc, ph, pw, H, W)
+        s = jnp.einsum("rcijhw,rih,rjw->rcij", xg, mh, mw)
+        area = ((hend - hstart)[:, None, :, None]
+                * (wend - wstart)[:, None, None, :])
+        return jnp.where(area > 0, s / jnp.maximum(area, 1.0), 0.0)
+
+    return _apply_det(fn, xt, bt, op_name="psroi_pool")
+
+
+def _apply_det(fn, *args, op_name):
+    from ..framework.core import _apply
+    return _apply(fn, *args, op_name=op_name)
+
+
+def detection_map(detect_res, gt_label, gt_box, gt_difficult=None,
+                  class_num=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", state=None):
+    """Detection mAP metric (parity: fluid.layers.detection_map,
+    operators/detection_map_op.h — VOC-style matching + integral or
+    11-point average precision).
+
+    Host-side metric op (the reference kernel is CPU-only too): inputs
+    are per-image LISTS (the dense analog of its LoD rows).
+
+    Args:
+        detect_res: list of ``[m_i, 6]`` arrays ``(label, score, x1, y1,
+            x2, y2)`` per image.
+        gt_label / gt_box: lists of ``[n_i]`` labels and ``[n_i, 4]``
+            boxes per image; ``gt_difficult`` optional matching lists of
+            0/1 flags.
+        state: optional ``(label_pos_count, true_pos, false_pos)`` dicts
+            from a previous call — the reference's accumulative
+            AccumPosCount/AccumTruePos/AccumFalsePos streaming state.
+    Returns:
+        (mAP float, new_state) — feed ``new_state`` back to accumulate
+        across batches like the reference's DetectionMAP evaluator.
+    """
+    import numpy as _np
+
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    if state is not None:
+        pos_count = {k: int(v) for k, v in state[0].items()}
+        true_pos = {k: list(v) for k, v in state[1].items()}
+        false_pos = {k: list(v) for k, v in state[2].items()}
+    else:
+        pos_count, true_pos, false_pos = {}, {}, {}
+
+    B = len(detect_res)
+    for n in range(B):
+        gl = _np.asarray(gt_label[n]).reshape(-1).astype(int)
+        gb = _np.asarray(gt_box[n]).reshape(-1, 4).astype(float)
+        gd = (_np.asarray(gt_difficult[n]).reshape(-1).astype(int)
+              if gt_difficult is not None
+              else _np.zeros(gl.shape[0], int))
+        for lab in set(gl.tolist()):
+            cnt = int((gl == lab).sum()) if evaluate_difficult else \
+                int(((gl == lab) & (gd == 0)).sum())
+            if cnt:
+                pos_count[lab] = pos_count.get(lab, 0) + cnt
+        det = _np.asarray(detect_res[n]).reshape(-1, 6).astype(float)
+        for lab in set(det[:, 0].astype(int).tolist()):
+            rows = det[det[:, 0].astype(int) == lab]
+            gsel = _np.where(gl == lab)[0]
+            if gsel.size == 0:
+                for r in rows:
+                    true_pos.setdefault(lab, []).append((r[1], 0))
+                    false_pos.setdefault(lab, []).append((r[1], 1))
+                continue
+            order = _np.argsort(-rows[:, 1])
+            visited = [False] * gsel.size
+            for r in rows[order]:
+                best, bj = -1.0, -1
+                box = _np.clip(r[2:6], 0.0, None)
+                for j, gi in enumerate(gsel):
+                    ov = _iou(box, gb[gi])
+                    if ov > best:
+                        best, bj = ov, j
+                if best > overlap_threshold:
+                    if (not evaluate_difficult) and gd[gsel[bj]]:
+                        continue   # difficult gt: ignored entirely
+                    if not visited[bj]:
+                        visited[bj] = True
+                        true_pos.setdefault(lab, []).append((r[1], 1))
+                        false_pos.setdefault(lab, []).append((r[1], 0))
+                    else:
+                        true_pos.setdefault(lab, []).append((r[1], 0))
+                        false_pos.setdefault(lab, []).append((r[1], 1))
+                else:
+                    true_pos.setdefault(lab, []).append((r[1], 0))
+                    false_pos.setdefault(lab, []).append((r[1], 1))
+
+    mAP, count = 0.0, 0
+    for lab, npos in pos_count.items():
+        # NOTE deliberate deviation: the reference kernel compares the
+        # POSITIVE COUNT to background_label (detection_map_op.h
+        # CalcMAP "label_num_pos == background_label") — an upstream
+        # slip that would drop any class with exactly that many boxes
+        # while still averaging the background class in.  mAP here
+        # skips the background CLASS, which is what the surrounding
+        # SSD/VOC pipeline intends.
+        if lab == background_label:
+            continue
+        if lab not in true_pos:
+            count += 1
+            continue
+        tp = sorted(true_pos[lab], key=lambda p: -p[0])
+        fp = sorted(false_pos[lab], key=lambda p: -p[0])
+        tp_sum = _np.cumsum([v for _, v in tp])
+        fp_sum = _np.cumsum([v for _, v in fp])
+        prec = tp_sum / _np.maximum(tp_sum + fp_sum, 1e-12)
+        rec = tp_sum / float(npos)
+        if ap_version == "11point":
+            maxp = _np.zeros(11)
+            start = len(rec) - 1
+            for j in range(10, -1, -1):
+                i = start
+                while i >= 0:
+                    if rec[i] < j / 10.0:
+                        start = i
+                        if j > 0:
+                            maxp[j - 1] = maxp[j]
+                        break
+                    if maxp[j] < prec[i]:
+                        maxp[j] = prec[i]
+                    i -= 1
+            mAP += maxp.sum() / 11.0
+            count += 1
+        elif ap_version == "integral":
+            ap, prev = 0.0, 0.0
+            for p, r in zip(prec, rec):
+                if abs(r - prev) > 1e-6:
+                    ap += p * abs(r - prev)
+                prev = r
+            mAP += ap
+            count += 1
+        else:
+            raise ValueError(f"unknown ap_version {ap_version!r}; use "
+                             "'integral' or '11point'")
+    mAP = mAP / count if count else 0.0
+    return mAP, (pos_count, true_pos, false_pos)

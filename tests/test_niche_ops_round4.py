@@ -1,0 +1,264 @@
+"""Round-4 niche op tail: match_matrix_tensor, var_conv_2d, tree_conv,
+search_pyramid_hash, plain psroi_pool, detection_map, and the loud
+DistributeTranspiler boundary.  Each numeric op is checked against an
+independent numpy reference implementing the reference kernel's
+arithmetic (operators/match_matrix_tensor_op.cc, var_conv_2d_op.cc,
+tree_conv_op.cc + math/tree2col.cc, psroi_pool_op.h,
+detection_map_op.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_match_matrix_tensor_numpy_ref():
+    rng = np.random.RandomState(0)
+    B, Sx, Sy, h, C = 2, 5, 4, 3, 2
+    x = rng.randn(B, Sx, h).astype(np.float32)
+    y = rng.randn(B, Sy, h).astype(np.float32)
+    w = rng.randn(h, C, h).astype(np.float32)
+    xl = np.array([5, 3], np.int64)
+    yl = np.array([2, 4], np.int64)
+
+    from paddle_tpu.incubate import match_matrix_tensor
+    out, tmp = match_matrix_tensor(x, y, w, xl, yl)
+    ov = np.asarray(out._value)
+    assert ov.shape == (B, C, Sx, Sy)
+    # reference arithmetic per valid (b, c, i, j): x_i @ W_c @ y_j
+    for b in range(B):
+        for c in range(C):
+            for i in range(Sx):
+                for j in range(Sy):
+                    want = (x[b, i] @ w[:, c, :] @ y[b, j]
+                            if i < xl[b] and j < yl[b] else 0.0)
+                    np.testing.assert_allclose(ov[b, c, i, j], want,
+                                               rtol=1e-4, atol=1e-5)
+    assert np.asarray(tmp._value).shape == (B, Sx, C, h)
+
+
+def test_match_matrix_tensor_grad_flows():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(1, 4, 3).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(3, 2, 3).astype(np.float32))
+    w.stop_gradient = False
+    from paddle_tpu.incubate import match_matrix_tensor
+    out, _ = match_matrix_tensor(
+        x, x, w, np.array([4]), np.array([4]))
+    out.sum().backward()
+    assert w.grad is not None and np.isfinite(
+        np.asarray(w.grad._value)).all()
+
+
+def test_var_conv_2d_matches_masked_conv():
+    rng = np.random.RandomState(0)
+    B, Cin, Cout, H, W = 2, 2, 3, 6, 7
+    x = rng.randn(B, Cin, H, W).astype(np.float32)
+    w = rng.randn(Cout, Cin * 3 * 3).astype(np.float32)
+    rows = np.array([6, 4], np.int64)
+    cols = np.array([5, 7], np.int64)
+
+    from paddle_tpu.incubate import var_conv_2d
+    out = var_conv_2d(x, w, rows, cols, Cin, Cout, [3, 3], stride=1)
+    ov = np.asarray(out._value)
+    # numpy reference: zero-pad SAME conv over the masked input
+    import jax
+    import jax.numpy as jnp
+    xm = x.copy()
+    for b in range(B):
+        xm[b, :, rows[b]:, :] = 0.0
+        xm[b, :, :, cols[b]:] = 0.0
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(xm), jnp.asarray(w.reshape(Cout, Cin, 3, 3)),
+        (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    for b in range(B):
+        oh, ow = rows[b], cols[b]   # stride 1: out size == in size
+        np.testing.assert_allclose(ov[b, :, :oh, :ow],
+                                   ref[b, :, :oh, :ow], rtol=1e-4,
+                                   atol=1e-5)
+        assert np.abs(ov[b, :, oh:, :]).sum() == 0.0
+        assert np.abs(ov[b, :, :, ow:]).sum() == 0.0
+
+
+def _tree_conv_numpy_ref(feats, edges, W, max_depth):
+    """Direct transcription of math/tree2col.cc construct_patch +
+    TreeNode eta coefficients (1-indexed nodes, DFS with visited set)."""
+    B, N, F = feats.shape
+    out = np.zeros((B, N, W.shape[2], W.shape[3]), np.float32)
+    Wm = W.reshape(F * 3, -1)
+    for b in range(B):
+        tr = {}
+        for (u, v) in edges[b]:
+            u, v = int(u), int(v)
+            if u != 0 and v != 0:
+                tr.setdefault(u, []).append(v)
+            else:
+                break
+        n_nodes = N
+        for root in range(1, n_nodes + 1):
+            # patch via DFS like construct_patch
+            stack = [[root, 1, 1, 0]]
+            patch = [(root, 1, 1, 0)]
+            visited = {root}
+            while stack:
+                node, idx, pclen, depth = stack[-1]
+                children = tr.get(node, [])
+                advanced = False
+                for i, v in enumerate(children):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append([v, i, len(children), depth + 1])
+                        patch.append((v, i + 1, len(children), depth + 1))
+                        advanced = True
+                if not advanced:
+                    stack.pop()
+            vec = np.zeros(F * 3, np.float32)
+            md = float(max_depth)
+            for (node, idx, pclen, depth) in patch:
+                eta_t = (md - depth) / md
+                temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1 - eta_t) * temp
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                f = feats[b, node - 1]
+                vec[0::3] += eta_l * f
+                vec[1::3] += eta_r * f
+                vec[2::3] += eta_t * f
+            out[b, root - 1] = (vec @ Wm).reshape(W.shape[2], W.shape[3])
+    return out
+
+
+def test_tree_conv_numpy_ref():
+    rng = np.random.RandomState(0)
+    B, N, F, OS, NF, MD = 2, 6, 4, 3, 2, 2
+    feats = rng.randn(B, N, F).astype(np.float32)
+    # tree: 1 -> (2, 3), 2 -> (4, 5); node 6 isolated; batch 1 chain
+    edges = np.zeros((B, 6, 2), np.int32)
+    edges[0, :4] = [(1, 2), (1, 3), (2, 4), (2, 5)]
+    edges[1, :3] = [(1, 2), (2, 3), (3, 4)]
+    W = rng.randn(F, 3, OS, NF).astype(np.float32)
+
+    from paddle_tpu.incubate import tree_conv
+    out = tree_conv(feats, edges, W, max_depth=MD, act=None)
+    want = _tree_conv_numpy_ref(feats, edges, W, MD)
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_tree_conv_depth3():
+    rng = np.random.RandomState(3)
+    feats = rng.randn(1, 5, 3).astype(np.float32)
+    edges = np.zeros((1, 4, 2), np.int32)
+    edges[0, :4] = [(1, 2), (2, 3), (3, 4), (4, 5)]   # deep chain
+    W = rng.randn(3, 3, 2, 1).astype(np.float32)
+    from paddle_tpu.incubate import tree_conv
+    out = tree_conv(feats, edges, W, max_depth=3, act="tanh")
+    want = np.tanh(_tree_conv_numpy_ref(feats, edges, W, 3))
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_search_pyramid_hash_shapes_and_determinism():
+    from paddle_tpu.incubate import search_pyramid_hash
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 1000, (2, 6)).astype(np.int32)
+    lens = np.array([6, 3], np.int64)
+    w = rng.randn(128 + 16, 1).astype(np.float32)
+    out, counts = search_pyramid_hash(
+        ids, w, lens, num_emb=32, space_len=128, pyramid_layer=3,
+        rand_len=16)
+    ov = np.asarray(out._value)
+    cv = np.asarray(counts._value)
+    # n-grams of len 2..3: seq of 6 -> 5 + 4 = 9; seq of 3 -> 2 + 1 = 3
+    assert cv.tolist() == [9, 3]
+    assert ov.shape == (2, 9, 32)
+    assert np.abs(ov[1, 3:]).sum() == 0.0      # padded rows zeroed
+    # deterministic
+    out2, _ = search_pyramid_hash(
+        ids, w, lens, num_emb=32, space_len=128, pyramid_layer=3,
+        rand_len=16)
+    np.testing.assert_array_equal(ov, np.asarray(out2._value))
+    # embeddings really index w: every nonzero row is made of w entries
+    assert np.isin(ov[0, 0].round(6),
+                   w[:, 0].round(6)).all()
+
+
+def test_psroi_pool_numpy_ref():
+    rng = np.random.RandomState(0)
+    N, OC, PH, PW, H, W = 1, 2, 2, 2, 8, 8
+    C = OC * PH * PW
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 3.0, 3.0],
+                      [2.0, 2.0, 7.0, 7.0]], np.float32)
+    boxes_num = np.array([2], np.int64)
+
+    from paddle_tpu.vision.detection import psroi_pool
+    out = psroi_pool(x, boxes, boxes_num, OC, spatial_scale=1.0,
+                     pooled_height=PH, pooled_width=PW)
+    ov = np.asarray(out._value)
+    assert ov.shape == (2, OC, PH, PW)
+
+    # reference arithmetic (psroi_pool_op.h)
+    for r, roi in enumerate(boxes):
+        sw = round(roi[0]) * 1.0
+        sh = round(roi[1]) * 1.0
+        ew = (round(roi[2]) + 1.0)
+        eh = (round(roi[3]) + 1.0)
+        bh = max(eh - sh, 0.1) / PH
+        bw = max(ew - sw, 0.1) / PW
+        for c in range(OC):
+            for i in range(PH):
+                for j in range(PW):
+                    hs = int(np.clip(np.floor(i * bh + sh), 0, H))
+                    he = int(np.clip(np.ceil((i + 1) * bh + sh), 0, H))
+                    ws = int(np.clip(np.floor(j * bw + sw), 0, W))
+                    we = int(np.clip(np.ceil((j + 1) * bw + sw), 0, W))
+                    ch = (c * PH + i) * PW + j
+                    if he <= hs or we <= ws:
+                        want = 0.0
+                    else:
+                        want = x[0, ch, hs:he, ws:we].mean()
+                    np.testing.assert_allclose(ov[r, c, i, j], want,
+                                               rtol=1e-4, atol=1e-5)
+
+
+def test_detection_map_perfect_and_miss():
+    from paddle_tpu.vision.detection import detection_map
+    gt_label = [np.array([1, 2])]
+    gt_box = [np.array([[0, 0, 10, 10], [20, 20, 30, 30]], float)]
+    # perfect detections
+    det = [np.array([[1, 0.9, 0, 0, 10, 10],
+                     [2, 0.8, 20, 20, 30, 30]], float)]
+    mAP, state = detection_map(det, gt_label, gt_box)
+    assert mAP == pytest.approx(1.0)
+    # a miss + a false positive
+    det2 = [np.array([[1, 0.9, 50, 50, 60, 60]], float)]
+    mAP2, _ = detection_map(det2, gt_label, gt_box)
+    assert mAP2 == pytest.approx(0.0)
+    # accumulation across batches (streaming state like the reference)
+    mAP3, state = detection_map(det, gt_label, gt_box, state=state)
+    assert mAP3 == pytest.approx(1.0)
+
+
+def test_detection_map_11point_and_partial():
+    from paddle_tpu.vision.detection import detection_map
+    gt_label = [np.array([1, 1])]
+    gt_box = [np.array([[0, 0, 10, 10], [20, 20, 30, 30]], float)]
+    det = [np.array([[1, 0.9, 0, 0, 10, 10],       # TP
+                     [1, 0.8, 50, 50, 60, 60]], float)]  # FP
+    m_int, _ = detection_map(det, gt_label, gt_box, ap_version="integral")
+    # recall reaches 0.5 with precision 1.0 then falls: integral AP = 0.5
+    assert m_int == pytest.approx(0.5)
+    m_11, _ = detection_map(det, gt_label, gt_box, ap_version="11point")
+    # 11-point: max precision 1.0 for recall<=0.5 (6 pts), 0 beyond
+    assert m_11 == pytest.approx(6 / 11.0, abs=1e-6)
+
+
+def test_distribute_transpiler_loud_boundary():
+    from paddle_tpu.distributed.transpiler import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+    cfg = DistributeTranspilerConfig()
+    cfg.slice_var_up = False          # config construction must work
+    t = DistributeTranspiler(cfg)
+    with pytest.raises(NotImplementedError, match="fleet"):
+        t.transpile(0, pservers="127.0.0.1:6170", trainers=2)
+    with pytest.raises(NotImplementedError, match="fleet"):
+        t.get_pserver_program("127.0.0.1:6170")
