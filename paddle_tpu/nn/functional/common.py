@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import functools
+import math
 
 from ...framework.core import Tensor, _apply, to_tensor
 from ...framework.random import split_key
@@ -25,16 +26,59 @@ __all__ = [
 ]
 
 
+def _two_stage_sum0(t):
+    """Hierarchical leading-axis sum: [rows, ...] -> [...].
+
+    XLA's TPU reduction emitter regresses on tall column sums — measured
+    on a v5e, the [32768, H] -> [H] bias/LN-param gradient reductions of
+    a batch-256 BERT-base step cost 19x their batch-128 time (28 ms of
+    pure emitter regression, PERF.md "batch-256 knee").  Splitting into
+    sqrt(rows)-ish blocks keeps both stages on the fast path.  Short
+    columns keep the plain single-stage sum (it is already optimal
+    there)."""
+    rows = t.shape[0]
+    if rows < 8192:
+        return t.sum(axis=0)
+    g = int(math.isqrt(rows))
+    while g > 1 and rows % g:
+        g -= 1
+    if g <= 1:
+        return t.sum(axis=0)
+    return t.reshape(g, rows // g, *t.shape[1:]).sum(axis=1).sum(axis=0)
+
+
+@jax.custom_vjp
+def _bias_add(mat, b):
+    return mat + b
+
+
+def _bias_add_fwd(mat, b):
+    return mat + b, None
+
+
+def _bias_add_bwd(_, dy):
+    db = _two_stage_sum0(
+        dy.astype(jnp.float32).reshape(-1, dy.shape[-1])).astype(dy.dtype)
+    return dy, db
+
+
+# the custom boundary wraps ONLY the elementwise +bias tail — the
+# matmul stays plain HLO (fusable, MXU-scheduled); the backward routes
+# the bias gradient through the two-stage reduction
+_bias_add.defvjp(_bias_add_fwd, _bias_add_bwd)
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b. Reference: operators/matmul_v2_op.* + elementwise_add
-    fused by XLA into one MXU call. Under amp.auto_cast runs in bf16."""
+    fused by XLA into one MXU call. Under amp.auto_cast runs in bf16.
+    The bias gradient reduces hierarchically (see _two_stage_sum0)."""
     from ...amp import maybe_cast_inputs
 
     def f(v, w, *mb):
         v, w = maybe_cast_inputs("linear", v, w)
         out = jnp.matmul(v, w)
         if mb:
-            out = out + mb[0].astype(out.dtype)
+            out = _bias_add(out, mb[0].astype(out.dtype))
         return out
     if bias is not None:
         return _apply(f, x, weight, bias, op_name="linear")
@@ -384,12 +428,41 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         va = jnp.mean((vf - m) * (vf - m), axis=axes, keepdims=True)
         out = (vf - m) * jax.lax.rsqrt(va + epsilon)
         if params:
-            out = out * params[0].astype(jnp.float32) \
-                + params[1].astype(jnp.float32)
+            out = _scale_shift(out, params[0].astype(jnp.float32),
+                               params[1].astype(jnp.float32))
         return out.astype(v.dtype)
     if weight is not None:
         return _apply(f, x, weight, bias, op_name="layer_norm")
     return _apply(f, x, op_name="layer_norm")
+
+
+@jax.custom_vjp
+def _scale_shift(xhat, g, b):
+    return xhat * g + b
+
+
+def _scale_shift_fwd(xhat, g, b):
+    return xhat * g + b, (xhat, g)
+
+
+def _scale_shift_bwd(res, dy):
+    xhat, g = res
+    pshape = g.shape
+    lead = dy.shape[:dy.ndim - g.ndim]
+    rows = 1
+    for d in lead:
+        rows *= d
+    dg = _two_stage_sum0((dy * xhat).reshape(rows, *pshape))
+    db = _two_stage_sum0(dy.reshape(rows, *pshape))
+    return dy * g, dg, db
+
+
+# ONLY the elementwise scale-shift tail sits behind the custom boundary
+# (the normalization itself stays inline for cross-op fusion — a
+# whole-LN custom vjp costs ~3% of a BERT step, PERF.md); the backward
+# routes the [rows, H] -> [H] parameter-gradient column sums through
+# the two-stage reduction (the batch-256 knee fix)
+_scale_shift.defvjp(_scale_shift_fwd, _scale_shift_bwd)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
