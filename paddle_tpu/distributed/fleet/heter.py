@@ -37,6 +37,91 @@ from .ps import SparseTable
 __all__ = ["HeterTrainer", "DeviceCachedTable"]
 
 
+class _NativeCacheDir:
+    """ctypes wrapper over native/cache_dir.cc — the cache DIRECTORY
+    (id->slot, LRU, pins, admission planning) as one C call per
+    transaction.  The r3 profile put the wide&deep residual step time in
+    exactly this bookkeeping (~27k unique-id dict/LRU operations per
+    batch in Python on the 1-core host); the reference keeps the same
+    structure native too (heter_ps/hashtable.h)."""
+
+    def __init__(self, lib, capacity: int):
+        import ctypes
+        self._lib = lib
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.cache_dir_create.restype = ctypes.c_void_p
+        lib.cache_dir_create.argtypes = [ctypes.c_int64]
+        lib.cache_dir_destroy.argtypes = [ctypes.c_void_p]
+        lib.cache_dir_pull.restype = ctypes.c_int64
+        lib.cache_dir_pull.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_int64, ctypes.c_int32,
+            i64p, i64p, i64p, i64p, i64p, i64p, i64p]
+        lib.cache_dir_lookup.restype = ctypes.c_int64
+        lib.cache_dir_lookup.argtypes = [
+            ctypes.c_void_p, i64p, ctypes.c_int64, ctypes.c_int32,
+            i64p, i64p, i64p, i64p]
+        lib.cache_dir_ids_of.argtypes = [ctypes.c_void_p, i64p,
+                                         ctypes.c_int64, i64p]
+        lib.cache_dir_unpin_slots.argtypes = [ctypes.c_void_p, i64p,
+                                              ctypes.c_int64]
+        lib.cache_dir_load.restype = ctypes.c_int64
+        lib.cache_dir_load.argtypes = [ctypes.c_void_p]
+        self._h = lib.cache_dir_create(capacity)
+
+    def __del__(self):
+        try:
+            self._lib.cache_dir_destroy(self._h)
+        except Exception:
+            pass
+
+    def pull(self, ids: np.ndarray, pin: bool):
+        n = len(ids)
+        uniq = np.empty(n, np.int64)
+        inverse = np.empty(n, np.int64)
+        slots = np.empty(n, np.int64)
+        miss_pos = np.empty(n, np.int64)
+        ev_slots = np.empty(n, np.int64)
+        ev_ids = np.empty(n, np.int64)
+        counts = np.empty(3, np.int64)
+        rc = self._lib.cache_dir_pull(
+            self._h, np.ascontiguousarray(ids), n, 1 if pin else 0,
+            uniq, inverse, slots, miss_pos, ev_slots, ev_ids, counts)
+        u, nm, ne = int(counts[0]), int(counts[1]), int(counts[2])
+        if rc != 0:
+            return None, u, nm      # thrash: directory unchanged
+        return (uniq[:u], inverse, slots[:u], miss_pos[:nm],
+                ev_slots[:ne], ev_ids[:ne]), u, nm
+
+    def lookup(self, ids: np.ndarray, unpin: bool):
+        n = len(ids)
+        uniq = np.empty(n, np.int64)
+        inverse = np.empty(n, np.int64)
+        slots = np.empty(n, np.int64)
+        counts = np.empty(1, np.int64)
+        rc = self._lib.cache_dir_lookup(
+            self._h, np.ascontiguousarray(ids), n, 1 if unpin else 0,
+            uniq, inverse, slots, counts)
+        if rc != 0:
+            return None
+        u = int(counts[0])
+        return uniq[:u], inverse, slots[:u]
+
+    def unpin_slots(self, slots: np.ndarray):
+        self._lib.cache_dir_unpin_slots(
+            self._h, np.ascontiguousarray(slots, dtype=np.int64),
+            len(slots))
+
+    def ids_of(self, slots: np.ndarray) -> np.ndarray:
+        out = np.empty(len(slots), np.int64)
+        self._lib.cache_dir_ids_of(
+            self._h, np.ascontiguousarray(slots, dtype=np.int64),
+            len(slots), out)
+        return out
+
+    def load(self) -> int:
+        return int(self._lib.cache_dir_load(self._h))
+
+
 class DeviceCachedTable:
     """Device-resident cache over a host :class:`SparseTable` — the TPU
     analog of the reference's GPU embedding cache
@@ -93,6 +178,18 @@ class DeviceCachedTable:
         # (plain pulls keep pure LRU semantics for pull-only use).
         self._lock = threading.RLock()
         self._pins: Dict[tuple, list] = {}   # uniq-ids key -> [slots, n]
+        # native directory (id->slot/LRU/pins/admission in one C call);
+        # Python bookkeeping below stays as the no-toolchain fallback
+        self._ndir = None
+        import os as _os
+        if _os.environ.get("PADDLE_TPU_DISABLE_NATIVE_CACHE_DIR") != "1":
+            try:
+                from ...native import load_library
+                lib = load_library("cache_dir")
+                if lib is not None:
+                    self._ndir = _NativeCacheDir(lib, self._cap)
+            except Exception:
+                self._ndir = None
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -179,6 +276,8 @@ class DeviceCachedTable:
         so a concurrent pull for the next batch cannot evict rows whose
         gradients are still in flight."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        if self._ndir is not None:
+            return self._pull_native(ids, pin)
         uniq, inverse = np.unique(ids, return_inverse=True)
         with self._lock:
             slots = np.empty(len(uniq), np.int64)
@@ -204,12 +303,81 @@ class DeviceCachedTable:
             self._last = (uniq, slots)  # push() fast path, same batch
             return self._buf[np.asarray(slots)[inverse]]
 
+    def _pull_native(self, ids: np.ndarray, pin: bool):
+        import jax.numpy as jnp
+        with self._lock:
+            ret, n_uniq, n_miss = self._ndir.pull(ids, pin)
+            if ret is None:
+                # stat accounting matches the Python fallback, which
+                # counts the failed batch's hits+misses before _admit
+                # raises
+                self.hits += n_uniq - n_miss
+                self.misses += n_miss
+                raise RuntimeError(
+                    f"device cache thrashing: current batch plus "
+                    f"in-flight (unpushed) batches pin more unique "
+                    f"rows than capacity={self._cap}")
+            uniq, inverse, slots, miss_pos, ev_slots, ev_ids = ret
+            self.hits += len(uniq) - len(miss_pos)
+            self.misses += len(miss_pos)
+            self.evictions += len(ev_slots)
+            if ev_slots.size:
+                # directory entries are gone; write dirty VALUES back
+                # with the ids the native call reported
+                self._write_back_rows(ev_slots, ev_ids)
+            if miss_pos.size:
+                miss_slots = slots[miss_pos]
+                rows = self._table.pull(uniq[miss_pos])
+                sp = self._pad_slots(miss_slots)
+                rows_p = np.zeros((len(sp), self._dim), np.float32)
+                rows_p[:len(miss_slots)] = rows
+                self._buf = self._buf.at[jnp.asarray(sp)].set(
+                    jnp.asarray(rows_p))
+                if self._acc is not None:
+                    self._acc = self._acc.at[jnp.asarray(sp)].set(0.0)
+                self._orig[miss_slots] = rows
+                self._dirty[miss_slots] = False
+            self._last = (uniq, slots)
+            # push() fast path: the async pipeline pushes EXACTLY the
+            # ids it pulled, so the plan can be reused by raw-id match
+            self._last_native = (ids.tobytes(), uniq, inverse, slots)
+            return self._buf[np.asarray(slots)[inverse]]
+
+    def _write_back_rows(self, slots: np.ndarray, ids: np.ndarray):
+        """Write dirty rows among ``slots`` (owned by ``ids``) back to
+        the host table — the native-directory variant of _write_back."""
+        import jax.numpy as jnp
+        m = self._dirty[slots]
+        d = slots[m]
+        if d.size == 0:
+            return
+        dp = self._pad_slots(d)
+        vals = np.asarray(self._buf[jnp.asarray(dp)])[:d.size]
+        self._table.push_delta(np.asarray(ids)[m], vals - self._orig[d])
+        self._orig[d] = vals
+        self._dirty[d] = False
+
     def push(self, ids: np.ndarray, grads):
         """Apply the optimizer on device to the rows of ``ids``;
         duplicate ids' grads are segment-summed first."""
         import jax
         import jax.numpy as jnp
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
+        if self._ndir is not None:
+            with self._lock:
+                ln = getattr(self, "_last_native", None)
+                if ln is not None and ln[0] == ids.tobytes():
+                    _, uniq, inverse, slots = ln
+                    self._ndir.unpin_slots(slots)
+                else:
+                    ret = self._ndir.lookup(ids, unpin=True)
+                    if ret is None:
+                        raise KeyError(
+                            "push() of ids not resident in the device "
+                            "cache")
+                    uniq, inverse, slots = ret
+                self._push_rows(uniq, inverse, slots, grads)
+            return
         uniq, inverse = np.unique(ids, return_inverse=True)
         with self._lock:
             last = getattr(self, "_last", None)
@@ -218,19 +386,25 @@ class DeviceCachedTable:
             else:
                 slots = np.asarray(
                     [self._slot_of[i] for i in uniq.tolist()], np.int64)
-            nseg = self._bucket(max(len(uniq), 1))
-            g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
-                                    jnp.asarray(inverse),
-                                    num_segments=nseg)
-            sl = jnp.asarray(self._pad_slots(np.asarray(slots, np.int64)))
-            if self._opt == "adagrad":
-                self._acc = self._acc.at[sl].add(g * g)
-                step = g / (jnp.sqrt(self._acc[sl]) + self._eps)
-            else:
-                step = g
-            self._buf = self._buf.at[sl].add(-self._lr * step)
-            self._dirty[slots] = True
+            self._push_rows(uniq, inverse, slots, grads)
             self._unpin(uniq)
+
+    def _push_rows(self, uniq, inverse, slots, grads):
+        """Shared device-side optimizer apply (segment-sum + scatter)."""
+        import jax
+        import jax.numpy as jnp
+        nseg = self._bucket(max(len(uniq), 1))
+        g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
+                                jnp.asarray(inverse),
+                                num_segments=nseg)
+        sl = jnp.asarray(self._pad_slots(np.asarray(slots, np.int64)))
+        if self._opt == "adagrad":
+            self._acc = self._acc.at[sl].add(g * g)
+            step = g / (jnp.sqrt(self._acc[sl]) + self._eps)
+        else:
+            step = g
+        self._buf = self._buf.at[sl].add(-self._lr * step)
+        self._dirty[slots] = True
 
     def _unpin(self, uniq: np.ndarray):
         key = uniq.tobytes()
@@ -246,18 +420,36 @@ class DeviceCachedTable:
         reclaim the slots."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
         with self._lock:
-            self._unpin(np.unique(ids))
+            if self._ndir is not None:
+                self._ndir.lookup(ids, unpin=True)
+            else:
+                self._unpin(np.unique(ids))
 
     def flush(self):
         """Write every dirty row back to the host table (the reference's
         PSGPUWrapper::EndPass)."""
         with self._lock:
-            self._write_back(np.flatnonzero(self._dirty).astype(np.int64))
+            dirty = np.flatnonzero(self._dirty).astype(np.int64)
+            if self._ndir is not None:
+                self._write_back_rows(dirty, self._ndir.ids_of(dirty))
+            else:
+                self._write_back(dirty)
 
     end_pass = flush
 
+    def has(self, id_) -> bool:
+        """Residency probe (directory-backend-agnostic)."""
+        with self._lock:
+            if self._ndir is not None:
+                return self._ndir.lookup(
+                    np.asarray([int(id_)], np.int64), unpin=False) \
+                    is not None
+            return int(id_) in self._slot_of
+
     @property
     def load(self) -> float:
+        if self._ndir is not None:
+            return self._ndir.load() / self._cap
         return 1.0 - len(self._free) / self._cap
 
 
@@ -292,9 +484,16 @@ class HeterTrainer:
 
     def _push(self, ids_map, grads: Dict[str, np.ndarray]):
         for name, g in grads.items():
-            self._tables[name].push(
-                np.ascontiguousarray(np.asarray(ids_map[name]), np.int64),
-                np.asarray(g))
+            t = self._tables[name]
+            if not (isinstance(t, DeviceCachedTable)
+                    and hasattr(g, "devices")):
+                # host table: grads land in numpy.  Device-resident
+                # grads feeding a device-resident cache stay on device
+                # (an np.asarray would round-trip the whole grad block
+                # host<->device through the remote tunnel every step).
+                g = np.asarray(g)
+            t.push(np.ascontiguousarray(
+                np.asarray(ids_map[name]), np.int64), g)
         for name in ids_map.keys() - grads.keys():
             # pulled but no grad (frozen/eval-only table): the pin from
             # the async pull must still come off or it leaks forever
