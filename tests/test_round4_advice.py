@@ -174,3 +174,26 @@ def test_vjp_cache_never_serves_under_trace():
         "jitted vjp under tracers)"
     assert _vjp_stats["hits"] == base_hits, \
         "vjp cache hit under an outer trace"
+
+
+def test_backward_inside_traced_region_lazy_vjp():
+    """The lazy-vjp path (ops recorded under an outer trace) must still
+    support an explicit backward() INSIDE the traced region — the
+    GradNode linearizes on demand (framework/core.py _LazyVjp)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.core import Tensor
+
+    def traced(v):
+        t = Tensor(v)
+        t.stop_gradient = False
+        y = (paddle.tanh(t * 2.0) ** 2).sum()
+        y.backward()
+        return t.grad._value
+
+    x = np.linspace(-1.0, 1.0, 8).astype(np.float32)
+    got = jax.jit(traced)(x)
+    want = jax.grad(lambda v: (jax.numpy.tanh(v * 2.0) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
