@@ -452,13 +452,15 @@ class DeviceCachedTable:
         import jax.numpy as jnp
         raw = int(max_ids or self._cap)
         b = 1
-        buckets = []
+        buckets = [1]
         while b < raw:
             b <<= 1
-            if b >= 256:
-                buckets.append(b)
+            buckets.append(b)
         raw_data = jnp.zeros((raw, self._dim), jnp.float32)
-        raw_seg = jnp.zeros(raw, jnp.int32)
+        # dtypes must derive exactly like the serving paths (np.int64
+        # through jnp.asarray — canonicalized identically with or
+        # without x64), or the primed executables miss the cache
+        raw_seg = jnp.asarray(np.zeros(raw, np.int64))
         with self._lock:
             # the pull-side [raw] gather
             _ = self._buf[jnp.asarray(np.full(raw, self._cap, np.int64))]
@@ -470,6 +472,8 @@ class DeviceCachedTable:
                 if self._acc is not None:
                     self._acc = self._acc.at[sp].set(0.0)
                     self._acc = self._acc.at[sp].add(zeros * zeros)
+                # write-back gather (eviction/flush path)
+                _ = self._buf[sp]
                 # push: [raw, dim] grads segment-summed to n buckets,
                 # then the bucketed optimizer apply — the exact shapes
                 # _push_rows compiles
