@@ -37,6 +37,83 @@ from .. import mesh as mesh_mod
 
 __all__ = ["DistributedTrainStep", "param_partition_spec"]
 
+# storage suffix for 8-bit optimizer-state scales ("m" -> "m@scale");
+# "@" cannot collide with real slot names
+_SCALE_SUFFIX = "@scale"
+
+
+def _q8_encode(x):
+    """f32 slot -> (int8 codes, f32 per-row scales) in signed-sqrt space.
+
+    8-bit optimizer state (greenfield; the reference keeps f32 slots —
+    low-precision moments are the VERDICT-named enabler for fitting the
+    7B step on 8 v5e chips).  Linear quantization in sqrt space
+    compresses the dynamic range enough for Adam's second moment: 127
+    levels over sqrt(v) bound the per-row step error at ~2/127.
+    Per-last-dim-row absmax scales keep the blocks aligned with any
+    leading-dim ZeRO sharding; a sharded LAST dim still works (XLA
+    reduces the row max across shards).
+    """
+    y = jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+    s = jnp.maximum(jnp.max(jnp.abs(y), axis=-1), 1e-12) / 127.0
+    q = jnp.round(y / s[..., None]).astype(jnp.int8)
+    return q, s
+
+
+def _q8_decode(q, s):
+    y = q.astype(jnp.float32) * s[..., None]
+    return jnp.sign(y) * (y * y)
+
+
+def _transform_slots(st, pshape, mdt, direction):
+    """THE slot-storage transform (single source of truth for the
+    decode/encode/at-rest-cast paths): param-shaped floating (or int8)
+    leaves convert between f32 working form and the storage dtype;
+    scalar machinery (beta_pow, decay flags) and sub-shaped scale
+    leaves pass through.  ``direction``: "decode" -> f32 working form;
+    "encode"/"storage" -> at-rest form (identical math; "storage"
+    additionally handles ShapeDtypeStruct avals for abstract_init)."""
+    int8_mode = mdt == jnp.int8
+    d = {}
+    for k, v in st.items():
+        if k.endswith(_SCALE_SUFFIX):
+            if direction != "decode":
+                d[k] = v        # already-encoded scale rides along
+            continue
+        param_shaped = (hasattr(v, "shape") and tuple(v.shape) == pshape)
+        if not param_shaped:
+            d[k] = v
+            continue
+        if direction == "decode":
+            if int8_mode and v.dtype == jnp.int8:
+                d[k] = _q8_decode(v, st[k + _SCALE_SUFFIX])
+            elif jnp.issubdtype(v.dtype, jnp.floating):
+                d[k] = v.astype(jnp.float32)
+            else:
+                d[k] = v
+            continue
+        # encode/storage: f32 working form -> at-rest dtype
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            d[k] = v
+        elif isinstance(v, jax.ShapeDtypeStruct):
+            if int8_mode and len(pshape) >= 1:
+                d[k] = jax.ShapeDtypeStruct(v.shape, jnp.int8)
+                d[k + _SCALE_SUFFIX] = jax.ShapeDtypeStruct(
+                    v.shape[:-1], jnp.float32)
+            elif not int8_mode:
+                d[k] = jax.ShapeDtypeStruct(v.shape, mdt)
+            else:
+                d[k] = v
+        elif int8_mode:
+            if len(pshape) >= 1:
+                d[k], d[k + _SCALE_SUFFIX] = _q8_encode(
+                    v.astype(jnp.float32))
+            else:
+                d[k] = v
+        else:
+            d[k] = v.astype(mdt)
+    return d
+
 
 def _tree_to_tensors(obj):
     # jit's helper wraps jax arrays only; batch elements may be numpy too
@@ -117,6 +194,49 @@ class DistributedTrainStep:
                          if n not in self._params}
         sh = self._strategy.sharding_configs
         self._zero_stage = sh["stage"] if self._strategy.sharding else 0
+        # sharding offload (reference distributed_strategy.proto:27
+        # `optimize_offload`, consumed by sharding_optimizer.py:33): the
+        # AdamW slots live in HOST memory and stream through the device
+        # only during the optimizer epilogue — XLA inserts the transfers
+        # from the pinned_host in/out shardings.  moment_dtype (greenfield
+        # low-precision-moments analog) stores param-shaped slots in
+        # bf16/fp16, upcast to f32 only inside the update.
+        self._offload = bool(sh.get("offload", False)) \
+            if self._strategy.sharding else False
+        _mdt = str(sh.get("moment_dtype", "float32")).lower()
+        _mdt_map = {"float32": jnp.float32, "fp32": jnp.float32,
+                    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                    "float16": jnp.float16, "fp16": jnp.float16,
+                    "int8": jnp.int8}
+        if _mdt not in _mdt_map:
+            # a typo here would silently keep f32 slots and OOM the
+            # run the knob was set to save
+            raise ValueError(
+                f"sharding_configs.moment_dtype={_mdt!r} is not one of "
+                f"{sorted(_mdt_map)}")
+        self._moment_dtype = (_mdt_map[_mdt] if self._strategy.sharding
+                              else jnp.float32)
+        if self._offload:
+            plat = self._mesh.devices.flat[0].platform
+            if plat not in ("tpu", "gpu"):
+                raise NotImplementedError(
+                    "sharding_configs.offload=True compiles host-resident "
+                    "optimizer state into the step (pinned_host memory "
+                    f"space), which the {plat!r} backend does not support "
+                    "in compiled programs; use sharding_configs."
+                    "moment_dtype='bfloat16' for the in-HBM alternative")
+            _gm_k = (self._strategy.gradient_merge_configs["k_steps"]
+                     if self._strategy.gradient_merge else 1)
+            if _gm_k > 1 or self._strategy.dgc:
+                # the host<->device streaming rides STATIC in/out
+                # shardings, so every micro-step would pay the full
+                # round trip even when lax.cond skips the apply —
+                # multiplying exactly the cost offload amortizes
+                raise NotImplementedError(
+                    "sharding_configs.offload does not compose with "
+                    "gradient_merge or DGC (the optimizer-state round "
+                    "trip cannot be gated per micro-step); use "
+                    "moment_dtype='bfloat16'/'int8' instead")
         gm = self._strategy.gradient_merge_configs
         self._k_steps = gm["k_steps"] if self._strategy.gradient_merge else 1
         self._gm_avg = gm["avg"]
@@ -226,9 +346,28 @@ class DistributedTrainStep:
                 if hasattr(v, "dtype") and v.dtype == jnp.float32 else v,
                 tree)
 
+        # the bf16 copies of ZeRO-sharded params must be PINNED to the
+        # param's sharding: without the constraint XLA's partitioner
+        # all-gathers the f32 master first and casts after, doubling
+        # both the gather traffic and the gathered temp (measured on the
+        # 7B pp2xfsdp4 buffer assignment: f32[4096,11008] all-gathers
+        # where bf16 ones suffice)
+        _cast_pspecs = self._param_specs()
+
+        def _amp_cast_params(pvals):
+            out = {}
+            for k, v in pvals.items():
+                if hasattr(v, "dtype") and v.dtype == jnp.float32:
+                    c = v.astype(amp_jdt)
+                    out[k] = jax.lax.with_sharding_constraint(
+                        c, NamedSharding(self._mesh, _cast_pspecs[k]))
+                else:
+                    out[k] = v
+            return out
+
         def loss_of(pvals, buffer_vals, key, args):
             if amp_on:
-                pvals = _amp_cast(pvals)
+                pvals = _amp_cast_params(pvals)
                 args = _amp_cast(args)
             targs = _tree_to_tensors(args)
             with use_key(key):
@@ -263,6 +402,17 @@ class DistributedTrainStep:
                 loss_of, has_aux=True)(pvals, buffer_vals, key, args)
             return loss, bufs, grads
 
+        mdt = self._moment_dtype
+        low_moments = mdt != jnp.float32
+        int8_moments = mdt == jnp.int8
+        pshapes = [tuple(self._params[n]._value.shape) for n in names]
+
+        def _decode_one(i, st):
+            return _transform_slots(st, pshapes[i], mdt, "decode")
+
+        def _encode_one(i, st):
+            return _transform_slots(st, pshapes[i], mdt, "encode")
+
         def apply_opt(pvals, grads, opt_state, lr):
             # fusion fence (measured on a v5e, BERT-base): without it XLA
             # fuses each dW matmul INTO its Adam elementwise epilogue and
@@ -275,8 +425,16 @@ class DistributedTrainStep:
             plist = [pvals[n] for n in names]
             glist = [grads[n] for n in names]
             # lr is a traced scalar so schedulers work without retracing
-            new_ps, new_ss = opt.functional_update(plist, glist, opt_state,
-                                                   lr=lr)
+            if low_moments:
+                # int8 storage: sequential scheduling so the per-param
+                # f32 decode/encode scratch is reused, not accumulated
+                new_ps, new_ss = opt.functional_update(
+                    plist, glist, opt_state, lr=lr,
+                    sequential=int8_moments,
+                    state_decode=_decode_one, state_encode=_encode_one)
+            else:
+                new_ps, new_ss = opt.functional_update(
+                    plist, glist, opt_state, lr=lr)
             return dict(zip(names, new_ps)), new_ss
 
         if use_scaling:
@@ -444,10 +602,23 @@ class DistributedTrainStep:
         # dominated by these tiny transfers)
         inner_step = step
         has_i = self._use_dgc or k_steps > 1
+        offload = self._offload
+        # populated after sspecs are derived below; the closure cell is
+        # shared so the traced step sees the final device shardings
+        _offload_dev_sh: list = []
 
         def step(*a):
             head, (lr, key, args) = a[:-3], a[-3:]
             key, next_key = jax.random.split(key)
+            if offload:
+                # host->device fetch of the optimizer slots; the update's
+                # results ride the pinned_host out_shardings back, so the
+                # slots only transit HBM during the optimizer epilogue
+                fetched = [
+                    {k: jax.device_put(v, _offload_dev_sh[i][k])
+                     if hasattr(v, "shape") else v for k, v in st.items()}
+                    for i, st in enumerate(head[2])]
+                head = (*head[:2], fetched, *head[3:])
             if has_i:
                 # the step counter advances on device too (same tunnel
                 # round-trip argument as the key)
@@ -487,9 +658,47 @@ class DistributedTrainStep:
                 jnp.asarray(float(acfg["init_loss_scaling"]), jnp.float32),
                 jnp.asarray(0, jnp.int32),   # consecutive finite steps
                 jnp.asarray(0, jnp.int32))   # consecutive nan/inf steps
+        in_sh = sh(tuple(in_specs))
+        out_sh = sh(tuple(out_specs))
+        if offload:
+            mesh = self._mesh
+
+            def host(tree):
+                return jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s,
+                                            memory_kind="pinned_host"),
+                    tree, is_leaf=lambda x: isinstance(x, P))
+            # opt state: input slot 2, output slot 3 (after loss, params,
+            # buffers) in every step variant
+            in_sh = (*in_sh[:2], host(in_specs[2]), *in_sh[3:])
+            out_sh = (*out_sh[:3], host(out_specs[3]), *out_sh[4:])
+            _offload_dev_sh.extend(
+                [{k: NamedSharding(mesh, d[k]) for k in d}
+                 for d in sspecs])
         return jax.jit(step, donate_argnums=donate,
-                       in_shardings=sh(tuple(in_specs)),
-                       out_shardings=sh(tuple(out_specs)))
+                       in_shardings=in_sh, out_shardings=out_sh)
+
+    def _storage_cast(self, opt_state):
+        """Slots in their at-rest dtype (sharding_configs.moment_dtype):
+        param-shaped floating leaves cast (int8 mode additionally grows
+        a per-row "<slot>@scale" leaf), scalar machinery stays f32.
+        No-op (returns the same arrays) once already cast, and aval-only
+        under abstract_init."""
+        mdt = self._moment_dtype
+        if mdt == jnp.float32:
+            return opt_state
+        return [
+            _transform_slots(st, tuple(self._params[n]._value.shape),
+                             mdt, "storage")
+            for n, st in zip(self._param_names, opt_state)]
+
+    def _state_sharding(self, spec):
+        """NamedSharding for one optimizer slot — host-resident under
+        sharding offload."""
+        if self._offload:
+            return NamedSharding(self._mesh, spec,
+                                 memory_kind="pinned_host")
+        return NamedSharding(self._mesh, spec)
 
     # rng / step checkpointing -----------------------------------------
     def rng_state(self) -> dict:
@@ -519,7 +728,7 @@ class DistributedTrainStep:
         arg_vals = _tree_to_values(list(args))
         param_vals = {n: p._value for n, p in self._params.items()}
         buffer_vals = {n: b._value for n, b in self._buffers.items()}
-        opt_state = self._opt.opt_state()
+        opt_state = self._storage_cast(self._opt.opt_state())
         if self._compiled is None:
             self._compiled = self._build(arg_vals, opt_state)
             # lay params/opt-state out on their final shardings once (ZeRO-3
@@ -532,7 +741,7 @@ class DistributedTrainStep:
                 param_vals[n] = p._value
             sspecs = self._opt_state_specs(opt_state, pspecs)
             opt_state = [
-                {k: jax.device_put(v, NamedSharding(self._mesh, d[k]))
+                {k: jax.device_put(v, self._state_sharding(d[k]))
                  if hasattr(v, "shape") else v for k, v in st.items()}
                 for st, d in zip(opt_state, sspecs)]
             self._opt.load_opt_state(opt_state)
@@ -632,7 +841,7 @@ class DistributedTrainStep:
         arg_vals = _tree_to_values(list(args))
         param_vals = {n: p._value for n, p in self._params.items()}
         buffer_vals = {n: b._value for n, b in self._buffers.items()}
-        opt_state = self._opt.opt_state()
+        opt_state = self._storage_cast(self._opt.opt_state())
         if self._compiled is None:
             self._compiled = self._build(arg_vals, opt_state)
         lr = jnp.asarray(float(self._opt.get_lr()), jnp.float32)

@@ -183,6 +183,29 @@ class Optimizer:
                     v = state_dict[sk]
                     st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
                     found = True
+            # storage-transformed slots beyond _create_state's layout
+            # (e.g. int8 moments carry "<slot>@scale" leaves when saved
+            # from a DistributedTrainStep with moment_dtype="int8"):
+            # restore any dot-free suffix under this param's prefix
+            prefix = f"{key}."
+            for sk, v in state_dict.items():
+                k = sk[len(prefix):] if sk.startswith(prefix) else None
+                if k and k not in st and "." not in k:
+                    st[k] = v._value if isinstance(v, Tensor) \
+                        else jnp.asarray(np.asarray(v))
+                    found = True
+            # decode int8-quantized slots back to plain f32 at restore:
+            # eager step() math and DistributedTrainSteps configured
+            # with a DIFFERENT moment_dtype must never see raw codes —
+            # a step with moment_dtype="int8" simply re-encodes on its
+            # next call (dist_step._storage_cast)
+            for k in [k for k in st if k.endswith("@scale")]:
+                base = k[: -len("@scale")]
+                if base in st and st[base].dtype == jnp.int8:
+                    from ..distributed.fleet.dist_step import _q8_decode
+                    st[base] = _q8_decode(st[base], st.pop(k))
+                else:
+                    st.pop(k)
             if found:
                 self._accumulators[id(p)] = st
 
@@ -205,19 +228,40 @@ class Optimizer:
         return states
 
     def functional_update(self, params: Sequence[jnp.ndarray],
-                          grads: Sequence[jnp.ndarray], states, lr=None):
+                          grads: Sequence[jnp.ndarray], states, lr=None,
+                          sequential: bool = False,
+                          state_decode=None, state_encode=None):
         """Pure batched update for use inside jit/pjit (no Tensor objects).
-        Applies grad_clip and weight_decay exactly like the eager step()."""
+        Applies grad_clip and weight_decay exactly like the eager step().
+
+        ``state_decode(i, s)`` / ``state_encode(i, ns)`` convert slot
+        storage to/from the update's f32 working form (dist_step's
+        low-precision moment_dtype).  ``sequential=True`` threads an
+        optimization_barrier token through the per-param updates so XLA
+        schedules them one after another and REUSES the decode/encode
+        scratch buffers — otherwise every slot's f32 copy materializes
+        concurrently, adding O(total params) f32 temps to peak HBM.  The
+        epilogue is bandwidth-bound elementwise work, so ordering it
+        costs nothing.
+        """
         lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
         if self._grad_clip is not None:
             grads = self._grad_clip.apply_values(list(grads))
         new_ps, new_ss = [], []
-        for p, g, s in zip(params, grads, states):
+        token = None
+        for i, (p, g, s) in enumerate(zip(params, grads, states)):
+            if sequential and token is not None:
+                (g, s), _ = jax.lax.optimization_barrier(((g, s), token))
+            if state_decode is not None:
+                s = state_decode(i, s)
             if self._weight_decay is not None:
                 g = self._weight_decay.apply_gradient(p, g)
             np_, ns = self._update(p, g, lr, s)
+            if state_encode is not None:
+                ns = state_encode(i, ns)
             new_ps.append(np_)
             new_ss.append(ns)
+            token = np_
         return new_ps, new_ss
 
     def load_opt_state(self, states):

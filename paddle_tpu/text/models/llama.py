@@ -515,10 +515,36 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, positions=None):
         hidden = self.model(input_ids, positions)
-        logits = self._logits(hidden)
         if labels is None:
-            return logits
-        loss = _apply(_causal_lm_loss, logits, labels, op_name="lm_loss")
+            return self._logits(hidden)
+        import jax as _jax
+        traced = isinstance(hidden._value, _jax.core.Tracer)
+        if traced and hidden.shape[1] - 1 >= 2 * _LOSS_CHUNK:
+            # long sequences under jit: CE computed chunked from hidden
+            # + the projection weight, so the full [B,S,V] f32 logits
+            # tensor never materializes (at 7B dims it is the single
+            # largest loss-path temp — ~0.5 GiB per microbatch).  The
+            # logits below still trace for API parity and are DCE'd
+            # whenever the caller keeps only the loss; a traced caller
+            # that CONSUMES the returned logits keeps the full
+            # projection (and pays the chunked loss on top) — the
+            # memory win targets training steps, which keep only the
+            # loss.  Eager callers materialize the returned logits
+            # regardless, so chunking would only add compute there —
+            # they take the plain path.
+            if self.lm_head is not None:
+                w, transposed = self.lm_head.weight, False
+            else:
+                w, transposed = self.model.embed_tokens.weight, True
+
+            def f(h, wv, lb):
+                return _chunked_causal_lm_loss(h, wv, lb, transposed)
+            loss = _apply(f, hidden, w, labels, op_name="lm_loss_chunked")
+            logits = self._logits(hidden)
+        else:
+            logits = self._logits(hidden)
+            loss = _apply(_causal_lm_loss, logits, labels,
+                          op_name="lm_loss")
         return loss, logits
 
     def generate(self, input_ids, **kwargs):
@@ -572,3 +598,80 @@ def _causal_lm_loss(logits, labels):
     nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)[..., 0]
     nll = jnp.where(valid, nll, 0.0)
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+_LOSS_CHUNK = 256    # sequence positions per loss chunk
+
+
+@jax.custom_vjp
+def _proj_chunk(hc, wm):
+    """[B,C,H] @ [H,V] with f32 accumulation — forward numerics match
+    ``_logits`` exactly (same input rounding, f32 accumulate).  The
+    custom vjp keeps the BACKWARD transpose dots in the params' compute
+    dtype: a plain f32-typed result would promote W to f32 in the
+    backward and all-gather an f32 copy of the whole projection under
+    ZeRO-3.  Rounding the cotangent to the compute dtype is the
+    standard AMP gradient convention."""
+    return jax.lax.dot_general(hc, wm, (((2,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _proj_chunk_fwd(hc, wm):
+    return _proj_chunk(hc, wm), (hc, wm)
+
+
+def _proj_chunk_bwd(res, g):
+    hc, wm = res
+    gl = g.astype(wm.dtype)
+    dhc = jax.lax.dot_general(gl, wm, (((2,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    dwm = jax.lax.dot_general(hc, gl, (((0, 1), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return dhc.astype(hc.dtype), dwm.astype(wm.dtype)
+
+
+_proj_chunk.defvjp(_proj_chunk_fwd, _proj_chunk_bwd)
+
+
+def _chunked_causal_lm_loss(hidden, w, labels, transposed):
+    """Next-token CE streamed over sequence chunks: per-chunk f32
+    logits [B, C, V] are the only vocab-sized temp (lax.scan reuses the
+    buffer), vs the unchunked path's [B, S, V].  ``w`` is [H, V]
+    (lm_head) or [V, H] with ``transposed`` (tied embedding).  Forward
+    numerics match :func:`_causal_lm_loss` (same input rounding, f32
+    accumulation, f32 log_softmax, same -100 masking and valid-count
+    normalization); the projection's cotangents are rounded to the
+    compute dtype (see :func:`_proj_chunk`)."""
+    B, S, H = hidden.shape
+    n = S - 1
+    h = hidden[:, :-1, :]
+    lb = labels[:, 1:]
+    C = _LOSS_CHUNK
+    n_chunks = -(-n // C)
+    pad = n_chunks * C - n
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        lb = jnp.pad(lb, ((0, 0), (0, pad)), constant_values=-100)
+    hs = jnp.swapaxes(h.reshape(B, n_chunks, C, H), 0, 1)
+    ls = jnp.swapaxes(lb.reshape(B, n_chunks, C), 0, 1)
+    wm = w.T if transposed else w          # [H, V], compute dtype
+
+    # chunk body rematerialized: without it lax.scan SAVES each chunk's
+    # [B, C, V] f32 logits for the backward and the chunking buys
+    # nothing.
+    @jax.checkpoint
+    def body(carry, hc_lc):
+        s_nll, s_cnt = carry
+        hc, lc = hc_lc
+        lg = _proj_chunk(hc, wm)
+        valid = lc >= 0
+        lcs = jnp.where(valid, lc, 0)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        nll = -jnp.take_along_axis(logp, lcs[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return (s_nll + nll.sum(), s_cnt + valid.sum()), None
+
+    (s_nll, s_cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ls))
+    return s_nll / jnp.maximum(s_cnt, 1).astype(jnp.float32)

@@ -1,0 +1,93 @@
+"""Single-chip microbench: host-offloaded optimizer state streaming cost.
+
+VERDICT r4 next-round item 1: "a single-chip microbench of the
+host<->device moment streaming cost".  Trains the same MLP three ways —
+baseline (moments in HBM, f32), sharding offload (moments pinned_host,
+streamed through the device each step), bf16 moments (in HBM at half
+bytes) — asserts step-loss parity, and reports per-step wall time plus
+the implied host<->device bandwidth for the offloaded slots.
+
+Reference analog: sharding_optimizer.py:33 offload path (the reference
+moves slots to CPUPlace pinned memory and relies on cudaMemcpyAsync
+overlap; here XLA inserts the transfers from pinned_host shardings).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _train(offload: bool, moment_dtype: str, steps: int = 12):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
+
+    mesh_mod.set_mesh(None)
+    mesh = mesh_mod.init_mesh({"dp": 1})
+    paddle.seed(0)
+    model = nn.Sequential(
+        nn.Linear(4096, 4096), nn.ReLU(),
+        nn.Linear(4096, 4096), nn.ReLU(),
+        nn.Linear(4096, 1024))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters())
+    s = fleet.DistributedStrategy()
+    s.sharding = True
+    s.sharding_configs = {"stage": 1, "offload": offload,
+                          "moment_dtype": moment_dtype}
+
+    def loss_fn(x, y):
+        return F.cross_entropy(model(x), y)
+
+    step = DistributedTrainStep(model, loss_fn, opt, s, mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(64, 4096).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 1024, (64,)))
+    losses = [float(step(x, y)) for _ in range(2)]   # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(step(x, y)))
+    dt = (time.perf_counter() - t0) / steps
+    mesh_mod.set_mesh(None)
+    return losses, dt, n_params
+
+
+def main():
+    base_losses, base_dt, n_params = _train(False, "float32")
+    off_losses, off_dt, _ = _train(True, "float32")
+    bf16_losses, bf16_dt, _ = _train(False, "bfloat16")
+    # parity: offload changes WHERE slots live, not the arithmetic
+    np.testing.assert_allclose(base_losses, off_losses, rtol=1e-5)
+    # bf16 moments: same trajectory within low-precision tolerance
+    np.testing.assert_allclose(base_losses, bf16_losses, rtol=5e-2)
+    # streamed bytes/step: m+v f32 down AND up (params stay resident)
+    stream_bytes = 2 * n_params * 4 * 2
+    overhead = off_dt - base_dt
+    bw = stream_bytes / overhead / 1e9 if overhead > 1e-5 else float("inf")
+    out = {
+        "metric": "offload_moment_streaming",
+        "params_m": round(n_params / 1e6, 1),
+        "baseline_step_ms": round(base_dt * 1e3, 2),
+        "offload_step_ms": round(off_dt * 1e3, 2),
+        "bf16_moments_step_ms": round(bf16_dt * 1e3, 2),
+        "offload_overhead_ms": round(overhead * 1e3, 2),
+        "streamed_mb_per_step": round(stream_bytes / 1e6, 1),
+        "implied_host_bw_gbs": round(bw, 2),
+        "loss_parity": "exact(f32-offload)+bf16within5pct",
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
