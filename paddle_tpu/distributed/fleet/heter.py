@@ -64,6 +64,8 @@ class _NativeCacheDir:
                                          ctypes.c_int64, i64p]
         lib.cache_dir_unpin_slots.argtypes = [ctypes.c_void_p, i64p,
                                               ctypes.c_int64]
+        lib.cache_dir_unpin_ids.argtypes = [ctypes.c_void_p, i64p,
+                                            ctypes.c_int64]
         lib.cache_dir_load.restype = ctypes.c_int64
         lib.cache_dir_load.argtypes = [ctypes.c_void_p]
         self._h = lib.cache_dir_create(capacity)
@@ -110,6 +112,14 @@ class _NativeCacheDir:
         self._lib.cache_dir_unpin_slots(
             self._h, np.ascontiguousarray(slots, dtype=np.int64),
             len(slots))
+
+    def unpin_ids(self, ids: np.ndarray):
+        """Tolerant unpin: non-resident ids (already evicted) are
+        skipped, resident ids' pins decrement — the all-or-nothing
+        lookup(unpin=True) would leak the survivors' pins forever
+        after a partial eviction."""
+        self._lib.cache_dir_unpin_ids(
+            self._h, np.ascontiguousarray(ids, dtype=np.int64), len(ids))
 
     def ids_of(self, slots: np.ndarray) -> np.ndarray:
         out = np.empty(len(slots), np.int64)
@@ -178,6 +188,15 @@ class DeviceCachedTable:
         # (plain pulls keep pure LRU semantics for pull-only use).
         self._lock = threading.RLock()
         self._pins: Dict[tuple, list] = {}   # uniq-ids key -> [slots, n]
+        # recent pull plans keyed by raw-id bytes: with overlapped lanes
+        # (r5) pull(i+1) may land BEFORE push(i), so a single last-plan
+        # slot would miss; bounded so an abandoned pull cannot grow it.
+        # Plans are invalidated whenever one of their slots is evicted —
+        # a push popping a stale plan would otherwise scatter its
+        # gradients into rows that now belong to a DIFFERENT batch
+        # (silent host-table corruption; the pre-r5 single-slot cache
+        # failed loudly via the strict lookup instead)
+        self._plans: "OrderedDict[bytes, tuple]" = OrderedDict()
         # native directory (id->slot/LRU/pins/admission in one C call);
         # Python bookkeeping below stays as the no-toolchain fallback
         self._ndir = None
@@ -205,6 +224,17 @@ class DeviceCachedTable:
         out = np.full(b, self._cap, np.int64)
         out[:len(slots)] = slots
         return out
+
+    def _invalidate_plans(self, evicted_slots):
+        """Drop any retained pull plan touching an evicted slot (see
+        the _plans comment in __init__)."""
+        if not self._plans:
+            return
+        ev = {int(s) for s in np.asarray(evicted_slots).tolist()}
+        for key in [k for k, (_, _, slots) in self._plans.items()
+                    if ev.intersection(int(s) for s in
+                                       np.asarray(slots).tolist())]:
+            del self._plans[key]
 
     # -- admission / eviction -----------------------------------------
     def _admit(self, miss_ids: np.ndarray, pinned: set) -> np.ndarray:
@@ -234,6 +264,8 @@ class DeviceCachedTable:
             del self._lru[s]
             del self._slot_of[int(self._id_of[s])]
             self.evictions += 1
+        if evict:
+            self._invalidate_plans(evict)
         slots = np.asarray(
             [self._free.pop() for _ in range(n - len(evict))] + evict,
             np.int64)
@@ -300,7 +332,11 @@ class DeviceCachedTable:
                 ent = self._pins.setdefault(uniq.tobytes(), [set(), 0])
                 ent[0] = {int(s) for s in slots}
                 ent[1] += 1
-            self._last = (uniq, slots)  # push() fast path, same batch
+            # push() fast path (bounded one-shot plan cache, r5: with
+            # overlapped lanes pull(i+1) may land before push(i))
+            self._plans[uniq.tobytes()] = (uniq, None, slots)
+            while len(self._plans) > 8:
+                self._plans.popitem(last=False)
             return self._buf[np.asarray(slots)[inverse]]
 
     def _pull_native(self, ids: np.ndarray, pin: bool):
@@ -324,6 +360,7 @@ class DeviceCachedTable:
             if ev_slots.size:
                 # directory entries are gone; write dirty VALUES back
                 # with the ids the native call reported
+                self._invalidate_plans(ev_slots)
                 self._write_back_rows(ev_slots, ev_ids)
             if miss_pos.size:
                 miss_slots = slots[miss_pos]
@@ -337,10 +374,12 @@ class DeviceCachedTable:
                     self._acc = self._acc.at[jnp.asarray(sp)].set(0.0)
                 self._orig[miss_slots] = rows
                 self._dirty[miss_slots] = False
-            self._last = (uniq, slots)
             # push() fast path: the async pipeline pushes EXACTLY the
-            # ids it pulled, so the plan can be reused by raw-id match
-            self._last_native = (ids.tobytes(), uniq, inverse, slots)
+            # ids it pulled, so the plan can be reused by raw-id match;
+            # plans are one-shot (popped by push) and bounded
+            self._plans[ids.tobytes()] = (uniq, inverse, slots)
+            while len(self._plans) > 8:
+                self._plans.popitem(last=False)
             return self._buf[np.asarray(slots)[inverse]]
 
     def _write_back_rows(self, slots: np.ndarray, ids: np.ndarray):
@@ -365,9 +404,13 @@ class DeviceCachedTable:
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
         if self._ndir is not None:
             with self._lock:
-                ln = getattr(self, "_last_native", None)
-                if ln is not None and ln[0] == ids.tobytes():
-                    _, uniq, inverse, slots = ln
+                # pop = one-shot, like the pull/push pairing it models:
+                # a second push of the same raw ids without a fresh
+                # pull must NOT reuse the plan (it would decrement
+                # another in-flight batch's pin on a shared slot)
+                plan = self._plans.pop(ids.tobytes(), None)
+                if plan is not None:
+                    uniq, inverse, slots = plan
                     self._ndir.unpin_slots(slots)
                 else:
                     ret = self._ndir.lookup(ids, unpin=True)
@@ -380,9 +423,9 @@ class DeviceCachedTable:
             return
         uniq, inverse = np.unique(ids, return_inverse=True)
         with self._lock:
-            last = getattr(self, "_last", None)
-            if last is not None and np.array_equal(last[0], uniq):
-                slots = last[1]
+            plan = self._plans.pop(uniq.tobytes(), None)
+            if plan is not None:
+                slots = plan[2]
             else:
                 slots = np.asarray(
                     [self._slot_of[i] for i in uniq.tolist()], np.int64)
@@ -420,8 +463,12 @@ class DeviceCachedTable:
         reclaim the slots."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64)).reshape(-1)
         with self._lock:
+            # the released batch's plan must go too: a later push of the
+            # same raw ids after an eviction would otherwise reuse it
+            self._plans.pop(ids.tobytes(), None)
+            self._plans.pop(np.unique(ids).tobytes(), None)
             if self._ndir is not None:
-                self._ndir.lookup(ids, unpin=True)
+                self._ndir.unpin_ids(ids)
             else:
                 self._unpin(np.unique(ids))
 
@@ -505,14 +552,28 @@ class DeviceCachedTable:
 class HeterTrainer:
     def __init__(self, tables: Dict[str, SparseTable],
                  dense_step: Callable,
-                 sync_mode: bool = False, pull_threads: int = 2):
+                 sync_mode: bool = False, pull_threads: int = 2,
+                 push_lag: int = 0):
         """``dense_step(embeddings: dict[str, np.ndarray], batch) ->
         (result, grads: dict[str, np.ndarray])`` — typically a jitted
         closure over the dense params; grads are d(loss)/d(rows), one row
-        per pulled id (duplicate ids get summed by SparseTable.push)."""
+        per pulled id (duplicate ids get summed by SparseTable.push).
+
+        ``push_lag`` (async mode): how many push futures may remain in
+        flight when the NEXT batch's pull is submitted.  0 (default)
+        is the lockstep schedule (guaranteed one-batch staleness,
+        capacity covers 2 batches); 1 lets push(i) overlap both
+        compute(i) and pull(i+1) — device ordering stays exact
+        regardless (every cache op consumes the previous device
+        buffer), the lag widens the HOST-table staleness window for
+        miss rows to ``1 + push_lag`` batches and the pinned working
+        set to ``2 + push_lag`` batches, the reference
+        async-communicator trade (framework/trainer.h:233 heter
+        pipelines)."""
         self._tables = tables
         self._dense_step = dense_step
         self._sync = sync_mode
+        self._push_lag = max(0, int(push_lag))
         self._pool = ThreadPoolExecutor(max_workers=pull_threads,
                                         thread_name_prefix="heter_ps")
         self._pending_push = []
@@ -573,9 +634,10 @@ class HeterTrainer:
         batch of lag (async mode) or inline (sync mode).
 
         Async mode over a :class:`DeviceCachedTable` pins batch i's rows
-        until its push lands, so the cache capacity must cover TWO
-        consecutive batches' unique rows; a tighter cache raises the
-        thrashing error instead of silently corrupting in-flight rows.
+        until its push lands, so the cache capacity must cover
+        ``2 + push_lag`` consecutive batches' unique rows; a tighter
+        cache raises the thrashing error instead of silently corrupting
+        in-flight rows.
         """
         it = iter(batches)
         try:
@@ -593,11 +655,15 @@ class HeterTrainer:
             nxt_ids = ids_fn(nxt) if nxt is not None else None
             emb = pull_f.result()
             if nxt is not None:  # prefetch lane for the NEXT batch
-                # ALL pushes through batch i-1 must land before the pull
-                # for batch i+1 reads the tables — the guaranteed staleness
-                # bound is exactly one batch (batch i's own push), the
-                # async-communicator semantics of the reference
-                self._drain_pushes(keep=0)
+                # bounded push queue: at most push_lag pushes stay in
+                # flight when pull(i+1) is submitted.  Device-value
+                # ordering is exact either way (each cache op consumes
+                # the previous device buffer under the table lock); the
+                # bound caps host-table miss-row staleness at
+                # 1 + push_lag batches and pinned batches at
+                # 2 + push_lag (the thrash guard raises if capacity
+                # cannot hold them)
+                self._drain_pushes(keep=self._push_lag)
                 pull_f = self._pool.submit(self._pull, nxt_ids)
             try:
                 result, grads = self._dense_step(emb, batch)  # TPU lane

@@ -153,7 +153,20 @@ def _bool_val(v):
 def loop_and_not(test, flag):
     """Loop-continue predicate ``test and not flag`` for break-flagged
     loops — jnp logical ops when either side is traced (python ``and``
-    would force a concrete bool out of a tracer)."""
+    would force a concrete bool out of a tracer).
+
+    ``test`` may be a thunk (the converter emits ``lambda: <test>``): a
+    CONCRETE set break flag then short-circuits without evaluating the
+    original condition, matching plain Python, where the condition is
+    never re-evaluated after ``break`` (it may only be safe pre-break,
+    e.g. ``while arr[i] > 0`` with the break guarding ``i``).  A traced
+    flag cannot short-circuit — both sides stage into the loop predicate
+    — but under tracing jnp indexing clamps rather than raises, so the
+    eager hazard does not carry over."""
+    if callable(test) and not hasattr(test, "dtype"):
+        if not _is_traced(flag) and bool(_bool_val(flag)):
+            return False
+        test = test()
     t, f = _bool_val(test), _bool_val(flag)
     if _is_traced(test) or _is_traced(flag):
         import jax.numpy as jnp
@@ -344,28 +357,46 @@ def _return_in_loop_or_try(stmts) -> bool:
     return False
 
 
-def _residualize(stmts):
+class _FoldOverflow(Exception):
+    """Raised when residualization would blow past the statement budget
+    (K sequential guard-clause ifs duplicate the tail O(2^K) times)."""
+
+
+_FOLD_BUDGET = 4096
+
+
+def _residualize(stmts, _budget=None):
     """Fold the statements after a maybe-returning ``if`` into its
     non-returning side(s), so every ``return`` ends up in tail position
     of its block (the reference return_transformer.py analog — but
     instead of threading a return flag, restructure to nested if/else,
     which stages directly as lax.cond branches).  Statements after a
-    bare ``return`` (dead code) are dropped."""
+    bare ``return`` (dead code) are dropped.
+
+    The duplication is exponential in the guard-chain depth, so a shared
+    statement budget caps total output; overflow raises
+    :class:`_FoldOverflow` and the caller leaves the body untransformed
+    (plain-Python early returns, reported via the conversion notes)."""
+    if _budget is None:
+        _budget = [_FOLD_BUDGET]
     out = []
     for idx, s in enumerate(stmts):
+        _budget[0] -= 1
+        if _budget[0] <= 0:
+            raise _FoldOverflow
         if isinstance(s, ast.Return):
             out.append(s)
             return out                      # rest is dead code
         if isinstance(s, ast.If) and (_has_return(s.body)
                                       or _has_return(s.orelse)):
-            body = _residualize(s.body)
-            orelse = _residualize(s.orelse)
+            body = _residualize(s.body, _budget)
+            orelse = _residualize(s.orelse, _budget)
             rest = stmts[idx + 1:]
             if rest:
                 if not _always_returns(body):
-                    body = _residualize(body + rest)
+                    body = _residualize(body + rest, _budget)
                 if not _always_returns(orelse):
-                    orelse = _residualize((orelse or []) + rest)
+                    orelse = _residualize((orelse or []) + rest, _budget)
             s2 = ast.copy_location(
                 ast.If(test=s.test, body=body, orelse=orelse), s)
             out.append(s2)
@@ -620,8 +651,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 # no break in the loop: the else clause ALWAYS runs —
                 # plain trailing statements, no (possibly traced) guard
                 post = list(node.orelse)
+            # the original test rides in a thunk so a set break flag
+            # short-circuits BEFORE evaluating it (plain-Python parity:
+            # the condition is never re-evaluated after break)
+            test_thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=node.test)
             node = ast.copy_location(ast.While(
-                test=_jst_call("loop_and_not", [node.test, _name(brk)]),
+                test=_jst_call("loop_and_not", [test_thunk, _name(brk)]),
                 body=new_body, orelse=[]), node)
             ast.fix_missing_locations(node)
             self.changed = True
@@ -806,6 +844,7 @@ def _do_convert(f: Callable):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return f, []
     fdef.decorator_list = []
+    pre_notes = []
     if _has_return(fdef.body):
         # make the implicit fall-off-the-end None-return explicit, then
         # fold post-if statements into the non-returning branches so
@@ -814,9 +853,18 @@ def _do_convert(f: Callable):
         if not _always_returns(body):
             body = body + [ast.copy_location(
                 ast.Return(ast.Constant(None)), fdef.body[-1])]
-        fdef.body = _residualize(body)
+        try:
+            fdef.body = _residualize(body)
+        except _FoldOverflow:
+            # guard-chain too deep: leave early returns to plain Python
+            # (full_graph=True will raise via the note below)
+            pre_notes.append(
+                "early-return guard chain exceeds the residualizer's "
+                f"statement budget ({_FOLD_BUDGET}); its ifs stay "
+                "plain Python")
         ast.fix_missing_locations(tree)
     tr = _ControlFlowTransformer()
+    tr.notes.extend(pre_notes)
     tree = tr.visit(tree)
     if not tr.changed:
         return f, tr.notes

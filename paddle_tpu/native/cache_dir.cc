@@ -213,6 +213,26 @@ void cache_dir_unpin_slots(void* h, const int64_t* slots, int64_t n) {
     if (d->pin[slots[i]] > 0) --d->pin[slots[i]];
 }
 
+// Tolerant unpin over raw ids (duplicates allowed): non-resident ids
+// are SKIPPED, resident ids' slots get one pin decrement each.  Used by
+// the public release() path, where a partial eviction may already have
+// dropped some of the batch's rows — the all-or-nothing lookup would
+// leak the surviving rows' pins forever.
+void cache_dir_unpin_ids(void* h, const int64_t* ids, int64_t n) {
+  auto* d = static_cast<CacheDir*>(h);
+  static thread_local std::vector<int64_t> uniq_buf, inv_buf;
+  uniq_buf.resize(n);
+  inv_buf.resize(n);
+  unique_inverse(ids, n, uniq_buf.data(), inv_buf.data());
+  int64_t u = 0;
+  for (int64_t i = 0; i < n; ++i) u = std::max(u, inv_buf[i] + 1);
+  for (int64_t j = 0; j < u; ++j) {
+    auto it = d->slot_of.find(uniq_buf[j]);
+    if (it != d->slot_of.end() && d->pin[it->second] > 0)
+      --d->pin[it->second];
+  }
+}
+
 // Slot ids for write-back bookkeeping (flush path).
 void cache_dir_ids_of(void* h, const int64_t* slots, int64_t n,
                       int64_t* out_ids) {

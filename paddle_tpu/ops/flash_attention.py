@@ -551,10 +551,16 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
     v5e at seq 128/BERT-base geometry the fused kernel LOSES to XLA's
     composition, 112k vs 166k tok/s: tiny per-(batch,head) programs pay
     more in launch overhead than the mask/RNG traffic they save) and
-    only without a mask (the fused backward has no dbias path)."""
+    only without a mask (the fused backward has no dbias path).
+
+    ``PADDLE_TPU_FLASH_MIN_SEQ`` overrides the sequence-length floor
+    (default 1024) for A/B experiments in the short-seq regime."""
+    import os
+
     import jax
+    min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "1024"))
     if not (jax.default_backend() == "tpu"
-            and head_dim in (64, 128, 256) and seq_len >= 1024):
+            and head_dim in (64, 128, 256) and seq_len >= min_seq):
         return False
     if dropout > 0.0:
         if has_mask or mask_shape is not None:

@@ -410,9 +410,17 @@ class Int8InferenceLinear(Layer):
 
 class Int8InferenceConv2D(Layer):
     """Conv2D with int8-stored weights + per-out-channel scales (see
-    Int8InferenceLinear)."""
+    Int8InferenceLinear).
 
-    def __init__(self, layer: Conv2D, compute_dtype=jnp.bfloat16):
+    ``act_quant="dynamic"`` (r5, VERDICT r4 item 7): the activation is
+    quantized per-call and the conv runs as a NATIVE int8 x int8 ->
+    int32 ``conv_general_dilated`` on the MXU (the reference analog:
+    inference/api/mkldnn_quantizer.cc int8 conv inference), rescaled by
+    ``x_scale * w_scale``.  ``act_quant=None`` keeps the weight-only
+    mode (bf16 dequant in-graph)."""
+
+    def __init__(self, layer: Conv2D, compute_dtype=jnp.bfloat16,
+                 act_quant="dynamic"):
         super().__init__()
         w = layer.weight._value                       # [out, in, kh, kw]
         scale = jnp.max(jnp.abs(w), axis=(1, 2, 3)) / 127.0
@@ -428,11 +436,17 @@ class Int8InferenceConv2D(Layer):
         self._inner_cfg = (layer._stride, layer._padding,
                            layer._dilation, layer._groups,
                            layer._data_format)
+        if act_quant not in ("dynamic", None):
+            raise ValueError(
+                f"act_quant must be 'dynamic' or None, got {act_quant!r}")
         self._cdt = compute_dtype
+        self._act_quant = act_quant
 
     def forward(self, x):
         import paddle_tpu.nn.functional as F
         st, pad, dil, grp, fmt = self._inner_cfg
+        if self._act_quant == "dynamic":
+            return self._forward_native_int8(x)
 
         def deq(qw, sc, xv):
             return (qw.astype(self._cdt)
@@ -446,6 +460,46 @@ class Int8InferenceConv2D(Layer):
                        x if isinstance(x, Tensor) else to_tensor(x),
                        op_name="int8_dequant", n_outputs=2)
         return F.conv2d(xc, w, self.bias, st, pad, dil, grp, fmt)
+
+    def _forward_native_int8(self, x):
+        from ..nn.functional.conv import _padding, _pair
+        x = x if isinstance(x, Tensor) else to_tensor(x)
+        st, pad, dil, grp, fmt = self._inner_cfg
+        n = 2
+        stride, dilation = _pair(st, n), _pair(dil, n)
+        channel_last = fmt == "NHWC"
+        lhs_spec = "NHWC" if channel_last else "NCHW"
+        rhs_spec = "OIHW"
+        dn = jax.lax.conv_dimension_numbers(
+            x._value.shape, self.qweight._value.shape,
+            (lhs_spec, rhs_spec, lhs_spec))
+        in_sizes = [x._value.shape[lhs_spec.index(c)] for c in "HW"]
+        kernel = [self.qweight._value.shape[rhs_spec.index(c)]
+                  for c in "HW"]
+        pads = _padding(pad, n, stride, kernel, dilation, in_sizes,
+                        channel_last)
+        cdt = self._cdt
+
+        def fn(xv, qw, sc, *b):
+            xf = xv.astype(jnp.float32)
+            xs = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-9) / 127.0
+            xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, qw, window_strides=stride, padding=pads,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=grp,
+                preferred_element_type=jnp.int32)
+            chan = ((1,) * 3 + (-1,)) if channel_last else (1, -1, 1, 1)
+            y = (acc.astype(jnp.float32)
+                 * (xs * sc).reshape(chan)).astype(cdt)
+            if b:
+                y = y + b[0].astype(cdt).reshape(chan)
+            return y
+
+        args = [x, self.qweight, self.w_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return _apply(fn, *args, op_name="int8_conv2d")
 
 
 def convert_to_int8_inference(model: Layer,
@@ -469,14 +523,16 @@ def convert_to_int8_inference(model: Layer,
                                             act_quant))
             elif isinstance(sub, QuantizedConv2D):
                 setattr(layer, name,
-                        Int8InferenceConv2D(sub._inner, compute_dtype))
+                        Int8InferenceConv2D(sub._inner, compute_dtype,
+                                            act_quant))
             elif isinstance(sub, Linear):
                 setattr(layer, name,
                         Int8InferenceLinear(sub, compute_dtype,
                                             act_quant))
             elif isinstance(sub, Conv2D):
                 setattr(layer, name,
-                        Int8InferenceConv2D(sub, compute_dtype))
+                        Int8InferenceConv2D(sub, compute_dtype,
+                                            act_quant))
             else:
                 swap(sub)
     swap(model)

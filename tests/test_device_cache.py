@@ -138,24 +138,28 @@ def test_pinned_pull_blocks_eviction_until_push():
     assert cache.has(3)
 
 
-def test_async_trainer_eviction_pressure_exact():
-    # disjoint 4-id batches through a capacity-8 cache in ASYNC mode:
-    # pull(i+1) must evict only batch i-1 (push landed), never batch i
-    # (pinned). Exactness vs direct-table training proves no row was
-    # dropped or double-applied.
+@pytest.mark.parametrize("push_lag,capacity", [(0, 8), (1, 12)])
+def test_async_trainer_eviction_pressure_exact(push_lag, capacity):
+    # disjoint 4-id batches in ASYNC mode: eviction may only claim
+    # batches whose push landed, never a pinned in-flight batch.
+    # push_lag=0 is the r4 lockstep (capacity covers 2 batches);
+    # push_lag=1 (r5 overlapped lanes) pins up to 2+lag batches, so
+    # capacity must cover 3.  Exactness vs direct-table training proves
+    # no row was dropped or double-applied under either schedule.
     dim = 4
     table = SparseTable(dim, optimizer="sgd", lr=1.0)
     ref = SparseTable(dim, optimizer="sgd", lr=1.0)
     all_ids = np.arange(16, dtype=np.int64)
     table.pull(all_ids); ref.pull(all_ids)
-    cache = DeviceCachedTable(table, capacity=8, lr=0.25)
+    cache = DeviceCachedTable(table, capacity=capacity, lr=0.25)
 
     def dense_step(emb, batch):
         rows = emb["emb"]
         grads = {"emb": np.ones_like(np.asarray(rows))}
         return 0.0, grads
 
-    tr = HeterTrainer({"emb": cache}, dense_step, sync_mode=False)
+    tr = HeterTrainer({"emb": cache}, dense_step, sync_mode=False,
+                      push_lag=push_lag)
     batches = [all_ids[(4 * i) % 16:(4 * i) % 16 + 4] for i in range(12)]
     steps = tr.run(batches, lambda b: {"emb": b})
     tr.shutdown()
@@ -232,3 +236,68 @@ def test_variable_batch_shapes_reuse_buckets():
     for i, n in n_push.items():
         np.testing.assert_allclose(got[i] - base[i], -0.5 * n * np.ones(4),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_release_tolerates_partial_eviction():
+    """r4 advisor finding: the native release() used the all-or-nothing
+    lookup, so a batch containing any non-resident id unpinned NOTHING
+    and leaked the resident ids' pins forever.  The tolerant unpin must
+    skip missing ids and decrement the rest."""
+    table, cache = _mk(capacity=4)
+    a = np.array([1, 2], np.int64)
+    cache.pull(a, pin=True)
+    # release with a superset containing ids that were never admitted:
+    # must not raise, and must actually unpin 1 and 2
+    cache.release(np.array([1, 2, 777, 888], np.int64))
+    # pins gone -> admitting 4 fresh rows may evict 1 and 2 freely
+    b = np.array([10, 11, 12, 13], np.int64)
+    cache.pull(b, pin=True)
+    got = {int(i) for i in b if cache.has(i)}
+    assert got == {10, 11, 12, 13}
+
+
+def test_plan_cache_survives_interleaved_pulls():
+    """r5 overlapped lanes: pull(i+1) may land before push(i); the
+    one-shot plan cache must serve push(i) by its own raw ids rather
+    than the latest pull's."""
+    rng = np.random.default_rng(3)
+    direct = SparseTable(4, optimizer="sgd", lr=0.5)
+    table, cache = _mk(capacity=16, lr=0.5)
+    ids_a = np.array([1, 2, 3], np.int64)
+    ids_b = np.array([3, 4, 5], np.int64)
+    g_a = rng.normal(size=(3, 4)).astype(np.float32)
+    g_b = rng.normal(size=(3, 4)).astype(np.float32)
+    cache.pull(ids_a, pin=True)
+    cache.pull(ids_b, pin=True)     # lands before push(a)
+    cache.push(ids_a, g_a)
+    cache.push(ids_b, g_b)
+    cache.flush()
+    direct.pull(ids_a)
+    direct.push(ids_a, g_a)
+    direct.pull(ids_b)
+    direct.push(ids_b, g_b)
+    for i in [1, 2, 3, 4, 5]:
+        np.testing.assert_allclose(
+            table.pull(np.array([i], np.int64)),
+            direct.pull(np.array([i], np.int64)), rtol=1e-5)
+
+
+def test_stale_plan_invalidated_on_eviction():
+    """r5 review finding: a retained pull plan whose slots were evicted
+    must NOT serve a later push of the same ids — that would scatter
+    gradients into rows now owned by a different batch.  With the plan
+    invalidated, the strict lookup sees the ids are gone and raises."""
+    table, cache = _mk(capacity=4, lr=1.0)
+    a = np.arange(0, 4, dtype=np.int64)
+    b = np.arange(4, 8, dtype=np.int64)
+    cache.pull(a)                      # unpinned; plan retained
+    cache.pull(b)                      # evicts batch a entirely
+    before = {int(i): np.asarray(table.pull(np.array([i], np.int64)))[0]
+              for i in b}
+    with pytest.raises(KeyError):
+        cache.push(a, np.ones((4, 4), np.float32))
+    cache.flush()
+    for i in b:                        # b's rows untouched by a's push
+        np.testing.assert_allclose(
+            np.asarray(table.pull(np.array([int(i)], np.int64)))[0],
+            before[int(i)])

@@ -1,0 +1,88 @@
+"""Regression tests for the r4 advisor's dy2static findings.
+
+1. A while-loop condition must NOT be re-evaluated after ``break`` sets
+   the flag (plain-Python parity: ``while arr[i] > 0`` where the break
+   guards ``i`` from running off the end).
+2. Deep early-return guard chains must not blow up the residualizer
+   O(2^K) — past the statement budget the function degrades to plain
+   Python with a note instead of hanging.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import _FOLD_BUDGET, _do_convert
+
+
+def test_break_does_not_reevaluate_condition():
+    arr = [3.0, 2.0, 1.0]
+
+    def f(x):
+        i = 0
+        total = x * 0.0
+        # the condition is only safe while i is in range; plain Python
+        # never evaluates it after the break fires
+        while arr[i] > 0:
+            total = total + arr[i] * x
+            i = i + 1
+            if i >= len(arr):
+                break
+        return total
+
+    g = to_static(f)
+    out = g(paddle.to_tensor(np.float32(1.0)))
+    assert abs(float(out) - 6.0) < 1e-6
+
+
+def test_break_condition_thunk_eager_parity():
+    # same shape, pure-python scalars: converted code must match eager
+    def f(n):
+        i, s = 0, 0
+        data = [5, 6, 7]
+        while data[i] % 2 == 1 or True:
+            s += data[i]
+            i += 1
+            if i == len(data):
+                break
+        return s
+
+    assert to_static(f)(3) == f(3)
+
+
+def test_guard_chain_budget_degrades_gracefully(tmp_path):
+    # K sequential guard ifs; K=24 would be 2^24 tail copies without
+    # the budget.  Conversion must finish fast and the function still
+    # compute correctly (as plain Python early returns).
+    lines = ["def f(x):"]
+    for k in range(24):
+        lines.append(f"    if x == {k}:")
+        lines.append(f"        return x * {k}")
+    lines.append("    return -x")
+    mod_file = tmp_path / "guard_chain_mod.py"
+    mod_file.write_text("\n".join(lines) + "\n")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("guard_chain_mod",
+                                                  mod_file)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    f = mod.f
+    conv, notes = _do_convert(f)
+    # either converted within budget or degraded with a note — both
+    # acceptable; what is NOT acceptable is hanging or a giant blowup
+    assert conv(3) == 9
+    assert conv(0) == 0
+    assert conv(100) == -100
+    if conv is f:
+        assert any("budget" in n for n in notes), notes
+
+
+def test_small_guard_chain_still_converts():
+    def f(x):
+        if x == 0:
+            return x + 10
+        if x == 1:
+            return x + 20
+        return -x
+
+    conv, notes = _do_convert(f)
+    assert conv(0) == 10 and conv(1) == 21 and conv(5) == -5
