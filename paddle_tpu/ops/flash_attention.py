@@ -462,6 +462,19 @@ def _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
                 f"blocks=({block_q},{block_k})")
 
 
+def _resolve_blocks(sq, sk, block_q, block_k):
+    """Shrink the default 512x512 tiles at very long sequence lengths:
+    the backward kernels' scoped-VMEM working set (dO/O/dQ tiles plus
+    the K/V stream) overflows the 16 MB stack at seq 8192 with 512-wide
+    blocks (measured: 316 KB over).  Caller-specified non-default
+    blocks are respected."""
+    if sq >= 8192 and block_q == 512:
+        block_q = 256
+    if sk >= 8192 and block_k == 512:
+        block_k = 256
+    return block_q, block_k
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def flash_attention_bhsd(q, k, v, bias=None, seed=None, test_mask=None,
                          causal=False, scale=None, block_q=512,
@@ -482,6 +495,7 @@ def flash_attention_bhsd(q, k, v, bias=None, seed=None, test_mask=None,
     primitives don't lower."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    block_q, block_k = _resolve_blocks(sq, sk, block_q, block_k)
     _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
                         block_k, bias)
     if bias is not None and tuple(bias.shape) != (q.shape[0], 1, 1, sk):
@@ -497,6 +511,7 @@ def _fa_fwd(q, k, v, bias, seed, test_mask, causal, scale, block_q,
             block_k, interpret, dropout_p):
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     sq, sk = q.shape[2], k.shape[2]
+    block_q, block_k = _resolve_blocks(sq, sk, block_q, block_k)
     # custom_vjp skips the primal under differentiation: validate here
     # too or dropout misuse surfaces as opaque unpack errors / silently
     # dropout-free gradients
@@ -520,6 +535,8 @@ def _fa_bwd(causal, scale, block_q, block_k, interpret, dropout_p, res,
             g):
     q, k, v, bias, seed, test_mask, out, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
+                                       block_q, block_k)
     if lse is not None:
         dq, dk, dv = _pallas_backward(q, k, v, out, lse, g, causal, s,
                                       block_q, block_k, interpret,
