@@ -22,6 +22,13 @@ host:
 
 ``sync_mode=True`` degrades to pull->step->push per batch (the
 reference's sync communicator mode).
+
+Hot-path note (r6): a push into a plain native ``SparseTable`` is ONE
+fused C call — dedup + segment-sum + optimizer apply happen inside
+ps_core.cc, with no ``jax.ops.segment_sum`` dispatch and no Python
+per-id work.  On a 1-core host (the r5 roofline) this is the fast
+wide_deep configuration; ``DeviceCachedTable`` remains the right shape
+when a real device sits close enough that HBM-resident rows pay off.
 """
 from __future__ import annotations
 
@@ -209,6 +216,16 @@ class DeviceCachedTable:
                     self._ndir = _NativeCacheDir(lib, self._cap)
             except Exception:
                 self._ndir = None
+        # native segment-sum for host-resident gradients (ps_core.cc
+        # ps_segsum_inv): replaces the per-push jax.ops.segment_sum
+        # DISPATCH — on a 1-core host the dispatch, not the sum, was the
+        # measured cost (PERF.md r5 roofline)
+        self._pslib = None
+        try:
+            from ...native import ps_core
+            self._pslib = ps_core()
+        except Exception:
+            self._pslib = None
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -433,13 +450,32 @@ class DeviceCachedTable:
             self._unpin(uniq)
 
     def _push_rows(self, uniq, inverse, slots, grads):
-        """Shared device-side optimizer apply (segment-sum + scatter)."""
+        """Shared device-side optimizer apply (segment-sum + scatter).
+
+        Host-resident grads take the native segment-sum (one C call, no
+        XLA dispatch, no grads host->device transfer before the merge);
+        device-resident grads keep the on-device ``jax.ops.segment_sum``
+        so they never round-trip through the host link."""
         import jax
         import jax.numpy as jnp
         nseg = self._bucket(max(len(uniq), 1))
-        g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
-                                jnp.asarray(inverse),
-                                num_segments=nseg)
+        if (isinstance(grads, np.ndarray) and self._pslib is not None
+                and inverse is not None):
+            import ctypes
+            inv = np.ascontiguousarray(np.asarray(inverse), np.int64)
+            gr = np.ascontiguousarray(grads.reshape(-1, self._dim),
+                                      np.float32)
+            sums = np.zeros((nseg, self._dim), np.float32)
+            self._pslib.ps_segsum_inv(
+                inv.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                inv.size, self._dim,
+                gr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                sums.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            g = jnp.asarray(sums)
+        else:
+            g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
+                                    jnp.asarray(inverse),
+                                    num_segments=nseg)
         sl = jnp.asarray(self._pad_slots(np.asarray(slots, np.int64)))
         if self._opt == "adagrad":
             self._acc = self._acc.at[sl].add(g * g)

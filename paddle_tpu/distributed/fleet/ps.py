@@ -13,6 +13,15 @@ gradients (host scatter-add, optimizer applied host-side), which is the
 host-offloaded-embedding pattern; the RPC transport for multi-host is the
 socket service in paddle_tpu/distributed/fleet/ps_service.py (launched by
 ``fleet.run_server``).
+
+The DATA PLANE is native (paddle_tpu/native/ps_core.cc): pull is one
+batched C gather, push is one fused C pass (dedup + segment-sum +
+optimizer apply), and feature-admission entries (CountFilterEntry /
+ProbabilityEntry) are evaluated inside the same directory probe — no
+per-id Python dict walk and no np.isin snapshot on the hot path
+(reference anchor: framework/fleet/fleet_wrapper.h:111-185).  The pure
+Python implementation is kept, bit-compatible, as the reference
+implementation and the no-toolchain fallback (``use_native=False``).
 """
 from __future__ import annotations
 
@@ -25,6 +34,7 @@ __all__ = ["SparseTable", "PSRuntime"]
 
 
 _OPT_CODES = {"sgd": 0, "adagrad": 1, "adam": 2}
+_ENTRY_NONE, _ENTRY_COUNT, _ENTRY_PROB = 0, 1, 2
 
 
 class SparseTable:
@@ -33,17 +43,26 @@ class SparseTable:
     common_sparse_table.cc).  Rows materialise on first touch.
 
     Backed by the native C++ sharded core (paddle_tpu/native/ps_core.cc)
-    when a toolchain is present and no custom Python initializer is
-    given; the native core gives lock-sharded concurrent pull/push and
-    deterministic per-id row init (model independent of insertion order
-    and shard count). Pure-Python dict fallback otherwise.
+    when ``use_native`` (default) and a toolchain is present and no
+    custom Python initializer is given; the native core gives
+    lock-sharded concurrent pull/push, a FUSED push (dedup + segment-sum
+    + optimizer apply in one C pass), native admission filtering for the
+    stock entry policies, and deterministic per-id row init (model
+    independent of insertion order and shard count).  Pure-Python dict
+    fallback otherwise (``use_native=False`` or ``backend="python"``).
+
+    Push semantics (both backends): duplicate ids' gradients are summed
+    first and the optimizer applies ONCE per unique id — the reference's
+    PushSparse merge, and the only well-defined AdaGrad/Adam behavior
+    under duplicates.
     """
 
     def __init__(self, dim: int, initializer=None, optimizer: str = "sgd",
                  lr: float = 0.01, seed: int = 0, init_std: float = 0.01,
                  backend: str = "auto", n_shards: int = 32,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 epsilon: float = 1e-10, entry=None):
+                 epsilon: float = 1e-10, entry=None,
+                 use_native: Optional[bool] = None):
         self.dim = dim
         # feature admission (reference entry_attr.py): ids the entry has
         # not admitted pull zeros and drop their grads — no row memory
@@ -54,9 +73,11 @@ class SparseTable:
         self._opt = optimizer
         self._lr = lr
         self._native = None
+        self._native_entry = False  # admission evaluated inside C
         self._lib = None
-        if backend != "python" and initializer is None \
-                and optimizer in _OPT_CODES:
+        if use_native is None:
+            use_native = backend != "python"
+        if use_native and initializer is None and optimizer in _OPT_CODES:
             from ...native import ps_core
             try:
                 lib = ps_core()
@@ -67,6 +88,18 @@ class SparseTable:
                 self._native = lib.pts_create(
                     dim, _OPT_CODES[optimizer], lr, beta1, beta2, epsilon,
                     init_std, seed, n_shards)
+                if entry is not None:
+                    # only the two stock policies have C twins; a custom
+                    # entry object keeps Python admission over native rows
+                    from ..entry import CountFilterEntry, ProbabilityEntry
+                    if type(entry) is CountFilterEntry:
+                        lib.pts_set_entry(self._native, _ENTRY_COUNT,
+                                          float(entry.count_filter))
+                        self._native_entry = True
+                    elif type(entry) is ProbabilityEntry:
+                        lib.pts_set_entry(self._native, _ENTRY_PROB,
+                                          float(entry.probability))
+                        self._native_entry = True
         # python fallback state
         self._rows: Dict[int, np.ndarray] = {}
         self._moments: Dict[int, np.ndarray] = {}
@@ -78,6 +111,10 @@ class SparseTable:
             lambda: self._rng.normal(0, init_std,
                                      size=(dim,)).astype(np.float32))
         self._lock = threading.Lock()
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
 
     def __del__(self):
         if getattr(self, "_native", None) is not None and self._lib:
@@ -92,11 +129,13 @@ class SparseTable:
         return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
     def _filter_admitted(self, ids: np.ndarray, counting: bool):
-        """Boolean admitted-mask for ``ids``; each pull counts as ONE
-        sighting per unique id (a batch with an id repeated k times is
-        one show, and every occurrence gets the same admission verdict
-        so one forward never mixes zeros with a real row for one id).
-        Steady state (all ids admitted) is one vectorized np.isin."""
+        """Boolean admitted-mask for ``ids`` (Python/hybrid path only —
+        native-entry tables evaluate admission inside C). Each pull
+        counts as ONE sighting per unique id (a batch with an id
+        repeated k times is one show, and every occurrence gets the same
+        admission verdict so one forward never mixes zeros with a real
+        row for one id). Steady state (all ids admitted) is one
+        vectorized np.isin."""
         with self._lock:
             arr = self._admitted_arr
             if arr is None or arr.size != len(self._admitted):
@@ -136,6 +175,14 @@ class SparseTable:
     def pull(self, ids: np.ndarray) -> np.ndarray:
         import ctypes
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        if self._native is not None and (self._entry is None
+                                         or self._native_entry):
+            # one C transaction: dedup + admission + gather (non-admitted
+            # positions come back zeroed)
+            out = np.empty((ids.size, self.dim), np.float32)
+            self._lib.pts_pull(self._native, self._c(ids, ctypes.c_int64),
+                               ids.size, self._c(out, ctypes.c_float))
+            return out
         if self._entry is not None:
             mask = self._filter_admitted(ids, counting=True)
             out = np.zeros((ids.size, self.dim), np.float32)
@@ -165,6 +212,12 @@ class SparseTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         grads = np.ascontiguousarray(
             np.asarray(grads, np.float32).reshape(ids.size, self.dim))
+        if self._native is not None and (self._entry is None
+                                         or self._native_entry):
+            # fused C pass: dedup + segment-sum + admission + apply
+            self._lib.pts_push(self._native, self._c(ids, ctypes.c_int64),
+                               ids.size, self._c(grads, ctypes.c_float))
+            return
         if self._entry is not None:
             # grads for never-admitted ids are dropped (their pulled
             # zeros carried no signal anyway) — reference show-click
@@ -173,13 +226,19 @@ class SparseTable:
             if not mask.any():
                 return
             if not mask.all():
-                ids, grads = ids[mask], grads[mask]
+                ids = np.ascontiguousarray(ids[mask])
+                grads = np.ascontiguousarray(grads[mask])
         if self._native is not None:
             self._lib.pts_push(self._native, self._c(ids, ctypes.c_int64),
                                ids.size, self._c(grads, ctypes.c_float))
             return
+        # python reference path: same fused semantics — duplicate ids'
+        # grads sum first, optimizer applies once per unique id
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        sums = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(sums, inverse, grads)
         with self._lock:
-            for k, g in zip(ids.tolist(), grads):
+            for k, g in zip(uniq.tolist(), sums):
                 row = self._rows.get(k)
                 if row is None:
                     row = self._rows[k] = self._init()
@@ -210,6 +269,12 @@ class SparseTable:
         ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
         deltas = np.ascontiguousarray(
             np.asarray(deltas, np.float32).reshape(ids.size, self.dim))
+        if self._native is not None and (self._entry is None
+                                         or self._native_entry):
+            self._lib.pts_push_delta(
+                self._native, self._c(ids, ctypes.c_int64), ids.size,
+                self._c(deltas, ctypes.c_float))
+            return
         if self._entry is not None:
             # the admission invariant holds on every write path: deltas
             # for never-admitted ids are dropped, no orphan rows
@@ -238,9 +303,29 @@ class SparseTable:
         with self._lock:
             return self._entry_state_locked()
 
+    def _native_entry_state(self):
+        """Admission state straight from the C directory (two-phase
+        export like pts_export, capped against concurrent growth)."""
+        import ctypes
+        lib, h = self._lib, self._native
+        n_adm = int(lib.pts_entry_export(h, 0, None, None, 0))
+        adm = np.empty(max(n_adm, 1), np.int64)
+        w = int(lib.pts_entry_export(h, 0, self._c(adm, ctypes.c_int64),
+                                     None, n_adm)) if n_adm else 0
+        n_seen = int(lib.pts_entry_export(h, 1, None, None, 0))
+        sid = np.empty(max(n_seen, 1), np.int64)
+        cnt = np.empty(max(n_seen, 1), np.int64)
+        ws = int(lib.pts_entry_export(h, 1, self._c(sid, ctypes.c_int64),
+                                      self._c(cnt, ctypes.c_int64),
+                                      n_seen)) if n_seen else 0
+        return {"admitted": adm[:w], "seen_ids": sid[:ws],
+                "seen_counts": cnt[:ws]}
+
     def _entry_state_locked(self):
         if self._entry is None:
             return {}
+        if self._native_entry:
+            return self._native_entry_state()
         adm = np.fromiter(self._admitted, np.int64, len(self._admitted))
         seen_ids = np.fromiter(self._seen, np.int64, len(self._seen))
         seen_cnt = np.asarray([self._seen[int(i)] for i in seen_ids],
@@ -252,14 +337,23 @@ class SparseTable:
         if self._entry is None:
             return
         if "admitted" in d:
-            self._admitted = set(d["admitted"].tolist())
-            self._seen = dict(zip(d["seen_ids"].tolist(),
-                                  d["seen_counts"].tolist()))
+            adm = np.ascontiguousarray(d["admitted"], np.int64)
+            sid = np.ascontiguousarray(d["seen_ids"], np.int64)
+            cnt = np.ascontiguousarray(d["seen_counts"], np.int64)
         else:
             # legacy checkpoint without admission state: every saved
             # row was trained, therefore admitted
-            self._admitted = set(np.asarray(row_ids).tolist())
-            self._seen = {}
+            adm = np.ascontiguousarray(np.asarray(row_ids), np.int64)
+            sid = cnt = np.zeros(0, np.int64)
+        if self._native_entry:
+            import ctypes
+            self._lib.pts_entry_import(
+                self._native, self._c(adm, ctypes.c_int64), adm.size,
+                self._c(sid, ctypes.c_int64),
+                self._c(cnt, ctypes.c_int64), sid.size)
+            return
+        self._admitted = set(adm.tolist())
+        self._seen = dict(zip(sid.tolist(), cnt.tolist()))
         self._admitted_arr = None
 
     def _restore_entry_state(self, d, row_ids):

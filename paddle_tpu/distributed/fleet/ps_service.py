@@ -5,9 +5,14 @@ Reference dataplane: brpc services defined by sendrecv.proto / ps.proto
 with a Communicator draining send queues in Sync/HalfAsync/Async/Geo modes
 (distributed/service/communicator.h:346,421,466,495).
 
-This module is the transport: length-prefixed msgpack-less binary frames
-(numpy buffers + a small pickled header) over TCP, thread-per-connection
-server, client with a background push thread implementing the async modes:
+This module is the transport: length-prefixed binary frames (a small
+pickled header; numpy payloads ride out-of-band as raw buffers, never
+pickled) over TCP, thread-per-connection server, client with a
+background push thread implementing the async modes.  Server-side, pull
+and push land directly on the native sparse-table core
+(native/ps_core.cc): one batched C gather / one fused C
+dedup+segment-sum+apply per RPC, no per-request Python dict walk.
+Modes:
 
   sync       push blocks until applied (Communicator::Sync)
   half_async push enqueues; queue drained continuously (HalfAsyncCommunicator)
@@ -42,8 +47,50 @@ _HDR = struct.Struct("!I")
 
 
 def _send_msg(sock: socket.socket, obj):
+    """Frame: [!I header_len][pickled header][raw array payloads...].
+
+    Top-level numpy values in a dict message ride OUT OF BAND: the
+    header pickles only their (key, dtype, shape) metadata and the
+    buffers follow as raw bytes via scatter-gather ``sendmsg`` — the
+    data plane (ids / grads / pulled rows) is never pickled or copied
+    into an intermediate frame, so a pull/push RPC against the native
+    table costs one small header pickle plus direct buffer writes."""
+    arrays = []
+    if isinstance(obj, dict) and any(isinstance(v, np.ndarray)
+                                     for v in obj.values()):
+        plain, meta = {}, []
+        for k, v in obj.items():
+            if isinstance(v, np.ndarray) and v.dtype != object:
+                v = np.ascontiguousarray(v)
+                meta.append((k, v.dtype.str, v.shape))
+                arrays.append(v)
+            else:
+                plain[k] = v
+        plain["__arrays__"] = meta
+        obj = plain
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(data)) + data)
+    parts = [memoryview(_HDR.pack(len(data)) + data)]
+    parts += [memoryview(a).cast("B") for a in arrays if a.nbytes]
+    _sendall_vec(sock, parts)
+
+
+def _sendall_vec(sock, views):
+    """sendall for a list of buffers without concatenating them (one
+    syscall per sendmsg window, zero staging copies)."""
+    while views:
+        try:
+            sent = sock.sendmsg(views)
+        except AttributeError:      # platform without sendmsg
+            for v in views:
+                sock.sendall(v)
+            return
+        while sent > 0 and views:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
 
 
 def _recv_msg(sock: socket.socket):
@@ -52,17 +99,31 @@ def _recv_msg(sock: socket.socket):
         return None
     (n,) = _HDR.unpack(hdr)
     data = _recv_exact(sock, n)
-    return None if data is None else pickle.loads(data)
+    if data is None:
+        return None
+    msg = pickle.loads(data)
+    if isinstance(msg, dict) and "__arrays__" in msg:
+        for k, dt, shape in msg.pop("__arrays__"):
+            dtype = np.dtype(dt)
+            count = int(np.prod(shape)) if shape else 1
+            buf = _recv_exact(sock, count * dtype.itemsize)
+            if buf is None:
+                return None
+            # bytearray-backed: the receiver may mutate in place
+            msg[k] = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    return msg
 
 
 def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             return None
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
+    return buf
 
 
 class HeartBeatMonitor:

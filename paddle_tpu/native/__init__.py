@@ -94,6 +94,7 @@ def ps_core() -> Optional[ctypes.CDLL]:
                                c.c_int]
     lib.pts_free.argtypes = [c.c_void_p]
     lib.pts_set_lr.argtypes = [c.c_void_p, c.c_float]
+    lib.pts_set_entry.argtypes = [c.c_void_p, c.c_int, c.c_double]
     lib.pts_pull.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_push.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_push_delta.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
@@ -101,8 +102,14 @@ def ps_core() -> Optional[ctypes.CDLL]:
     lib.pts_size.argtypes = [c.c_void_p]
     lib.pts_export.restype = c.c_int64
     lib.pts_export.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
+    lib.pts_entry_export.restype = c.c_int64
+    lib.pts_entry_export.argtypes = [c.c_void_p, c.c_int, i64p, i64p,
+                                     c.c_int64]
+    lib.pts_entry_import.argtypes = [c.c_void_p, i64p, c.c_int64, i64p,
+                                     i64p, c.c_int64]
     lib.pts_import.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_clear.argtypes = [c.c_void_p]
+    lib.ps_segsum_inv.argtypes = [i64p, c.c_int64, c.c_int, f32p, f32p]
     lib._pts_ready = True
     return lib
 

@@ -1,32 +1,50 @@
-// Native sparse-table core for the parameter-server path.
+// Native sparse-table core for the parameter-server path — the PS data
+// plane lives HERE, not in Python.
 //
 // TPU-native equivalent of the reference's C++ sparse table stack
 // (reference: paddle/fluid/distributed/table/common_sparse_table.cc,
 // operators/distributed/large_scale_kv.h — unbounded id->row storage with
 // per-row optimizer state, lazily initialised, sharded + locked for
-// concurrent trainer threads; framework/fleet/fleet_wrapper.h:66
-// PullSparseVarsSync / PushSparseVarsWithLabelAsync semantics).
+// concurrent trainer threads; framework/fleet/fleet_wrapper.h:111-185
+// PullSparseVarsSync / PushSparseVarsWithLabelAsync — the batched C++
+// hot loop this file is the analog of).
 //
 // Design (not a port):
-//  - N shards, each an open unordered_map id -> row index into a chunked
-//    slab (16k rows/chunk) so rows never move and pointers stay stable.
-//  - Row stride = dim * (1 value + optimizer-state slots) + 1 step slot;
-//    SGD:0 extra, AdaGrad:1 (accumulator), Adam:2 (m, v).
+//  - N shards, each an OPEN-ADDRESSING directory (linear probe, power-of-2
+//    capacity) of Slot{id, row, seen, flags}: one probe resolves the row
+//    index, the admission verdict, and the sighting counter together.
+//  - Rows live in a chunked float32 arena (16k rows/chunk) so row
+//    pointers never move; row stride = dim * (1 value + optimizer-state
+//    slots) + 1 step slot; SGD:0 extra, AdaGrad:1 (accumulator),
+//    Adam:2 (m, v).
+//  - pull(ids, out): per-shard dedup, then ONE directory probe +
+//    admission verdict per unique id; duplicate positions memcpy from
+//    the same resolved row. A pull counts ONE sighting per unique id and
+//    every occurrence gets the same verdict (zeros or the row) — the
+//    Python SparseTable admission contract, now in C.
+//  - push(ids, grads): FUSED dedup + segment-sum + optimizer apply in
+//    one pass — duplicate ids' gradients are accumulated first and the
+//    optimizer applies ONCE per unique id (the reference's
+//    PushSparse merge semantics; also what makes AdaGrad/Adam correct
+//    under duplicate ids).
+//  - Admission entries native: count-filter (admit after K sightings)
+//    and probability (deterministic splitmix-style per-id hash, BIT-EXACT
+//    with python/paddle_tpu/distributed/entry.py so the two backends
+//    admit identical subsets). Rejected probability ids leave NO slot
+//    behind; rejected count ids keep only the counter (row = -1).
 //  - Per-id deterministic init: splitmix64(seed ^ id) -> Box-Muller
-//    normal(0, init_std). Pull/push order and shard count thus never
-//    change the model — the reference's RNG-per-server cannot say that.
-//  - pull/push fan out over worker threads, grouped by shard so each
-//    shard lock is taken once per call, not once per id.
+//    normal(0, init_std). Pull/push order and shard count never change
+//    the model.
+//  - pull/push fan out over worker threads grouped by shard: each shard
+//    lock is taken once per call, not once per id.
 //
 // C ABI only (loaded via ctypes; pybind11 is not in this image).
 #include <atomic>
 #include <cmath>
 #include <cstdint>
-#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <thread>
-#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -34,6 +52,7 @@ namespace {
 constexpr int kRowsPerChunk = 1 << 14;
 
 enum Opt { kSGD = 0, kAdaGrad = 1, kAdam = 2 };
+enum EntryMode { kNoEntry = 0, kCountEntry = 1, kProbEntry = 2 };
 
 static inline uint64_t splitmix64(uint64_t x) {
   x += 0x9e3779b97f4a7c15ULL;
@@ -42,10 +61,31 @@ static inline uint64_t splitmix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// BIT-EXACT mirror of ProbabilityEntry.admit (distributed/entry.py):
+// both backends must admit the identical subset for a given probability.
+static inline bool prob_admit(int64_t id, double p) {
+  uint64_t h = (uint64_t)id * 0x9E3779B97F4A7C15ULL;
+  h ^= h >> 31;
+  h *= 0xBF58476D1CE4E5B9ULL;
+  h ^= h >> 29;
+  return (double)h * (1.0 / 18446744073709551616.0) < p;
+}
+
+constexpr uint32_t kOccupied = 1u;
+constexpr uint32_t kAdmitted = 2u;
+
+struct Slot {
+  int64_t id;
+  int64_t row;    // arena row index; -1 = admission counter only, no row
+  uint32_t seen;  // sighting count (count-filter entries, pre-admission)
+  uint32_t flags;
+};
+
 struct Shard {
-  std::unordered_map<int64_t, uint64_t> index;
+  std::vector<Slot> slots;  // open addressing, power-of-2, linear probe
+  uint64_t used = 0;        // occupied slots
+  uint64_t rows_used = 0;   // arena rows allocated
   std::vector<float*> chunks;
-  uint64_t used = 0;  // rows in use
   std::mutex mu;
 
   ~Shard() {
@@ -60,6 +100,8 @@ struct Table {
   uint64_t seed;
   int n_shards;
   int stride;  // floats per row incl. optimizer state + step counter
+  int entry_mode = kNoEntry;
+  double entry_param = 0.0;  // count threshold / admit probability
   std::vector<Shard> shards;
 
   Table(int dim_, int opt_, float lr_, float b1, float b2, float eps_,
@@ -75,23 +117,72 @@ struct Table {
     return (int)(splitmix64((uint64_t)id) % (uint64_t)n_shards);
   }
 
-  // caller holds s.mu
-  float* row_locked(Shard& s, int64_t id, bool create) {
-    auto it = s.index.find(id);
-    if (it == s.index.end()) {
-      if (!create) return nullptr;
-      uint64_t idx = s.used++;
+  // directory hash must be independent of shard_of (which consumes the
+  // low splitmix bits via % n_shards): re-mix, or every id in a shard
+  // would collide into 1/n_shards of the buckets
+  static uint64_t slot_hash(int64_t id) {
+    return splitmix64(splitmix64((uint64_t)id) ^ 0x517cc1b727220a95ULL);
+  }
+
+  // caller holds s.mu for all directory/arena ops ------------------------
+  Slot* find(Shard& s, int64_t id) const {
+    if (s.slots.empty()) return nullptr;
+    uint64_t mask = s.slots.size() - 1;
+    uint64_t i = slot_hash(id) & mask;
+    while (s.slots[i].flags & kOccupied) {
+      if (s.slots[i].id == id) return &s.slots[i];
+      i = (i + 1) & mask;
+    }
+    return nullptr;
+  }
+
+  void grow(Shard& s) {
+    size_t ncap = s.slots.empty() ? 1024 : s.slots.size() * 2;
+    std::vector<Slot> old;
+    old.swap(s.slots);
+    s.slots.assign(ncap, Slot{0, -1, 0, 0});
+    uint64_t mask = ncap - 1;
+    for (Slot& sl : old) {
+      if (!(sl.flags & kOccupied)) continue;
+      uint64_t i = slot_hash(sl.id) & mask;
+      while (s.slots[i].flags & kOccupied) i = (i + 1) & mask;
+      s.slots[i] = sl;
+    }
+  }
+
+  // find-or-create; may grow (invalidating previously returned Slot*)
+  Slot* insert(Shard& s, int64_t id) {
+    if (s.slots.empty() || (s.used + 1) * 10 >= s.slots.size() * 7)
+      grow(s);
+    uint64_t mask = s.slots.size() - 1;
+    uint64_t i = slot_hash(id) & mask;
+    while (s.slots[i].flags & kOccupied) {
+      if (s.slots[i].id == id) return &s.slots[i];
+      i = (i + 1) & mask;
+    }
+    s.slots[i] = Slot{id, -1, 0, kOccupied};
+    ++s.used;
+    return &s.slots[i];
+  }
+
+  float* row_ptr(Shard& s, int64_t row) const {
+    return s.chunks[row / kRowsPerChunk] +
+           (size_t)(row % kRowsPerChunk) * stride;
+  }
+
+  // materialise the slot's arena row (deterministic init unless the
+  // caller will overwrite it wholesale, e.g. import)
+  float* row_of(Shard& s, Slot* sl, bool init) {
+    if (sl->row < 0) {
+      uint64_t idx = s.rows_used++;
       if (idx / kRowsPerChunk >= s.chunks.size())
         s.chunks.push_back(new float[(size_t)kRowsPerChunk * stride]);
-      s.index.emplace(id, idx);
-      float* r = s.chunks[idx / kRowsPerChunk] +
-                 (size_t)(idx % kRowsPerChunk) * stride;
-      init_row(r, id);
+      sl->row = (int64_t)idx;
+      float* r = row_ptr(s, sl->row);
+      if (init) init_row(r, sl->id);
       return r;
     }
-    uint64_t idx = it->second;
-    return s.chunks[idx / kRowsPerChunk] +
-           (size_t)(idx % kRowsPerChunk) * stride;
+    return row_ptr(s, sl->row);
   }
 
   void init_row(float* r, int64_t id) {
@@ -104,7 +195,8 @@ struct Table {
       double u2 = (st >> 11) * (1.0 / 9007199254740992.0);
       double m = std::sqrt(-2.0 * std::log(u1)) * init_std;
       r[j] = (float)(m * std::cos(6.283185307179586 * u2));
-      if (j + 1 < dim) r[j + 1] = (float)(m * std::sin(6.283185307179586 * u2));
+      if (j + 1 < dim)
+        r[j + 1] = (float)(m * std::sin(6.283185307179586 * u2));
     }
     std::memset(r + dim, 0, sizeof(float) * (stride - dim));
   }
@@ -140,12 +232,47 @@ struct Table {
       }
     }
   }
+
+  // Admission verdict for one unique id. counting=true is the pull path
+  // (each pull is ONE sighting per unique id); false is the push path
+  // (grads never count as sightings). Returns the row pointer when
+  // admitted (creating the row), nullptr when the id pulls zeros /
+  // drops its grad. Mirrors SparseTable._filter_admitted exactly.
+  float* admit_row(Shard& s, int64_t id, bool counting) {
+    switch (entry_mode) {
+      case kNoEntry:
+        return row_of(s, insert(s, id), true);
+      case kCountEntry: {
+        Slot* sl = insert(s, id);
+        if (sl->flags & kAdmitted) return row_of(s, sl, true);
+        if (counting) ++sl->seen;
+        if ((double)sl->seen >= entry_param) {
+          sl->flags |= kAdmitted;
+          sl->seen = 0;  // python pops the counter on admit
+          return row_of(s, sl, true);
+        }
+        return nullptr;
+      }
+      default: {  // kProbEntry
+        Slot* sl = find(s, id);
+        if (sl != nullptr && (sl->flags & kAdmitted))
+          return row_of(s, sl, true);
+        if (!prob_admit(id, entry_param)) return nullptr;
+        // rejected ids leave NO slot behind (ProbabilityEntry is
+        // count-independent — the memory the entry exists to save)
+        sl = insert(s, id);
+        sl->flags |= kAdmitted;
+        return row_of(s, sl, true);
+      }
+    }
+  }
 };
 
-// Group positions by shard once, then each worker thread owns a disjoint
-// set of shards — one lock acquisition per (call, shard), no contention.
+// Per-shard batched fan-out: positions grouped by shard once, worker
+// threads claim whole shards — one lock acquisition per (call, shard).
+// fn(shard_index, positions) owns the shard's slice of the batch.
 template <typename Fn>
-void for_each_shard_group(Table* t, const int64_t* ids, int64_t n, Fn fn) {
+void for_each_shard_batch(Table* t, const int64_t* ids, int64_t n, Fn fn) {
   std::vector<std::vector<int64_t>> by_shard(t->n_shards);
   for (int64_t i = 0; i < n; ++i)
     by_shard[t->shard_of(ids[i])].push_back(i);
@@ -159,7 +286,7 @@ void for_each_shard_group(Table* t, const int64_t* ids, int64_t n, Fn fn) {
       if (by_shard[s].empty()) continue;
       Shard& sh = t->shards[s];
       std::lock_guard<std::mutex> lk(sh.mu);
-      for (int64_t pos : by_shard[s]) fn(sh, pos);
+      fn(s, by_shard[s]);
     }
   };
   if (workers == 1) {
@@ -168,6 +295,31 @@ void for_each_shard_group(Table* t, const int64_t* ids, int64_t n, Fn fn) {
     std::vector<std::thread> th;
     for (int w = 0; w < workers; ++w) th.emplace_back(run);
     for (auto& x : th) x.join();
+  }
+}
+
+// Local first-occurrence dedup of a shard's positions: fills u_of
+// (position -> unique index) and uniq (unique ids in first-touch order).
+void dedup(const int64_t* ids, const std::vector<int64_t>& pos,
+           std::vector<int32_t>& u_of, std::vector<int64_t>& uniq) {
+  size_t m = pos.size();
+  size_t cap = 16;
+  while (cap < 2 * m) cap <<= 1;
+  std::vector<int64_t> keys(cap);
+  std::vector<int32_t> vals(cap, -1);
+  u_of.resize(m);
+  uniq.clear();
+  uint64_t mask = cap - 1;
+  for (size_t p = 0; p < m; ++p) {
+    int64_t id = ids[pos[p]];
+    uint64_t i = Table::slot_hash(id) & mask;
+    while (vals[i] >= 0 && keys[i] != id) i = (i + 1) & mask;
+    if (vals[i] < 0) {
+      keys[i] = id;
+      vals[i] = (int32_t)uniq.size();
+      uniq.push_back(id);
+    }
+    u_of[p] = vals[i];
   }
 }
 
@@ -186,41 +338,99 @@ void pts_free(void* h) { delete (Table*)h; }
 
 void pts_set_lr(void* h, float lr) { ((Table*)h)->lr = lr; }
 
-// gather rows (lazy init) into out[n, dim]
+// feature admission policy: mode 1 = count filter (param = threshold),
+// mode 2 = probability (param = admit probability), 0 = none
+void pts_set_entry(void* h, int mode, double param) {
+  Table* t = (Table*)h;
+  t->entry_mode = mode;
+  t->entry_param = param;
+}
+
+// gather rows (lazy init, admission-aware) into out[n, dim]: ONE
+// directory transaction per unique id; non-admitted ids write zeros at
+// every one of their positions (one sighting per unique id per call)
 void pts_pull(void* h, const int64_t* ids, int64_t n, float* out) {
   Table* t = (Table*)h;
-  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
-    float* r = t->row_locked(sh, ids[i], true);
-    std::memcpy(out + (size_t)i * t->dim, r, sizeof(float) * t->dim);
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    std::vector<int32_t> u_of;
+    std::vector<int64_t> uniq;
+    dedup(ids, pos, u_of, uniq);
+    // resolve each unique once; row pointers are stable under the shard
+    // lock (arena rows never move), so duplicates just memcpy
+    std::vector<float*> rowp(uniq.size());
+    for (size_t u = 0; u < uniq.size(); ++u)
+      rowp[u] = t->admit_row(sh, uniq[u], /*counting=*/true);
+    for (size_t p = 0; p < pos.size(); ++p) {
+      float* dst = out + (size_t)pos[p] * t->dim;
+      float* r = rowp[u_of[p]];
+      if (r != nullptr)
+        std::memcpy(dst, r, sizeof(float) * t->dim);
+      else
+        std::memset(dst, 0, sizeof(float) * t->dim);
+    }
   });
 }
 
-// apply optimizer update per (id, grad) pair; duplicates apply in order
+// FUSED push: dedup + segment-sum + admission filter + optimizer apply
+// in one pass. Duplicate ids' grads accumulate first; the optimizer
+// applies ONCE per unique id (correct AdaGrad/Adam merge semantics).
+// Grads of never-admitted ids are dropped (their pulled zeros carried
+// no signal); pushes do not count as sightings.
 void pts_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
   Table* t = (Table*)h;
-  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
-    float* r = t->row_locked(sh, ids[i], true);
-    t->apply(r, grads + (size_t)i * t->dim);
+  int dim = t->dim;
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    std::vector<int32_t> u_of;
+    std::vector<int64_t> uniq;
+    dedup(ids, pos, u_of, uniq);
+    std::vector<float> acc(uniq.size() * (size_t)dim, 0.0f);
+    for (size_t p = 0; p < pos.size(); ++p) {
+      const float* g = grads + (size_t)pos[p] * dim;
+      float* a = acc.data() + (size_t)u_of[p] * dim;
+      for (int j = 0; j < dim; ++j) a[j] += g[j];
+    }
+    for (size_t u = 0; u < uniq.size(); ++u) {
+      float* r = t->admit_row(sh, uniq[u], /*counting=*/false);
+      if (r != nullptr) t->apply(r, acc.data() + u * (size_t)dim);
+    }
   });
 }
 
-// geo-mode raw delta add (no optimizer)
+// geo-mode raw delta add (no optimizer); same fused dedup + admission
 void pts_push_delta(void* h, const int64_t* ids, int64_t n,
                     const float* deltas) {
   Table* t = (Table*)h;
-  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
-    float* r = t->row_locked(sh, ids[i], true);
-    const float* d = deltas + (size_t)i * t->dim;
-    for (int j = 0; j < t->dim; ++j) r[j] += d[j];
+  int dim = t->dim;
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    std::vector<int32_t> u_of;
+    std::vector<int64_t> uniq;
+    dedup(ids, pos, u_of, uniq);
+    std::vector<float> acc(uniq.size() * (size_t)dim, 0.0f);
+    for (size_t p = 0; p < pos.size(); ++p) {
+      const float* d = deltas + (size_t)pos[p] * dim;
+      float* a = acc.data() + (size_t)u_of[p] * dim;
+      for (int j = 0; j < dim; ++j) a[j] += d[j];
+    }
+    for (size_t u = 0; u < uniq.size(); ++u) {
+      float* r = t->admit_row(sh, uniq[u], /*counting=*/false);
+      if (r == nullptr) continue;
+      const float* a = acc.data() + u * (size_t)dim;
+      for (int j = 0; j < dim; ++j) r[j] += a[j];
+    }
   });
 }
 
+// materialised rows only — admission counters (row == -1) don't count,
+// matching the Python backend's len(self._rows)
 int64_t pts_size(void* h) {
   Table* t = (Table*)h;
   int64_t n = 0;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lk(s.mu);
-    n += (int64_t)s.index.size();
+    n += (int64_t)s.rows_used;
   }
   return n;
 }
@@ -237,14 +447,41 @@ int64_t pts_export(void* h, int64_t* ids_out, float* vals_out,
   int64_t n = 0;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lk(s.mu);
-    for (auto& kv : s.index) {
-      if ((ids_out || vals_out) && n >= cap) return n;
-      if (ids_out) ids_out[n] = kv.first;
-      if (vals_out) {
-        float* r = s.chunks[kv.second / kRowsPerChunk] +
-                   (size_t)(kv.second % kRowsPerChunk) * t->stride;
-        std::memcpy(vals_out + (size_t)n * t->dim, r,
+    if (ids_out == nullptr && vals_out == nullptr) {
+      n += (int64_t)s.rows_used;
+      continue;
+    }
+    for (auto& sl : s.slots) {
+      if (!(sl.flags & kOccupied) || sl.row < 0) continue;
+      if (n >= cap) return n;
+      if (ids_out) ids_out[n] = sl.id;
+      if (vals_out)
+        std::memcpy(vals_out + (size_t)n * t->dim, t->row_ptr(s, sl.row),
                     sizeof(float) * t->dim);
+      ++n;
+    }
+  }
+  return n;
+}
+
+// admission-state export, same two-phase contract as pts_export.
+// which=0: admitted ids. which=1: pre-admission sighting counters
+// (ids_out + cnt_out). Null ids_out queries the count.
+int64_t pts_entry_export(void* h, int which, int64_t* ids_out,
+                         int64_t* cnt_out, int64_t cap) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& sl : s.slots) {
+      if (!(sl.flags & kOccupied)) continue;
+      bool want = which == 0 ? (sl.flags & kAdmitted) != 0
+                             : !(sl.flags & kAdmitted) && sl.seen > 0;
+      if (!want) continue;
+      if (ids_out != nullptr) {
+        if (n >= cap) return n;
+        ids_out[n] = sl.id;
+        if (cnt_out != nullptr) cnt_out[n] = (int64_t)sl.seen;
       }
       ++n;
     }
@@ -252,26 +489,65 @@ int64_t pts_export(void* h, int64_t* ids_out, float* vals_out,
   return n;
 }
 
-// drop every row (used by load(): restore replaces, never merges)
+// restore admission state (after pts_clear + pts_import): admitted ids
+// get the flag (their rows, if saved, already exist; otherwise the row
+// materialises on next pull), seen ids get their counters back
+void pts_entry_import(void* h, const int64_t* admitted, int64_t n_adm,
+                      const int64_t* seen_ids, const int64_t* seen_cnt,
+                      int64_t n_seen) {
+  Table* t = (Table*)h;
+  for (int64_t i = 0; i < n_adm; ++i) {
+    Shard& s = t->shards[t->shard_of(admitted[i])];
+    std::lock_guard<std::mutex> lk(s.mu);
+    t->insert(s, admitted[i])->flags |= kAdmitted;
+  }
+  for (int64_t i = 0; i < n_seen; ++i) {
+    Shard& s = t->shards[t->shard_of(seen_ids[i])];
+    std::lock_guard<std::mutex> lk(s.mu);
+    t->insert(s, seen_ids[i])->seen = (uint32_t)seen_cnt[i];
+  }
+}
+
+// drop every row AND the admission state (used by load(): restore
+// replaces, never merges)
 void pts_clear(void* h) {
   Table* t = (Table*)h;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lk(s.mu);
-    s.index.clear();
+    s.slots.clear();
+    s.used = 0;
     for (float* c : s.chunks) delete[] c;
     s.chunks.clear();
-    s.used = 0;
+    s.rows_used = 0;
   }
 }
 
-// bulk load values (fresh optimizer state)
+// bulk load values (fresh optimizer state); bypasses admission — the
+// caller restores entry state separately via pts_entry_import
 void pts_import(void* h, const int64_t* ids, int64_t n, const float* vals) {
   Table* t = (Table*)h;
-  for_each_shard_group(t, ids, n, [&](Shard& sh, int64_t i) {
-    float* r = t->row_locked(sh, ids[i], true);
-    std::memcpy(r, vals + (size_t)i * t->dim, sizeof(float) * t->dim);
-    std::memset(r + t->dim, 0, sizeof(float) * (t->stride - t->dim));
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    for (int64_t p : pos) {
+      float* r = t->row_of(sh, t->insert(sh, ids[p]), /*init=*/false);
+      std::memcpy(r, vals + (size_t)p * t->dim, sizeof(float) * t->dim);
+      std::memset(r + t->dim, 0, sizeof(float) * (t->stride - t->dim));
+    }
   });
+}
+
+// standalone dedup-free segment-sum: sums[seg_of[i]] += grads[i] for a
+// caller-provided segment map (e.g. np.unique's inverse). Replaces the
+// per-push jax.ops.segment_sum DISPATCH on the host-gradient path of
+// the device cache (fleet/heter.py) — the sum itself was never the
+// cost; the per-call XLA dispatch on a 1-core host was.
+void ps_segsum_inv(const int64_t* seg_of, int64_t n, int dim,
+                   const float* grads, float* sums) {
+  for (int64_t i = 0; i < n; ++i) {
+    float* a = sums + (size_t)seg_of[i] * dim;
+    const float* g = grads + (size_t)i * dim;
+    for (int j = 0; j < dim; ++j) a[j] += g[j];
+  }
 }
 
 }  // extern "C"

@@ -192,3 +192,163 @@ def test_load_replaces_python_backend(tmp_path):
     t2.load(p + ".npz")
     assert len(t2) == 2
     assert not t2._moments                   # optimizer state reset
+
+
+# ---------------------------------------------------------------------
+# r6: native-vs-Python parity for the full data plane (fused push,
+# admission entries, moments, cross-backend checkpoints) + wide_deep
+# e2e smoke — the ISSUE-1 acceptance tests.
+# ---------------------------------------------------------------------
+
+def _zero_native(t, ids):
+    """Force a native table's rows for ``ids`` to zeros so both backends
+    start from identical state (their default inits differ by design)."""
+    import ctypes
+    ids = np.ascontiguousarray(ids, np.int64)
+    z = np.zeros((ids.size, t.dim), np.float32)
+    t._lib.pts_import(t._native, t._c(ids, ctypes.c_int64), ids.size,
+                      t._c(z, ctypes.c_float))
+
+
+@requires_native
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam"])
+def test_pull_after_push_parity(opt):
+    """Pull-after-push parity, duplicates included: the fused native
+    push (dedup + segment-sum + single apply) must match the Python
+    reference path bit-for-tolerance across every optimizer."""
+    ids = np.array([3, 9, 3, 42, 9, 3], np.int64)
+    uniq = np.array([3, 9, 42], np.int64)
+    g = np.random.RandomState(7).randn(6, 5).astype(np.float32)
+    tn = SparseTable(5, optimizer=opt, lr=0.03)
+    tp = SparseTable(5, optimizer=opt, lr=0.03, use_native=False,
+                     initializer=lambda: np.zeros(5, np.float32))
+    _zero_native(tn, uniq)
+    for _ in range(4):
+        tn.push(ids, g)
+        tp.push(ids, g)
+    np.testing.assert_allclose(tn.pull(uniq), tp.pull(uniq),
+                               rtol=1e-4, atol=1e-6)
+
+
+@requires_native
+def test_fused_push_equals_presummed_push():
+    """The fused-push contract, stated directly: pushing duplicate ids
+    equals pushing their summed gradient once (NOT sequential applies —
+    the distinction matters for adagrad/adam)."""
+    g = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    ta = SparseTable(4, optimizer="adam", lr=0.01)
+    tb = SparseTable(4, optimizer="adam", lr=0.01)
+    one = np.array([11], np.int64)
+    _zero_native(ta, one)
+    _zero_native(tb, one)
+    ta.push(np.array([11, 11, 11], np.int64), g)
+    tb.push(one, g.sum(axis=0, keepdims=True))
+    np.testing.assert_allclose(ta.pull(one), tb.pull(one),
+                               rtol=1e-5, atol=1e-7)
+
+
+@requires_native
+def test_native_count_entry_matches_python():
+    """CountFilterEntry admission runs inside C: threshold counting,
+    one-sighting-per-unique-id-per-pull, and grad dropping must all
+    match the Python reference decisions."""
+    from paddle_tpu.distributed import CountFilterEntry
+    tn = SparseTable(4, entry=CountFilterEntry(3), lr=1.0)
+    tp = SparseTable(4, entry=CountFilterEntry(3), lr=1.0,
+                     use_native=False)
+    assert tn._native_entry
+    ids = np.array([7, 8, 7], np.int64)     # 7 twice = ONE sighting
+    for _ in range(2):                       # sightings 1, 2: rejected
+        on, op = tn.pull(ids), tp.pull(ids)
+        assert not on.any() and not op.any()
+        assert len(tn) == 0 and len(tp._rows) == 0
+    # grads before admission are dropped by both
+    tn.push(ids, np.ones((3, 4), np.float32))
+    tp.push(ids, np.ones((3, 4), np.float32))
+    assert len(tn) == 0 and len(tp._rows) == 0
+    # 3rd sighting admits in both; duplicate positions serve one row
+    on, op = tn.pull(ids), tp.pull(ids)
+    assert on.any() and op.any()
+    np.testing.assert_array_equal(on[0], on[2])
+    assert len(tn) == 2 and len(tp._rows) == 2
+    # post-admission push applies (lr=1, grads summed over duplicates)
+    before = tn.pull(ids).copy()
+    tn.push(ids, np.ones((3, 4), np.float32))
+    got = tn.pull(ids)
+    np.testing.assert_allclose(got[1], before[1] - 1.0, rtol=1e-5)
+    np.testing.assert_allclose(got[0], before[0] - 2.0, rtol=1e-5)
+
+
+@requires_native
+def test_native_probability_entry_matches_python():
+    """ProbabilityEntry's C hash is bit-exact with entry.py: both
+    backends must admit the IDENTICAL subset, and rejected ids must
+    leave no slot behind (len == admitted rows only)."""
+    from paddle_tpu.distributed import ProbabilityEntry
+    tn = SparseTable(4, entry=ProbabilityEntry(0.5))
+    tp = SparseTable(4, entry=ProbabilityEntry(0.5), use_native=False)
+    assert tn._native_entry
+    ids = np.arange(500, dtype=np.int64)
+    on, op = tn.pull(ids), tp.pull(ids)
+    zn = ~on.any(axis=1)
+    zp = ~op.any(axis=1)
+    np.testing.assert_array_equal(zn, zp)
+    assert len(tn) == len(tp._rows) == int((~zn).sum())
+    st = tn._entry_state()
+    assert set(st["admitted"].tolist()) == tp._admitted
+    assert st["seen_ids"].size == 0          # count-independent entry
+
+
+@requires_native
+def test_entry_state_roundtrip_cross_backend(tmp_path):
+    """Checkpoint format parity including admission state: save from
+    either backend, load into the other, admission picks up where it
+    left off (trained rows served immediately, counters survive)."""
+    from paddle_tpu.distributed import CountFilterEntry
+    for src_native in (True, False):
+        t = SparseTable(4, entry=CountFilterEntry(2), lr=1.0,
+                        use_native=src_native)
+        hot = np.asarray([5], np.int64)
+        t.pull(hot)
+        t.pull(hot)                          # admitted at sighting 2
+        t.push(hot, np.ones((1, 4), np.float32))
+        trained = t.pull(hot).copy()
+        warm = np.asarray([9], np.int64)
+        t.pull(warm)                         # 1 sighting, not admitted
+        p = str(tmp_path / f"ck{src_native}")
+        t.save(p)
+        for dst_native in (True, False):
+            t2 = SparseTable(4, entry=CountFilterEntry(2), lr=1.0,
+                             use_native=dst_native)
+            t2.load(p)
+            np.testing.assert_allclose(t2.pull(hot), trained)
+            t2.pull(warm)                    # counter survived: admits
+            assert t2.pull(warm).any(), (src_native, dst_native)
+
+
+@requires_native
+def test_use_native_flag():
+    assert SparseTable(4, use_native=True).is_native
+    assert not SparseTable(4, use_native=False).is_native
+    # use_native=False must still be a fully working table
+    t = SparseTable(4, use_native=False)
+    t.push(np.array([1], np.int64), np.ones((1, 4), np.float32))
+    assert len(t) == 1
+
+
+@requires_native
+def test_wide_deep_native_e2e_smoke(monkeypatch):
+    """wide_deep end-to-end through HeterTrainer with use_native=True
+    (the r6 bench default): loss finite, native backend actually on."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    monkeypatch.setenv("BENCH_PS_NATIVE", "1")
+    monkeypatch.setenv("BENCH_STEPS", "4")
+    monkeypatch.setenv("BENCH_BATCH", "64")
+    out = bench._bench_wide_deep(smoke=True, peak_tflops=100.0)
+    assert out["ps_backend"] == "native"
+    assert out["value"] > 0
+    assert np.isfinite(out["loss_last"])
+    assert out["plausible"]

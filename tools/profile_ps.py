@@ -1,0 +1,183 @@
+"""Python-vs-native share of the wide_deep PS step time.
+
+VERDICT r5 (weak #5, next-round #5) showed the wide_deep step is
+host-bound on a 1-core host and asked for exactly this evidence: after
+moving the PS data plane into native/ps_core.cc, how much of the step
+still runs in the Python interpreter?
+
+Method: the bench-shaped wide_deep workload (Zipf ids, jitted dense
+step) run SYNCHRONOUSLY — pull -> dense step -> push, no pipeline
+threads — so every millisecond attributes to exactly one phase:
+
+  native_c_ms   wall time inside the ps_core.cc entry points (measured
+                by wrapping the ctypes functions; includes the C-side
+                dedup + segment-sum + optimizer apply)
+  xla_ms        wall time inside the jitted dense fwd/bwd call (device
+                compute + its dispatch)
+  python_ms     everything else: interpreter, numpy marshalling,
+                host<->device transfers, loop overhead
+  python_share  python_ms / total — the number the acceptance gate
+                reads (target: < 0.5 with the native backend)
+
+Runs both backends (pure-Python SparseTable reference, then native) and
+prints one JSON line per backend plus a speedup line.
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_ps.py [--smoke]
+Env: PROFILE_BATCH, PROFILE_STEPS, PROFILE_SLOTS, PROFILE_DIM.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class TimedLib:
+    """ctypes CDLL proxy that accumulates wall time spent inside the
+    native PS entry points (pts_* / ps_*)."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self.seconds = 0.0
+
+    def __getattr__(self, name):
+        fn = getattr(self._lib, name)
+        if not callable(fn) or not name.startswith(("pts_", "ps_")):
+            return fn
+
+        def timed(*args):
+            t0 = time.perf_counter()
+            r = fn(*args)
+            self.seconds += time.perf_counter() - t0
+            return r
+
+        return timed
+
+
+def profile_backend(use_native: bool, smoke: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet.ps import SparseTable
+
+    n_slots = int(os.environ.get("PROFILE_SLOTS", "4" if smoke else "26"))
+    dim = int(os.environ.get("PROFILE_DIM", "8" if smoke else "16"))
+    batch = int(os.environ.get("PROFILE_BATCH",
+                               "64" if smoke else "1024"))
+    steps = int(os.environ.get("PROFILE_STEPS", "4" if smoke else "30"))
+    vocab = 1000 if smoke else 20_000
+    n_dense = 13
+    hidden = 64 if smoke else 256
+
+    table = SparseTable(dim, optimizer="sgd", lr=0.05,
+                        use_native=use_native)
+    if use_native and not table.is_native:
+        return None   # no toolchain on this host
+    if table.is_native:
+        table._lib = TimedLib(table._lib)
+
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(n_slots * dim + n_dense, hidden) * 0.05,
+                     jnp.float32)
+    b1 = jnp.zeros((hidden,), jnp.float32)
+    w2 = jnp.asarray(rng.randn(hidden, 1) * 0.05, jnp.float32)
+    wide_w = jnp.asarray(rng.randn(n_dense, 1) * 0.05, jnp.float32)
+    params = (w1, b1, w2, wide_w)
+
+    @jax.jit
+    def dense_fwd_bwd(params, emb, dense, label):
+        def loss_of(params, emb):
+            w1, b1, w2, wide_w = params
+            e = emb.reshape(batch, n_slots * dim)
+            deep_in = jnp.concatenate([e, dense], axis=1)
+            h = jax.nn.relu(deep_in @ w1 + b1)
+            logit = jnp.clip((h @ w2 + dense @ wide_w)[:, 0], -15, 15)
+            return jnp.mean(jnp.logaddexp(0.0, logit) - logit * label)
+
+        l, (gp, ge) = jax.value_and_grad(
+            loss_of, argnums=(0, 1))(params, emb)
+        new_params = tuple(p - 0.05 * g for p, g in zip(params, gp))
+        return l, new_params, ge
+
+    zipf = np.clip(rng.zipf(1.3, size=(steps + 2, batch, n_slots)),
+                   1, vocab)
+    batches = []
+    for i in range(steps + 2):
+        ids = ((zipf[i] - 1)
+               + np.arange(n_slots) * vocab).astype(np.int64).reshape(-1)
+        dense = jnp.asarray(rng.rand(batch, n_dense).astype(np.float32))
+        label = jnp.asarray((np.asarray(dense)[:, 0] > 0.5)
+                            .astype(np.float32))
+        batches.append((ids, dense, label))
+
+    # warmup: compile + first-touch row init
+    for ids, dense, label in batches[:2]:
+        emb = table.pull(ids)
+        l, params, ge = dense_fwd_bwd(params, emb, dense, label)
+        table.push(ids, np.asarray(ge).reshape(-1, dim))
+
+    if table.is_native:
+        table._lib.seconds = 0.0
+    t_pull = t_xla = t_push = 0.0
+    t_all0 = time.perf_counter()
+    loss = None
+    for ids, dense, label in batches[2:]:
+        t0 = time.perf_counter()
+        emb = table.pull(ids)
+        t_pull += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loss, params, ge = dense_fwd_bwd(params, emb, dense, label)
+        jax.block_until_ready(ge)
+        t_xla += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        table.push(ids, np.asarray(ge).reshape(-1, dim))
+        t_push += time.perf_counter() - t0
+    total = time.perf_counter() - t_all0
+    native_s = table._lib.seconds if table.is_native else 0.0
+    python_s = total - native_s - t_xla
+    return {
+        "backend": "native" if table.is_native else "python",
+        "batch": batch, "n_slots": n_slots, "emb_dim": dim,
+        "steps": steps,
+        "examples_per_s": round(batch * steps / total, 2),
+        "ms_per_step": round(total / steps * 1e3, 3),
+        "pull_ms_per_step": round(t_pull / steps * 1e3, 3),
+        "push_ms_per_step": round(t_push / steps * 1e3, 3),
+        "xla_ms_per_step": round(t_xla / steps * 1e3, 3),
+        "native_c_ms_per_step": round(native_s / steps * 1e3, 3),
+        "python_ms_per_step": round(python_s / steps * 1e3, 3),
+        "python_share": round(python_s / total, 4),
+        "loss_final": round(float(loss), 4),
+    }
+
+
+def main():
+    smoke = "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE") == "1"
+    out = []
+    for use_native in (False, True):
+        r = profile_backend(use_native, smoke)
+        if r is None:
+            print(json.dumps({"backend": "native", "skipped":
+                              "no C++ toolchain"}), flush=True)
+            continue
+        out.append(r)
+        print(json.dumps(r), flush=True)
+    if len(out) == 2 and out[0]["examples_per_s"]:
+        py, nat = out
+        print(json.dumps({
+            "native_speedup_vs_python": round(
+                nat["examples_per_s"] / py["examples_per_s"], 3),
+            "python_share_python_backend": py["python_share"],
+            "python_share_native_backend": nat["python_share"],
+            "python_below_half_step": nat["python_share"] < 0.5,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

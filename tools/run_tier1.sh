@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Tier-1 gate, twice: once in file order, once in SHUFFLED order — an
+# order-dependent failure (VERDICT r5 weak #3: test_remat_matches_no_remat
+# passed alone, failed in the combined suite) fails this script and
+# therefore can't ship again.
+#
+# Usage: tools/run_tier1.sh [extra pytest args...]
+# Env:   TIER1_SHUFFLE_SEED  fix the shuffle (default: date-derived,
+#                            printed so a red run is reproducible)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+PYARGS=(-q -m 'not slow' --continue-on-collection-errors
+        -p no:cacheprovider -p no:xdist "$@")
+
+echo "== tier-1 pass 1/2: file order"
+env JAX_PLATFORMS=cpu python -m pytest tests/ "${PYARGS[@]}" -p no:randomly
+rc1=$?
+
+echo "== tier-1 pass 2/2: shuffled order"
+if python -c "import pytest_randomly" 2>/dev/null; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/ "${PYARGS[@]}" -p randomly
+    rc2=$?
+else
+    # no pytest-randomly in this image: shuffle the test FILE order
+    # ourselves with a recorded seed (file order is the granularity the
+    # known order-dependent failures occurred at)
+    SEED="${TIER1_SHUFFLE_SEED:-$(date +%Y%m%d)}"
+    echo "   (pytest-randomly unavailable; file-order shuffle, seed=$SEED)"
+    FILES=$(python - "$SEED" <<'EOF'
+import glob, random, sys
+fs = sorted(glob.glob("tests/test_*.py"))
+random.Random(int(sys.argv[1])).shuffle(fs)
+print(" ".join(fs))
+EOF
+)
+    env JAX_PLATFORMS=cpu python -m pytest $FILES "${PYARGS[@]}" \
+        -p no:randomly
+    rc2=$?
+fi
+
+echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2"
+if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ]; then
+    echo "== tier-1 FAILED (either ordering being red fails the gate)"
+    exit 1
+fi
+echo "== tier-1 OK in both orderings"
