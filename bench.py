@@ -861,11 +861,225 @@ def _bench_inference(smoke, peak_tflops):
     return out
 
 
+def _bench_serve(smoke, peak_tflops):
+    """AOT serving engine (ISSUE 2 tentpole): BERT and ResNet exports
+    served two ways on the same compile-once Predictor —
+
+    - SEQUENTIAL batch-1 ``Predictor.run()`` loop (the deploy pattern
+      every per-request client gets), and
+    - ``PredictorServer``: N concurrent batch-1 clients whose requests
+      coalesce under a max-wait deadline into power-of-2 padded bucket
+      batches, one pre-warmed executable per bucket.
+
+    Reports examples/sec for both, the speedup, client-observed p50/p99
+    latency, the bucket hit distribution, and the compile counter
+    (steady-state zero-retrace evidence).  A third record measures
+    cold-load-to-first-inference in TWO fresh subprocesses sharing one
+    persistent compile-cache dir: the second process must load its
+    executable from disk instead of re-running XLA.
+
+    Env knobs: BENCH_SERVE_REQS (total requests), BENCH_SERVE_CLIENTS,
+    BENCH_SERVE_MAXB (top bucket), BENCH_SERVE_WAIT_MS.
+    """
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, PredictorServer, \
+        create_predictor
+    from paddle_tpu.static import InputSpec
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_serve_")
+    # a 1-core CPU host cannot batch-compile + serve BERT-base/
+    # ResNet-50 inside any sane bench budget; off-TPU the metric keeps
+    # its methodology but drops to the proxy models (the recorded
+    # speedups are the dispatch-amortization regime either way)
+    reduced = smoke or jax.default_backend() != "tpu"
+    n_reqs = int(os.environ.get("BENCH_SERVE_REQS",
+                                "128" if reduced else "192"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "16"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAXB",
+                                   "16" if reduced else "32"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "1"))
+
+    def export_bert():
+        from paddle_tpu.text.models.bert import (BertModel, bert_base,
+                                                 bert_tiny)
+        seq = 32 if reduced else 128
+        cfg = bert_tiny() if reduced else bert_base()
+        paddle.seed(0)
+        m = BertModel(cfg)
+        m.eval()
+        path = os.path.join(tmp, "bert")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([None, seq], "int32",
+                                              "ids")])
+        rng = np.random.RandomState(0)
+
+        def mk(b):
+            return [rng.randint(0, cfg.vocab_size, (b, seq))
+                    .astype("int32")]
+        name = "bert_base_serve" if not reduced else "bert_tiny_serve"
+        return name, path, mk, {"seq_len": seq}
+
+    def export_resnet():
+        from paddle_tpu.vision.models import resnet18, resnet50
+        hw = 32 if reduced else 224
+        paddle.seed(0)
+        m = (resnet18(num_classes=10) if reduced
+             else resnet50(num_classes=1000))
+        m.eval()
+        path = os.path.join(tmp, "resnet")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([None, 3, hw, hw],
+                                              "float32", "img")])
+        rng = np.random.RandomState(0)
+
+        def mk(b):
+            return [rng.standard_normal((b, 3, hw, hw))
+                    .astype("float32")]
+        name = "resnet50_serve" if not reduced else "resnet18_serve"
+        return name, path, mk, {"image_size": hw}
+
+    def measure(name, path, mk_input, extra):
+        cfg = Config(path)
+        cfg.set_optim_cache_dir(os.path.join(tmp, "cache"))
+        pred = create_predictor(cfg)
+        x1 = mk_input(1)
+        pred.run(x1)                       # warm the batch-1 executable
+
+        # sequential batch-1 loop (per-request deployment baseline)
+        t0 = _time.perf_counter()
+        for _ in range(n_reqs):
+            pred.run(x1)
+        dt_seq = _time.perf_counter() - t0
+        batch1_ex_s = n_reqs / dt_seq
+
+        # concurrent clients against the micro-batching server
+        per_client = n_reqs // clients
+        server = PredictorServer(pred, max_batch=max_batch,
+                                 max_wait_ms=wait_ms, max_queue=1024,
+                                 request_timeout_s=600.0)
+        server.start()                     # prewarms every bucket
+        n_warm = pred.num_compiles()
+        lats = [[] for _ in range(clients)]
+
+        def worker(ci):
+            x = mk_input(1)
+            for _ in range(per_client):
+                t = _time.perf_counter()
+                server.infer(x, timeout_s=600.0)
+                lats[ci].append(_time.perf_counter() - t)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(clients)]
+        t0 = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt_srv = _time.perf_counter() - t0
+        st = server.stats()
+        server.stop()
+        assert pred.num_compiles() == n_warm, \
+            "serving traffic compiled — bucket prewarm is broken"
+        served = clients * per_client
+        lat_ms = sorted(l * 1e3 for ls in lats for l in ls)
+        speedup = (served / dt_srv) / batch1_ex_s if batch1_ex_s else None
+        return {
+            "metric": f"{name}_throughput",
+            "value": round(served / dt_srv, 2),
+            "unit": "examples/sec",
+            "vs_baseline": None,
+            "batch1_ex_s": round(batch1_ex_s, 2),
+            "serve_speedup_vs_batch1": round(speedup, 3),
+            "p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+            "p99_ms": round(lat_ms[min(len(lat_ms) - 1,
+                                       int(len(lat_ms) * 0.99))], 3),
+            "clients": clients, "requests": served,
+            "max_batch": max_batch, "max_wait_ms": wait_ms,
+            "batches": st["batches"],
+            "bucket_hits": {str(k): v for k, v in
+                            st["bucket_hits"].items() if v},
+            "padded_frac": round(st["padded_examples"]
+                                 / max(st["examples"], 1), 4),
+            "num_compiles": st["num_compiles"],
+            "host_backend": jax.default_backend(),
+            **extra,
+        }
+
+    out = []
+    # resnet leads: per-image conv work at batch 1 underutilizes any
+    # backend, so it shows the serving engine's regime cleanly; the
+    # CPU bench host runs bert's batch-1 matmuls at full SIMD width
+    # already (its big batching win needs the tunnel-backed TPU, where
+    # per-call dispatch ~100ms dwarfs a batch-1 forward)
+    rn_name, rn_path, rn_mk, rn_extra = export_resnet()
+    out.append(measure(rn_name, rn_path, rn_mk, rn_extra))
+    bert_name, bert_path, bert_mk, bert_extra = export_bert()
+    out.append(measure(bert_name, bert_path, bert_mk, bert_extra))
+
+    # cold-load-to-first-inference: two fresh processes, one shared
+    # persistent cache dir — the second must hit the disk cache
+    cold_cache = os.path.join(tmp, "cold_cache")
+    np.save(os.path.join(tmp, "cold_x.npy"), bert_mk(1)[0])
+    code = (
+        "import time, numpy as np\n"
+        "import paddle_tpu\n"
+        "from paddle_tpu.inference import Config, create_predictor\n"
+        f"x = np.load({os.path.join(tmp, 'cold_x.npy')!r})\n"
+        "t0 = time.perf_counter()\n"
+        f"cfg = Config({bert_path!r})\n"
+        f"cfg.set_optim_cache_dir({cold_cache!r})\n"
+        "p = create_predictor(cfg)\n"
+        "p.run([x])\n"
+        "print('COLD', time.perf_counter() - t0)\n")
+    times = []
+    for _ in range(2):
+        env = dict(os.environ)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=1200,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = next((l for l in proc.stdout.splitlines()
+                     if l.startswith("COLD")), None)
+        if proc.returncode != 0 or line is None:
+            times.append(None)
+            break
+        times.append(float(line.split()[1]))
+    ok = len(times) == 2 and all(t is not None for t in times)
+    out.append({
+        "metric": "serve_cold_load_to_first_inference",
+        "value": round(times[1], 3) if ok else None,
+        "unit": "s_second_process",
+        "vs_baseline": None,
+        "first_process_s": round(times[0], 3) if times and times[0]
+        else None,
+        "cold_speedup_cache_hit": (round(times[0] / times[1], 3)
+                                   if ok and times[1] else None),
+        "cache_entries": len([f for f in os.listdir(cold_cache)
+                              if f.endswith("-cache")])
+        if os.path.isdir(cold_cache) else 0,
+        "plausible": bool(ok and times[1] < times[0]),
+        "suspect_reason": None if (ok and times[1] < times[0]) else
+            "second-process load not below first — persistent cache "
+            "miss or measurement failed",
+    })
+    return out
+
+
 # Tunnel-sensitive metrics re-run in N fresh subprocesses (fresh backend
 # each — the r4 artifacts showed a 1.8x spread between single-trial runs
 # of identical code); the reported object is the median-by-value trial,
 # annotated with every trial's value and the spread.
-_TUNNEL_TRIALS = {"wide_deep": 3, "infer": 3}
+_TUNNEL_TRIALS = {"wide_deep": 3, "infer": 3, "serve": 3}
 
 
 def _flatten(out):
@@ -950,7 +1164,7 @@ def main():
     if os.environ.get("BENCH_CHILD") == "1":
         _main()
         return
-    default = "resnet,bert,llama,llama_long,llama_8k,wide_deep,infer"
+    default = "resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,serve"
     known = set(default.split(",")) | {"ps_scaling"}
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")
@@ -1055,7 +1269,8 @@ def main():
         s = {"value": r.get("value"), "unit": r.get("unit")}
         for k in ("ms_per_step", "plausible", "trials",
                   "trial_spread_pct", "int8_speedup",
-                  "flash_speedup_vs_xla", "error"):
+                  "flash_speedup_vs_xla", "serve_speedup_vs_batch1",
+                  "p99_ms", "cold_speedup_cache_hit", "error"):
             if r.get(k) is not None:
                 s[k] = r[k]
         summary[r.get("metric") or "?"] = s
@@ -1074,7 +1289,7 @@ def _main():
         import jax
         jax.config.update("jax_platforms", "cpu")
     peak, peak_src = _detect_peak_tflops()
-    default = "resnet,bert,llama,llama_long,llama_8k,wide_deep,infer"
+    default = "resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,serve"
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")]
     which = [w for w in which if w] or default.split(",")
@@ -1094,6 +1309,8 @@ def _main():
         results.append(_bench_wide_deep(smoke, peak))
     if "infer" in which:
         results.extend(_bench_inference(smoke, peak))
+    if "serve" in which:
+        results.extend(_bench_serve(smoke, peak))
     if "ps_scaling" in which:
         results.append(_bench_ps_scaling(smoke, peak))
     if not results:  # unknown names: still honor the one-JSON-line contract

@@ -41,8 +41,21 @@ __all__ = ["DistributedTrainStep", "param_partition_spec"]
 # "@" cannot collide with real slot names
 _SCALE_SUFFIX = "@scale"
 
+# opt_state's position in the step signature: input [params, buffers,
+# opt_state, ...], output [loss, params, buffers, opt_state, ...].
+# _build asserts these against the actual spec trees it constructs, so
+# the offload host-memory overrides and the traced slot fetch can never
+# silently address a different subtree after a signature reshuffle.
+_OPT_IN_SLOT = 2
+_OPT_OUT_SLOT = 3
 
-def _q8_encode(x):
+# slots that sit under a sqrt in the optimizer's denominator (Adam/
+# Lamb "v", Adamax "inf_norm", Adagrad "moment", RMSProp
+# "mean_square"): their codes round AWAY from zero, never toward it
+_DENOM_SLOTS = frozenset({"v", "inf_norm", "moment", "mean_square"})
+
+
+def _q8_encode(x, round_up=False):
     """f32 slot -> (int8 codes, f32 per-row scales) in signed-sqrt space.
 
     8-bit optimizer state (greenfield; the reference keeps f32 slots —
@@ -53,10 +66,26 @@ def _q8_encode(x):
     Per-last-dim-row absmax scales keep the blocks aligned with any
     leading-dim ZeRO sharding; a sharded LAST dim still works (XLA
     reduces the row max across shards).
+
+    ``round_up`` (denominator slots, ADVICE r5): round |codes| UP so a
+    nonzero second moment can never decode to exactly 0.  v = g^2
+    survives nearest-rounding only over a ~254:1 per-row range of |g|
+    while m = g survives over ~64516:1, so a small-but-live coordinate
+    could decode v to 0 with m intact — and the update becomes
+    m_hat/(0+eps), a ~1e8x step blow-up.  Ceiling the magnitude floors
+    decoded v at (s/1)^2 per row instead; the bias is upward (slightly
+    smaller steps), which is the safe direction.
     """
     y = jnp.sign(x) * jnp.sqrt(jnp.abs(x))
     s = jnp.maximum(jnp.max(jnp.abs(y), axis=-1), 1e-12) / 127.0
-    q = jnp.round(y / s[..., None]).astype(jnp.int8)
+    c = y / s[..., None]
+    if round_up:
+        # clip BEFORE the int8 cast: float slop can push the row max to
+        # ceil(127.0000001) = 128, which wraps to -128 in int8
+        q = jnp.clip(jnp.sign(c) * jnp.ceil(jnp.abs(c)),
+                     -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = jnp.round(c).astype(jnp.int8)
     return q, s
 
 
@@ -107,7 +136,8 @@ def _transform_slots(st, pshape, mdt, direction):
         elif int8_mode:
             if len(pshape) >= 1:
                 d[k], d[k + _SCALE_SUFFIX] = _q8_encode(
-                    v.astype(jnp.float32))
+                    v.astype(jnp.float32),
+                    round_up=k in _DENOM_SLOTS)
             else:
                 d[k] = v
         else:
@@ -606,6 +636,7 @@ class DistributedTrainStep:
         # populated after sspecs are derived below; the closure cell is
         # shared so the traced step sees the final device shardings
         _offload_dev_sh: list = []
+        opt_in, opt_out = _OPT_IN_SLOT, _OPT_OUT_SLOT
 
         def step(*a):
             head, (lr, key, args) = a[:-3], a[-3:]
@@ -617,8 +648,8 @@ class DistributedTrainStep:
                 fetched = [
                     {k: jax.device_put(v, _offload_dev_sh[i][k])
                      if hasattr(v, "shape") else v for k, v in st.items()}
-                    for i, st in enumerate(head[2])]
-                head = (*head[:2], fetched, *head[3:])
+                    for i, st in enumerate(head[opt_in])]
+                head = (*head[:opt_in], fetched, *head[opt_in + 1:])
             if has_i:
                 # the step counter advances on device too (same tunnel
                 # round-trip argument as the key)
@@ -635,6 +666,16 @@ class DistributedTrainStep:
         bufspec = {k: P() for k in self._buffers}
         in_specs = [pspecs, bufspec, sspecs]
         out_specs = [P(), pspecs, bufspec, sspecs]
+        # every step variant lays its signature out as
+        # [params, buffers, opt_state, ...] in / [loss, params, buffers,
+        # opt_state, ...] out; the offload overrides below and the
+        # traced fetch address opt_state through the named slots, and
+        # these identity asserts catch any future reordering at build
+        # time instead of silently hosting the wrong subtree
+        assert in_specs[_OPT_IN_SLOT] is sspecs, \
+            "opt_state moved out of input slot %d" % _OPT_IN_SLOT
+        assert out_specs[_OPT_OUT_SLOT] is sspecs, \
+            "opt_state moved out of output slot %d" % _OPT_OUT_SLOT
         if use_scaling:
             in_specs += [(P(), P(), P()), P(), P(), bspec]  # amp_state,lr,key
             out_specs += [(P(), P(), P())]
@@ -668,10 +709,13 @@ class DistributedTrainStep:
                     lambda s: NamedSharding(mesh, s,
                                             memory_kind="pinned_host"),
                     tree, is_leaf=lambda x: isinstance(x, P))
-            # opt state: input slot 2, output slot 3 (after loss, params,
-            # buffers) in every step variant
-            in_sh = (*in_sh[:2], host(in_specs[2]), *in_sh[3:])
-            out_sh = (*out_sh[:3], host(out_specs[3]), *out_sh[4:])
+            # opt state rides the named slots asserted above
+            in_sh = (*in_sh[:_OPT_IN_SLOT],
+                     host(in_specs[_OPT_IN_SLOT]),
+                     *in_sh[_OPT_IN_SLOT + 1:])
+            out_sh = (*out_sh[:_OPT_OUT_SLOT],
+                      host(out_specs[_OPT_OUT_SLOT]),
+                      *out_sh[_OPT_OUT_SLOT + 1:])
             _offload_dev_sh.extend(
                 [{k: NamedSharding(mesh, d[k]) for k in d}
                  for d in sspecs])

@@ -28,7 +28,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version",
+           "PredictorServer", "ServeError", "ServerOverloaded",
+           "ServerClosed", "RequestTimeout", "enable_compile_cache"]
 
 
 def get_version() -> str:
@@ -79,6 +81,14 @@ class Config:
         self._cpu_math_threads = 1
         self._enable_profile = False
         self._donate_inputs = False
+        # persistent XLA compile cache (reference API name:
+        # AnalysisConfig::SetOptimCacheDir — there it caches optimized
+        # IR programs, here serialized XLA executables): "auto" resolves
+        # to $PADDLE_INFER_CACHE_DIR or ~/.cache/paddle_tpu/xla_cache;
+        # None/"" disables.  A second process cold-loads its compiled
+        # program from this cache instead of re-running XLA.
+        self._optim_cache_dir = "auto"
+        self._load_batch = 1              # batch the load-time AOT uses
 
     # -- model paths -------------------------------------------------
     def set_model(self, model_arg, params_file=None):
@@ -179,6 +189,20 @@ class Config:
     def enable_profile(self):
         self._enable_profile = True
 
+    def set_optim_cache_dir(self, path: Optional[str]):
+        """Directory for the persistent compile cache (reference:
+        AnalysisConfig::SetOptimCacheDir).  ``"auto"`` (the default)
+        resolves to ``$PADDLE_INFER_CACHE_DIR`` or
+        ``~/.cache/paddle_tpu/xla_cache``; ``None`` or ``""`` disables
+        cross-process caching for predictors built from this config."""
+        self._optim_cache_dir = path
+
+    def set_load_batch(self, batch: int):
+        """Batch size the load-time AOT compile specializes symbolic
+        dims to (default 1).  Additional shapes compile on first use or
+        via :meth:`Predictor.prewarm`."""
+        self._load_batch = int(batch)
+
     def switch_use_feed_fetch_ops(self, flag):
         _warn_inert("switch_use_feed_fetch_ops",
                     "no feed/fetch ops exist under XLA — zero-copy "
@@ -262,14 +286,66 @@ class Tensor:
         return self.copy_to_cpu()
 
 
+def _resolve_cache_dir(config: Config) -> Optional[str]:
+    d = getattr(config, "_optim_cache_dir", None)
+    if d == "auto":
+        d = os.environ.get("PADDLE_INFER_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
+    return d or None
+
+
+_cache_dir_enabled: Optional[str] = None
+
+
+def enable_compile_cache(path: str):
+    """Point JAX's persistent compilation cache at ``path`` (idempotent;
+    first caller wins for the process).  Every XLA executable the
+    Predictor AOT-compiles is then serialized to disk, so a SECOND
+    process loading the same artifact skips XLA entirely — this is what
+    makes cold-load-to-first-inference a disk read instead of a compile
+    (reference analog: AnalysisConfig::SetOptimCacheDir persisting the
+    optimized program)."""
+    global _cache_dir_enabled
+    if _cache_dir_enabled is not None:
+        return _cache_dir_enabled
+    import jax
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # serving programs are small and compile fast — cache them anyway
+    # (the defaults skip sub-second compiles, which is every smoke model)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:      # older jax: knob absent, cache still works
+        pass
+    # any compile BEFORE the dir was set froze the lazily-initialized
+    # cache in its disabled state for the whole process (jax memoizes
+    # the init); reset so the predictor's compiles actually persist
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:      # pragma: no cover - internal API moved
+        pass
+    _cache_dir_enabled = path
+    return path
+
+
 class Predictor:
-    """Compiled predictor over a deserialized StableHLO artifact
+    """Compile-once AOT predictor over a deserialized StableHLO artifact
     (parity: AnalysisPredictor, reference
     inference/api/analysis_predictor.cc:168).
 
-    The constructor deserializes the export and jit-compiles its call;
-    ``run()`` executes zero-copy: numpy buffers go straight to device,
-    outputs come back into the output handles.
+    The constructor deserializes the export and AOT-compiles it against
+    the meta's input specs (``jax.jit(...).lower(...).compile()``) —
+    load time IS compile time, exactly like the reference's
+    OptimizeInferenceProgram.  ``run()`` then only looks up the
+    executable for its input shapes and dispatches: no retracing, no
+    per-call Python flatten of the outputs, no handle-skeleton rebuild.
+    One executable exists per input-shape signature (``num_compiles()``
+    counts them; a steady-state server holds one per batch bucket), and
+    with the persistent compile cache enabled (default) a second
+    process cold-loads executables from disk instead of re-running XLA.
     """
 
     def __init__(self, config: Config):
@@ -278,6 +354,9 @@ class Predictor:
         from jax import export as jexport
 
         self._config = config
+        cache_dir = _resolve_cache_dir(config)
+        if cache_dir:
+            enable_compile_cache(cache_dir)
         prefix = config._path_prefix()
         with open(prefix + ".pdmodel", "rb") as f:
             self._exported = jexport.deserialize(bytearray(f.read()))
@@ -287,6 +366,7 @@ class Predictor:
         if os.path.exists(prefix + ".pdmeta"):
             with open(prefix + ".pdmeta", "rb") as f:
                 meta = pickle.load(f)
+        self._meta = meta
 
         if config._use_accelerator:
             try:
@@ -318,34 +398,119 @@ class Predictor:
         # exported artifacts bake the key SHAPE in at save time:
         # stay on portable threefry regardless of FLAGS_rng_impl
         self._rng = jax.random.PRNGKey(0)
+
+        exported_call = self._exported.call
         if bf16:
-            exported_call = self._exported.call
             expected = self._expected
 
-            # jitted so the upcast fuses into the compiled program and
-            # the f32 copies are compiler-managed, not per-run eager
-            # materializations of the whole weight set.
-            @jax.jit
-            def _bf16_call(params, buffers, rng, vals):
+            def _model_call(params, buffers, rng, vals):
+                # the upcast fuses into the compiled program; the f32
+                # copies are compiler-managed, not per-run eager
+                # materializations of the whole weight set
                 up = lambda d: {k: v.astype(expected[k]) for k, v in
                                 d.items()}
-                return exported_call(up(params), up(buffers), rng, vals)
-
-            self._exported_call = _bf16_call
+                return exported_call(up(params), up(buffers), rng,
+                                     list(vals))
         else:
-            self._exported_call = self._exported.call
+            def _model_call(params, buffers, rng, vals):
+                return exported_call(params, buffers, rng, list(vals))
+
+        def _flat_call(params, buffers, rng, vals):
+            out, _bufs = _model_call(params, buffers, rng, vals)
+            return tuple(_flatten(out))
+
+        self._flat_call = _flat_call
+        self._jit_call = jax.jit(_flat_call)
+        self._executables: Dict[tuple, object] = {}
+        self._compile_count = 0
 
         n = meta.get("n_inputs", len(meta.get("input_names", [])) or 1)
         names = meta.get("input_names") or [f"x{i}" for i in range(n)]
         shapes = meta.get("input_shapes") or [[-1]] * n
         dtypes = meta.get("input_dtypes") or ["float32"] * n
         self._input_names: List[str] = list(names)
+        self._input_shapes = [list(s) for s in shapes]
+        self._input_dtypes = [np.dtype(d) for d in dtypes]
         self._inputs: Dict[str, Tensor] = {
             nm: Tensor(nm, shp, dt)
             for nm, shp, dt in zip(names, shapes, dtypes)}
         self._output_names: List[str] = []
         self._outputs: Dict[str, Tensor] = {}
-        self._call = self._exported_call
+
+        # AOT compile at load against the meta input specs (symbolic
+        # dims specialized: dim 0 -> load_batch, others -> 1).  Old
+        # artifacts without recorded shapes keep the lazy compile-on-
+        # first-run behavior.
+        if meta.get("input_shapes"):
+            try:
+                self._compile_for_specs(self._specs_for_batch(
+                    getattr(config, "_load_batch", 1)))
+            except Exception as e:     # pragma: no cover - degraded path
+                import warnings
+                warnings.warn(
+                    "Predictor load-time AOT compile failed "
+                    f"({type(e).__name__}: {e}); falling back to "
+                    "compile-on-first-run", stacklevel=2)
+
+    # -- AOT machinery -----------------------------------------------
+    def _specs_for_batch(self, batch: int):
+        """Concrete ShapeDtypeStructs from the meta input specs: the
+        leading symbolic (-1) dim becomes ``batch``, interior symbolic
+        dims become 1."""
+        import jax
+        specs = []
+        for shp, dt in zip(self._input_shapes, self._input_dtypes):
+            dims = []
+            for j, d in enumerate(shp):
+                if isinstance(d, int) and d >= 0:
+                    dims.append(int(d))
+                else:
+                    dims.append(int(batch) if j == 0 else 1)
+            specs.append(jax.ShapeDtypeStruct(tuple(dims), dt))
+        return specs
+
+    @staticmethod
+    def _shape_key(vals) -> tuple:
+        return tuple((tuple(int(d) for d in v.shape), str(v.dtype))
+                     for v in vals)
+
+    def _compile_for_specs(self, specs):
+        """AOT lower + compile ONE executable for this input-shape
+        signature; cache it and fix the output handle skeleton."""
+        import jax
+        key = self._shape_key(specs)
+        exe = self._executables.get(key)
+        if exe is not None:
+            return exe
+        lowered = self._jit_call.lower(self._params, self._buffers,
+                                       self._rng, tuple(specs))
+        exe = lowered.compile()
+        self._compile_count += 1
+        self._executables[key] = exe
+        if not self._output_names:
+            out_avals = jax.eval_shape(self._flat_call, self._params,
+                                       self._buffers, self._rng,
+                                       tuple(specs))
+            self._output_names = [f"out{i}"
+                                  for i in range(len(out_avals))]
+        return exe
+
+    def num_compiles(self) -> int:
+        """How many distinct XLA executables this predictor built — the
+        steady-state zero-retrace contract: one per (model, input-shape
+        bucket), never one per call."""
+        return self._compile_count
+
+    def compiled_shapes(self) -> List[tuple]:
+        return list(self._executables.keys())
+
+    def prewarm(self, batch_sizes) -> "Predictor":
+        """Compile (or cache-load) the executable for each batch size
+        ahead of traffic — a serving bucket never pays its compile
+        inside a request."""
+        for b in batch_sizes:
+            self._compile_for_specs(self._specs_for_batch(int(b)))
+        return self
 
     # -- handles -----------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -363,10 +528,11 @@ class Predictor:
     # -- execution ---------------------------------------------------
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. Either pre-fill input handles (reference style) or
-        pass arrays positionally; returns the list of output arrays."""
-        import jax
-        import jax.numpy as jnp
+        pass arrays positionally; returns the list of output arrays.
 
+        Steady state this is a dict lookup + one XLA dispatch: the
+        executable, output names and handle skeleton were all fixed at
+        compile time (load, prewarm, or this shape's first call)."""
         if inputs is not None:
             for nm, arr in zip(self._input_names, inputs):
                 self._inputs[nm].copy_from_cpu(np.asarray(arr))
@@ -376,18 +542,23 @@ class Predictor:
             if h._data is None:
                 raise RuntimeError(f"input '{nm}' has no data; call "
                                    "copy_from_cpu first")
-            vals.append(jax.device_put(jnp.asarray(h._data), self._device))
+            vals.append(h._data)
 
-        out, _bufs = self._call(self._params, self._buffers, self._rng, vals)
-        flat = _flatten(out)
-        self._output_names = [f"out{i}" for i in range(len(flat))]
-        self._outputs = {}
+        exe = self._executables.get(self._shape_key(vals))
+        if exe is None:
+            exe = self._compile_for_specs(vals)
+        flat = exe(self._params, self._buffers, self._rng, tuple(vals))
+
+        if not self._outputs or len(self._outputs) != len(flat):
+            self._outputs = {nm: Tensor(nm, (), np.float32)
+                             for nm in self._output_names[:len(flat)]}
         results = []
         for nm, v in zip(self._output_names, flat):
             a = np.asarray(v)
-            t = Tensor(nm, a.shape, a.dtype)
+            t = self._outputs[nm]
             t._data = a
-            self._outputs[nm] = t
+            t._shape = list(a.shape)
+            t._dtype = a.dtype
             results.append(a)
         return results
 
@@ -420,3 +591,7 @@ def create_predictor(config: Config) -> Predictor:
     """Parity: paddle.inference.create_predictor /
     CreatePaddlePredictor (analysis_predictor.cc:168)."""
     return Predictor(config)
+
+
+from .serving import (PredictorServer, RequestTimeout,  # noqa: E402
+                      ServeError, ServerClosed, ServerOverloaded)

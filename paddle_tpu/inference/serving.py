@@ -1,0 +1,371 @@
+"""paddle_tpu.inference.serving — shape-bucketed dynamic micro-batching.
+
+The L10 serving engine over the compile-once :class:`~paddle_tpu.
+inference.Predictor` (ISSUE 2 tentpole; VERDICT next-round item 8).
+Reference analog: the multi-stream AnalysisPredictor pool behind
+PaddleServing — there concurrency comes from cloning predictors per
+thread; on TPU the chip wants ONE big program per step, so concurrency
+comes from *coalescing* instead:
+
+- concurrent batch-1/-N requests queue up and are merged under a
+  max-wait deadline into one device batch;
+- the batch pads to a power-of-2 BUCKET (the same bucket-and-prime
+  trick that fixed DeviceCachedTable's per-shape recompiles, PERF.md
+  r4), so the predictor holds exactly one pre-warmed XLA executable per
+  bucket and steady state never retraces;
+- results split back to the callers' futures; padding rows are sliced
+  off before anyone sees them.
+
+Overload degrades instead of collapsing (VERDICT: "serve heavy traffic
+... as fast as the hardware allows" is meaningless if the 1.01x-load
+behavior is an unbounded queue): the submit queue has a hard depth cap
+— past it requests shed immediately with :class:`ServerOverloaded` —
+and every request carries a deadline; requests that exceed it before
+execution fail with :class:`RequestTimeout` rather than occupying a
+batch slot.
+
+Phase accounting (``stats()``): wall time attributes to queue / pad /
+run / unpad so ``tools/profile_serve.py`` can say WHERE a slow server
+spends its step — the same discipline as ``tools/profile_ps.py``.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
+           "ServerClosed", "RequestTimeout"]
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-path errors."""
+
+
+class ServerOverloaded(ServeError):
+    """Typed load-shed: the submit queue is at its depth cap.  Clients
+    should back off and retry; the server keeps serving what it already
+    admitted."""
+
+
+class ServerClosed(ServeError):
+    """The server was stopped before (or while) handling the request."""
+
+
+class RequestTimeout(ServeError, TimeoutError):
+    """The request's deadline passed before its batch executed."""
+
+
+class _Future:
+    """Minimal thread-safe one-shot future (no executor dependency)."""
+
+    __slots__ = ("_ev", "_value", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value):
+        self._value = value
+        self._ev.set()
+
+    def set_exception(self, exc: BaseException):
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise RequestTimeout("request did not complete within "
+                                 f"{timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _Request:
+    __slots__ = ("arrays", "n", "future", "t_submit", "deadline")
+
+    def __init__(self, arrays: List[np.ndarray], n: int,
+                 deadline: float):
+        self.arrays = arrays
+        self.n = n
+        self.future = _Future()
+        self.t_submit = time.monotonic()
+        self.deadline = deadline
+
+
+def _default_buckets(max_batch: int) -> List[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+class PredictorServer:
+    """Dynamic micro-batching server over a compile-once Predictor.
+
+    Usage::
+
+        server = PredictorServer(predictor, max_batch=32,
+                                 max_wait_ms=2.0)
+        server.start()                       # prewarms every bucket
+        out = server.infer([x])              # blocking, thread-safe
+        fut = server.submit([x])             # async; fut.result()
+        server.stop()
+
+    Knobs:
+
+    - ``max_batch``: largest device batch (top bucket).
+    - ``max_wait_ms``: how long the batcher holds the FIRST request of
+      a batch open for co-travelers.  0 disables coalescing-by-wait
+      (still batches whatever is already queued).
+    - ``buckets``: ascending batch buckets; default powers of two up to
+      ``max_batch``.  One compiled program exists per bucket.
+    - ``max_queue``: submit-queue depth cap; beyond it ``submit``
+      raises :class:`ServerOverloaded` (load-shedding, never unbounded
+      memory).
+    - ``request_timeout_s``: per-request deadline; enforced both while
+      queued (stale requests are dropped with :class:`RequestTimeout`
+      before wasting a batch slot) and in :meth:`infer`'s wait.
+    """
+
+    def __init__(self, predictor, max_batch: int = 32,
+                 max_wait_ms: float = 2.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 256,
+                 request_timeout_s: float = 30.0,
+                 prewarm: bool = True):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._pred = predictor
+        self._max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        bks = sorted(set(int(b) for b in (buckets or
+                                          _default_buckets(max_batch))))
+        if bks[-1] < max_batch:
+            bks.append(int(max_batch))
+        self._buckets = bks
+        self._q: _queue.Queue = _queue.Queue(maxsize=int(max_queue))
+        self._timeout_s = float(request_timeout_s)
+        self._prewarm = bool(prewarm)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._carry: Optional[_Request] = None
+        self._lock = threading.Lock()
+        self._stats = {
+            "requests": 0, "examples": 0, "batches": 0,
+            "padded_examples": 0, "shed_overload": 0, "shed_timeout": 0,
+            "bucket_hits": {b: 0 for b in self._buckets},
+            "queue_ms": 0.0, "pad_ms": 0.0, "run_ms": 0.0,
+            "unpad_ms": 0.0,
+        }
+
+    # -- lifecycle ---------------------------------------------------
+    def start(self) -> "PredictorServer":
+        if self._running:
+            return self
+        if self._prewarm and hasattr(self._pred, "prewarm"):
+            # every bucket's executable exists BEFORE traffic: a
+            # first-seen shape never pays its compile inside a request
+            self._pred.prewarm(self._buckets)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="predictor-server",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        if not self._running:
+            return
+        if drain:
+            deadline = time.monotonic() + timeout
+            while (not self._q.empty() or self._carry is not None) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        # anything still queued fails loudly, not silently
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            req.future.set_exception(ServerClosed("server stopped"))
+        if self._carry is not None:
+            self._carry.future.set_exception(
+                ServerClosed("server stopped"))
+            self._carry = None
+
+    def __enter__(self) -> "PredictorServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client surface ----------------------------------------------
+    def submit(self, inputs: Sequence[np.ndarray],
+               timeout_s: Optional[float] = None) -> _Future:
+        """Enqueue one request (list of arrays, shared leading batch
+        dim).  Returns a future; raises :class:`ServerOverloaded` when
+        the queue is at its cap and :class:`ServerClosed` when stopped.
+        """
+        if not self._running:
+            raise ServerClosed("server not started")
+        arrays = [np.asarray(a) for a in inputs]
+        if not arrays:
+            raise ValueError("empty request")
+        n = int(arrays[0].shape[0]) if arrays[0].ndim else 1
+        for a in arrays:
+            if a.ndim == 0 or int(a.shape[0]) != n:
+                raise ValueError(
+                    "all request inputs must share the leading batch "
+                    f"dim, got {[tuple(a.shape) for a in arrays]}")
+        if n > self._max_batch:
+            raise ValueError(
+                f"request batch {n} exceeds max_batch="
+                f"{self._max_batch}; split it client-side")
+        to = self._timeout_s if timeout_s is None else float(timeout_s)
+        req = _Request(arrays, n, time.monotonic() + to)
+        try:
+            self._q.put_nowait(req)
+        except _queue.Full:
+            with self._lock:
+                self._stats["shed_overload"] += 1
+            raise ServerOverloaded(
+                f"queue depth cap {self._q.maxsize} reached; request "
+                "shed — back off and retry") from None
+        return req.future
+
+    def infer(self, inputs: Sequence[np.ndarray],
+              timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking submit + wait.  Thread-safe; this is the per-client
+        call the bench's concurrent workers use."""
+        to = self._timeout_s if timeout_s is None else float(timeout_s)
+        return self.submit(inputs, timeout_s=to).result(timeout=to)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            s = dict(self._stats)
+            s["bucket_hits"] = dict(self._stats["bucket_hits"])
+        s["num_compiles"] = (self._pred.num_compiles()
+                             if hasattr(self._pred, "num_compiles")
+                             else None)
+        s["queue_depth"] = self._q.qsize()
+        return s
+
+    # -- batcher loop ------------------------------------------------
+    def _bucket_for(self, rows: int) -> int:
+        for b in self._buckets:
+            if rows <= b:
+                return b
+        return self._buckets[-1]
+
+    def _gather(self) -> Optional[List[_Request]]:
+        """Collect one batch: the first request (carry-over or queue)
+        opens a ``max_wait`` window; co-travelers join until the window
+        closes or the next request would overflow ``max_batch`` (it
+        carries to the next batch)."""
+        first = self._carry
+        self._carry = None
+        if first is None:
+            try:
+                first = self._q.get(timeout=0.05)
+            except _queue.Empty:
+                return None
+        batch, rows = [first], first.n
+        deadline = time.monotonic() + self._max_wait_s
+        while rows < self._max_batch:
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=rem)
+            except _queue.Empty:
+                break
+            if rows + nxt.n > self._max_batch:
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            rows += nxt.n
+        return batch
+
+    def _loop(self):
+        while self._running:
+            batch = self._gather()
+            if not batch:
+                continue
+            try:
+                self._execute(batch)
+            except BaseException as e:    # noqa: BLE001 - fail futures
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            ServeError(f"batch execution failed: {e!r}"))
+
+    def _execute(self, batch: List[_Request]):
+        t0 = time.monotonic()
+        live = []
+        for r in batch:
+            if t0 > r.deadline:
+                with self._lock:
+                    self._stats["shed_timeout"] += 1
+                r.future.set_exception(RequestTimeout(
+                    "request spent its whole deadline queued — server "
+                    "overloaded"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        queue_s = sum(t0 - r.t_submit for r in live)
+        rows = sum(r.n for r in live)
+        bucket = self._bucket_for(rows)
+        pad = bucket - rows
+
+        n_in = len(live[0].arrays)
+        padded = []
+        for i in range(n_in):
+            parts = [r.arrays[i] for r in live]
+            if pad:
+                # pad with copies of the first row: REAL data, so a
+                # model with input-dependent control ranges (log/
+                # gather/embedding lookups) never sees out-of-domain
+                # zeros in the dead rows
+                fill = np.broadcast_to(
+                    parts[0][:1], (pad,) + parts[0].shape[1:])
+                parts = parts + [fill]
+            padded.append(np.concatenate(parts, axis=0)
+                          if len(parts) > 1 else parts[0])
+        t1 = time.monotonic()
+
+        outs = self._pred.run(padded)
+        t2 = time.monotonic()
+
+        off = 0
+        for r in live:
+            r.future.set_result([o[off:off + r.n] for o in outs])
+            off += r.n
+        t3 = time.monotonic()
+
+        with self._lock:
+            s = self._stats
+            s["requests"] += len(live)
+            s["examples"] += rows
+            s["batches"] += 1
+            s["padded_examples"] += pad
+            s["bucket_hits"][bucket] = s["bucket_hits"].get(bucket, 0) + 1
+            s["queue_ms"] += queue_s * 1e3
+            s["pad_ms"] += (t1 - t0) * 1e3
+            s["run_ms"] += (t2 - t1) * 1e3
+            s["unpad_ms"] += (t3 - t2) * 1e3

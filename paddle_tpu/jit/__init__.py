@@ -441,15 +441,31 @@ def save(layer, path, input_spec=None, **config):
                      "buffers": {k: np.asarray(v) for k, v in buf_vals.items()}},
                     f, protocol=4)
     # out_avals = ((outputs...), new_buffers) per the compiled signature;
-    # record the user-visible output count for load_inference_model.
-    out_tree = jax.tree_util.tree_unflatten(exp.out_tree, exp.out_avals)
-    n_outputs = len(jax.tree_util.tree_leaves(out_tree[0]))
+    # record the user-visible output structure for load_inference_model
+    # and the AOT Predictor: the treedef rides as a template whose
+    # leaves are their flat indices (picklable where a PyTreeDef is
+    # not — and None won't do, jax treats it as an empty subtree;
+    # tree_structure() of the template reconstructs the treedef and the
+    # index leaves give the flat order), plus per-leaf shapes/dtypes with
+    # symbolic dims as -1 — together with the input specs this lets a
+    # server compile and pre-warm every serving bucket without ever
+    # tracing the model or running a request.
+    out_tree = jax.tree_util.tree_unflatten(exp.out_tree,
+                                            list(exp.out_avals))
+    user_out = out_tree[0]
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(user_out)
     meta = {"n_inputs": len(example),
             "input_names": in_names,
             "input_shapes": [[d if isinstance(d, int) else -1 for d in e.shape]
                              for e in example],
             "input_dtypes": [str(np.dtype(e.dtype)) for e in example],
-            "n_outputs": n_outputs}
+            "n_outputs": len(out_leaves),
+            "output_template": jax.tree_util.tree_unflatten(
+                out_treedef, list(range(len(out_leaves)))),
+            "output_shapes": [[d if isinstance(d, int) else -1
+                               for d in a.shape] for a in out_leaves],
+            "output_dtypes": [str(np.dtype(a.dtype))
+                              for a in out_leaves]}
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -463,22 +463,25 @@ def _check_dropout_args(dropout_p, seed, test_mask, sq, sk, block_q,
 
 
 def _resolve_blocks(sq, sk, block_q, block_k):
-    """Shrink the default 512x512 tiles at very long sequence lengths:
-    the backward kernels' scoped-VMEM working set (dO/O/dQ tiles plus
-    the K/V stream) overflows the 16 MB stack at seq 8192 with 512-wide
-    blocks (measured: 316 KB over).  Caller-specified non-default
-    blocks are respected."""
-    if sq >= 8192 and block_q == 512:
-        block_q = 256
-    if sk >= 8192 and block_k == 512:
-        block_k = 256
+    """Resolve the public ``block_q=block_k=None`` defaults: 512, shrunk
+    to 256 at very long sequence lengths — the backward kernels'
+    scoped-VMEM working set (dO/O/dQ tiles plus the K/V stream)
+    overflows the 16 MB stack at seq 8192 with 512-wide blocks
+    (measured: 316 KB over).  Any caller-specified block size — 512
+    included — is honored verbatim; only ``None`` auto-resolves, so an
+    explicit 512 at seq 8192 is distinguishable from the default (the
+    old sentinel-on-512 scheme silently rewrote it)."""
+    if block_q is None:
+        block_q = 256 if sq >= 8192 else 512
+    if block_k is None:
+        block_k = 256 if sk >= 8192 else 512
     return block_q, block_k
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def flash_attention_bhsd(q, k, v, bias=None, seed=None, test_mask=None,
-                         causal=False, scale=None, block_q=512,
-                         block_k=512, interpret=False, dropout_p=0.0):
+                         causal=False, scale=None, block_q=None,
+                         block_k=None, interpret=False, dropout_p=0.0):
     """Flash attention on (B, H, S, D) tensors.
 
     ``bias``: optional additive [B, 1, 1, S_k] tensor (padding masks as
@@ -587,7 +590,9 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
         # constraints gate dispatch here — shapes the kernel would
         # reject must fall back to the XLA composition, not raise
         sk = kv_seq_len if kv_seq_len is not None else seq_len
-        return _dropout_blocks_ok(seq_len, sk, 512, 512)
+        return _dropout_blocks_ok(seq_len, sk,
+                                  *_resolve_blocks(seq_len, sk, None,
+                                                   None))
     if not has_mask and mask_shape is None:
         return True
     if mask_shape is None:      # mask present but un-vettable
@@ -599,7 +604,7 @@ def flash_eligible(seq_len: int, head_dim: int, *, has_mask: bool = False,
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    block_q=512, block_k=512, interpret=False,
+                    block_q=None, block_k=None, interpret=False,
                     dropout_p=0.0, seed=None):
     """Flash attention on paddle-layout (B, S, H, D) tensors."""
     qh = jnp.swapaxes(q, 1, 2)

@@ -1,0 +1,52 @@
+"""bench.py trial-merge integrity (ISSUE 2 satellite, ADVICE r5).
+
+``_merge_trials`` must pair trial candidates by metric NAME: a trial
+whose child emitted fewer sub-metrics (e.g. a timed-out extra metric)
+must not silently shift which metric's values get merged into a row.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_merge_trials_pairs_by_name_not_position():
+    t1 = [{"metric": "a", "value": 1.0, "unit": "x"},
+          {"metric": "b", "value": 10.0, "unit": "y"}]
+    # trial 2 lost its extra metric: positional pairing would merge
+    # b-values into a (the ADVICE r5 failure)
+    t2 = [{"metric": "a", "value": 3.0, "unit": "x"}]
+    t3 = [{"metric": "a", "value": 2.0, "unit": "x"},
+          {"metric": "b", "value": 30.0, "unit": "y"}]
+    merged = bench._merge_trials([t1, t2, t3])
+    by_name = {m["metric"]: m for m in merged}
+    assert set(by_name) == {"a", "b"}
+    # a merges only a-values (median of 1,3,2 = 2), b only b-values
+    assert by_name["a"]["value"] == 2.0
+    assert by_name["a"]["trials"] == 3
+    assert by_name["a"]["trial_values"] == [1.0, 3.0, 2.0]
+    assert by_name["b"]["value"] == 30.0
+    assert by_name["b"]["trials"] == 2
+    assert by_name["b"]["trial_values"] == [10.0, 30.0]
+    # first-seen order is stable
+    assert [m["metric"] for m in merged] == ["a", "b"]
+
+
+def test_merge_trials_keeps_valueless_placeholder():
+    # a metric whose every trial lacks a numeric value passes through
+    # as-is (no crash, no fabricated median)
+    t1 = [{"metric": "a", "value": None, "unit": None, "failed": True}]
+    t2 = [{"metric": "a", "value": None, "unit": None, "failed": True}]
+    merged = bench._merge_trials([t1, t2])
+    assert len(merged) == 1 and merged[0]["failed"]
+    assert merged[0].get("value") is None
+
+
+def test_merge_trials_spread_annotation():
+    trials = [[{"metric": "m", "value": v}] for v in (100.0, 80.0, 120.0)]
+    (m,) = bench._merge_trials(trials)
+    assert m["value"] == 100.0
+    assert m["trial_spread_pct"] == 40.0

@@ -160,3 +160,33 @@ def test_fused_dropout_matches_masked_reference(causal):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-3)
+
+
+def test_resolve_blocks_defaults_vs_explicit():
+    """Public block defaults are None and resolve internally (512,
+    shrunk to 256 at seq >= 8192); an EXPLICIT 512 is honored verbatim
+    — the old sentinel-on-512 scheme silently rewrote it (ISSUE 2
+    satellite)."""
+    from paddle_tpu.ops.flash_attention import _resolve_blocks
+
+    assert _resolve_blocks(2048, 2048, None, None) == (512, 512)
+    assert _resolve_blocks(8192, 8192, None, None) == (256, 256)
+    # explicit 512 at long seq survives (caller opted in)
+    assert _resolve_blocks(8192, 8192, 512, 512) == (512, 512)
+    # per-side resolution: only the long side shrinks
+    assert _resolve_blocks(8192, 2048, None, None) == (256, 512)
+    assert _resolve_blocks(2048, 8192, None, None) == (512, 256)
+    # explicit non-default blocks always pass through
+    assert _resolve_blocks(1024, 1024, 128, 64) == (128, 64)
+
+
+def test_default_blocks_flow_through_call():
+    """flash_attention_bhsd with default (None) blocks runs the same
+    program as explicit 512s at short seq (interpret-mode smoke)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 1, 128, 64).astype(np.float32))
+    a = flash_attention_bhsd(q, q, q, causal=True, interpret=True)
+    b = flash_attention_bhsd(q, q, q, causal=True, block_q=512,
+                             block_k=512, interpret=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -157,15 +157,44 @@ def test_chunked_lm_loss_parity_under_trace():
         lbl_np = rng.randint(0, 96, (2, 64)).astype("int64")
         lbl_np[0, 5:9] = -100                      # ignore-index parity
         lbl = paddle.to_tensor(lbl_np)
+        # eager reference FIRST (before to_static replaces m.forward):
+        # untraced calls always take the plain unchunked loss path, so
+        # these grads are the ground truth the chunked custom-vjp
+        # backward must reproduce
         le, _ = m(ids, labels=lbl)                 # eager -> plain path
-        st = paddle.jit.to_static(m)
-        lt = st(ids, labels=lbl)                   # traced -> chunked
-        lt0 = lt[0] if isinstance(lt, (tuple, list)) else lt
-        assert abs(float(le) - float(lt0)) < 1e-4, (float(le), float(lt0))
-        # gradients flow through the chunked projection
-        loss, _ = m(ids, labels=lbl)
-        loss.backward()
+        le.backward()
+        g_eager = {n: np.asarray(p.grad._value).copy()
+                   for n, p in m.named_parameters()}
+        m.clear_gradients()
+
+        # prove the traced call really dispatches the chunked path (a
+        # silently-plain trace would make the comparison vacuous)
+        hits = []
+        orig_chunked = L._chunked_causal_lm_loss
+
+        def spy(*a, **k):
+            hits.append(1)
+            return orig_chunked(*a, **k)
+
+        L._chunked_causal_lm_loss = spy
+        try:
+            st = paddle.jit.to_static(m)
+            lt = st(ids, labels=lbl)               # traced -> chunked
+            lt0 = lt[0] if isinstance(lt, (tuple, list)) else lt
+            assert hits, "traced call never reached the chunked loss"
+            assert abs(float(le) - float(lt0)) < 1e-4, (float(le),
+                                                        float(lt0))
+            # the chunked-projection BACKWARD (custom vjp) under trace
+            # must match the eager unchunked gradient on every param
+            lt0.backward()
+        finally:
+            L._chunked_causal_lm_loss = orig_chunked
+        for n, p in m.named_parameters():
+            assert p.grad is not None, n
+            np.testing.assert_allclose(
+                np.asarray(p.grad._value), g_eager[n],
+                rtol=1e-4, atol=1e-5, err_msg=n)
         g = m.model.embed_tokens.weight.grad
-        assert g is not None and float(abs(g).sum()) > 0
+        assert float(abs(g).sum()) > 0
     finally:
         L._LOSS_CHUNK, L._CHUNK_BYTES_MIN = old_chunk, old_min

@@ -125,6 +125,48 @@ def test_offload_ignored_without_sharding():
     mesh_mod.set_mesh(None)
 
 
+def test_q8_second_moment_wide_dynamic_range_no_blowup():
+    """ADVICE r5 hazard: v = g^2 survives nearest-rounding only over a
+    ~254:1 per-row range of |g| while m = g survives over ~64516:1 — a
+    small-but-live coordinate decoded v to exactly 0 with m intact and
+    the Adam update blew up to m_hat/(0+eps) ~ 1e8x.  Denominator slots
+    now round codes AWAY from zero, flooring decoded v at the per-row
+    quantization threshold."""
+    from paddle_tpu.distributed.fleet.dist_step import (_q8_decode,
+                                                        _transform_slots)
+    # one row whose gradient spans the hazard window: g ∈ {1, 1e-3}
+    # puts v = g^2 below v's nearest-rounding floor while m stays alive
+    g = np.zeros((1, 128), np.float32)
+    g[0, :64] = 1.0
+    g[0, 64:] = 1e-3
+    m = jnp.asarray(g)                       # first moment ~ g
+    v = jnp.asarray(g * g)                   # second moment ~ g^2
+    st = {"m": m, "v": v,
+          "beta1_pow": jnp.asarray(0.9, jnp.float32),
+          "beta2_pow": jnp.asarray(0.999, jnp.float32)}
+    enc = _transform_slots(st, (1, 128), jnp.int8, "encode")
+    dec = _transform_slots(enc, (1, 128), jnp.int8, "decode")
+    m_dec, v_dec = np.asarray(dec["m"]), np.asarray(dec["v"])
+    # the hazard coordinate: m alive => v must be alive too
+    alive = np.abs(m_dec) > 0
+    assert alive.any()
+    assert np.all(v_dec[alive] > 0), \
+        "decoded v hit exact 0 on a coordinate whose m survived"
+    # and the resulting Adam step magnitude is bounded by ~|m|/sqrt(v)
+    # of the true values (no eps-division blow-up); the unfixed path
+    # yields ~1e5 here
+    step = np.abs(m_dec) / (np.sqrt(np.maximum(v_dec, 0.0)) + 1e-8)
+    assert float(step.max()) < 10.0, float(step.max())
+    # round-up biases v upward only: decoded v >= nearest-rounded decode
+    v_nearest = np.asarray(_q8_decode(*_q8_encode_nearest(g * g)))
+    assert np.all(v_dec >= v_nearest - 1e-12)
+
+
+def _q8_encode_nearest(x):
+    from paddle_tpu.distributed.fleet.dist_step import _q8_encode
+    return _q8_encode(jnp.asarray(x), round_up=False)
+
+
 def test_q8_encode_decode_accuracy():
     from paddle_tpu.distributed.fleet.dist_step import (_q8_decode,
                                                         _q8_encode)
