@@ -467,12 +467,33 @@ def _bench_wide_deep(smoke, peak_tflops):
     hidden = 64 if smoke else 256
 
     use_native = os.environ.get("BENCH_PS_NATIVE", "1") == "1"
+    # BENCH_CHAOS=1: sanity mode — the same training loop, but the
+    # sparse path rides the PS RPC service with the "flaky" fault plan
+    # injecting delays/dups/lost acks/cuts.  Not a headline number; it
+    # proves the fault-tolerant client keeps a wide_deep run training
+    # (loss falls, zero double-applies) under transport failure.
+    chaos_on = os.environ.get("BENCH_CHAOS", "0") == "1"
+    ps_server = ps_client = chaos_plan = None
     cache = None
     if use_native:
         # optimizer applies host-side in the fused native push
         table = SparseTable(dim, optimizer="sgd", lr=0.05)
         use_native = table.is_native   # no toolchain: cache fallback
-    if use_native:
+    if use_native and chaos_on:
+        from paddle_tpu.distributed.fleet import chaos as chaos_mod
+        from paddle_tpu.distributed.fleet.heter import RemoteTable
+        from paddle_tpu.distributed.fleet.ps_service import (PSClient,
+                                                             PSServer)
+        ps_server = PSServer({"slots": table}, host="127.0.0.1")
+        ps_server.start()
+        chaos_plan = chaos_mod.install(
+            chaos_mod.named_plan("flaky", seed=0))
+        ps_client = PSClient([f"127.0.0.1:{ps_server.port}"],
+                             mode="sync", rpc_timeout=2.0,
+                             connect_timeout=5.0, backoff_base=0.02,
+                             rpc_deadline=30.0)
+        sparse = RemoteTable(ps_client, "slots", dim)
+    elif use_native:
         sparse = table
     else:
         table = SparseTable(dim, optimizer="sgd", lr=1.0)
@@ -552,6 +573,17 @@ def _bench_wide_deep(smoke, peak_tflops):
     tr.shutdown()
     if cache is not None:
         cache.flush()
+    chaos_report = None
+    if chaos_plan is not None:
+        from paddle_tpu.distributed.fleet import chaos as chaos_mod
+        stats = ps_server._stats()
+        chaos_report = {"injected": chaos_plan.stats_dict(),
+                        "rpc_retries": ps_client.retries,
+                        "server_applied": stats["applied"],
+                        "server_dup_acks": stats["dup_acks"]}
+        chaos_mod.uninstall()
+        ps_client.close()
+        ps_server.stop()
     ex_s = batch * n / dt
     timed_losses = state["losses"][n_warm:]
     falling = timed_losses[-1] < timed_losses[0]
@@ -559,6 +591,9 @@ def _bench_wide_deep(smoke, peak_tflops):
         # a 4-step CPU smoke run may not move the loss; finiteness is
         # the smoke-level check
         falling = bool(np.isfinite(state["losses"][-1]))
+    backend = ("device_cache" if cache is not None else
+               "native+chaos_rpc" if chaos_report is not None
+               else "native")
     return {
         "metric": "wide_deep_ps_throughput",
         "value": round(ex_s, 2),
@@ -569,7 +604,8 @@ def _bench_wide_deep(smoke, peak_tflops):
         "batch": batch,
         "n_slots": n_slots,
         "emb_dim": dim,
-        "ps_backend": "native" if cache is None else "device_cache",
+        "ps_backend": backend,
+        "chaos": chaos_report,
         "cache_hit_rate": (None if cache is None else round(
             cache.hits / max(cache.hits + cache.misses, 1), 4)),
         "loss_first": round(timed_losses[0], 4),

@@ -1,0 +1,315 @@
+"""Deterministic fault injection for the PS service layer.
+
+The reference framework earns its fault tolerance claims with brpc
+retry loops and launch-watchdog restarts that are exercised only by
+real cluster churn; this module makes the same failure modes *unit
+testable*: a seedable :class:`FaultPlan` wraps the ``_send_msg`` /
+``_recv_msg`` framing layer of :mod:`~paddle_tpu.distributed.fleet.
+ps_service` and injects faults at exact, reproducible points in the
+RPC stream.
+
+Fault kinds (``Fault.kind``):
+
+  delay   sleep ``arg`` seconds (seeded jitter when arg == 0) before
+          the frame goes out — slow network / GC pause.
+  dup     deliver the frame twice — duplicate delivery.  Only applied
+          to one-way frames (async push / push_delta / heartbeat);
+          duplicating a frame that expects a reply would desynchronise
+          the request/reply stream in a way no real network can
+          (TCP retransmits are invisible), so those downgrade to
+          no-ops and are counted as ``dup_skipped``.
+  cut     send only the first half of the frame, then sever the
+          connection — mid-frame connection loss.
+  drop    sever the connection instead of sending — targeted at
+          ``*_reply`` ops this is the classic "server applied the
+          write but the ack was lost" window that makes naive retry
+          double-apply.
+  refuse  fail a client connect attempt with ConnectionRefusedError —
+          server not yet up / port blackholed.
+  crash   hard-kill the current process (``os._exit(137)``) when the
+          server receives the matching request — SIGKILL-grade server
+          loss for subprocess harnesses (tools/chaos_ps.py).
+
+Matching: every fault names an ``op`` (the request header's ``op``
+field; reply frames match ``<op>_reply``, or ``reply`` as a catch-all;
+``*`` matches everything) and fires on a deterministic schedule over
+its match counter: the ``first``-th match, then every ``every``-th
+after that, at most ``times`` firings (0 = unlimited).
+
+Activation: ``install(plan)`` / ``uninstall()`` in tests, or the
+``PADDLE_CHAOS`` environment variable for subprocess servers and the
+chaos tool, e.g.::
+
+    PADDLE_CHAOS="seed=3;dup:push:every=2;crash:push:first=50"
+    PADDLE_CHAOS="plan=flaky;seed=7"
+
+``plan.stats`` counts every fired fault by ``kind:op`` so harnesses
+can report exactly what was injected.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import random
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Fault", "FaultPlan", "install", "uninstall", "active",
+           "named_plan", "plan_from_spec"]
+
+# frames the protocol never answers: safe to duplicate on the wire
+_ONE_WAY_OPS = {"heartbeat"}
+
+
+def _one_way(obj) -> bool:
+    if not isinstance(obj, dict):
+        return False
+    op = obj.get("op")
+    if op in _ONE_WAY_OPS:
+        return True
+    # async-mode push/push_delta frames carry sync=False and get no ack
+    return op in ("push", "push_delta") and not obj.get("sync")
+
+
+class Fault:
+    """One deterministic fault rule (see module docstring)."""
+
+    KINDS = ("delay", "dup", "cut", "drop", "refuse", "crash")
+
+    def __init__(self, kind: str, op: str = "*", first: int = 1,
+                 every: int = 0, times: int = 1, arg: float = 0.0):
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; "
+                             f"one of {self.KINDS}")
+        self.kind = kind
+        self.op = op
+        self.first = max(1, int(first))
+        self.every = int(every)
+        self.times = int(times)
+        self.arg = float(arg)
+        self.matches = 0   # candidate events seen
+        self.fired = 0     # faults actually injected
+
+    def _site(self) -> str:
+        if self.kind == "refuse":
+            return "connect"
+        if self.kind == "crash":
+            return "serve"
+        return "send"
+
+    def _should_fire(self) -> bool:
+        """Called with the plan lock held, after ``matches`` was
+        incremented for the current candidate event."""
+        n = self.matches
+        if n < self.first:
+            return False
+        if self.every <= 0:
+            hit = n == self.first
+        else:
+            hit = (n - self.first) % self.every == 0
+        if not hit:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+    def __repr__(self):
+        return (f"Fault({self.kind}:{self.op} first={self.first} "
+                f"every={self.every} times={self.times} arg={self.arg})")
+
+
+class FaultPlan:
+    """A seeded, ordered list of :class:`Fault` rules plus firing
+    stats.  At most ONE fault fires per event (list order wins), so a
+    plan reads as a deterministic schedule, not a probability soup."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0,
+                 name: str = ""):
+        self.faults = list(faults)
+        self.seed = int(seed)
+        self.name = name
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._tl = threading.local()
+        self.stats: "collections.Counter" = collections.Counter()
+
+    # -- context: lets reply frames (which carry no op) match the op
+    #    of the request being answered ---------------------------------
+    def set_context(self, op: Optional[str]):
+        self._tl.op = op
+
+    def _op_of(self, obj) -> str:
+        if isinstance(obj, dict) and "op" in obj:
+            return str(obj["op"])
+        ctx = getattr(self._tl, "op", None)
+        return f"{ctx}_reply" if ctx else "reply"
+
+    def _match(self, site: str, op: str) -> Optional[Fault]:
+        with self._lock:
+            for f in self.faults:
+                if f._site() != site:
+                    continue
+                if f.op != "*" and f.op != op and not (
+                        site == "send" and f.op == "reply"
+                        and op.endswith("_reply")):
+                    continue
+                f.matches += 1
+                if f._should_fire():
+                    return f
+            return None
+
+    # -- injection sites (called from ps_service) ----------------------
+    def send(self, sock, obj, raw_send):
+        """Wrap one outgoing frame.  ``raw_send(sock, obj)`` is the real
+        framing function; faults may call it 0, 1 or 2 times."""
+        op = self._op_of(obj)
+        f = self._match("send", op)
+        if f is None:
+            return raw_send(sock, obj)
+        if f.kind == "delay":
+            with self._lock:
+                d = f.arg if f.arg > 0 else 0.001 + self._rng.random() * 0.01
+            self.stats[f"delay:{op}"] += 1
+            time.sleep(d)
+            return raw_send(sock, obj)
+        if f.kind == "dup":
+            if _one_way(obj):
+                self.stats[f"dup:{op}"] += 1
+                raw_send(sock, obj)
+                return raw_send(sock, obj)
+            self.stats["dup_skipped"] += 1
+            return raw_send(sock, obj)
+        if f.kind == "cut":
+            from .ps_service import _frame_bytes
+            self.stats[f"cut:{op}"] += 1
+            data = _frame_bytes(obj)
+            try:
+                sock.sendall(data[:max(1, len(data) // 2)])
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise ConnectionError(f"chaos: mid-frame cut ({op})")
+        if f.kind == "drop":
+            self.stats[f"drop:{op}"] += 1
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"chaos: frame dropped ({op})")
+        # unreachable for send-site kinds
+        return raw_send(sock, obj)
+
+    def check_connect(self, endpoint):
+        f = self._match("connect", "connect")
+        if f is not None:
+            self.stats["refuse:connect"] += 1
+            raise ConnectionRefusedError(
+                f"chaos: connection refused to {endpoint[0]}:{endpoint[1]}")
+
+    def on_serve(self, msg):
+        """Server-side hook, called once per received request."""
+        op = msg.get("op", "?") if isinstance(msg, dict) else "?"
+        f = self._match("serve", op)
+        if f is not None and f.kind == "crash":
+            # stats are lost with the process — that is the point
+            os._exit(137)
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return dict(self.stats)
+
+    def __repr__(self):
+        return (f"FaultPlan(name={self.name!r}, seed={self.seed}, "
+                f"faults={self.faults})")
+
+
+# -- named plans --------------------------------------------------------
+
+def named_plan(name: str, seed: int = 0) -> FaultPlan:
+    """Prebuilt schedules for the chaos tool / bench sanity mode."""
+    if name == "flaky":
+        # survivable background noise: slow frames, duplicated async
+        # pushes, a lost push ack (forces the idempotent retry path),
+        # one mid-frame cut
+        faults = [
+            Fault("delay", op="pull", first=3, every=7, times=0,
+                  arg=0.002),
+            Fault("dup", op="push", first=2, every=5, times=0),
+            Fault("drop", op="push_reply", first=4, every=9, times=0),
+            Fault("cut", op="pull", first=11, every=17, times=0),
+        ]
+    elif name == "dup":
+        faults = [Fault("dup", op="push", first=1, every=1, times=0),
+                  Fault("dup", op="push_delta", first=1, every=1,
+                        times=0)]
+    elif name == "lost_ack":
+        faults = [Fault("drop", op="push_reply", first=1, every=3,
+                        times=0)]
+    elif name.startswith("crash@"):
+        faults = [Fault("crash", op="push", first=int(name[6:]))]
+    else:
+        raise ValueError(f"unknown chaos plan {name!r} (flaky, dup, "
+                         f"lost_ack, crash@N)")
+    return FaultPlan(faults, seed=seed, name=name)
+
+
+def plan_from_spec(spec: str) -> FaultPlan:
+    """Parse a ``PADDLE_CHAOS`` spec: ``;``-separated tokens, each
+    either ``seed=N``, ``plan=<name>``, or
+    ``kind:op[:key=val[:key=val...]]`` with keys first/every/times/arg."""
+    seed = 0
+    name = None
+    faults: List[Fault] = []
+    for tok in (t.strip() for t in spec.split(";")):
+        if not tok:
+            continue
+        if tok.startswith("seed="):
+            seed = int(tok[5:])
+        elif tok.startswith("plan="):
+            name = tok[5:]
+        else:
+            parts = tok.split(":")
+            if len(parts) < 2:
+                raise ValueError(f"bad chaos token {tok!r} "
+                                 f"(want kind:op[:k=v...])")
+            kw = {}
+            for p in parts[2:]:
+                k, _, v = p.partition("=")
+                if k not in ("first", "every", "times", "arg"):
+                    raise ValueError(f"bad chaos fault key {k!r} in "
+                                     f"{tok!r}")
+                kw[k] = float(v) if k == "arg" else int(v)
+            faults.append(Fault(parts[0], op=parts[1], **kw))
+    if name is not None:
+        plan = named_plan(name, seed=seed)
+        plan.faults.extend(faults)
+        return plan
+    return FaultPlan(faults, seed=seed, name="env")
+
+
+# -- global activation --------------------------------------------------
+_plan: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan):
+    global _plan
+    _plan = plan
+    return plan
+
+
+def uninstall():
+    global _plan
+    _plan = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _plan
+
+
+_env_spec = os.environ.get("PADDLE_CHAOS")
+if _env_spec:
+    install(plan_from_spec(_env_spec))

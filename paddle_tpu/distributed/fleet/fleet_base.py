@@ -160,8 +160,20 @@ class Fleet:
     def run_server(self):
         if self._ps_runtime is not None:
             # the launch-skew guard needs the trainer count: the first
-            # barrier must not complete before everyone has registered
-            self._ps_runtime.run_server(expected_workers=self.worker_num())
+            # barrier must not complete before everyone has registered.
+            # When this server's endpoint sits behind a primary in a
+            # "|"-separated replica group of PADDLE_PSERVERS_IP_PORT_LIST,
+            # it comes up as that primary's hot standby.
+            from .role_maker import replica_primary_for
+            me = (f"{os.environ.get('POD_IP', '127.0.0.1')}:"
+                  f"{os.environ.get('PADDLE_PORT', '')}")
+            replica_of = replica_primary_for(
+                me, self._rm().server_endpoints())
+            port = os.environ.get("PADDLE_PORT")
+            self._ps_runtime.run_server(
+                expected_workers=self.worker_num(),
+                replica_of=replica_of,
+                port=int(port) if port else None)
 
     def stop_worker(self):
         if self._ps_runtime is not None:

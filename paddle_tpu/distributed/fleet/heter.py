@@ -41,7 +41,37 @@ import numpy as np
 
 from .ps import SparseTable
 
-__all__ = ["HeterTrainer", "DeviceCachedTable"]
+__all__ = ["HeterTrainer", "DeviceCachedTable", "RemoteTable"]
+
+
+class RemoteTable:
+    """A table living behind the PS service, presented with the local
+    ``SparseTable`` pull/push surface so :class:`HeterTrainer` (and the
+    bench's wide_deep loop) can train against a remote — and, with an
+    endpoint list per shard, fault-tolerant — PS cluster instead of an
+    in-process table.
+
+    The wrapped :class:`~paddle_tpu.distributed.fleet.ps_service.
+    PSClient` owns retries, idempotent seq numbering and replica
+    failover; this adapter only pins the table name and dim.
+    """
+
+    def __init__(self, client, name: str, dim: int):
+        self._client = client
+        self.name = name
+        self.dim = dim
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        return self._client.pull(self.name, ids)
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        self._client.push(self.name, ids,
+                          np.asarray(grads, np.float32).reshape(
+                              ids.size, self.dim))
+
+    def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
+        self._client.push_delta(self.name, ids, deltas)
 
 
 class _NativeCacheDir:

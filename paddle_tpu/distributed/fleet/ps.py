@@ -64,6 +64,8 @@ class SparseTable:
                  epsilon: float = 1e-10, entry=None,
                  use_native: Optional[bool] = None):
         self.dim = dim
+        self._seed = int(seed)
+        self._init_std = float(init_std)
         # feature admission (reference entry_attr.py): ids the entry has
         # not admitted pull zeros and drop their grads — no row memory
         self._entry = entry
@@ -101,6 +103,7 @@ class SparseTable:
                                           float(entry.probability))
                         self._native_entry = True
         # python fallback state
+        self._version = 0   # applied mutating batches (native: in C)
         self._rows: Dict[int, np.ndarray] = {}
         self._moments: Dict[int, np.ndarray] = {}
         self._moments2: Dict[int, np.ndarray] = {}
@@ -238,6 +241,7 @@ class SparseTable:
         sums = np.zeros((uniq.size, self.dim), np.float32)
         np.add.at(sums, inverse, grads)
         with self._lock:
+            self._version += 1
             for k, g in zip(uniq.tolist(), sums):
                 row = self._rows.get(k)
                 if row is None:
@@ -290,6 +294,7 @@ class SparseTable:
                 self._c(deltas, ctypes.c_float))
             return
         with self._lock:
+            self._version += 1
             for k, d in zip(ids.tolist(), deltas):
                 row = self._rows.get(k)
                 if row is None:
@@ -365,9 +370,51 @@ class SparseTable:
             return int(self._lib.pts_size(self._native))
         return len(self._rows)
 
-    # checkpoint (reference: servers persist their shard,
-    # the_one_ps.py:758 warm-start)
-    def save(self, path: str):
+    @property
+    def version(self) -> int:
+        """Count of applied mutating batches (push/push_delta calls) —
+        the native core's last-seq counter, exposed alongside the id
+        directory.  A primary and a caught-up replica report the same
+        version; the chaos harness audits it."""
+        if self._native is not None:
+            return int(self._lib.pts_version(self._native))
+        return self._version
+
+    def config_arrays(self) -> dict:
+        """The table's construction config as npz-storable scalars —
+        rides in every snapshot so a replica (or warm start) can
+        recreate a table it was not configured with, byte-compatible:
+        same optimizer math AND the same deterministic per-id init
+        (seed/init_std) for rows that first materialise after a
+        failover."""
+        return dict(opt=np.str_(self._opt), lr=np.float64(self._lr),
+                    beta1=np.float64(self._beta1),
+                    beta2=np.float64(self._beta2),
+                    eps=np.float64(self._eps),
+                    init_std=np.float64(self._init_std),
+                    seed=np.int64(self._seed))
+
+    @staticmethod
+    def from_config(d) -> "SparseTable":
+        """Build a table from a snapshot's npz dict: exact dim even for
+        an empty table (vals is always (0, dim)-shaped), and the saved
+        optimizer/init config when present (older checkpoints fall back
+        to defaults)."""
+        vals = d["vals"]
+        dim = int(vals.shape[1]) if getattr(vals, "ndim", 0) == 2 else 1
+        kw = {}
+        if "opt" in d:
+            kw = dict(optimizer=str(d["opt"]), lr=float(d["lr"]),
+                      beta1=float(d["beta1"]), beta2=float(d["beta2"]),
+                      epsilon=float(d["eps"]),
+                      init_std=float(d["init_std"]),
+                      seed=int(d["seed"]))
+        return SparseTable(dim, **kw)
+
+    def _snapshot_arrays(self):
+        """The checkpoint payload (ids/vals/entry state/config/version)
+        as one consistent dict — shared by file save and replication
+        snapshots."""
         import ctypes
         if self._native is not None:
             with self._lock:
@@ -387,8 +434,9 @@ class SparseTable:
                                              self._c(vals, ctypes.c_float),
                                              n)
                     ids, vals = ids[:w], vals[:w]
-            np.savez(path, ids=ids, vals=vals, **entry)
-            return
+                ver = int(self._lib.pts_version(self._native))
+            return dict(ids=ids, vals=vals, version=np.int64(ver),
+                        **self.config_arrays(), **entry)
         with self._lock:
             # one lock section: the rows snapshot and the admission
             # state must agree (and concurrent push must not mutate the
@@ -397,11 +445,34 @@ class SparseTable:
             vals = np.stack([self._rows[int(i)] for i in ids]) \
                 if len(ids) else np.zeros((0, self.dim), np.float32)
             entry = self._entry_state_locked()
-        np.savez(path, ids=ids, vals=vals, **entry)
+            ver = self._version
+        return dict(ids=ids, vals=vals, version=np.int64(ver),
+                    **self.config_arrays(), **entry)
+
+    # checkpoint (reference: servers persist their shard,
+    # the_one_ps.py:758 warm-start)
+    def save(self, path: str):
+        np.savez(path, **self._snapshot_arrays())
+
+    def state_bytes(self) -> bytes:
+        """The whole table as npz bytes (the on-disk checkpoint format,
+        in memory) — what a hot standby catches up from."""
+        import io
+        buf = io.BytesIO()
+        np.savez(buf, **self._snapshot_arrays())
+        return buf.getvalue()
 
     def load(self, path: str):
+        self._load_npz(
+            np.load(path if path.endswith(".npz") else path + ".npz"))
+
+    def load_state_bytes(self, data: bytes):
+        """Restore from :meth:`state_bytes` (replication snapshot)."""
+        import io
+        self._load_npz(np.load(io.BytesIO(data)))
+
+    def _load_npz(self, d):
         import ctypes
-        d = np.load(path if path.endswith(".npz") else path + ".npz")
         ids = np.ascontiguousarray(d["ids"], np.int64)
         vals = np.ascontiguousarray(d["vals"], np.float32)
         if vals.ndim != 2 or vals.shape[0] != ids.size or (
@@ -410,12 +481,14 @@ class SparseTable:
                 f"checkpoint layout {vals.shape} does not match table "
                 f"(rows={ids.size}, dim={self.dim}); was it saved from a "
                 f"table with a different embedding dim?")
+        ver = int(d["version"]) if "version" in d else 0
         if self._native is not None:
             # restore REPLACES (reference warm-start semantics,
             # the_one_ps.py:758) — never merges into existing rows
             self._lib.pts_clear(self._native)
             self._lib.pts_import(self._native, self._c(ids, ctypes.c_int64),
                                  ids.size, self._c(vals, ctypes.c_float))
+            self._lib.pts_set_version(self._native, ver)
             self._restore_entry_state(d, ids)
             return
         with self._lock:
@@ -426,6 +499,7 @@ class SparseTable:
             self._moments.clear()
             self._moments2.clear()
             self._steps.clear()
+            self._version = ver
             self._restore_entry_state_locked(d, ids)
 
 
@@ -451,14 +525,20 @@ class PSRuntime:
             for f in os.listdir(dirname):
                 if f.endswith(".npz"):
                     name = f[:-4]
-                    # dim recovered from the file
+                    # dim + optimizer/init config recovered from the
+                    # file (exact dim even for an empty table)
                     d = np.load(os.path.join(dirname, f))
-                    t = SparseTable(d["vals"].shape[1]
-                                    if d["vals"].size else 1)
+                    t = SparseTable.from_config(d)
                     t.load(os.path.join(dirname, f))
                     self._tables[name] = t
 
-    def run_server(self, expected_workers: Optional[int] = None):
+    def run_server(self, expected_workers: Optional[int] = None,
+                   replica_of: Optional[str] = None,
+                   port: Optional[int] = None):
+        """Serve this runtime's tables.  ``replica_of="host:port"``
+        starts a hot standby of that primary instead of a fresh
+        primary (fleet.run_server derives it from this server's
+        position in its ``|``-separated replica group)."""
         from .ps_service import PSServer
         kw = {}
         cfg = getattr(self._strategy, "a_sync_configs", None)
@@ -466,7 +546,9 @@ class PSRuntime:
             kw = dict(heartbeat_timeout=cfg.get("heartbeat_timeout", 10.0),
                       on_dead=cfg.get("on_dead", "evict"))
         self._server = PSServer(self._tables,
-                                expected_workers=expected_workers, **kw)
+                                port=port or 0,
+                                expected_workers=expected_workers,
+                                replica_of=replica_of, **kw)
         self._server.start()
 
     def init_worker(self, endpoints=None, worker_id=None):

@@ -20,6 +20,30 @@ Modes:
   geo        client trains on a local mirror, pushes step deltas every
              k steps (GeoCommunicator:495 delta-push semantics)
 
+Fault tolerance (parity: brpc_ps_client.cc retry loops + the launch
+watchdog's server restarts, launch_utils.py:526):
+
+  * every mutating RPC (push / push_delta / register / barrier) carries
+    a per-client monotonically increasing sequence number; the server
+    keeps a per-client last-applied-seq window and ACKS duplicates
+    without re-applying, so retries are safe even though server-side
+    push is additive;
+  * the client retries with connect/send/recv timeouts, bounded
+    exponential backoff with seeded jitter and transparent
+    reconnection (a failed socket is always dropped — a partial frame
+    must never be resumed), surfacing a typed :class:`PSUnavailable`
+    at the hard deadline;
+  * a server can run as a hot standby (``replica_of=primary``): it
+    catches up from an npz snapshot of every table, then applies a
+    streamed log of acked mutations (the primary forwards each applied
+    push to all replicas *before* acking the client, so an acked push
+    is never lost to single-server failure); clients take an endpoint
+    LIST per shard ("host:p1|host:p2") and fail over when the active
+    endpoint misses deadlines;
+  * the framing layer is wrapped by the deterministic chaos harness
+    (:mod:`~paddle_tpu.distributed.fleet.chaos`) so all of the above
+    is provable under injected failure.
+
 Worker liveness (parity: operators/distributed/heart_beat_monitor.cc):
 clients register a worker id and a background thread beats every
 ``heartbeat_interval``; the server's monitor thread marks a worker dead
@@ -31,30 +55,59 @@ surviving workers so the job stops instead of silently shrinking.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import pickle
 import queue
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PSServer", "PSClient"]
+from . import chaos as _chaos
+
+__all__ = ["PSServer", "PSClient", "PSError", "PSConnectError",
+           "PSUnavailable"]
 
 _HDR = struct.Struct("!I")
 
 
-def _send_msg(sock: socket.socket, obj):
-    """Frame: [!I header_len][pickled header][raw array payloads...].
+class PSError(RuntimeError):
+    """Base class for parameter-server transport errors."""
 
-    Top-level numpy values in a dict message ride OUT OF BAND: the
-    header pickles only their (key, dtype, shape) metadata and the
-    buffers follow as raw bytes via scatter-gather ``sendmsg`` — the
-    data plane (ids / grads / pulled rows) is never pickled or copied
-    into an intermediate frame, so a pull/push RPC against the native
-    table costs one small header pickle plus direct buffer writes."""
+
+class PSConnectError(PSError):
+    """Could not establish a connection to any endpoint of a shard."""
+
+
+class PSUnavailable(PSError):
+    """An RPC exhausted its retry budget / hard deadline."""
+
+
+# RPCs with server-side effects: they carry (src, seq) so a retry can be
+# acked without re-applying (additive pushes would double-apply)
+_MUTATING_OPS = ("push", "push_delta", "register", "barrier")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _parse_ep(e) -> Tuple[str, int]:
+    h, p = str(e).rsplit(":", 1)
+    return h, int(p)
+
+
+def _extract_arrays(obj):
+    """Split top-level ndarray values out of a dict message: returns
+    (picklable header object, list of contiguous arrays)."""
     arrays = []
     if isinstance(obj, dict) and any(isinstance(v, np.ndarray)
                                      for v in obj.values()):
@@ -68,10 +121,41 @@ def _send_msg(sock: socket.socket, obj):
                 plain[k] = v
         plain["__arrays__"] = meta
         obj = plain
+    return obj, arrays
+
+
+def _send_msg_raw(sock: socket.socket, obj):
+    """Frame: [!I header_len][pickled header][raw array payloads...].
+
+    Top-level numpy values in a dict message ride OUT OF BAND: the
+    header pickles only their (key, dtype, shape) metadata and the
+    buffers follow as raw bytes via scatter-gather ``sendmsg`` — the
+    data plane (ids / grads / pulled rows) is never pickled or copied
+    into an intermediate frame, so a pull/push RPC against the native
+    table costs one small header pickle plus direct buffer writes."""
+    obj, arrays = _extract_arrays(obj)
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     parts = [memoryview(_HDR.pack(len(data)) + data)]
     parts += [memoryview(a).cast("B") for a in arrays if a.nbytes]
     _sendall_vec(sock, parts)
+
+
+def _send_msg(sock: socket.socket, obj):
+    """Chaos-aware framing entry point: when a fault plan is installed
+    (tests, ``PADDLE_CHAOS``) every frame passes through it."""
+    plan = _chaos.active()
+    if plan is not None:
+        return plan.send(sock, obj, _send_msg_raw)
+    _send_msg_raw(sock, obj)
+
+
+def _frame_bytes(obj) -> bytes:
+    """The exact wire bytes of a frame, as one buffer — the chaos
+    harness uses this to sever connections mid-frame."""
+    obj, arrays = _extract_arrays(obj)
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return b"".join([_HDR.pack(len(data)), data]
+                    + [a.tobytes() for a in arrays if a.nbytes])
 
 
 def _sendall_vec(sock, views):
@@ -124,6 +208,44 @@ def _recv_exact(sock, n):
             return None
         got += r
     return buf
+
+
+class _SeqWindow:
+    """Per-client duplicate detector: last-applied-seq high-water mark
+    plus the set of seqs seen inside a sliding window.  A seq at or
+    below ``max_seq - WINDOW`` is treated as an ancient duplicate —
+    the client's bounded retry budget cannot legitimately be that far
+    behind its own high-water mark."""
+
+    WINDOW = 4096
+    __slots__ = ("max_seq", "seen")
+
+    def __init__(self, max_seq: int = 0, seen=()):
+        self.max_seq = int(max_seq)
+        self.seen = set(int(s) for s in seen)
+
+    def check_and_record(self, seq) -> bool:
+        """True when ``seq`` is a duplicate (already applied); records
+        it as applied otherwise."""
+        seq = int(seq)
+        if seq <= self.max_seq - self.WINDOW:
+            return True
+        if seq in self.seen:
+            return True
+        self.seen.add(seq)
+        if seq > self.max_seq:
+            self.max_seq = seq
+        if len(self.seen) > 2 * self.WINDOW:
+            floor = self.max_seq - self.WINDOW
+            self.seen = {s for s in self.seen if s > floor}
+        return False
+
+    def export(self):
+        return [self.max_seq, sorted(self.seen)[-self.WINDOW:]]
+
+    @classmethod
+    def from_export(cls, x):
+        return cls(x[0], x[1])
 
 
 class HeartBeatMonitor:
@@ -195,13 +317,22 @@ class HeartBeatMonitor:
 
 
 class PSServer:
-    """Serves SparseTable pull/push (parity: brpc_ps_server.cc)."""
+    """Serves SparseTable pull/push (parity: brpc_ps_server.cc).
+
+    ``replica_of="host:port"`` starts this server as a hot standby of a
+    running primary: it pulls an npz snapshot of every table + the
+    primary's seq windows, then applies the primary's streamed log of
+    acked mutations.  When the primary connection dies the standby
+    promotes itself (``promoted``/``role``) and keeps serving — clients
+    holding an endpoint list fail over to it transparently.
+    """
 
     def __init__(self, tables: Dict[str, "SparseTable"],
                  host: str = "0.0.0.0", port: int = 0,
                  heartbeat_timeout: float = 10.0,
                  on_dead: str = "evict",
-                 expected_workers: Optional[int] = None):
+                 expected_workers: Optional[int] = None,
+                 replica_of: Optional[str] = None):
         if on_dead not in ("evict", "fail"):
             raise ValueError(f"on_dead must be 'evict' or 'fail', "
                              f"got {on_dead!r}")
@@ -213,6 +344,8 @@ class PSServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads = []
+        self._conns: set = set()       # live client connections
+        self._conns_lock = threading.Lock()
         self._on_dead = on_dead
         self.monitor = HeartBeatMonitor(timeout=heartbeat_timeout)
         # rendezvous state: barrier generation -> set of arrived workers
@@ -223,12 +356,32 @@ class PSServer:
         # expected_workers distinct workers have ever registered
         self._expected = expected_workers
         self._ever_registered: set = set()
+        # idempotency + replication state.  _apply_lock serializes
+        # mutations so (dedup check, table apply, replica forward) is
+        # one atomic commit with a total order the replica replays.
+        self._apply_lock = threading.Lock()
+        self._seqs: Dict[str, _SeqWindow] = {}
+        self._replicas: List[dict] = []
+        self.applied = 0      # mutations committed
+        self.dup_acks = 0     # duplicates acked without re-applying
+        self.replica_of = replica_of
+        self.role = "replica" if replica_of else "primary"
+        self.promoted = False
+        self.replica_error: Optional[Exception] = None
+        self.replica_ready = threading.Event()
+        self._repl_sock: Optional[socket.socket] = None
+        if replica_of is None:
+            self.replica_ready.set()
 
     def start(self, block: bool = False):
         self.monitor.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.replica_of is not None:
+            rt = threading.Thread(target=self._replica_loop, daemon=True)
+            rt.start()
+            self._threads.append(rt)
         if block:
             t.join()
 
@@ -241,18 +394,28 @@ class PSServer:
                 continue
             except OSError:
                 break
+            with self._conns_lock:
+                self._conns.add(conn)
             th = threading.Thread(target=self._serve, args=(conn,),
                                   daemon=True)
             th.start()
             self._threads.append(th)
 
     def _serve(self, conn):
+        handed_off = False
+        plan = _chaos.active()
         try:
             while not self._stop.is_set():
-                msg = _recv_msg(conn)
+                try:
+                    msg = _recv_msg(conn)
+                except (OSError, ConnectionError):
+                    break   # client gone (or chaos severed the stream)
                 if msg is None:
                     break
                 op = msg["op"]
+                if plan is not None:
+                    plan.on_serve(msg)       # may crash the process
+                    plan.set_context(op)     # replies match "<op>_reply"
                 # any RPC that names its worker is proof of life, so a
                 # client doing only pull/push (no beat thread) stays live
                 w = msg.get("worker")
@@ -265,19 +428,15 @@ class PSServer:
                 if op == "pull":
                     t = self._table(msg["table"])
                     _send_msg(conn, {"vals": t.pull(msg["ids"])})
-                elif op == "push":
-                    t = self._table(msg["table"])
-                    t.push(msg["ids"], msg["grads"])
+                elif op in ("push", "push_delta"):
+                    applied = self._apply_mutation(msg)
                     if msg.get("sync"):
-                        _send_msg(conn, {"ok": True})
-                elif op == "push_delta":  # geo mode: raw delta add
-                    t = self._table(msg["table"])
-                    t.push_delta(msg["ids"], msg["deltas"])
-                    if msg.get("sync"):
-                        _send_msg(conn, {"ok": True})
+                        _send_msg(conn, {"ok": True, "dup": not applied})
                 elif op == "barrier":
+                    self._record_seq(msg)
                     _send_msg(conn, {"ok": True})
                 elif op == "register" or op == "heartbeat":
+                    self._record_seq(msg)
                     self.monitor.beat(msg["worker"])
                     with self.monitor.cond:
                         self._ever_registered.add(msg["worker"])
@@ -289,12 +448,223 @@ class PSServer:
                 elif op == "worker_barrier":
                     _send_msg(conn, self._worker_barrier(
                         msg["worker"], msg.get("timeout")))
+                elif op == "replicate":
+                    handed_off = self._attach_replica(conn)
+                    return
+                elif op == "stats":
+                    _send_msg(conn, self._stats())
                 elif op == "stop":
                     _send_msg(conn, {"ok": True})
                     self._stop.set()
                     break
+                if plan is not None:
+                    plan.set_context(None)
+        except (OSError, ConnectionError):
+            # a reply send failing (client died mid-RPC, or chaos cut
+            # the frame) ends this connection, not the server
+            pass
         finally:
-            conn.close()
+            if plan is not None:
+                plan.set_context(None)
+            with self._conns_lock:
+                self._conns.discard(conn)
+            if not handed_off:
+                conn.close()
+
+    # -- idempotency + replication --------------------------------------
+    def _record_seq(self, msg) -> bool:
+        """Record (src, seq) of a non-table mutating RPC (register /
+        barrier); returns True when it was a duplicate.  Both are
+        idempotent anyway — recording keeps the window an exact log of
+        what this server acked."""
+        src, seq = msg.get("src"), msg.get("seq")
+        if src is None or seq is None:
+            return False
+        with self._apply_lock:
+            w = self._seqs.get(src)
+            if w is None:
+                w = self._seqs[src] = _SeqWindow()
+            dup = w.check_and_record(seq)
+            if dup:
+                self.dup_acks += 1
+            return dup
+
+    def _apply_mutation(self, msg) -> bool:
+        """Commit one push/push_delta exactly once: dedup by (src, seq),
+        apply to the table, and forward to every attached replica —
+        all under the apply lock, BEFORE the client is acked.  Returns
+        False when the seq was already applied (retry: ack only)."""
+        src, seq = msg.get("src"), msg.get("seq")
+        with self._apply_lock:
+            if src is not None and seq is not None:
+                w = self._seqs.get(src)
+                if w is None:
+                    w = self._seqs[src] = _SeqWindow()
+                if w.check_and_record(seq):
+                    self.dup_acks += 1
+                    return False
+            t = self._table(msg["table"])
+            if msg["op"] == "push":
+                t.push(msg["ids"], msg["grads"])
+            else:
+                t.push_delta(msg["ids"], msg["deltas"])
+            self.applied += 1
+            if self._replicas:
+                self._forward(msg)
+        return True
+
+    def _forward(self, msg):
+        """Stream one committed mutation to every replica and wait for
+        each ack (called under the apply lock).  A replica that errors
+        is detached — it will re-sync from a fresh snapshot if it comes
+        back."""
+        rec = {k: msg[k] for k in ("op", "table", "ids", "grads",
+                                   "deltas", "src", "seq") if k in msg}
+        for rep in list(self._replicas):
+            with rep["lock"]:
+                try:
+                    _send_msg_raw(rep["conn"], rec)
+                    ack = _recv_msg(rep["conn"])
+                    if ack is None or not ack.get("ok"):
+                        raise ConnectionError("replica closed mid-stream")
+                except (OSError, ConnectionError):
+                    self._replicas.remove(rep)
+                    try:
+                        rep["conn"].close()
+                    except OSError:
+                        pass
+
+    def _attach_replica(self, conn) -> bool:
+        """Handshake for ``op=replicate``: under the apply lock snapshot
+        every table (npz bytes — the PR 1 checkpoint format) plus the
+        seq windows, register the connection as a stream sink, then send
+        the snapshot.  The sink's lock is held until the snapshot is on
+        the wire so a concurrent mutation's forward cannot overtake it.
+        Returns True when the connection was handed off to the stream.
+        """
+        rep = {"conn": conn, "lock": threading.Lock()}
+        with self._apply_lock:
+            names = sorted(self._tables)
+            blobs = [(n, self._tables[n].state_bytes()) for n in names]
+            seqs = {s: w.export() for s, w in self._seqs.items()}
+            rep["lock"].acquire()
+            self._replicas.append(rep)
+        try:
+            conn.settimeout(30.0)
+            _send_msg_raw(conn, {"op": "snapshot", "tables": names,
+                                 "seqs": seqs})
+            for n, b in blobs:
+                _send_msg_raw(conn, {"table": n,
+                                     "blob": np.frombuffer(b, np.uint8)})
+            ack = _recv_msg(conn)
+            if ack is None or not ack.get("ok"):
+                raise ConnectionError("replica rejected snapshot")
+        except (OSError, ConnectionError):
+            with self._apply_lock:
+                if rep in self._replicas:
+                    self._replicas.remove(rep)
+            rep["lock"].release()
+            return False
+        rep["lock"].release()
+        return True
+
+    def _replica_loop(self):
+        """Standby side: attach to the primary, load the snapshot, then
+        apply the mutation stream until the primary dies — at which
+        point this server promotes itself."""
+        ep = _parse_ep(self.replica_of)
+        sock = None
+        deadline = time.monotonic() + 60.0
+        while not self._stop.is_set():
+            try:
+                sock = socket.create_connection(ep, timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    return
+                time.sleep(0.2)
+        if sock is None:
+            return
+        self._repl_sock = sock
+        try:
+            sock.settimeout(60.0)
+            _send_msg_raw(sock, {"op": "replicate"})
+            head = _recv_msg(sock)
+            if head is None:
+                return
+            for _ in head.get("tables", []):
+                fr = _recv_msg(sock)
+                if fr is None:
+                    return
+                self._load_snapshot_table(fr["table"],
+                                          fr["blob"].tobytes())
+            with self._apply_lock:
+                self._seqs = {s: _SeqWindow.from_export(x)
+                              for s, x in head.get("seqs", {}).items()}
+            _send_msg_raw(sock, {"ok": True})
+            self.replica_ready.set()
+            sock.settimeout(None)
+            while not self._stop.is_set():
+                rec = _recv_msg(sock)
+                if rec is None:
+                    break   # primary is gone
+                try:
+                    self._apply_mutation(rec)
+                except Exception as e:
+                    # a record this standby cannot apply means it is
+                    # OUT OF SYNC (config mismatch, bug): it must never
+                    # promote and serve diverged state.  Dropping the
+                    # connection (no ack) also detaches it primary-side.
+                    self.replica_error = e
+                    import sys
+                    print(f"paddle_tpu PSServer standby: replication "
+                          f"stream failed, NOT promoting: {e!r}",
+                          file=sys.stderr)
+                    return
+                _send_msg_raw(sock, {"ok": True})
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if not self._stop.is_set() and self.replica_error is None:
+                self.promote()
+
+    def _load_snapshot_table(self, name: str, blob: bytes):
+        t = self._tables.get(name)
+        if t is None:
+            # table the replica was not configured with (e.g. an
+            # auto-vivified __util accumulator): recover it from the
+            # snapshot itself — dim AND optimizer/init config, so
+            # streamed pushes apply the identical math and rows that
+            # first materialise after failover use the identical
+            # deterministic init
+            if name.startswith("__util"):
+                t = self._table(name)
+            else:
+                import io
+                from .ps import SparseTable
+                t = self._tables[name] = SparseTable.from_config(
+                    np.load(io.BytesIO(blob)))
+        t.load_state_bytes(blob)
+
+    def promote(self):
+        """Become the primary (the standby's stream ended)."""
+        self.promoted = True
+        self.role = "primary"
+
+    def _stats(self) -> dict:
+        with self._apply_lock:
+            return {"ok": True, "role": self.role,
+                    "promoted": self.promoted,
+                    "applied": self.applied,
+                    "dup_acks": self.dup_acks,
+                    "n_replicas": len(self._replicas),
+                    "versions": {n: t.version
+                                 for n, t in self._tables.items()
+                                 if hasattr(t, "version")}}
 
     def _table(self, name: str):
         """Reserved "__util" tables auto-vivify as zero-initialized
@@ -383,31 +753,99 @@ class PSServer:
     def stop(self):
         self._stop.set()
         self.monitor.stop()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        # sever live client connections too: a stopped server must look
+        # DOWN (clients fail over to a standby), not half-alive
+        for s in ([self._sock, self._repl_sock] + conns
+                  + [r["conn"] for r in self._replicas]):
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+_UNSET = object()
 
 
 class PSClient:
-    """Worker-side client (parity: brpc_ps_client.cc + Communicator modes)."""
+    """Worker-side client (parity: brpc_ps_client.cc + Communicator modes).
+
+    ``endpoints`` names one entry per SHARD; each entry is either a
+    single ``"host:port"`` or a failover list — ``"h:p1|h:p2"`` or an
+    actual list/tuple — ordered primary first.  Ids shard by
+    ``id % n_shards`` exactly as before; within a shard the client
+    talks to the active endpoint and rotates on repeated failure.
+
+    Retry/backoff knobs (constructor args override the environment):
+
+    ==========================  =============================  =======
+    arg                         env                            default
+    ==========================  =============================  =======
+    ``connect_timeout``         ``PADDLE_PS_CONNECT_TIMEOUT``  10 s
+    ``rpc_timeout``             ``PADDLE_PS_RPC_TIMEOUT``      20 s
+    ``max_retries``             ``PADDLE_PS_MAX_RETRIES``      8
+    ``backoff_base``            ``PADDLE_PS_BACKOFF_BASE``     0.05 s
+    ``rpc_deadline``            ``PADDLE_PS_RPC_DEADLINE``     60 s
+    ==========================  =============================  =======
+
+    Every mutating RPC carries a monotonically increasing seq number
+    (``src`` scoped), so the bounded retry loop is exactly-once on the
+    server even for additive pushes; exhausting the budget raises
+    :class:`PSUnavailable` naming the shard's endpoints.
+    """
 
     def __init__(self, endpoints, mode: str = "sync", send_queue_size=16,
                  geo_k_steps: int = 100, worker_id: Optional[str] = None,
-                 heartbeat_interval: float = 0.0):
-        self._eps = [(h, int(p)) for h, p in
-                     (e.rsplit(":", 1) for e in endpoints)]
-        self._socks = []
-        for h, p in self._eps:
-            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            s.connect((h, p))
-            self._socks.append(s)
+                 heartbeat_interval: float = 0.0,
+                 connect_timeout: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 rpc_deadline: Optional[float] = None):
+        self._ep_lists: List[List[Tuple[str, int]]] = []
+        for e in endpoints:
+            if isinstance(e, (list, tuple)):
+                group = [_parse_ep(x) for x in e]
+            else:
+                group = [_parse_ep(x) for x in str(e).split("|") if x]
+            if not group:
+                raise ValueError(f"empty endpoint entry {e!r}")
+            self._ep_lists.append(group)
+        self._active = [0] * len(self._ep_lists)
+        self._connect_timeout = (connect_timeout if connect_timeout
+                                 is not None else
+                                 _env_float("PADDLE_PS_CONNECT_TIMEOUT", 10.0))
+        self._rpc_timeout = (rpc_timeout if rpc_timeout is not None else
+                             _env_float("PADDLE_PS_RPC_TIMEOUT", 20.0))
+        self._max_retries = int(max_retries if max_retries is not None else
+                                _env_float("PADDLE_PS_MAX_RETRIES", 8))
+        self._backoff = (backoff_base if backoff_base is not None else
+                         _env_float("PADDLE_PS_BACKOFF_BASE", 0.05))
+        self._deadline = (rpc_deadline if rpc_deadline is not None else
+                          _env_float("PADDLE_PS_RPC_DEADLINE", 60.0))
+        self.worker_id = worker_id
+        # seq numbers are scoped by src so even anonymous clients (no
+        # worker_id) get idempotent retries
+        self._src = worker_id or f"cli-{os.getpid()}-{id(self):x}"
+        self._seq = itertools.count(1)
+        self._seq_lock = threading.Lock()
+        self._jitter = random.Random(
+            hash(self._src) & 0xFFFFFFFF)   # deterministic per client
+        self.retries = 0     # RPC attempts beyond the first
+        self.failovers = 0   # active-endpoint rotations
         self._mode = mode
-        self._lock = [threading.Lock() for _ in self._socks]
+        self._socks: List[Optional[socket.socket]] = []
+        self._lock = [threading.Lock() for _ in self._ep_lists]
+        for r in range(len(self._ep_lists)):
+            self._socks.append(self._connect_rank(r))
         self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
         self._stop = threading.Event()
         self._push_err: "Exception | None" = None
-        self.worker_id = worker_id
+        self._push_err_later = 0   # failures after the first (masked)
         self._beat_stop = threading.Event()
         self._beat_socks = []
         if worker_id is not None:
@@ -419,9 +857,9 @@ class PSClient:
                 # are held for the whole duration of a blocking
                 # worker_barrier, which would starve heartbeats to every
                 # other server and get this live worker evicted there
-                for h, p in self._eps:
-                    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                    s.connect((h, p))
+                for r in range(len(self._ep_lists)):
+                    s = socket.create_connection(
+                        self._ep(r), timeout=self._connect_timeout)
                     # bound sendall: a frozen-but-connected server must
                     # not wedge the beater once the send buffer fills
                     s.settimeout(2.0)
@@ -440,6 +878,57 @@ class PSClient:
             self._drainer = threading.Thread(target=self._drain, daemon=True)
             self._drainer.start()
 
+    # -- connection management -----------------------------------------
+    def _ep(self, rank: int) -> Tuple[str, int]:
+        return self._ep_lists[rank][self._active[rank]]
+
+    def _eps_str(self, rank: int) -> str:
+        return "|".join(f"{h}:{p}" for h, p in self._ep_lists[rank])
+
+    def _connect_rank(self, rank: int) -> socket.socket:
+        """Connect to the shard's active endpoint, rotating through the
+        failover list; every attempt is bounded by the connect timeout.
+        Raises :class:`PSConnectError` naming the endpoints when none
+        accepts."""
+        group = self._ep_lists[rank]
+        plan = _chaos.active()
+        last_err: Optional[Exception] = None
+        for k in range(len(group)):
+            idx = (self._active[rank] + k) % len(group)
+            ep = group[idx]
+            try:
+                if plan is not None:
+                    plan.check_connect(ep)
+                s = socket.create_connection(
+                    ep, timeout=self._connect_timeout)
+                if idx != self._active[rank]:
+                    self._active[rank] = idx
+                    self.failovers += 1
+                return s
+            except OSError as e:
+                last_err = e
+        raise PSConnectError(
+            f"could not connect to PS shard {rank} "
+            f"({self._eps_str(rank)}) within {self._connect_timeout}s: "
+            f"{last_err}") from last_err
+
+    def _reconnect_locked(self, rank: int) -> socket.socket:
+        """(Re)establish the shard's data socket and re-register this
+        worker on it — the new endpoint may be a freshly promoted
+        standby that has never seen us.  Caller holds the rank lock."""
+        sock = self._connect_rank(rank)
+        self._socks[rank] = sock
+        if self.worker_id is not None:
+            reg = {"op": "register", "worker": self.worker_id,
+                   "src": self._src}
+            with self._seq_lock:
+                reg["seq"] = next(self._seq)
+            sock.settimeout(self._rpc_timeout)
+            _send_msg(sock, reg)
+            if _recv_msg(sock) is None:
+                raise ConnectionError("server closed during re-register")
+        return sock
+
     def _beat(self, interval: float):
         while not self._beat_stop.wait(interval):
             if self._stop.is_set():
@@ -447,8 +936,8 @@ class PSClient:
             for i, s in enumerate(self._beat_socks):
                 if s is None:   # broken last beat: fresh connection
                     try:
-                        h, p = self._eps[i]
-                        s = socket.create_connection((h, p), timeout=2.0)
+                        s = socket.create_connection(self._ep(i),
+                                                     timeout=2.0)
                         s.settimeout(2.0)
                         self._beat_socks[i] = s
                     except OSError:
@@ -456,7 +945,7 @@ class PSClient:
                 try:
                     _send_msg(s, {"op": "heartbeat",
                                   "worker": self.worker_id})
-                except (OSError, socket.timeout):
+                except (OSError, socket.timeout, ConnectionError):
                     # a timed-out sendall may have left a PARTIAL frame:
                     # reusing this socket would garble the length-prefixed
                     # stream and get a live worker falsely evicted. Drop
@@ -513,10 +1002,13 @@ class PSClient:
         """Raw additive push (server-side push_delta), sharded like
         pull — the primitive UtilBase's collectives build on."""
         ids = np.asarray(ids).reshape(-1)
-        deltas = np.asarray(deltas, np.float32)
-        deltas = deltas.reshape(len(ids), -1) if ids.size \
-            else deltas.reshape(0, 1)
-        if len(self._socks) == 1 or ids.size == 0:
+        if ids.size == 0:
+            # nothing to add: skip the RPC instead of shipping a
+            # degenerate (0, 1)-reshaped payload that forgets the
+            # table's true trailing dim
+            return
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+        if len(self._socks) == 1:
             self._rpc(0, {"op": "push_delta", "table": table,
                           "ids": ids, "deltas": deltas, "sync": sync},
                       reply=sync)
@@ -571,9 +1063,18 @@ class PSClient:
             except queue.Empty:
                 continue
             try:
+                # fire-and-forget frames (async contract); their seq
+                # stamp still makes a send-path retry or a duplicated
+                # delivery apply exactly once server-side
                 self._push_now(table, ids, grads, sync=False)
             except Exception as e:  # keep draining; surface at barrier()
-                self._push_err = e
+                # keep the FIRST error — later cascade errors (every
+                # queued push failing the same dead server) would mask
+                # the root cause
+                if self._push_err is None:
+                    self._push_err = e
+                else:
+                    self._push_err_later += 1
             finally:
                 self._q.task_done()
 
@@ -586,7 +1087,11 @@ class PSClient:
         self._q.join()
         if self._push_err is not None:
             err, self._push_err = self._push_err, None
-            raise RuntimeError("async push failed before barrier") from err
+            later, self._push_err_later = self._push_err_later, 0
+            raise RuntimeError(
+                f"async push failed before barrier"
+                + (f" ({later} subsequent push failure(s) suppressed)"
+                   if later else "")) from err
         for r in range(len(self._socks)):
             self._rpc(r, {"op": "barrier"}, reply=True)
 
@@ -601,8 +1106,12 @@ class PSClient:
         if self.worker_id is None:
             raise RuntimeError("worker_barrier needs a client worker_id")
         self.barrier()  # flush async queue + per-server round trip
+        # the server-side barrier legitimately blocks until every
+        # worker arrives: the transport timeout must outlast it
+        rpc_to = None if timeout is None else timeout + 10.0
         rep = self._rpc(0, {"op": "worker_barrier", "worker": self.worker_id,
-                            "timeout": timeout}, reply=True)
+                            "timeout": timeout}, reply=True,
+                        timeout=rpc_to)
         if rep is None:
             raise RuntimeError("worker_barrier failed: server connection "
                                "closed while waiting")
@@ -625,15 +1134,21 @@ class PSClient:
             try:
                 self._rpc(r, {"op": "unregister", "worker": self.worker_id},
                           reply=True)
-            except OSError:
+            except (OSError, PSError):
                 pass
 
     def stop_server(self):
         for r in range(len(self._socks)):
             try:
                 self._rpc(r, {"op": "stop"}, reply=True)
-            except OSError:
+            except (OSError, PSError):
                 pass
+
+    def server_stats(self, rank: int = 0) -> dict:
+        """Fetch the server's fault-tolerance counters (applied pushes,
+        duplicate acks, role) — the observable the chaos harness
+        audits."""
+        return self._rpc(rank, {"op": "stats"}, reply=True)
 
     def close(self):
         self._stop.set()
@@ -646,12 +1161,72 @@ class PSClient:
             except OSError:
                 pass
 
-    def _rpc(self, rank, msg, reply=False):
+    def _rpc(self, rank, msg, reply=False, timeout=_UNSET):
+        """One RPC with bounded retries.
+
+        Mutating ops get a (src, seq) stamp ONCE — retries resend the
+        same seq, so the server applies at most once.  Any transport
+        failure drops the socket (a partial frame must never be
+        resumed), backs off exponentially with jitter, reconnects —
+        rotating to the shard's next endpoint after repeated failures —
+        and re-sends, until ``max_retries``/``rpc_deadline`` surface a
+        :class:`PSUnavailable`.
+        """
         if self.worker_id is not None:
             # every RPC names its worker: data traffic is proof of life,
             # so pull/push-only clients (no beat thread) stay live
             msg.setdefault("worker", self.worker_id)
-        with self._lock[rank]:
-            _send_msg(self._socks[rank], msg)
-            if reply:
-                return _recv_msg(self._socks[rank])
+        if msg.get("op") in _MUTATING_OPS and "seq" not in msg:
+            msg["src"] = self._src
+            with self._seq_lock:
+                msg["seq"] = next(self._seq)
+        rpc_to = self._rpc_timeout if timeout is _UNSET else timeout
+        deadline = time.monotonic() + self._deadline
+        attempt = 0
+        last_err: Optional[Exception] = None
+        group = self._ep_lists[rank]
+        while True:
+            try:
+                with self._lock[rank]:
+                    sock = self._socks[rank]
+                    if sock is None:
+                        sock = self._reconnect_locked(rank)
+                    try:
+                        sock.settimeout(rpc_to)
+                        _send_msg(sock, msg)
+                        if not reply:
+                            return None
+                        rep = _recv_msg(sock)
+                        if rep is None:
+                            raise ConnectionError(
+                                "server closed the connection")
+                        return rep
+                    except (OSError, ConnectionError, socket.timeout):
+                        # the stream may hold a partial frame — never
+                        # reuse this socket
+                        self._socks[rank] = None
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        raise
+            except (OSError, ConnectionError, socket.timeout,
+                    PSConnectError) as e:
+                last_err = e
+            attempt += 1
+            now = time.monotonic()
+            if attempt > self._max_retries or now >= deadline:
+                op = msg.get("op")
+                raise PSUnavailable(
+                    f"PS rpc {op!r} to shard {rank} "
+                    f"({self._eps_str(rank)}) failed after {attempt} "
+                    f"attempt(s): {last_err}") from last_err
+            self.retries += 1
+            if attempt >= 2 and len(group) > 1:
+                # the active endpoint keeps failing: fail over to the
+                # next endpoint in the shard's list (promoted standby)
+                self._active[rank] = (self._active[rank] + 1) % len(group)
+                self.failovers += 1
+            delay = min(self._backoff * (2 ** (attempt - 1)), 1.0)
+            delay *= 0.5 + 0.5 * self._jitter.random()
+            time.sleep(min(delay, max(0.0, deadline - now)))

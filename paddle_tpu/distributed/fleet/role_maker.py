@@ -18,7 +18,26 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
-           "UtilBase"]
+           "UtilBase", "endpoint_groups", "replica_primary_for"]
+
+
+def endpoint_groups(endpoints: Sequence[str]) -> List[List[str]]:
+    """Split server endpoint entries into replica groups: each entry
+    (one PS shard) is ``"host:port"`` or a ``|``-separated failover
+    list ordered primary first — ``"h:p1|h:p2"`` means shard served by
+    p1 with hot standby p2 (PADDLE_PSERVERS_IP_PORT_LIST carries the
+    same syntax, commas between shards)."""
+    return [[x for x in str(e).split("|") if x] for e in endpoints]
+
+
+def replica_primary_for(me: str, endpoints: Sequence[str]):
+    """The primary endpoint THIS server replicates, or ``None`` when
+    ``me`` is itself a shard primary (or not listed at all — the
+    single-server dev case)."""
+    for group in endpoint_groups(endpoints):
+        if me in group and group.index(me) > 0:
+            return group[0]
+    return None
 
 
 class Role:
@@ -100,8 +119,15 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             ip = os.getenv("POD_IP", "127.0.0.1")
             port = os.getenv("PADDLE_PORT", "")
             me = f"{ip}:{port}"
-            self._current_id = (self._server_endpoints.index(me)
-                                if me in self._server_endpoints else 0)
+            # an endpoint entry may be a "|"-separated replica group:
+            # the shard id is the group's index, whether this server is
+            # the group's primary or a standby
+            self._current_id = 0
+            for gi, group in enumerate(
+                    endpoint_groups(self._server_endpoints)):
+                if me in group:
+                    self._current_id = gi
+                    break
         else:
             self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
 

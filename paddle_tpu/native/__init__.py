@@ -94,6 +94,9 @@ def ps_core() -> Optional[ctypes.CDLL]:
                                c.c_int]
     lib.pts_free.argtypes = [c.c_void_p]
     lib.pts_set_lr.argtypes = [c.c_void_p, c.c_float]
+    lib.pts_version.restype = c.c_uint64
+    lib.pts_version.argtypes = [c.c_void_p]
+    lib.pts_set_version.argtypes = [c.c_void_p, c.c_uint64]
     lib.pts_set_entry.argtypes = [c.c_void_p, c.c_int, c.c_double]
     lib.pts_pull.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_push.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]

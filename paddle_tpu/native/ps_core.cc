@@ -102,6 +102,10 @@ struct Table {
   int stride;  // floats per row incl. optimizer state + step counter
   int entry_mode = kNoEntry;
   double entry_param = 0.0;  // count threshold / admit probability
+  // last-seq: count of applied mutating batches (push/push_delta),
+  // exposed alongside the id directory so a replica's catch-up can be
+  // audited (primary and caught-up standby report the same version)
+  std::atomic<uint64_t> version{0};
   std::vector<Shard> shards;
 
   Table(int dim_, int opt_, float lr_, float b1, float b2, float eps_,
@@ -338,6 +342,17 @@ void pts_free(void* h) { delete (Table*)h; }
 
 void pts_set_lr(void* h, float lr) { ((Table*)h)->lr = lr; }
 
+// last-seq accessors: the applied-mutation counter travels with
+// checkpoints/replication snapshots (pts_import resets rows, the
+// caller restores the counter alongside)
+uint64_t pts_version(void* h) {
+  return ((Table*)h)->version.load(std::memory_order_relaxed);
+}
+
+void pts_set_version(void* h, uint64_t v) {
+  ((Table*)h)->version.store(v, std::memory_order_relaxed);
+}
+
 // feature admission policy: mode 1 = count filter (param = threshold),
 // mode 2 = probability (param = admit probability), 0 = none
 void pts_set_entry(void* h, int mode, double param) {
@@ -379,6 +394,7 @@ void pts_pull(void* h, const int64_t* ids, int64_t n, float* out) {
 // no signal); pushes do not count as sightings.
 void pts_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
   Table* t = (Table*)h;
+  t->version.fetch_add(1, std::memory_order_relaxed);
   int dim = t->dim;
   for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
     Shard& sh = t->shards[s];
@@ -402,6 +418,7 @@ void pts_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
 void pts_push_delta(void* h, const int64_t* ids, int64_t n,
                     const float* deltas) {
   Table* t = (Table*)h;
+  t->version.fetch_add(1, std::memory_order_relaxed);
   int dim = t->dim;
   for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
     Shard& sh = t->shards[s];

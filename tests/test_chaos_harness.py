@@ -1,0 +1,188 @@
+"""The deterministic chaos harness itself: fault schedules, spec
+parsing, framing-layer injection, and the chaos_ps tool.
+
+Everything here is seeded and schedule-driven — two runs of the same
+plan inject the identical fault sequence, which is what makes the
+fault-tolerance suite tier-1 material instead of a soak test.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet.chaos import Fault, FaultPlan
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSClient, PSServer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _fires(fault, n):
+    """Which of n candidate events the fault fires on (1-based)."""
+    out = []
+    for i in range(1, n + 1):
+        fault.matches += 1
+        if fault._should_fire():
+            out.append(i)
+    return out
+
+
+def test_fault_schedule_first_every_times():
+    assert _fires(Fault("delay", first=3), 10) == [3]
+    assert _fires(Fault("delay", first=2, every=3, times=0), 12) == \
+        [2, 5, 8, 11]
+    assert _fires(Fault("delay", first=1, every=2, times=2), 10) == [1, 3]
+    # two identically-built faults fire identically
+    a, b = Fault("cut", first=4, every=5, times=0), \
+        Fault("cut", first=4, every=5, times=0)
+    assert _fires(a, 40) == _fires(b, 40)
+
+
+def test_plan_from_spec_parsing():
+    p = chaos.plan_from_spec("seed=9;dup:push:every=2;"
+                             "delay:pull:first=3:arg=0.5;"
+                             "crash:push:first=50")
+    assert p.seed == 9
+    kinds = [(f.kind, f.op) for f in p.faults]
+    assert kinds == [("dup", "push"), ("delay", "pull"),
+                     ("crash", "push")]
+    assert p.faults[1].first == 3 and p.faults[1].arg == 0.5
+    # plan=<name> merges extra faults on top of the named schedule
+    p2 = chaos.plan_from_spec("plan=dup;seed=4;delay:pull:first=1")
+    assert p2.seed == 4
+    assert any(f.kind == "delay" for f in p2.faults)
+    with pytest.raises(ValueError):
+        chaos.plan_from_spec("explode:push")
+    with pytest.raises(ValueError):
+        chaos.plan_from_spec("badtoken")
+    with pytest.raises(ValueError):
+        chaos.plan_from_spec("dup:push:bogus=1")
+
+
+def test_named_plans_exist():
+    for name in ("flaky", "dup", "lost_ack", "crash@7"):
+        p = chaos.named_plan(name, seed=1)
+        assert p.faults, name
+    assert chaos.named_plan("crash@7").faults[0].first == 7
+    with pytest.raises(ValueError):
+        chaos.named_plan("nope")
+
+
+def test_install_uninstall_roundtrip():
+    assert chaos.active() is None
+    p = chaos.install(FaultPlan([], seed=0))
+    assert chaos.active() is p
+    chaos.uninstall()
+    assert chaos.active() is None
+
+
+def test_dup_downgrades_on_request_reply_frames():
+    """Duplicating a frame that expects a reply would desync the
+    stream; the harness downgrades it and counts the skip."""
+    srv = PSServer({"emb": SparseTable(4, optimizer="sgd", lr=0.5)},
+                   host="127.0.0.1")
+    srv.start()
+    plan = chaos.install(FaultPlan(
+        [Fault("dup", op="push", first=1, every=1, times=0)], seed=0))
+    cli = PSClient([f"127.0.0.1:{srv.port}"], mode="sync",
+                   rpc_timeout=2.0, connect_timeout=2.0)
+    ids = np.arange(4, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()
+    cli.push("emb", ids, np.ones((4, 4), np.float32))  # sync: not dup'd
+    np.testing.assert_allclose(cli.pull("emb", ids), base - 0.5,
+                               rtol=1e-5)
+    st = plan.stats_dict()
+    assert st.get("dup_skipped") == 1 and "dup:push" not in st
+    cli.close()
+    srv.stop()
+
+
+def test_delay_fault_fires_and_is_counted():
+    srv = PSServer({"emb": SparseTable(4)}, host="127.0.0.1")
+    srv.start()
+    plan = chaos.install(FaultPlan(
+        [Fault("delay", op="pull", first=1, every=1, times=3,
+               arg=0.01)], seed=0))
+    cli = PSClient([f"127.0.0.1:{srv.port}"], rpc_timeout=2.0,
+                   connect_timeout=2.0)
+    ids = np.arange(3, dtype=np.int64)
+    for _ in range(5):
+        cli.pull("emb", ids)
+    assert plan.stats_dict().get("delay:pull") == 3   # times cap
+    cli.close()
+    srv.stop()
+
+
+def test_refuse_fault_fails_connect_then_recovers():
+    srv = PSServer({"emb": SparseTable(4)}, host="127.0.0.1")
+    srv.start()
+    cli = PSClient([f"127.0.0.1:{srv.port}"], rpc_timeout=1.0,
+                   connect_timeout=1.0, max_retries=6, backoff_base=0.01,
+                   rpc_deadline=10.0)
+    # the connection drops, and the next TWO reconnect attempts are
+    # refused; the retry loop must back off through them
+    chaos.install(FaultPlan(
+        [Fault("refuse", op="*", first=1, every=1, times=2)], seed=0))
+    cli._socks[0].close()
+    out = cli.pull("emb", np.arange(2, dtype=np.int64))
+    assert out.shape == (2, 4)
+    assert cli.retries >= 1
+    cli.close()
+    srv.stop()
+
+
+def test_same_seed_same_injection_sequence():
+    """End-to-end determinism: identical plans against identical
+    traffic fire on identical events."""
+    def run():
+        srv = PSServer({"emb": SparseTable(4, optimizer="sgd", lr=0.5,
+                                           seed=3)}, host="127.0.0.1")
+        srv.start()
+        plan = chaos.install(chaos.named_plan("flaky", seed=42))
+        cli = PSClient([f"127.0.0.1:{srv.port}"], mode="sync",
+                       rpc_timeout=1.0, connect_timeout=2.0,
+                       backoff_base=0.01, rpc_deadline=20.0)
+        ids = np.arange(16, dtype=np.int64)
+        for step in range(12):
+            cli.pull("emb", ids)
+            cli.push("emb", ids,
+                     np.full((16, 4), 0.1 * (step + 1), np.float32))
+        rows = cli.pull("emb", ids).copy()
+        stats = plan.stats_dict()
+        cli.close()
+        srv.stop()
+        chaos.uninstall()
+        return rows, stats
+
+    rows1, stats1 = run()
+    rows2, stats2 = run()
+    assert stats1 == stats2
+    assert np.array_equal(rows1, rows2)
+
+
+@pytest.mark.parametrize("plan", ["flaky", "dup"])
+def test_chaos_ps_tool_reports_clean_run(plan):
+    """tools/chaos_ps.py under a survivable plan: completes, zero lost
+    and zero double-applied rows, machine-readable report."""
+    mode = "async" if plan == "dup" else "sync"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "chaos_ps.py"),
+         "--plan", plan, "--mode", mode, "--steps", "10",
+         "--batch", "32", "--vocab", "200"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["completed"]
+    assert rep["double_applied_rows"] == 0
+    assert rep["lost_rows"] == 0
+    assert rep["server"]["applied"] == 10

@@ -1,0 +1,98 @@
+"""Crash-consistency of CheckpointManager: a process SIGKILLed inside
+``save_state_dict`` must leave the PREVIOUS step fully restorable and
+the partial ``step_N`` directory invisible.
+
+The index file (checkpoint.index.json) is the commit record — shard
+.npy files land first, the index lands last via os.replace — so a
+half-written step is exactly "shards without an index".  These tests
+pin that contract by actually SIGKILLing a subprocess at the moment
+the index would land.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The child saves step 0 cleanly, then arms a bomb: the os.replace that
+# would publish step 1's index SIGKILLs the process instead — shard
+# files are on disk, the commit record is not (exactly the state a
+# machine loss mid-checkpoint leaves behind).
+_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from paddle_tpu.distributed import checkpoint as ckpt
+
+mgr = ckpt.CheckpointManager(sys.argv[2], max_to_keep=3)
+mgr.save(0, {"w": np.arange(8.0), "step": 0})
+
+real_replace = os.replace
+def bomb(src, dst):
+    if dst.endswith("checkpoint.index.json"):
+        os.kill(os.getpid(), signal.SIGKILL)
+    return real_replace(src, dst)
+ckpt.os.replace = bomb
+mgr.save(1, {"w": np.arange(8.0) * 2, "step": 1})
+raise SystemExit("unreachable: save(1) must have died")
+"""
+
+
+def test_sigkill_mid_save_keeps_previous_step_restorable(tmp_path):
+    d = str(tmp_path / "ckpts")
+    r = subprocess.run([sys.executable, "-c", _CHILD_SRC, _REPO, d],
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+
+    # the partial step is really there on disk (shards, no index) ...
+    step1 = os.path.join(d, "step_1")
+    assert os.path.isdir(step1)
+    assert not os.path.exists(os.path.join(step1,
+                                           "checkpoint.index.json"))
+    assert any(f.endswith(".npy") or f.endswith(".npy.tmp")
+               for f in os.listdir(step1)), os.listdir(step1)
+
+    # ... and completely invisible to the manager
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    mgr = CheckpointManager(d, max_to_keep=3)
+    assert mgr.all_steps() == [0]
+    assert mgr.latest_step() == 0
+
+    # restore() lands on the intact step 0, not the torn step 1
+    state = mgr.restore()
+    np.testing.assert_array_equal(state["w"], np.arange(8.0))
+    assert state["step"] == 0
+
+    # a later save of the same step OVERWRITES the torn leftovers and
+    # becomes visible again
+    mgr.save(1, {"w": np.arange(8.0) * 2, "step": 1})
+    assert mgr.all_steps() == [0, 1]
+    state = mgr.restore()
+    np.testing.assert_array_equal(state["w"], np.arange(8.0) * 2)
+    assert state["step"] == 1
+
+
+def test_torn_shard_file_fails_loudly_not_garbage(tmp_path):
+    """A shard file torn AFTER the index landed (lost fsync) must raise,
+    not hand back np.empty garbage as weights."""
+    from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                                   load_state_dict)
+    d = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(d)
+    mgr.save(0, {"w": np.arange(16.0)})
+    step0 = os.path.join(d, "step_0")
+    with open(os.path.join(step0, "checkpoint.index.json")) as f:
+        idx = json.load(f)
+    shard = idx["entries"]["w"]["shards"][0]["file"]
+    os.remove(os.path.join(step0, shard))
+    try:
+        load_state_dict(step0)
+    except (IOError, FileNotFoundError):
+        pass
+    else:
+        raise AssertionError("torn checkpoint loaded silently")

@@ -1,0 +1,422 @@
+"""Fault tolerance of the PS service layer: idempotent retries,
+timeouts, hot-standby replication and SIGKILL failover.
+
+Parity model: the reference survives worker/server churn through
+brpc_ps_client.cc retry loops and the launch watchdog's restarts
+(launch_utils.py:526); here the same guarantees are PROVEN under
+deterministic injected failure (fleet/chaos.py) — including the
+acceptance bar: a sync-mode training run whose primary server is
+SIGKILLed mid-run finishes via replica failover with pulled rows
+bit-for-bit equal to the fault-free run (no lost, no double-applied
+pushes).
+
+Subprocess servers deliberately avoid importing jax so they start in
+well under a second.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import (
+    PSClient, PSConnectError, PSServer, PSUnavailable, _SeqWindow)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fast-failing client knobs for tests (the defaults are production-scale)
+_FAST = dict(connect_timeout=2.0, rpc_timeout=1.0, max_retries=6,
+             backoff_base=0.02, rpc_deadline=20.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _server(dim=4, lr=0.5, seed=7, replica_of=None, **kw):
+    srv = PSServer({"emb": SparseTable(dim, optimizer="sgd", lr=lr,
+                                       seed=seed)},
+                   host="127.0.0.1", replica_of=replica_of, **kw)
+    srv.start()
+    return srv, f"127.0.0.1:{srv.port}"
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed connect errors + constructor timeouts
+# ---------------------------------------------------------------------------
+
+def test_connect_refused_raises_typed_error_naming_endpoint():
+    with pytest.raises(PSConnectError) as ei:
+        PSClient(["127.0.0.1:1"], connect_timeout=0.5)
+    assert "127.0.0.1:1" in str(ei.value)
+
+
+def test_unresponsive_server_cannot_wedge_constructor():
+    # a listener that accepts but never speaks the protocol: without
+    # timeouts the old constructor's register would block forever
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    ep = f"127.0.0.1:{lst.getsockname()[1]}"
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(PSUnavailable) as ei:
+            PSClient([ep], worker_id="w0", connect_timeout=1.0,
+                     rpc_timeout=0.3, max_retries=2, backoff_base=0.01,
+                     rpc_deadline=3.0)
+        assert ep in str(ei.value)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        lst.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: _drain error masking + empty push_delta
+# ---------------------------------------------------------------------------
+
+def test_drain_keeps_first_error_and_counts_the_rest():
+    srv, ep = _server()
+    cli = PSClient([ep], mode="async", rpc_timeout=0.3, max_retries=1,
+                   backoff_base=0.01, rpc_deadline=0.8, connect_timeout=0.5)
+    ids = np.arange(4, dtype=np.int64)
+    cli.push("emb", ids, np.ones((4, 4), np.float32))
+    cli.barrier()           # healthy flush first
+    srv.stop()
+    # fire-and-forget frames into a freshly dead server may land in the
+    # TCP buffer before the RST arrives; push until the drainer records
+    # the first real failure (after which the socket is dropped and
+    # every further push fails deterministically at reconnect)
+    deadline = time.monotonic() + 20.0
+    while cli._push_err is None:
+        assert time.monotonic() < deadline, "drainer never saw an error"
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+        cli._q.join()
+    first = cli._push_err
+    for _ in range(3):      # cascade errors that used to MASK the first
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+    cli._q.join()
+    assert cli._push_err_later == 3
+    with pytest.raises(RuntimeError) as ei:
+        cli.barrier()
+    # the FIRST failure is the cause; later cascade errors are counted,
+    # not substituted
+    assert ei.value.__cause__ is first
+    assert isinstance(first, PSUnavailable)
+    assert "3 subsequent" in str(ei.value)
+    assert cli._push_err is None and cli._push_err_later == 0  # drained
+    cli.close()
+
+
+def test_push_delta_empty_ids_skips_rpc_and_keeps_dim():
+    srv, ep = _server(dim=5)
+    cli = PSClient([ep], **_FAST)
+    before = srv.applied
+    # regression: this used to reshape deltas to (0, 1) regardless of
+    # the table dim and still ship the RPC
+    cli.push_delta("emb", np.zeros(0, np.int64),
+                   np.zeros((0, 5), np.float32))
+    cli.push_delta("emb", [], [])
+    assert srv.applied == before          # no RPC reached the server
+    # non-empty path still lands
+    cli.push_delta("emb", np.array([2], np.int64),
+                   np.full((1, 5), 0.25, np.float32))
+    assert srv.applied == before + 1
+    np.testing.assert_allclose(
+        cli.pull("emb", np.array([2], np.int64)),
+        srv._tables["emb"].pull(np.array([2], np.int64)), rtol=1e-6)
+    cli.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# idempotent retries
+# ---------------------------------------------------------------------------
+
+def test_seq_window_semantics():
+    w = _SeqWindow()
+    assert not w.check_and_record(1)
+    assert w.check_and_record(1)          # immediate duplicate
+    assert not w.check_and_record(3)      # gaps are fine (sharding)
+    assert not w.check_and_record(2)      # late arrival inside window
+    assert w.check_and_record(2)
+    # ancient seqs (below the window) are treated as duplicates
+    assert not w.check_and_record(10_000)
+    assert w.check_and_record(10_000 - _SeqWindow.WINDOW)
+    # round trip through export (replication snapshot)
+    w2 = _SeqWindow.from_export(w.export())
+    assert w2.max_seq == w.max_seq
+    assert w2.check_and_record(10_000)
+
+
+def test_lost_ack_retry_applies_push_exactly_once():
+    """The classic double-apply window: server applies the push, the
+    ack is lost, the client retries.  The seq window must ack the
+    retry without re-applying the additive push."""
+    srv, ep = _server(lr=0.5)
+    chaos.install(chaos.FaultPlan(
+        [chaos.Fault("drop", op="push_reply", first=1)], seed=1))
+    cli = PSClient([ep], mode="sync", **_FAST)
+    ids = np.arange(6, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()
+    cli.push("emb", ids, np.ones((6, 4), np.float32))
+    np.testing.assert_allclose(cli.pull("emb", ids), base - 0.5,
+                               rtol=1e-5)   # once, not twice
+    assert srv.dup_acks == 1
+    assert cli.retries >= 1
+    assert srv.applied == 1
+    cli.close()
+    srv.stop()
+
+
+def test_duplicate_delivery_fault_plan_applies_push_once():
+    """Acceptance: a duplicate-delivery fault plan proves idempotency.
+    Async-mode push frames are one-way; the dup fault delivers every
+    frame twice and the server must apply each seq once."""
+    srv, ep = _server(lr=1.0)
+    plan = chaos.install(chaos.named_plan("dup", seed=3))
+    cli = PSClient([ep], mode="async", **_FAST)
+    ids = np.arange(8, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()
+    for _ in range(5):
+        cli.push("emb", ids, np.ones((8, 4), np.float32))
+    cli.barrier()
+    after = cli.pull("emb", ids)
+    np.testing.assert_allclose(after, base - 5.0, rtol=1e-5)
+    assert plan.stats_dict().get("dup:push", 0) == 5
+    assert srv.dup_acks == 5              # every duplicate detected
+    assert srv.applied == 5               # ...and applied exactly once
+    cli.close()
+    srv.stop()
+
+
+def test_mid_frame_cut_is_survived_by_retry():
+    srv, ep = _server(lr=0.5)
+    plan = chaos.install(chaos.FaultPlan(
+        [chaos.Fault("cut", op="pull", first=2),
+         chaos.Fault("cut", op="push", first=1)], seed=2))
+    cli = PSClient([ep], mode="sync", **_FAST)
+    ids = np.arange(4, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()      # pull #1 clean
+    cli.push("emb", ids, np.ones((4, 4), np.float32))  # push frame cut
+    after = cli.pull("emb", ids)            # pull #2 frame cut
+    np.testing.assert_allclose(after, base - 0.5, rtol=1e-5)
+    st = plan.stats_dict()
+    assert st.get("cut:pull") == 1 and st.get("cut:push") == 1
+    assert cli.retries >= 2
+    cli.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# hot-standby replication + failover
+# ---------------------------------------------------------------------------
+
+def test_replica_catches_up_from_snapshot_and_stream():
+    prim, pep = _server(seed=11)
+    cli = PSClient([pep], **_FAST)
+    ids = np.arange(8, dtype=np.int64)
+    cli.pull("emb", ids)   # materialise rows pre-snapshot
+    cli.push("emb", ids, np.ones((8, 4), np.float32))     # pre-snapshot
+    rep, _ = _server(seed=11, replica_of=pep)
+    assert rep.replica_ready.wait(10.0)
+    cli.push("emb", ids, np.ones((8, 4), np.float32))     # streamed
+    p = prim._tables["emb"].pull(ids)
+    r = rep._tables["emb"].pull(ids)
+    assert np.array_equal(p, r)           # bit-for-bit, not allclose
+    assert prim._tables["emb"].version == rep._tables["emb"].version
+    cli.close()
+    prim.stop()
+    rep.stop()
+
+
+def test_client_fails_over_to_promoted_replica():
+    prim, pep = _server(seed=5)
+    rep, rep_ep = _server(seed=5, replica_of=pep)
+    assert rep.replica_ready.wait(10.0)
+    cli = PSClient([f"{pep}|{rep_ep}"], worker_id="w0", **_FAST)
+    ids = np.arange(6, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()
+    cli.push("emb", ids, np.ones((6, 4), np.float32))
+    prim.stop()                           # primary gone
+    after = cli.pull("emb", ids)          # transparently re-routed
+    np.testing.assert_allclose(after, base - 0.5, rtol=1e-5)
+    cli.push("emb", ids, np.ones((6, 4), np.float32))
+    np.testing.assert_allclose(cli.pull("emb", ids), base - 1.0,
+                               rtol=1e-5)
+    assert cli.failovers >= 1
+    st = cli.server_stats()
+    assert st["role"] == "primary" and st["promoted"]
+    cli.close()
+    rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL e2e parity (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+_SERVER_PROC_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+tables = {n: SparseTable(**kw) for n, kw in cfg["tables"].items()}
+srv = PSServer(tables, host="127.0.0.1",
+               replica_of=cfg.get("replica_of"))
+srv.start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+
+def _spawn_server(tables, replica_of=None, env_extra=None):
+    cfg = {"tables": tables, "replica_of": replica_of}
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_PROC_SRC, _REPO, json.dumps(cfg)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    line = proc.stdout.readline()
+    info = json.loads(line)
+    return proc, f"127.0.0.1:{info['port']}"
+
+
+def _train(endpoints, steps, ids, kill_at=None, kill_proc=None,
+           dim=4, seed=23):
+    """Deterministic sync-mode wide_deep-style loop: pull rows, push a
+    step-dependent gradient.  Returns the final pulled rows."""
+    cli = PSClient(endpoints, mode="sync", worker_id="w0", **_FAST)
+    for step in range(steps):
+        rows = cli.pull("emb", ids)
+        assert rows.shape == (ids.size, dim)
+        # gradient derived from the step only — identical across runs
+        g = np.full((ids.size, dim), 0.125 * ((step % 5) + 1), np.float32)
+        cli.push("emb", ids, g)
+        if kill_at is not None and step == kill_at:
+            os.kill(kill_proc.pid, signal.SIGKILL)
+            kill_proc.wait(timeout=10)
+    final = cli.pull("emb", ids).copy()
+    cli.close()
+    return final
+
+
+def test_sigkill_failover_matches_fault_free_run_bit_for_bit():
+    """Sync-mode training with a mid-run primary SIGKILL completes via
+    replica failover, and the pulled rows match the fault-free run
+    EXACTLY — no lost pushes, no double-applied pushes."""
+    spec = {"emb": dict(dim=4, optimizer="adagrad", lr=0.1, seed=23)}
+    # the id universe is touched from step 0, so every row materialises
+    # (deterministically) before the kill on both runs
+    ids = np.arange(32, dtype=np.int64)
+    steps, kill_at = 12, 5
+
+    # fault-free reference run
+    ref_proc, ref_ep = _spawn_server(spec)
+    try:
+        ref = _train([ref_ep], steps, ids)
+    finally:
+        ref_proc.kill()
+        ref_proc.wait(timeout=10)
+
+    # faulted run: subprocess primary + in-process standby
+    prim_proc, prim_ep = _spawn_server(spec)
+    rep = PSServer({"emb": SparseTable(**spec["emb"])}, host="127.0.0.1",
+                   replica_of=prim_ep)
+    rep.start()
+    try:
+        assert rep.replica_ready.wait(15.0)
+        got = _train([f"{prim_ep}|127.0.0.1:{rep.port}"], steps, ids,
+                     kill_at=kill_at, kill_proc=prim_proc)
+        assert rep.promoted
+        assert np.array_equal(got, ref), (
+            "failover trajectory diverged from the fault-free run")
+    finally:
+        prim_proc.kill()
+        prim_proc.wait(timeout=10)
+        rep.stop()
+
+
+def test_chaos_crash_fault_kills_subprocess_server():
+    """PADDLE_CHAOS env activation: a crash@N plan hard-kills the
+    server on the Nth push it receives (the harness the watchdog-less
+    single server is tested against)."""
+    spec = {"emb": dict(dim=4, optimizer="sgd", lr=0.5, seed=1)}
+    proc, ep = _spawn_server(
+        spec, env_extra={"PADDLE_CHAOS": "crash:push:first=2"})
+    cli = PSClient([ep], mode="sync", rpc_timeout=0.5, max_retries=2,
+                   backoff_base=0.01, rpc_deadline=3.0,
+                   connect_timeout=1.0)
+    ids = np.arange(4, dtype=np.int64)
+    cli.push("emb", ids, np.ones((4, 4), np.float32))   # push #1 fine
+    with pytest.raises(PSUnavailable):
+        cli.push("emb", ids, np.ones((4, 4), np.float32))  # crashes it
+    assert proc.wait(timeout=10) == 137
+    cli.close()
+
+
+# ---------------------------------------------------------------------------
+# endpoint groups: role maker + fleet wiring
+# ---------------------------------------------------------------------------
+
+def test_endpoint_groups_and_replica_primary():
+    from paddle_tpu.distributed.fleet.role_maker import (
+        endpoint_groups, replica_primary_for)
+    eps = ["10.0.0.1:7100|10.0.0.2:7100", "10.0.0.3:7100"]
+    assert endpoint_groups(eps) == [["10.0.0.1:7100", "10.0.0.2:7100"],
+                                    ["10.0.0.3:7100"]]
+    assert replica_primary_for("10.0.0.2:7100", eps) == "10.0.0.1:7100"
+    assert replica_primary_for("10.0.0.1:7100", eps) is None
+    assert replica_primary_for("10.0.0.3:7100", eps) is None
+    assert replica_primary_for("10.0.0.9:7100", eps) is None
+
+
+def test_role_maker_shard_id_inside_replica_group(monkeypatch):
+    from paddle_tpu.distributed.fleet.role_maker import PaddleCloudRoleMaker
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.1:7100|10.0.0.2:7100,10.0.0.3:7100|10.0.0.4:7100")
+    monkeypatch.setenv("POD_IP", "10.0.0.4")
+    monkeypatch.setenv("PADDLE_PORT", "7100")
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_server()
+    assert rm.server_index() == 1    # standby of shard 1's primary
+
+
+def test_fleet_run_server_starts_replica_from_env(monkeypatch):
+    from paddle_tpu.distributed.fleet.fleet_base import Fleet
+    prim, pep = _server(seed=3)
+    try:
+        # a worker must touch the table so the snapshot is non-trivial
+        cli = PSClient([pep], **_FAST)
+        ids = np.arange(4, dtype=np.int64)
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+        expect = prim._tables["emb"].pull(ids)
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                           f"{pep}|127.0.0.1:0")
+        monkeypatch.setenv("POD_IP", "127.0.0.1")
+        monkeypatch.setenv("PADDLE_PORT", "0")
+        f = Fleet()
+        f.init(is_collective=False)
+        f.init_server()
+        f.run_server()
+        srv = f._ps_runtime._server
+        assert srv.role == "replica" and srv.replica_of == pep
+        assert srv.replica_ready.wait(10.0)
+        # replica recovered the table (dim included) from the snapshot
+        assert np.array_equal(srv._tables["emb"].pull(ids), expect)
+        cli.close()
+        f._ps_runtime.stop()
+    finally:
+        prim.stop()

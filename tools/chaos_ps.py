@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Run a wide_deep-style PS training step-loop under a named fault
+schedule and audit what survived.
+
+The parameter server is launched as a SUBPROCESS (optionally with a
+hot-standby replica), the training loop runs here through the
+fault-tolerant ``PSClient``, and a local shadow ``SparseTable`` —
+mirroring the exact pull/push call order — provides the fault-free
+expectation.  At the end the surviving server's rows are compared to
+the shadow bit-for-bit, so the report counts precisely:
+
+  recovered   RPC attempts beyond the first (retries that succeeded)
+  failed      pushes that exhausted the retry budget (PSUnavailable)
+  double_applied_rows / lost_rows
+              rows whose final value shows extra / missing pushes
+
+Plans (fleet/chaos.py named plans):
+
+  flaky     delays + duplicated async frames + lost push acks + cuts
+  dup       every push frame delivered twice (idempotency proof)
+  lost_ack  every 3rd push ack dropped (retry-dedup proof)
+  crash@N   the server process hard-exits on its Nth push — use
+            --replica so the job survives via failover
+
+Examples::
+
+    python tools/chaos_ps.py --plan flaky --steps 30
+    python tools/chaos_ps.py --plan crash@20 --replica --steps 40
+
+Exit status 0 iff the run completed with no lost and no double-applied
+pushes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.distributed.fleet import chaos                   # noqa: E402
+from paddle_tpu.distributed.fleet.heter import RemoteTable       # noqa: E402
+from paddle_tpu.distributed.fleet.ps import SparseTable          # noqa: E402
+from paddle_tpu.distributed.fleet.ps_service import (            # noqa: E402
+    PSClient, PSUnavailable)
+
+_SERVER_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+tables = {n: SparseTable(**kw) for n, kw in cfg["tables"].items()}
+srv = PSServer(tables, host="127.0.0.1", replica_of=cfg.get("replica_of"))
+srv.start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+
+def _spawn_server(table_spec, replica_of=None, chaos_spec=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    if chaos_spec:
+        env["PADDLE_CHAOS"] = chaos_spec
+    cfg = {"tables": {"emb": table_spec}, "replica_of": replica_of}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SRC, _REPO, json.dumps(cfg)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    info = json.loads(proc.stdout.readline())
+    return proc, f"127.0.0.1:{info['port']}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--plan", default="flaky",
+                    help="flaky | dup | lost_ack | crash@N")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replica", action="store_true",
+                    help="run a hot-standby replica (required to "
+                         "survive crash@N)")
+    ap.add_argument("--mode", default="sync",
+                    choices=["sync", "async", "half_async"])
+    args = ap.parse_args(argv)
+
+    spec = dict(dim=args.dim, optimizer="sgd", lr=0.05, seed=args.seed)
+    is_crash = args.plan.startswith("crash@")
+    # crash plans fire inside the SERVER process; other plans are
+    # installed on BOTH sides (PADDLE_CHAOS env for the primary, local
+    # install here) so server-side faults like a dropped push ack fire
+    # too.  The standby's replication channel stays clean either way.
+    if is_crash:
+        srv_spec = f"crash:push:first={args.plan[6:]};seed={args.seed}"
+    else:
+        srv_spec = f"plan={args.plan};seed={args.seed}"
+    prim_proc, prim_ep = _spawn_server(spec, chaos_spec=srv_spec)
+    rep_proc = None
+    endpoints = [prim_ep]
+    if args.replica:
+        rep_proc, rep_ep = _spawn_server(spec, replica_of=prim_ep)
+        endpoints = [f"{prim_ep}|{rep_ep}"]
+    plan = None
+    if not is_crash:
+        plan = chaos.install(chaos.named_plan(args.plan, seed=args.seed))
+
+    shadow = SparseTable(**spec)   # the fault-free expectation
+    cli = PSClient(endpoints, mode=args.mode, worker_id="chaos-w0",
+                   connect_timeout=5.0, rpc_timeout=1.0, max_retries=6,
+                   backoff_base=0.02, rpc_deadline=30.0)
+    table = RemoteTable(cli, "emb", args.dim)
+
+    rng = np.random.RandomState(args.seed)
+    zipf = np.clip(rng.zipf(1.3, size=(args.steps, args.batch)), 1,
+                   args.vocab) - 1
+    acked = failed = 0
+    report: dict = {"plan": args.plan, "steps": args.steps,
+                    "mode": args.mode, "replica": bool(args.replica)}
+    try:
+        for step in range(args.steps):
+            ids = zipf[step].astype(np.int64)
+            table.pull(ids)
+            shadow.pull(ids)          # mirror call order exactly
+            g = np.full((ids.size, args.dim),
+                        0.01 * ((step % 7) + 1), np.float32)
+            try:
+                table.push(ids, g)
+                if args.mode == "sync":
+                    shadow.push(ids, g)
+                    acked += 1
+            except PSUnavailable:
+                failed += 1
+        if args.mode != "sync":
+            cli.barrier()     # flush; async pushes all acked-or-raised
+            for step in range(args.steps):
+                shadow.push(zipf[step].astype(np.int64),
+                            np.full((args.batch, args.dim),
+                                    0.01 * ((step % 7) + 1), np.float32))
+            acked = args.steps
+        all_ids = np.arange(args.vocab, dtype=np.int64)
+        got = cli.pull("emb", all_ids)
+        want = shadow.pull(all_ids)
+        row_neq = ~np.all(got == want, axis=1)
+        # sgd with positive grads only subtracts: a row sitting BELOW
+        # the shadow saw extra applies, ABOVE it lost some
+        report["double_applied_rows"] = int(
+            (row_neq & (got.sum(1) < want.sum(1))).sum())
+        report["lost_rows"] = int(
+            (row_neq & (got.sum(1) >= want.sum(1))).sum())
+        report["server"] = {k: v for k, v in cli.server_stats().items()
+                            if k != "ok"}
+        report["completed"] = True
+    except (PSUnavailable, RuntimeError) as e:
+        report["completed"] = False
+        report["error"] = str(e)
+        report.setdefault("double_applied_rows", -1)
+        report.setdefault("lost_rows", -1)
+    finally:
+        report["pushes_acked"] = acked
+        report["pushes_failed"] = failed
+        report["recovered"] = cli.retries
+        report["failovers"] = cli.failovers
+        if plan is not None:
+            report["chaos"] = plan.stats_dict()
+            chaos.uninstall()
+        cli.close()
+        for p in (prim_proc, rep_proc):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    ok = (report.get("completed") and failed == 0
+          and report["double_applied_rows"] == 0
+          and report["lost_rows"] == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,11 +4,20 @@
 # passed alone, failed in the combined suite) fails this script and
 # therefore can't ship again.
 #
-# Usage: tools/run_tier1.sh [extra pytest args...]
+# Usage: tools/run_tier1.sh [--chaos] [extra pytest args...]
+#        --chaos additionally runs the fault-injection suite (chaos
+#        harness + PS fault tolerance + crash-mid-save) as a third
+#        pass with its fixed, deterministic seeds
 # Env:   TIER1_SHUFFLE_SEED  fix the shuffle (default: date-derived,
 #                            printed so a red run is reproducible)
 set -u -o pipefail
 cd "$(dirname "$0")/.."
+
+CHAOS=0
+if [ "${1:-}" = "--chaos" ]; then
+    CHAOS=1
+    shift
+fi
 
 PYARGS=(-q -m 'not slow' --continue-on-collection-errors
         -p no:cacheprovider -p no:xdist "$@")
@@ -39,9 +48,20 @@ EOF
     rc2=$?
 fi
 
-echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2"
-if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ]; then
-    echo "== tier-1 FAILED (either ordering being red fails the gate)"
+rc3=0
+if [ "$CHAOS" -eq 1 ]; then
+    # the chaos suite is deterministic (seeded FaultPlans, no
+    # probabilistic sleeps) — a red run here reproduces as-is
+    echo "== tier-1 chaos pass: fault injection suite"
+    env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
+        tests/test_crash_mid_save.py "${PYARGS[@]}" -p no:randomly
+    rc3=$?
+fi
+
+echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3"
+if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ]; then
+    echo "== tier-1 FAILED (any pass being red fails the gate)"
     exit 1
 fi
-echo "== tier-1 OK in both orderings"
+echo "== tier-1 OK"
