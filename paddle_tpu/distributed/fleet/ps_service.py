@@ -33,6 +33,19 @@ watchdog's server restarts, launch_utils.py:526):
     reconnection (a failed socket is always dropped — a partial frame
     must never be resumed), surfacing a typed :class:`PSUnavailable`
     at the hard deadline;
+  * async-mode pushes are fire-and-forget frames, so a connection
+    that dies after the kernel buffered them can silently swallow
+    them; the client therefore tracks every unacked mutating seq and
+    ``barrier()`` verifies the full set against the server's
+    applied-seq window, raising :class:`PSUnavailable` when any push
+    was lost — async delivery is exactly-once-or-reported, never
+    silently at-most-once;
+  * an un-promoted standby refuses data RPCs with a retryable error
+    reply (a client that rotated to it too eagerly keeps rotating
+    until it reaches the promoted server) — writes can never land on
+    a standby and diverge from the primary; handler errors (unknown
+    table, bad payload) come back as a typed NON-retryable
+    :class:`PSError` instead of a dead connection;
   * a server can run as a hot standby (``replica_of=primary``): it
     catches up from an npz snapshot of every table, then applies a
     streamed log of acked mutations (the primary forwards each applied
@@ -88,9 +101,32 @@ class PSUnavailable(PSError):
     """An RPC exhausted its retry budget / hard deadline."""
 
 
+class _StandbyReply(PSError):
+    """Internal: the endpoint answered "I am an un-promoted standby".
+    The retry loop treats it like a down endpoint (drop the socket,
+    back off, rotate) — it must never be surfaced as success."""
+
+
 # RPCs with server-side effects: they carry (src, seq) so a retry can be
 # acked without re-applying (additive pushes would double-apply)
 _MUTATING_OPS = ("push", "push_delta", "register", "barrier")
+
+# RPCs an un-promoted standby must refuse: serving pulls would return
+# rows the snapshot/stream has not caught up to, and applying writes
+# would diverge from the primary (split brain).  stats/stop/heartbeat/
+# replicate stay allowed.
+_GATED_OPS = ("pull", "push", "push_delta", "barrier", "register",
+              "unregister", "worker_barrier")
+
+
+def _expects_reply(msg) -> bool:
+    """Whether the protocol answers this request frame.  An error reply
+    to a one-way frame would desynchronise the request/reply stream."""
+    op = msg.get("op")
+    if op in ("push", "push_delta"):
+        return bool(msg.get("sync"))
+    return op in ("pull", "barrier", "register", "unregister",
+                  "worker_barrier", "stats", "stop")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -425,38 +461,72 @@ class PSServer:
                         with self.monitor.cond:
                             self._ever_registered.add(w)
                     self.monitor.touch(w)
-                if op == "pull":
-                    t = self._table(msg["table"])
-                    _send_msg(conn, {"vals": t.pull(msg["ids"])})
-                elif op in ("push", "push_delta"):
-                    applied = self._apply_mutation(msg)
-                    if msg.get("sync"):
-                        _send_msg(conn, {"ok": True, "dup": not applied})
-                elif op == "barrier":
-                    self._record_seq(msg)
-                    _send_msg(conn, {"ok": True})
-                elif op == "register" or op == "heartbeat":
-                    self._record_seq(msg)
-                    self.monitor.beat(msg["worker"])
-                    with self.monitor.cond:
-                        self._ever_registered.add(msg["worker"])
-                    if op == "register":
+                if (self.role == "replica" and not self.promoted
+                        and op in _GATED_OPS):
+                    # split-brain guard: a client that rotated here too
+                    # eagerly (slow-but-alive primary) gets a retryable
+                    # refusal and keeps rotating until it reaches the
+                    # promoted server — this standby must neither apply
+                    # writes nor serve rows it has not caught up to
+                    if _expects_reply(msg):
+                        _send_msg(conn, {
+                            "ok": False, "retryable": True,
+                            "error": f"standby of {self.replica_of} "
+                                     f"is not promoted"})
+                    if plan is not None:
+                        plan.set_context(None)
+                    continue
+                try:
+                    if op == "pull":
+                        t = self._table(msg["table"])
+                        _send_msg(conn, {"vals": t.pull(msg["ids"])})
+                    elif op in ("push", "push_delta"):
+                        applied = self._apply_mutation(msg)
+                        if msg.get("sync"):
+                            _send_msg(conn, {"ok": True,
+                                             "dup": not applied})
+                    elif op == "barrier":
+                        self._record_seq(msg)
+                        rep = {"ok": True}
+                        conf = msg.get("confirm")
+                        if conf:
+                            rep["missing"] = self._unapplied(
+                                msg.get("src"), conf)
+                        _send_msg(conn, rep)
+                    elif op == "register" or op == "heartbeat":
+                        self._record_seq(msg)
+                        self.monitor.beat(msg["worker"])
+                        with self.monitor.cond:
+                            self._ever_registered.add(msg["worker"])
+                        if op == "register":
+                            _send_msg(conn, {"ok": True})
+                    elif op == "unregister":
+                        self.monitor.leave(msg["worker"])
                         _send_msg(conn, {"ok": True})
-                elif op == "unregister":
-                    self.monitor.leave(msg["worker"])
-                    _send_msg(conn, {"ok": True})
-                elif op == "worker_barrier":
-                    _send_msg(conn, self._worker_barrier(
-                        msg["worker"], msg.get("timeout")))
-                elif op == "replicate":
-                    handed_off = self._attach_replica(conn)
-                    return
-                elif op == "stats":
-                    _send_msg(conn, self._stats())
-                elif op == "stop":
-                    _send_msg(conn, {"ok": True})
-                    self._stop.set()
-                    break
+                    elif op == "worker_barrier":
+                        _send_msg(conn, self._worker_barrier(
+                            msg["worker"], msg.get("timeout")))
+                    elif op == "replicate":
+                        handed_off = self._attach_replica(conn)
+                        return
+                    elif op == "stats":
+                        _send_msg(conn, self._stats())
+                    elif op == "stop":
+                        _send_msg(conn, {"ok": True})
+                        self._stop.set()
+                        break
+                except (OSError, ConnectionError):
+                    raise   # transport death ends this connection
+                except Exception as e:
+                    # handler failure (unknown table, bad payload): a
+                    # typed NON-retryable error reply instead of a dead
+                    # serve thread — otherwise the client only sees
+                    # connection-closed and burns its whole retry
+                    # budget into PSUnavailable, masking the real error
+                    if _expects_reply(msg):
+                        _send_msg(conn, {
+                            "ok": False, "fatal": True,
+                            "error": f"{type(e).__name__}: {e}"})
                 if plan is not None:
                     plan.set_context(None)
         except (OSError, ConnectionError):
@@ -560,10 +630,21 @@ class PSServer:
             if ack is None or not ack.get("ok"):
                 raise ConnectionError("replica rejected snapshot")
         except (OSError, ConnectionError):
+            # lock ORDER matters: a concurrent _forward holds the apply
+            # lock and blocks on this sink's lock, so taking the apply
+            # lock while still holding rep["lock"] here would deadlock
+            # every mutation behind a failed attach.  Close the conn
+            # first (a waiting _forward then fails fast instead of
+            # streaming to a rejected replica), release the sink lock,
+            # THEN detach under the apply lock.
+            try:
+                conn.close()
+            except OSError:
+                pass
+            rep["lock"].release()
             with self._apply_lock:
                 if rep in self._replicas:
                     self._replicas.remove(rep)
-            rep["lock"].release()
             return False
         rep["lock"].release()
         return True
@@ -649,6 +730,20 @@ class PSServer:
                 t = self._tables[name] = SparseTable.from_config(
                     np.load(io.BytesIO(blob)))
         t.load_state_bytes(blob)
+
+    def _unapplied(self, src, seqs) -> list:
+        """Of ``seqs`` (mutations ``src`` sent with no reply expected),
+        the ones this server never applied — barrier()'s delivery check
+        for fire-and-forget async pushes.  Seqs below the dedup window
+        count as applied, exactly as the window itself would treat
+        them."""
+        with self._apply_lock:
+            w = self._seqs.get(src)
+            if w is None:
+                return [int(s) for s in seqs]
+            floor = w.max_seq - w.WINDOW
+            return [int(s) for s in seqs
+                    if s > floor and s not in w.seen]
 
     def promote(self):
         """Become the primary (the standby's stream ended)."""
@@ -796,6 +891,14 @@ class PSClient:
     (``src`` scoped), so the bounded retry loop is exactly-once on the
     server even for additive pushes; exhausting the budget raises
     :class:`PSUnavailable` naming the shard's endpoints.
+
+    Delivery semantics by mode: sync (and geo flush) pushes are acked
+    before returning — exactly-once.  Async/half-async pushes are
+    one-way frames, at-most-once in flight; :meth:`barrier` then
+    confirms every sent seq against the server's applied-seq window
+    and raises :class:`PSUnavailable` if any was lost, so a barrier
+    that returns cleanly proves exactly-once delivery of everything
+    pushed before it.
     """
 
     def __init__(self, endpoints, mode: str = "sync", send_queue_size=16,
@@ -846,6 +949,12 @@ class PSClient:
         self._stop = threading.Event()
         self._push_err: "Exception | None" = None
         self._push_err_later = 0   # failures after the first (masked)
+        # per-shard seqs of mutations sent with no reply expected
+        # (async pushes): "sent" only means the kernel buffered the
+        # frame, so barrier() verifies the whole set against the
+        # server's applied-seq window before reporting success
+        self._unconfirmed: List[set] = [set() for _ in self._ep_lists]
+        self._unconf_lock = threading.Lock()
         self._beat_stop = threading.Event()
         self._beat_socks = []
         if worker_id is not None:
@@ -915,19 +1024,50 @@ class PSClient:
     def _reconnect_locked(self, rank: int) -> socket.socket:
         """(Re)establish the shard's data socket and re-register this
         worker on it — the new endpoint may be a freshly promoted
-        standby that has never seen us.  Caller holds the rank lock."""
+        standby that has never seen us.  Caller holds the rank lock.
+
+        The socket is installed in ``_socks`` only once the register
+        round trip has fully succeeded: a half-used socket (register
+        sent, reply timed out) must never be reused by the next retry
+        or a late register reply would be read as that RPC's reply,
+        desyncing the request/reply stream."""
         sock = self._connect_rank(rank)
+        try:
+            if self.worker_id is not None:
+                reg = {"op": "register", "worker": self.worker_id,
+                       "src": self._src}
+                with self._seq_lock:
+                    reg["seq"] = next(self._seq)
+                sock.settimeout(self._rpc_timeout)
+                _send_msg(sock, reg)
+                rep = _recv_msg(sock)
+                if rep is None:
+                    raise ConnectionError(
+                        "server closed during re-register")
+                self._raise_flagged(rep, rank, "register")
+        except BaseException:
+            self._socks[rank] = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         self._socks[rank] = sock
-        if self.worker_id is not None:
-            reg = {"op": "register", "worker": self.worker_id,
-                   "src": self._src}
-            with self._seq_lock:
-                reg["seq"] = next(self._seq)
-            sock.settimeout(self._rpc_timeout)
-            _send_msg(sock, reg)
-            if _recv_msg(sock) is None:
-                raise ConnectionError("server closed during re-register")
         return sock
+
+    @staticmethod
+    def _raise_flagged(rep, rank: int, op):
+        """Raise on a flagged server error reply: ``fatal`` (handler
+        error, e.g. unknown table) becomes a typed NON-retryable
+        :class:`PSError`; ``retryable`` (un-promoted standby) becomes
+        :class:`_StandbyReply` so the retry loop rotates endpoints."""
+        if isinstance(rep, dict) and rep.get("ok") is False:
+            if rep.get("fatal"):
+                raise PSError(f"PS shard {rank} rejected {op!r}: "
+                              f"{rep.get('error')}")
+            if rep.get("retryable"):
+                raise _StandbyReply(rep.get("error")
+                                    or "standby not promoted")
 
     def _beat(self, interval: float):
         while not self._beat_stop.wait(interval):
@@ -1065,7 +1205,12 @@ class PSClient:
             try:
                 # fire-and-forget frames (async contract); their seq
                 # stamp still makes a send-path retry or a duplicated
-                # delivery apply exactly once server-side
+                # delivery apply exactly once server-side, and
+                # barrier() verifies the whole sent set against the
+                # server's applied-seq window (a frame the kernel
+                # buffered but a dying connection swallowed is LOST,
+                # not retried — at-most-once until the barrier check
+                # turns silent loss into an error)
                 self._push_now(table, ids, grads, sync=False)
             except Exception as e:  # keep draining; surface at barrier()
                 # keep the FIRST error — later cascade errors (every
@@ -1077,6 +1222,17 @@ class PSClient:
                     self._push_err_later += 1
             finally:
                 self._q.task_done()
+
+    def _note_sent(self, rank: int, seq: int):
+        """Record an async mutation as sent-but-unconfirmed.  Bounded
+        like the server's dedup window: seqs that old are unverifiable
+        there anyway (they count as applied)."""
+        with self._unconf_lock:
+            s = self._unconfirmed[rank]
+            s.add(seq)
+            if len(s) > 2 * _SeqWindow.WINDOW:
+                for old in sorted(s)[:len(s) - _SeqWindow.WINDOW]:
+                    s.discard(old)
 
     def barrier(self):
         # flush the async queue (join waits for task_done, so in-flight
@@ -1093,7 +1249,27 @@ class PSClient:
                 + (f" ({later} subsequent push failure(s) suppressed)"
                    if later else "")) from err
         for r in range(len(self._socks)):
-            self._rpc(r, {"op": "barrier"}, reply=True)
+            # fire-and-forget pushes only prove the kernel buffered
+            # them; ask the server which of them it actually applied —
+            # a connection that died after buffering loses frames with
+            # no client-side error, and that loss must surface HERE,
+            # not as silent at-most-once delivery
+            with self._unconf_lock:
+                pending = sorted(self._unconfirmed[r])
+            msg = {"op": "barrier"}
+            if pending:
+                msg["confirm"] = pending
+            rep = self._rpc(r, msg, reply=True)
+            if pending:
+                missing = rep.get("missing") or []
+                with self._unconf_lock:
+                    self._unconfirmed[r].difference_update(pending)
+                if missing:
+                    raise PSUnavailable(
+                        f"{len(missing)} async push(es) to PS shard "
+                        f"{r} ({self._eps_str(r)}) were lost before "
+                        f"the server applied them (first lost seq "
+                        f"{missing[0]})")
 
     def worker_barrier(self, timeout: Optional[float] = None):
         """Rendezvous with every live worker (sync-mode step barrier).
@@ -1195,13 +1371,23 @@ class PSClient:
                         sock.settimeout(rpc_to)
                         _send_msg(sock, msg)
                         if not reply:
+                            if "seq" in msg:
+                                # "sent" == kernel buffered; barrier()
+                                # verifies actual delivery
+                                self._note_sent(rank, msg["seq"])
                             return None
                         rep = _recv_msg(sock)
                         if rep is None:
                             raise ConnectionError(
                                 "server closed the connection")
+                        # fatal handler errors raise PSError out of the
+                        # retry loop entirely (the stream is clean, the
+                        # socket stays); a standby refusal falls into
+                        # the except below like a down endpoint
+                        self._raise_flagged(rep, rank, msg.get("op"))
                         return rep
-                    except (OSError, ConnectionError, socket.timeout):
+                    except (OSError, ConnectionError, socket.timeout,
+                            _StandbyReply):
                         # the stream may hold a partial frame — never
                         # reuse this socket
                         self._socks[rank] = None
@@ -1211,7 +1397,7 @@ class PSClient:
                             pass
                         raise
             except (OSError, ConnectionError, socket.timeout,
-                    PSConnectError) as e:
+                    PSConnectError, _StandbyReply) as e:
                 last_err = e
             attempt += 1
             now = time.monotonic()

@@ -19,15 +19,18 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 import pytest
 
 from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet import ps_service as svc
 from paddle_tpu.distributed.fleet.ps import SparseTable
 from paddle_tpu.distributed.fleet.ps_service import (
-    PSClient, PSConnectError, PSServer, PSUnavailable, _SeqWindow)
+    PSClient, PSConnectError, PSError, PSServer, PSUnavailable,
+    _SeqWindow)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -216,6 +219,77 @@ def test_mid_frame_cut_is_survived_by_retry():
     srv.stop()
 
 
+def test_failed_reregister_never_reuses_half_used_socket():
+    """Regression: _reconnect_locked used to install the socket BEFORE
+    the re-register round trip; a timed-out register left the half-used
+    socket in place and the next retry read the LATE register reply as
+    its own reply (here: a pull getting {"ok": True} -> KeyError)."""
+    srv, ep = _server(lr=0.5)
+    cli = PSClient([ep], worker_id="w0", connect_timeout=2.0,
+                   rpc_timeout=0.5, max_retries=4, backoff_base=0.01,
+                   rpc_deadline=20.0)
+    ids = np.arange(4, dtype=np.int64)
+    base = cli.pull("emb", ids).copy()
+    # delay the next register reply past the rpc timeout
+    chaos.install(chaos.FaultPlan(
+        [chaos.Fault("delay", op="register_reply", first=1, times=1,
+                     arg=1.5)], seed=4))
+    # force a reconnect: the next RPC must re-establish + re-register
+    cli._socks[0].close()
+    cli._socks[0] = None
+    vals = cli.pull("emb", ids)
+    assert np.array_equal(vals, base)
+    assert cli.retries >= 1
+    cli.close()
+    srv.stop()
+
+
+def test_unknown_table_is_typed_error_not_retry_burn():
+    """A handler error (unknown table) must come back as a typed
+    NON-retryable PSError naming the cause — not kill the serve thread
+    and burn the whole retry budget into PSUnavailable."""
+    srv, ep = _server()
+    cli = PSClient([ep], **_FAST)
+    ids = np.arange(2, dtype=np.int64)
+    with pytest.raises(PSError) as ei:
+        cli.pull("nope", ids)
+    assert not isinstance(ei.value, (PSUnavailable, PSConnectError))
+    assert "nope" in str(ei.value) and "KeyError" in str(ei.value)
+    assert cli.retries == 0          # non-retryable: no budget burned
+    # the connection (and the server) survive the handler error
+    assert cli.pull("emb", ids).shape == (2, 4)
+    with pytest.raises(PSError) as ei2:
+        cli.push("nope", ids, np.ones((2, 4), np.float32))
+    assert not isinstance(ei2.value, PSUnavailable)
+    cli.close()
+    srv.stop()
+
+
+def test_barrier_confirms_async_delivery_and_reports_loss():
+    """Async pushes are one-way frames: "sent" only means the kernel
+    buffered them.  barrier() must verify the sent seqs against the
+    server's applied-seq window and raise on loss instead of silently
+    degrading to at-most-once."""
+    srv, ep = _server(lr=1.0)
+    cli = PSClient([ep], mode="async", **_FAST)
+    ids = np.arange(4, dtype=np.int64)
+    for _ in range(3):
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+    cli.barrier()                    # clean: everything confirmed
+    assert cli._unconfirmed[0] == set()
+    assert srv.applied == 3
+    # a seq the kernel buffered but the wire never delivered: the
+    # server's window has no trace of it -> the next barrier must fail
+    cli._note_sent(0, 10_000)
+    with pytest.raises(PSUnavailable) as ei:
+        cli.barrier()
+    assert "lost" in str(ei.value)
+    assert cli._unconfirmed[0] == set()   # reported once, then drained
+    cli.barrier()                    # back to clean
+    cli.close()
+    srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # hot-standby replication + failover
 # ---------------------------------------------------------------------------
@@ -257,6 +331,85 @@ def test_client_fails_over_to_promoted_replica():
     assert st["role"] == "primary" and st["promoted"]
     cli.close()
     rep.stop()
+
+
+def test_unpromoted_standby_refuses_data_rpcs():
+    """Split-brain guard: an un-promoted standby must refuse data RPCs
+    (retryable), so a client that rotated to it too eagerly — e.g. off
+    a slow-but-alive primary — can neither write diverging state nor
+    pull rows the stream has not caught up to."""
+    prim, pep = _server(seed=5)
+    rep, rep_ep = _server(seed=5, replica_of=pep)
+    assert rep.replica_ready.wait(10.0)
+    ids = np.arange(4, dtype=np.int64)
+    # a client pointed ONLY at the standby gets a fast typed failure
+    cli = PSClient([rep_ep], connect_timeout=1.0, rpc_timeout=0.5,
+                   max_retries=2, backoff_base=0.01, rpc_deadline=3.0)
+    with pytest.raises(PSUnavailable) as ei:
+        cli.pull("emb", ids)
+    assert "not promoted" in str(ei.value)
+    with pytest.raises(PSUnavailable):
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+    assert rep.applied == 0          # nothing landed on the standby
+    cli.close()
+
+    # standby FIRST in the endpoint list: the client transparently
+    # rotates to the primary instead of split-braining
+    cli2 = PSClient([f"{rep_ep}|{pep}"], **_FAST)
+    base = cli2.pull("emb", ids).copy()
+    cli2.push("emb", ids, np.ones((4, 4), np.float32))
+    np.testing.assert_allclose(cli2.pull("emb", ids), base - 0.5,
+                               rtol=1e-5)
+    assert cli2.failovers >= 1
+    assert prim.applied == 1
+    assert rep.applied == 1          # via the replication stream only
+    cli2.close()
+    prim.stop()
+    rep.stop()
+
+
+def test_failed_replica_attach_does_not_deadlock_mutations():
+    """Regression: _attach_replica's failure path used to take the
+    apply lock while still holding the sink's stream lock — the exact
+    reverse of _forward's order — so a push concurrent with a failed
+    attach deadlocked every future mutation on the primary."""
+    srv, ep = _server(lr=0.5)
+    cli = PSClient([ep], **_FAST)
+    ids = np.arange(4, dtype=np.int64)
+    cli.pull("emb", ids)             # materialise rows pre-snapshot
+    # fake replica: handshake, read the snapshot, but DON'T ack yet —
+    # the attach thread now holds the sink lock waiting for our ack
+    raw = socket.create_connection(svc._parse_ep(ep), timeout=5.0)
+    try:
+        svc._send_msg_raw(raw, {"op": "replicate"})
+        head = svc._recv_msg(raw)
+        for _ in head["tables"]:
+            assert svc._recv_msg(raw) is not None
+        # a concurrent push takes the apply lock and blocks in
+        # _forward on the attach's sink lock...
+        done = threading.Event()
+
+        def _push():
+            cli.push("emb", ids, np.ones((4, 4), np.float32))
+            done.set()
+
+        t = threading.Thread(target=_push, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        # ...then the snapshot is rejected: the failed attach must
+        # detach WITHOUT deadlocking against the in-flight push
+        svc._send_msg_raw(raw, {"ok": False})
+        assert done.wait(10.0), "push deadlocked behind failed attach"
+        assert srv.applied == 1
+        with srv._apply_lock:
+            assert srv._replicas == []
+        # the server still serves and mutates after the failed attach
+        cli.push("emb", ids, np.ones((4, 4), np.float32))
+        assert srv.applied == 2
+    finally:
+        raw.close()
+        cli.close()
+        srv.stop()
 
 
 # ---------------------------------------------------------------------------
