@@ -155,7 +155,61 @@ def _measure(step, args, steps, items_per_step, metric, unit,
     }
 
 
-def _make_step(model, loss_fn, opt, smoke):
+def _guard_overhead(plain_fn, guarded_fn, steps):
+    """BENCH_GUARD=1 support: median-of-3 A/B of the per-step cost of
+    the train_guard fused health check.  ``guarded_fn`` must run the
+    SAME work as ``plain_fn`` plus the fused reduction and its single
+    host fetch (the guard's entire clean-path footprint).  Target
+    (PERF.md): <1% of step time."""
+    import time as _time
+
+    def loop(fn):
+        fn()                                   # warm (compile)
+        ts = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                fn()
+            ts.append((_time.perf_counter() - t0) / steps)
+        return sorted(ts)[1]
+
+    a = loop(plain_fn)
+    b = loop(guarded_fn)
+    return {
+        "guard_ms_plain": round(a * 1e3, 3),
+        "guard_ms_guarded": round(b * 1e3, 3),
+        "guard_overhead_pct": round((b - a) / a * 100.0, 2),
+    }
+
+
+def _guard_ab(model, loss_fn, opt, smoke, step, args, steps):
+    """BENCH_GUARD=1: A/B the clean-path cost of TrainGuard on this
+    model — a second DistributedTrainStep compiled with
+    ``guard_health=True`` (the fused health reduction rides inside the
+    step program) vs the plain step, plus the guard's single 12-byte
+    host fetch per step."""
+    if os.environ.get("BENCH_GUARD", "0") != "1":
+        return {}
+    import jax
+
+    from paddle_tpu.train_guard import TrainGuard
+    guard = TrainGuard(min_history=10 ** 9)   # detection-only A/B
+    gstep = _make_step(model, loss_fn, opt, smoke, guard_health=True)
+
+    def plain():
+        loss = step(*args)
+        jax.block_until_ready(loss._value)
+
+    def guarded():
+        gstep(*args)
+        guard.check(gstep.last_health)  # the fetch forces the same sync
+
+    out = _guard_overhead(plain, guarded, steps)
+    out["guard_skips"] = guard.skips
+    return out
+
+
+def _make_step(model, loss_fn, opt, smoke, guard_health=False):
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed import mesh as mesh_mod
     from paddle_tpu.distributed.fleet.dist_step import DistributedTrainStep
@@ -168,7 +222,8 @@ def _make_step(model, loss_fn, opt, smoke):
         strategy.amp_configs = {"dtype": "bfloat16"}
     mesh_mod.set_mesh(None)
     mesh = mesh_mod.init_mesh({"dp": -1})
-    return DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh)
+    return DistributedTrainStep(model, loss_fn, opt, strategy, mesh=mesh,
+                                guard_health=guard_health)
 
 
 def _bench_resnet(smoke, peak_tflops):
@@ -207,9 +262,12 @@ def _bench_resnet(smoke, peak_tflops):
 
     # analytic fallback: fwd ~4.1 GFLOP/img at 224^2, train ~3x fwd
     analytic = 3 * 4.1e9 * (hw / 224.0) ** 2 * batch
-    return _measure(step, (img, label), steps, batch,
-                    "resnet50_train_throughput", "images/sec/chip",
-                    analytic, peak_tflops, batch=batch, image_size=hw)
+    res = _measure(step, (img, label), steps, batch,
+                   "resnet50_train_throughput", "images/sec/chip",
+                   analytic, peak_tflops, batch=batch, image_size=hw)
+    res.update(_guard_ab(model, loss_fn, opt, smoke, step,
+                         (img, label), steps))
+    return res
 
 
 def _bench_bert(smoke, peak_tflops):
@@ -352,10 +410,13 @@ def _bench_llama(smoke, peak_tflops):
 
     nparams = sum(int(np.prod(p.shape)) for p in model.parameters())
     analytic = _llama_analytic(cfg, nparams, batch, seq)
-    return _measure(step, (ids, ids), steps, batch * seq,
-                    "llama_proxy_pretrain_throughput", "tokens/sec/chip",
-                    analytic, peak_tflops, batch=batch, seq_len=seq,
-                    n_params=nparams, **flash_info)
+    res = _measure(step, (ids, ids), steps, batch * seq,
+                   "llama_proxy_pretrain_throughput", "tokens/sec/chip",
+                   analytic, peak_tflops, batch=batch, seq_len=seq,
+                   n_params=nparams, **flash_info)
+    res.update(_guard_ab(model, loss_fn, opt, smoke, step,
+                         (ids, ids), steps))
+    return res
 
 
 def _bench_llama_long(smoke, peak_tflops, seq=4096, default_batch="2",
@@ -508,8 +569,7 @@ def _bench_wide_deep(smoke, peak_tflops):
     wide_w = jnp.asarray(rng.randn(n_dense, 1) * 0.05, jnp.float32)
     params = (w1, b1, w2, wide_w)
 
-    @jax.jit
-    def dense_fwd_bwd(params, emb, dense, label):
+    def _dense_core(params, emb, dense, label):
         def loss_of(params, emb):
             w1, b1, w2, wide_w = params
             e = emb.reshape(batch, n_slots * dim)
@@ -522,6 +582,8 @@ def _bench_wide_deep(smoke, peak_tflops):
             loss_of, argnums=(0, 1))(params, emb)
         new_params = tuple(p - 0.05 * g for p, g in zip(params, gp))
         return l, new_params, ge
+
+    dense_fwd_bwd = jax.jit(_dense_core)
 
     state = {"params": params, "losses": []}
 
@@ -594,6 +656,37 @@ def _bench_wide_deep(smoke, peak_tflops):
     backend = ("device_cache" if cache is not None else
                "native+chaos_rpc" if chaos_report is not None
                else "native")
+    guard_report = {}
+    if os.environ.get("BENCH_GUARD", "0") == "1":
+        # per-step guard cost on the dense hot path: the fused health
+        # reduction compiled INTO the dense step (same pattern as
+        # DistributedTrainStep guard_health) + its one host fetch — the
+        # sync point a real guarded PS loop pays each step
+        from paddle_tpu.train_guard import TrainGuard, fused_health
+        guard = TrainGuard(min_history=10 ** 9)
+
+        @jax.jit
+        def dense_fwd_bwd_guarded(params, emb, dense, label):
+            l, new_params, ge = _dense_core(params, emb, dense, label)
+            return l, new_params, ge, fused_health([ge], loss=l,
+                                                   precise=False)
+
+        emb0 = jnp.zeros((batch * n_slots, dim), jnp.float32)
+        dense0 = jnp.asarray(batches[0][1])
+        label0 = jnp.asarray(batches[0][2])
+
+        def plain():
+            l, _, ge = dense_fwd_bwd(state["params"], emb0, dense0,
+                                     label0)
+            jax.block_until_ready(ge)
+
+        def guarded():
+            l, _, ge, h = dense_fwd_bwd_guarded(state["params"], emb0,
+                                                dense0, label0)
+            guard.check(h)   # the fetch forces the same sync
+
+        guard_report = _guard_overhead(plain, guarded, max(steps, 10))
+        guard_report["guard_skips"] = guard.skips
     return {
         "metric": "wide_deep_ps_throughput",
         "value": round(ex_s, 2),
@@ -613,6 +706,7 @@ def _bench_wide_deep(smoke, peak_tflops):
         "plausible": bool(falling),
         "suspect_reason": None if falling else
             "loss did not fall over the run — pipeline may be broken",
+        **guard_report,
     }
 
 

@@ -74,6 +74,9 @@ if "hapi" in globals():
 if "distributed" in globals():
     from .distributed.parallel import DataParallel  # noqa: F401
 
+from . import train_guard  # noqa: F401
+from .train_guard import NumericalDivergence, TrainGuard  # noqa: F401
+
 # paddle-compat mode toggles: the reference flips between dygraph and
 # static graph globally; here "static" only changes default tracing hints,
 # since jit tracing subsumes the static graph.

@@ -105,9 +105,15 @@ class GradScaler:
     On TPU/bf16 scaling is typically unnecessary — ``enable=False`` makes
     every method a passthrough, matching reference behavior."""
 
+    # unbounded incr_ratio growth overflows _scale to inf on a long clean
+    # run, and the next scale(loss) NaNs a healthy step — growth is
+    # clamped here (reference update_loss_scaling_op has the same bound)
+    MAX_LOSS_SCALING = 2.0 ** 32
+
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True,
+                 max_loss_scaling=None):
         self._enable = enable
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
@@ -115,9 +121,13 @@ class GradScaler:
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
+        self._max_scale = float(max_loss_scaling
+                                if max_loss_scaling is not None
+                                else self.MAX_LOSS_SCALING)
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._last_health = None   # HealthState of the last unscale_
         self._unscaled = set()  # ids of optimizers already unscaled this step
 
     def scale(self, loss):
@@ -126,23 +136,25 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
+        """Unscale grads in place and record found_inf via ONE fused
+        device reduction + ONE host transfer for the whole grad tree
+        (train_guard.health_check) — the previous implementation paid a
+        ``bool(isfinite(...).all())`` host round trip per parameter."""
         if not self._enable or id(optimizer) in self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
         from ..framework.selected_rows import SelectedRows
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             if isinstance(p.grad, SelectedRows):
-                sr = p.grad.scale(inv)
-                found = found or bool(~jnp.isfinite(sr.values).all())
-                p.grad = sr
+                p.grad = p.grad.scale(inv)
             else:
-                g = p.grad._value * inv
-                found = found or bool(~jnp.isfinite(g).all())
-                p.grad = Tensor(g)
-        self._found_inf = found
+                p.grad = Tensor(p.grad._value * inv)
+        from ..train_guard import health_check
+        h = health_check(optimizer)
+        self._last_health = h      # a co-operating TrainGuard reuses it
+        self._found_inf = h.nonfinite_count > 0
         self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
@@ -172,7 +184,8 @@ class GradScaler:
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
+                self._scale = min(self._scale * self._incr_ratio,
+                                  self._max_scale)
                 self._good_steps = 0
 
     def is_enable(self):

@@ -365,7 +365,21 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        # steps rotation must never delete — e.g. train_guard's
+        # last-healthy rewind target (losing it would turn a recoverable
+        # loss spike into an unrecoverable NumericalDivergence)
+        self._pinned: set = set()
         os.makedirs(directory, exist_ok=True)
+
+    def pin(self, step: int):
+        """Exempt ``step`` from max_to_keep rotation."""
+        self._pinned.add(int(step))
+
+    def unpin(self, step: int):
+        self._pinned.discard(int(step))
+
+    def pinned_steps(self):
+        return sorted(self._pinned)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
@@ -398,6 +412,8 @@ class CheckpointManager:
 
     def _gc(self):
         import shutil
-        steps = self.all_steps()
+        # pinned steps neither rotate out NOR consume max_to_keep slots:
+        # the newest max_to_keep UNPINNED steps survive alongside them
+        steps = [s for s in self.all_steps() if s not in self._pinned]
         for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)

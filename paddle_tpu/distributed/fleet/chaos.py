@@ -29,6 +29,13 @@ Fault kinds (``Fault.kind``):
   crash   hard-kill the current process (``os._exit(137)``) when the
           server receives the matching request — SIGKILL-grade server
           loss for subprocess harnesses (tools/chaos_ps.py).
+  nan     NUMERIC site (PR 4): inject NaN into a matching array stream.
+          ``op`` names the stream — ``grad`` (parameter gradients, hook
+          in train_guard), ``batch`` (input rows, hook in hapi/Model and
+          tools/chaos_numerics.py), ``activation`` (forward outputs),
+          ``loss``.  ``arg`` = how many leading rows/elements to poison
+          (default 1), so batch blame can assert exactly which rows.
+  inf     same, injecting +inf.
 
 Matching: every fault names an ``op`` (the request header's ``op``
 field; reply frames match ``<op>_reply``, or ``reply`` as a catch-all;
@@ -42,6 +49,12 @@ chaos tool, e.g.::
 
     PADDLE_CHAOS="seed=3;dup:push:every=2;crash:push:first=50"
     PADDLE_CHAOS="plan=flaky;seed=7"
+    PADDLE_CHAOS="nan:grad:step=50"          # numeric: NaN grads at step 50
+    PADDLE_CHAOS="inf:batch:step=10:times=3" # 3 consecutive poisoned batches
+
+``step=N`` is an alias for ``first=N`` that reads naturally at numeric
+sites, where the match counter advances exactly once per training step
+per stream.
 
 ``plan.stats`` counts every fired fault by ``kind:op`` so harnesses
 can report exactly what was injected.
@@ -75,7 +88,8 @@ def _one_way(obj) -> bool:
 class Fault:
     """One deterministic fault rule (see module docstring)."""
 
-    KINDS = ("delay", "dup", "cut", "drop", "refuse", "crash")
+    KINDS = ("delay", "dup", "cut", "drop", "refuse", "crash",
+             "nan", "inf")
 
     def __init__(self, kind: str, op: str = "*", first: int = 1,
                  every: int = 0, times: int = 1, arg: float = 0.0):
@@ -96,6 +110,8 @@ class Fault:
             return "connect"
         if self.kind == "crash":
             return "serve"
+        if self.kind in ("nan", "inf"):
+            return "numeric"
         return "send"
 
     def _should_fire(self) -> bool:
@@ -210,6 +226,18 @@ class FaultPlan:
             raise ConnectionRefusedError(
                 f"chaos: connection refused to {endpoint[0]}:{endpoint[1]}")
 
+    def match_numeric(self, op: str) -> Optional[Fault]:
+        """Numeric-site hook (train_guard.chaos_corrupt): consult the
+        schedule for stream ``op`` ("grad"/"batch"/"activation"/"loss").
+        Called exactly once per training step per stream, so ``first=N``
+        (spelled ``step=N`` in specs) fires at step N, 1-based.  Returns
+        the firing Fault (kind "nan"/"inf") or None; the CALLER applies
+        the corruption and records stats (it knows the array layout)."""
+        f = self._match("numeric", op)
+        if f is not None and f.kind in ("nan", "inf"):
+            return f
+        return None
+
     def on_serve(self, msg):
         """Server-side hook, called once per received request."""
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
@@ -251,9 +279,26 @@ def named_plan(name: str, seed: int = 0) -> FaultPlan:
                         times=0)]
     elif name.startswith("crash@"):
         faults = [Fault("crash", op="push", first=int(name[6:]))]
+    # -- numeric plans (PR 4, tools/chaos_numerics.py) ------------------
+    elif name.startswith("nan_grad@"):
+        faults = [Fault("nan", op="grad", first=int(name[9:]))]
+    elif name.startswith("inf_grad@"):
+        faults = [Fault("inf", op="grad", first=int(name[9:]))]
+    elif name.startswith("nan_batch@"):
+        # poison 2 rows of one batch: exercises skip + batch blame
+        faults = [Fault("nan", op="batch", first=int(name[10:]), arg=2)]
+    elif name.startswith("diverge@"):
+        # sustained divergence: a 4-step window of poisoned batches from
+        # step N — drives the skip streak over max_consecutive_bad (3)
+        # into a rewind, then one more skip, then the stream heals (a
+        # bad window that never ends exhausts the rewind budget into
+        # NumericalDivergence by design — that is the correct outcome)
+        faults = [Fault("nan", op="batch", first=int(name[8:]),
+                        every=1, times=4, arg=1)]
     else:
         raise ValueError(f"unknown chaos plan {name!r} (flaky, dup, "
-                         f"lost_ack, crash@N)")
+                         f"lost_ack, crash@N, nan_grad@N, inf_grad@N, "
+                         f"nan_batch@N, diverge@N)")
     return FaultPlan(faults, seed=seed, name=name)
 
 
@@ -279,6 +324,8 @@ def plan_from_spec(spec: str) -> FaultPlan:
             kw = {}
             for p in parts[2:]:
                 k, _, v = p.partition("=")
+                if k == "step":     # numeric-site spelling of first=
+                    k = "first"
                 if k not in ("first", "every", "times", "arg"):
                     raise ValueError(f"bad chaos fault key {k!r} in "
                                      f"{tok!r}")

@@ -184,10 +184,22 @@ class DistributedTrainStep:
         step = DistributedTrainStep(model, loss_fn, opt, strategy)
         for x, y in loader:
             loss = step(x, y)
+
+    ``guard_health=True`` additionally computes train_guard's fused
+    health reduction ([global_norm, nonfinite_count, loss]) INSIDE the
+    compiled step — XLA folds it into the backward/update sweep, so
+    unlike an out-of-jit health_check() there is no extra dispatch and
+    no second pass over the grad tree.  After each call the f32[3]
+    device array is on ``self.last_health``; hand it to
+    ``TrainGuard.check`` (its fetch is the step's single guard host
+    transfer).
     """
 
-    def __init__(self, model, loss_fn, optimizer, strategy=None, mesh=None):
+    def __init__(self, model, loss_fn, optimizer, strategy=None, mesh=None,
+                 guard_health=False):
         from .strategy import DistributedStrategy
+        self._guard_health = bool(guard_health)
+        self.last_health = None    # f32[3] device array per call
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
@@ -369,6 +381,13 @@ class DistributedTrainStep:
                 "strategy.dgc cannot combine with float16 loss scaling or "
                 "gradient_merge (the reference treats DGC as its own meta "
                 "optimizer too)")
+        if self._guard_health and (use_scaling or self._use_dgc
+                                   or k_steps > 1):
+            raise NotImplementedError(
+                "guard_health covers the plain step (bf16 AMP / ZeRO / "
+                "TP / PP); fp16 scaling carries its own in-step finite "
+                "check, and DGC/gradient_merge accumulate state a "
+                "per-microbatch health vector would misrepresent")
 
         def _amp_cast(tree):
             return jax.tree_util.tree_map(
@@ -595,9 +614,20 @@ class DistributedTrainStep:
                 return loss, new_p, nbufs, new_s, new_dgc
             donate = (0, 1, 2, 3)
         elif k_steps <= 1:
+            guard_health = self._guard_health
+
             def step(pvals, bufs, opt_state, lr, key, args):
                 loss, nbufs, grads = grads_of(pvals, bufs, key, args)
+                if guard_health:
+                    from ...train_guard import fused_health
+                    # fast mode: one pass per grad — the skip policy
+                    # needs the bad/ok bit, not an element census
+                    health = fused_health(
+                        jax.tree_util.tree_leaves(grads), loss=loss,
+                        precise=False)
                 new_p, new_s = apply_opt(pvals, grads, opt_state, lr)
+                if guard_health:
+                    return loss, new_p, nbufs, new_s, health
                 return loss, new_p, nbufs, new_s
             donate = (0, 1, 2)
         else:
@@ -689,6 +719,8 @@ class DistributedTrainStep:
             out_specs += [gspecs]
         else:
             in_specs += [P(), P(), bspec]
+            if self._guard_health:
+                out_specs += [P()]   # the fused health vector (f32[3])
         out_specs += [P()]   # the advanced RNG key
         if has_i:
             out_specs += [P()]   # the advanced step counter
@@ -833,6 +865,11 @@ class DistributedTrainStep:
                              self._step_dev, lr, key, arg_vals)
                 (loss, new_p, new_b, new_s, self._accum,
                  self._key_dev, self._step_dev) = self._compiled(*call_args)
+            elif self._guard_health:
+                call_args = (param_vals, buffer_vals, opt_state, lr, key,
+                             arg_vals)
+                (loss, new_p, new_b, new_s, self.last_health,
+                 self._key_dev) = self._compiled(*call_args)
             else:
                 call_args = (param_vals, buffer_vals, opt_state, lr, key,
                              arg_vals)

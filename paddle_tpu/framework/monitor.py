@@ -14,8 +14,8 @@ import threading
 from typing import Dict, Optional
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "get_all_stats", "device_memory_stats", "max_memory_allocated",
-           "memory_allocated"]
+           "get_all_stats", "stats_with_prefix", "device_memory_stats",
+           "max_memory_allocated", "memory_allocated"]
 
 _lock = threading.Lock()
 
@@ -72,6 +72,14 @@ def stat_reset(name: Optional[str] = None):
 
 def get_all_stats() -> Dict[str, int]:
     return _registry.snapshot()
+
+
+def stats_with_prefix(prefix: str) -> Dict[str, int]:
+    """Snapshot of every counter under a namespace (e.g. ``"guard_"``
+    for train_guard's guard_skips/guard_rewinds/guard_blamed_rows) —
+    the monitoring surface a dashboard scrapes per subsystem."""
+    return {k: v for k, v in _registry.snapshot().items()
+            if k.startswith(prefix)}
 
 
 def device_memory_stats(device=None) -> Dict[str, int]:

@@ -54,12 +54,24 @@ class Model:
         self._amp_level = "O0"
         self.stop_training = False
         self._save_dir = None
+        self._guard = None          # train_guard.TrainGuard (prepare())
+        self._guard_step = 0
+        self.last_guard_verdict = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
-        """Configure the model (reference model.py ``prepare``)."""
+                amp_configs=None, guard=None):
+        """Configure the model (reference model.py ``prepare``).
+
+        ``guard``: an optional :class:`paddle_tpu.train_guard.TrainGuard`
+        — every train batch then runs the fused numerics health check
+        and bad steps are skipped (or rewound) instead of applied; the
+        verdict of the latest batch is on ``model.last_guard_verdict``.
+        """
         self._optimizer = optimizer
+        self._guard = guard
+        if guard is not None and guard.optimizer is None:
+            guard.optimizer = optimizer
         if loss is not None and not isinstance(loss, Layer) \
                 and not callable(loss):
             raise TypeError(
@@ -97,6 +109,42 @@ class Model:
                 return self.network(*inputs)
         return self.network(*inputs)
 
+    @staticmethod
+    def _chaos_active():
+        from ..distributed.fleet import chaos
+        return chaos.active()
+
+    def _chaos_batch(self, inputs):
+        """Deterministic numeric chaos on the TRAIN data stream
+        (``PADDLE_CHAOS="nan:batch:step=N"``): poison leading rows of
+        the first float input.  No-op without an installed plan."""
+        if self._chaos_active() is None:
+            return inputs
+        from ..train_guard import chaos_corrupt
+        vals, fired = chaos_corrupt(
+            "batch", [x._value for x in inputs])
+        if not fired:
+            return inputs
+        return [Tensor(v) if not isinstance(v, Tensor) else v
+                for v in vals]
+
+    def _chaos_activation(self, outputs):
+        """``nan:activation:step=N``: ADD a nan/inf-rowed zero tensor to
+        the first forward output — addition keeps the autograd node, so
+        the poison propagates into loss AND gradients exactly like a
+        real activation blow-up."""
+        if self._chaos_active() is None:
+            return outputs
+        from ..train_guard import chaos_corrupt
+        outs = _to_list(outputs)
+        first = outs[0]
+        poison, fired = chaos_corrupt(
+            "activation", np.zeros(tuple(first.shape), np.float32))
+        if not fired:
+            return outputs
+        outs = [first + Tensor(poison)] + outs[1:]
+        return outs if isinstance(outputs, (list, tuple)) else outs[0]
+
     def _train_batch_impl(self, inputs, labels, update=True,
                           loss_scale=1.0):
         """Returns (losses, metrics) — always a pair.  ``loss_scale``
@@ -110,12 +158,20 @@ class Model:
                   for x in _to_list(inputs)]
         labels = [Tensor(y) if not isinstance(y, Tensor) else y
                   for y in _to_list(labels)]
+        inputs = self._chaos_batch(inputs)
         outputs = self._run_forward(inputs)
+        outputs = self._chaos_activation(outputs)
         loss = self._compute_loss(outputs, labels)
         (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            if self._guard is not None:
+                self.last_guard_verdict = self._guard.step(
+                    loss, step=self._guard_step,
+                    optimizer=self._optimizer)
+            else:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            self._guard_step += 1
         metrics = []
         with no_grad():
             for metric in self._metrics:

@@ -86,6 +86,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
             return params_grads
         gnorm = jnp.sqrt(sum(sq))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        # NaN contagion guard: ONE nonfinite grad makes gnorm nonfinite,
+        # and scaling by it would turn EVERY grad (healthy ones included)
+        # to NaN.  Fall back to scale 1.0 — detecting/skipping the bad
+        # step is train_guard's job; the clip must not widen the blast
+        # radius it has to diagnose.
+        scale = jnp.where(jnp.isfinite(gnorm), scale, 1.0)
         out = []
         for p, g in merged:
             if g is None:

@@ -57,6 +57,7 @@ class Optimizer:
         # state: param id -> dict of jnp arrays
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._global_step = 0
+        self._skipped_steps = 0   # guard/scaler-dropped steps (audit only)
         self._jit_update = jax.jit(self._update)
         # NOT jitted: rows/vals shapes track the batch's unique-id count,
         # which changes almost every step — jit would retrace per count.
@@ -140,6 +141,29 @@ class Optimizer:
         self._global_step += 1
 
     minimize_step = step
+
+    def grad_leaves(self):
+        """Raw grad arrays for every parameter holding one (SelectedRows
+        contribute their value blocks).  This is the canonical input to
+        train_guard's fused health check and GradScaler.unscale_'s
+        found_inf reduction — one list, zero host syncs."""
+        from ..framework.selected_rows import SelectedRows
+        out = []
+        for p in self._parameter_list:
+            g = p.grad
+            if g is None:
+                continue
+            out.append(g.values if isinstance(g, SelectedRows)
+                       else (g._value if isinstance(g, Tensor) else g))
+        return out
+
+    def skip_step(self):
+        """Drop this step's gradients without applying them (train_guard
+        skip verdict / GradScaler found_inf).  ``_global_step`` does NOT
+        advance — a skipped step must leave the optimizer bit-identical
+        to never having seen the batch, or rewind-exactness breaks."""
+        self.clear_grad()
+        self._skipped_steps += 1
 
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:

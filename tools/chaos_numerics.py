@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Run a guarded training loop under deterministic NUMERIC fault
+injection and audit what the guard did (the train_guard counterpart of
+tools/chaos_ps.py, which audits the transport layer).
+
+A small regression net trains with :class:`paddle_tpu.TrainGuard`
+attached (fused health check + skip/rewind policy + batch blame +
+pinned-checkpoint rewind target) while fleet/chaos.py injects NaN/Inf
+into the chosen stream at exact, seeded steps.  The report counts
+precisely what fired and what the guard recovered:
+
+  skips         steps whose poisoned grads were dropped (never applied)
+  rewinds       restores to the last-healthy pinned checkpoint
+  blamed_rows   poisoned rows identified by microbatch bisection
+  final_loss    must come out finite for exit status 0
+
+Plans (fleet/chaos.py named numeric plans, or any raw spec):
+
+  nan_grad@N    NaN in the gradient tree at step N   -> one skip
+  inf_grad@N    +inf in the gradient tree at step N  -> one skip
+  nan_batch@N   2 poisoned rows in batch N           -> skip + blame
+  diverge@N     every batch from N on poisoned       -> rewind(s)
+  clean         no injection (baseline; guard must stay silent)
+
+Examples::
+
+    python tools/chaos_numerics.py --plan nan_grad@5 --steps 20
+    python tools/chaos_numerics.py --plan diverge@8 --steps 24
+    PADDLE_CHAOS="nan:grad:step=5" python tools/chaos_numerics.py \
+        --plan env --steps 20
+
+Exit status 0 iff the run completed with a finite final loss and the
+guard's actions match the plan (clean => zero guard events).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle                                    # noqa: E402
+import paddle_tpu.nn as nn                                     # noqa: E402
+import paddle_tpu.nn.functional as F                           # noqa: E402
+from paddle_tpu.distributed.checkpoint import CheckpointManager  # noqa: E402
+from paddle_tpu.distributed.fleet import chaos                 # noqa: E402
+from paddle_tpu.framework import random as prandom             # noqa: E402
+from paddle_tpu.framework.core import Tensor                   # noqa: E402
+from paddle_tpu.framework.monitor import stats_with_prefix     # noqa: E402
+from paddle_tpu.train_guard import (NumericalDivergence,       # noqa: E402
+                                    TrainGuard, chaos_corrupt)
+
+
+def _batch(step, batch_size):
+    """Position-keyed data stream: every (re)run regenerates the same
+    per-step batch, the property rewind-resume relies on."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(batch_size, 4)).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    return x, y
+
+
+def run(plan_name, steps, batch_size, seed, ckdir,
+        max_consecutive_bad=3, rewind_budget=2):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=5,
+                                          gamma=0.5)
+    opt = paddle.optimizer.Momentum(learning_rate=sched, momentum=0.9,
+                                    parameters=net.parameters())
+    mgr = CheckpointManager(ckdir, max_to_keep=2)
+
+    def state_fn():
+        return {"model": net.state_dict(), "opt": opt.state_dict(),
+                "sched": sched.state_dict(),
+                "rng": {"key": prandom.get_rng_state()}}
+
+    def restore_fn(state):
+        net.set_state_dict(state["model"])
+        opt.set_state_dict(state["opt"])
+        sched.set_state_dict(state["sched"])
+        prandom.set_rng_state(state["rng"]["key"])
+
+    guard = TrainGuard(optimizer=opt, manager=mgr, state_fn=state_fn,
+                       restore_fn=restore_fn, min_history=4,
+                       spike_factor=8.0,
+                       max_consecutive_bad=max_consecutive_bad,
+                       rewind_budget=rewind_budget, checkpoint_every=2)
+
+    if plan_name == "env":
+        plan = chaos.active()   # PADDLE_CHAOS installed it at import
+    elif plan_name == "clean":
+        plan = None
+    else:
+        plan = chaos.install(chaos.named_plan(plan_name, seed=seed))
+
+    losses = []
+    diverged = None
+    for step in range(steps):
+        x, y = _batch(step, batch_size)
+        (x,), _ = chaos_corrupt("batch", [x])
+        xt, yt = Tensor(x), Tensor(y)
+
+        def blame_fn(rows):
+            sub = F.mse_loss(net(Tensor(x[rows])), Tensor(y[rows]))
+            return bool(np.isfinite(sub.numpy()).all())
+
+        loss = F.mse_loss(net(xt), yt)
+        loss.backward()
+        try:
+            verdict = guard.step(loss, step=step, blame_fn=blame_fn,
+                                 n_rows=batch_size)
+        except NumericalDivergence as e:
+            diverged = str(e)
+            break
+        if verdict == "ok":
+            sched.step()
+            losses.append(guard.last_health.loss)
+
+    report = {
+        "plan": plan_name, "steps": steps, "applied_steps": len(losses),
+        "final_loss": losses[-1] if losses else None,
+        "skips": guard.skips, "rewinds": guard.rewinds,
+        "blamed": guard.blamed_rows,
+        "pinned": mgr.pinned_steps(),
+        "registry": stats_with_prefix("guard_"),
+        "events": guard.events,
+        "diverged": diverged,
+        "chaos": plan.stats_dict() if plan is not None else {},
+        "completed": diverged is None,
+    }
+    if plan is not None and plan_name != "env":
+        chaos.uninstall()
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--plan", default="nan_grad@5",
+                    help="clean | env | nan_grad@N | inf_grad@N | "
+                         "nan_batch@N | diverge@N")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckdir", default=None,
+                    help="checkpoint dir (default: fresh tempdir)")
+    args = ap.parse_args(argv)
+
+    ckdir = args.ckdir or tempfile.mkdtemp(prefix="chaos_numerics_")
+    report = run(args.plan, args.steps, args.batch, args.seed, ckdir)
+    print(json.dumps(report, indent=1, sort_keys=True, default=str))
+
+    ok = (report["completed"] and report["final_loss"] is not None
+          and np.isfinite(report["final_loss"]))
+    if args.plan == "clean":
+        ok = ok and report["skips"] == 0 and report["rewinds"] == 0
+    elif args.plan.startswith(("nan_grad@", "inf_grad@")):
+        ok = ok and report["skips"] == 1 and report["rewinds"] == 0
+    elif args.plan.startswith("nan_batch@"):
+        ok = (ok and report["skips"] == 1
+              and sum(len(r) for _, r in report["blamed"]) == 2)
+    elif args.plan.startswith("diverge@"):
+        ok = ok and report["rewinds"] >= 1
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,11 +51,14 @@ fi
 rc3=0
 if [ "$CHAOS" -eq 1 ]; then
     # the chaos suite is deterministic (seeded FaultPlans, no
-    # probabilistic sleeps) — a red run here reproduces as-is
+    # probabilistic sleeps) — a red run here reproduces as-is.
+    # test_train_guard.py is the NUMERIC chaos suite (PR 4): NaN/Inf
+    # injection into grads/batches/activations, skip/rewind/blame.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
-        tests/test_crash_mid_save.py "${PYARGS[@]}" -p no:randomly
+        tests/test_crash_mid_save.py tests/test_train_guard.py \
+        "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
 
