@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...framework.core import Tensor, no_grad
 from ...framework.random import split_key, use_key
 from ...jit import _tree_to_values
+from ...observability.timeline import StepTimeline
 from .. import mesh as mesh_mod
 
 __all__ = ["DistributedTrainStep", "param_partition_spec"]
@@ -291,6 +292,9 @@ class DistributedTrainStep:
         self._dgc_state = None  # DGC (u, v) accumulator pair
         self._use_dgc = bool(self._strategy.dgc)
         self._step_i = np.int64(0)
+        # step timeline (ISSUE 5): phase spans/histograms, sampled by
+        # PADDLE_TRACE_EVERY; both exporters off -> near-zero cost
+        self._obs = StepTimeline("train_step")
         self._use_scaling = False  # set by _build for float16 AMP
         # (loss_scale, consecutive_finite_steps, consecutive_bad_steps)
         self._amp_state = None
@@ -801,10 +805,19 @@ class DistributedTrainStep:
 
     # run --------------------------------------------------------------
     def __call__(self, *args):
-        arg_vals = _tree_to_values(list(args))
-        param_vals = {n: p._value for n, p in self._params.items()}
-        buffer_vals = {n: b._value for n, b in self._buffers.items()}
-        opt_state = self._storage_cast(self._opt.opt_state())
+        # one "train_step" span per SAMPLED step (trace_every) with
+        # h2d / dispatch / host phase children; phase histograms land
+        # in the registry on every step while metrics are enabled
+        with self._obs.step(int(self._step_i)):
+            return self._call_impl(*args)
+
+    def _call_impl(self, *args):
+        obs = self._obs
+        with obs.phase("h2d"):
+            arg_vals = _tree_to_values(list(args))
+            param_vals = {n: p._value for n, p in self._params.items()}
+            buffer_vals = {n: b._value for n, b in self._buffers.items()}
+            opt_state = self._storage_cast(self._opt.opt_state())
         if self._compiled is None:
             self._compiled = self._build(arg_vals, opt_state)
             # lay params/opt-state out on their final shardings once (ZeRO-3
@@ -848,7 +861,7 @@ class DistributedTrainStep:
         lr = self._lr_cache[1]
         if (self._use_dgc or self._k_steps > 1) and self._step_dev is None:
             self._step_dev = jnp.asarray(self._step_i, jnp.int32)
-        with no_grad():
+        with obs.phase("dispatch"), no_grad():
             if self._use_scaling:
                 call_args = (param_vals, buffer_vals, opt_state,
                              self._amp_state, lr, key, arg_vals)
@@ -875,26 +888,28 @@ class DistributedTrainStep:
                              arg_vals)
                 (loss, new_p, new_b, new_s,
                  self._key_dev) = self._compiled(*call_args)
-        # cheap signature over just the batch args: params/opt-state avals
-        # are fixed after _build, but a different batch shape retraces the
-        # jit silently and cost_analysis must report the live variant
-        arg_sig = tuple((tuple(v.shape), str(v.dtype))
-                        for v in jax.tree_util.tree_leaves(arg_vals)
-                        if hasattr(v, "shape"))
-        if getattr(self, "_last_arg_sig", None) != arg_sig:
-            self._last_arg_sig = arg_sig
-            # only shape/dtype structs are kept (holding the arrays would
-            # pin a full batch + donated-state aliases in HBM)
-            self._last_call_args = jax.tree_util.tree_map(
-                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
-                if hasattr(v, "shape") and hasattr(v, "dtype") else v,
-                call_args)
-        self._step_i += 1   # host mirror (authoritative copy: _step_dev)
-        for n, p in self._params.items():
-            p._value = new_p[n]
-        for n, b in self._buffers.items():
-            b._value = new_b[n]
-        self._opt.load_opt_state(new_s)
+        with obs.phase("host"):
+            # cheap signature over just the batch args: params/opt-state
+            # avals are fixed after _build, but a different batch shape
+            # retraces the jit silently and cost_analysis must report
+            # the live variant
+            arg_sig = tuple((tuple(v.shape), str(v.dtype))
+                            for v in jax.tree_util.tree_leaves(arg_vals)
+                            if hasattr(v, "shape"))
+            if getattr(self, "_last_arg_sig", None) != arg_sig:
+                self._last_arg_sig = arg_sig
+                # only shape/dtype structs are kept (holding the arrays
+                # would pin a full batch + donated-state aliases in HBM)
+                self._last_call_args = jax.tree_util.tree_map(
+                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                    if hasattr(v, "shape") and hasattr(v, "dtype") else v,
+                    call_args)
+            self._step_i += 1   # host mirror (authoritative: _step_dev)
+            for n, p in self._params.items():
+                p._value = new_p[n]
+            for n, b in self._buffers.items():
+                b._value = new_b[n]
+            self._opt.load_opt_state(new_s)
         return Tensor(loss)
 
     def compile_abstract(self, *args):

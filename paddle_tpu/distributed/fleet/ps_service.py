@@ -82,11 +82,32 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import chaos as _chaos
+from ...framework import monitor as _monitor
+from ...observability import trace as _trace
 
 __all__ = ["PSServer", "PSClient", "PSError", "PSConnectError",
            "PSUnavailable"]
 
 _HDR = struct.Struct("!I")
+
+# observability (ISSUE 5): every RPC carries an optional trace context
+# under this header key — [trace_id, span_id] of the client-side span —
+# so the server's handler span parents correctly in the merged trace.
+_TRACE_KEY = "tr"
+
+
+def _note_clock(rep, t0_ns: int, t1_ns: int):
+    """Clock-offset sample from a register round trip: the server's
+    reply carries its wall clock (``srv_us``) + sink identity; the
+    midpoint of [t0, t1] estimates when that clock was read on OUR
+    timeline, so ``offset = srv_us - midpoint`` maps the server's span
+    timestamps into this process's clock (trace_merge applies it)."""
+    if not isinstance(rep, dict) or "srv_us" not in rep:
+        return
+    t0_us, t1_us = t0_ns // 1000, t1_ns // 1000
+    _trace.record_clock(rep.get("srv_sink", "?"),
+                        rep["srv_us"] - (t0_us + t1_us) / 2.0,
+                        t1_us - t0_us)
 
 
 class PSError(RuntimeError):
@@ -449,6 +470,7 @@ class PSServer:
                 if msg is None:
                     break
                 op = msg["op"]
+                tctx = msg.pop(_TRACE_KEY, None)
                 if plan is not None:
                     plan.on_serve(msg)       # may crash the process
                     plan.set_context(op)     # replies match "<op>_reply"
@@ -476,6 +498,14 @@ class PSServer:
                     if plan is not None:
                         plan.set_context(None)
                     continue
+                # handler span: a child of the client's RPC span when
+                # the frame carried a trace context — the merged trace
+                # shows this apply INSIDE the client's push/pull span
+                srv_sp = (_trace.server_span(f"ps.server.{op}", tctx,
+                                             table=msg.get("table"))
+                          if _trace.enabled() else None)
+                if srv_sp is not None:
+                    srv_sp.__enter__()
                 try:
                     if op == "pull":
                         t = self._table(msg["table"])
@@ -499,7 +529,14 @@ class PSServer:
                         with self.monitor.cond:
                             self._ever_registered.add(msg["worker"])
                         if op == "register":
-                            _send_msg(conn, {"ok": True})
+                            # reply carries this server's wall clock +
+                            # sink identity: the client derives the
+                            # clock-offset sample trace_merge uses to
+                            # fuse the two processes' timelines
+                            _send_msg(conn, {
+                                "ok": True,
+                                "srv_us": time.time_ns() // 1000,
+                                "srv_sink": _trace.sink_id()})
                     elif op == "unregister":
                         self.monitor.leave(msg["worker"])
                         _send_msg(conn, {"ok": True})
@@ -527,6 +564,9 @@ class PSServer:
                         _send_msg(conn, {
                             "ok": False, "fatal": True,
                             "error": f"{type(e).__name__}: {e}"})
+                finally:
+                    if srv_sp is not None:
+                        srv_sp.__exit__(None, None, None)
                 if plan is not None:
                     plan.set_context(None)
         except (OSError, ConnectionError):
@@ -572,6 +612,7 @@ class PSServer:
                     w = self._seqs[src] = _SeqWindow()
                 if w.check_and_record(seq):
                     self.dup_acks += 1
+                    _monitor.stat_add("ps_server_dup_acks")
                     return False
             t = self._table(msg["table"])
             if msg["op"] == "push":
@@ -579,6 +620,10 @@ class PSServer:
             else:
                 t.push_delta(msg["ids"], msg["deltas"])
             self.applied += 1
+            if _monitor.metrics_enabled():
+                # per-mutation gauge: a scrape of primary + replica
+                # reads replica lag as the difference of the two
+                _monitor.gauge_set("ps_applied_total", self.applied)
             if self._replicas:
                 self._forward(msg)
         return True
@@ -590,19 +635,29 @@ class PSServer:
         back."""
         rec = {k: msg[k] for k in ("op", "table", "ids", "grads",
                                    "deltas", "src", "seq") if k in msg}
-        for rep in list(self._replicas):
-            with rep["lock"]:
-                try:
-                    _send_msg_raw(rep["conn"], rec)
-                    ack = _recv_msg(rep["conn"])
-                    if ack is None or not ack.get("ok"):
-                        raise ConnectionError("replica closed mid-stream")
-                except (OSError, ConnectionError):
-                    self._replicas.remove(rep)
+        # the forward span is a child of the server's apply span (tls),
+        # and its context rides the record so the REPLICA's apply span
+        # parents here — client -> primary -> replica is one chain in
+        # the merged trace
+        with _trace.span("ps.replica.forward", cat="rpc",
+                         op=rec.get("op")):
+            ctx = _trace.propagation_ctx()
+            if ctx is not None:
+                rec[_TRACE_KEY] = ctx
+            for rep in list(self._replicas):
+                with rep["lock"]:
                     try:
-                        rep["conn"].close()
-                    except OSError:
-                        pass
+                        _send_msg_raw(rep["conn"], rec)
+                        ack = _recv_msg(rep["conn"])
+                        if ack is None or not ack.get("ok"):
+                            raise ConnectionError(
+                                "replica closed mid-stream")
+                    except (OSError, ConnectionError):
+                        self._replicas.remove(rep)
+                        try:
+                            rep["conn"].close()
+                        except OSError:
+                            pass
 
     def _attach_replica(self, conn) -> bool:
         """Handshake for ``op=replicate``: under the apply lock snapshot
@@ -622,7 +677,9 @@ class PSServer:
         try:
             conn.settimeout(30.0)
             _send_msg_raw(conn, {"op": "snapshot", "tables": names,
-                                 "seqs": seqs})
+                                 "seqs": seqs,
+                                 "srv_us": time.time_ns() // 1000,
+                                 "srv_sink": _trace.sink_id()})
             for n, b in blobs:
                 _send_msg_raw(conn, {"table": n,
                                      "blob": np.frombuffer(b, np.uint8)})
@@ -669,10 +726,17 @@ class PSServer:
         self._repl_sock = sock
         try:
             sock.settimeout(60.0)
+            t0 = time.time_ns()
             _send_msg_raw(sock, {"op": "replicate"})
             head = _recv_msg(sock)
             if head is None:
                 return
+            # clock edge replica -> primary (the primary snapshots
+            # under its apply lock before answering, so the rtt is
+            # inflated and the midpoint estimate coarse — good enough
+            # to fuse same-rack timelines; trace_merge takes the
+            # median over all samples)
+            _note_clock(head, t0, time.time_ns())
             for _ in head.get("tables", []):
                 fr = _recv_msg(sock)
                 if fr is None:
@@ -689,6 +753,12 @@ class PSServer:
                 rec = _recv_msg(sock)
                 if rec is None:
                     break   # primary is gone
+                tctx = rec.pop(_TRACE_KEY, None)
+                rep_sp = (_trace.server_span("ps.replica.apply", tctx,
+                                             table=rec.get("table"))
+                          if _trace.enabled() else None)
+                if rep_sp is not None:
+                    rep_sp.__enter__()
                 try:
                     self._apply_mutation(rec)
                 except Exception as e:
@@ -702,6 +772,9 @@ class PSServer:
                           f"stream failed, NOT promoting: {e!r}",
                           file=sys.stderr)
                     return
+                finally:
+                    if rep_sp is not None:
+                        rep_sp.__exit__(None, None, None)
                 _send_msg_raw(sock, {"ok": True})
         except (OSError, ConnectionError):
             pass
@@ -1013,6 +1086,7 @@ class PSClient:
                 if idx != self._active[rank]:
                     self._active[rank] = idx
                     self.failovers += 1
+                    _monitor.stat_add("ps_client_failovers")
                 return s
             except OSError as e:
                 last_err = e
@@ -1039,12 +1113,14 @@ class PSClient:
                 with self._seq_lock:
                     reg["seq"] = next(self._seq)
                 sock.settimeout(self._rpc_timeout)
+                t_reg = time.time_ns()
                 _send_msg(sock, reg)
                 rep = _recv_msg(sock)
                 if rep is None:
                     raise ConnectionError(
                         "server closed during re-register")
                 self._raise_flagged(rep, rank, "register")
+                _note_clock(rep, t_reg, time.time_ns())
         except BaseException:
             self._socks[rank] = None
             try:
@@ -1356,6 +1432,28 @@ class PSClient:
             msg["src"] = self._src
             with self._seq_lock:
                 msg["seq"] = next(self._seq)
+        # client-side RPC span; its (trace, span) context rides the
+        # frame header so the server's handler span parents under it.
+        # Retries re-send the same context — the retried apply is the
+        # same logical RPC.
+        sp = (_trace.Span(f"ps.client.{msg.get('op')}", cat="rpc",
+                          shard=rank)
+              if _trace.enabled() else None)
+        if sp is not None:
+            msg[_TRACE_KEY] = [sp.trace, sp.span_id]
+            sp.__enter__()
+        mx = _monitor.metrics_enabled()
+        t_rpc0 = time.perf_counter() if mx else 0.0
+        try:
+            return self._rpc_attempts(rank, msg, reply, timeout)
+        finally:
+            if mx:
+                _monitor.hist_observe(
+                    "ps_rpc_ms", (time.perf_counter() - t_rpc0) * 1e3)
+            if sp is not None:
+                sp.__exit__(None, None, None)
+
+    def _rpc_attempts(self, rank, msg, reply, timeout):
         rpc_to = self._rpc_timeout if timeout is _UNSET else timeout
         deadline = time.monotonic() + self._deadline
         attempt = 0
@@ -1369,6 +1467,8 @@ class PSClient:
                         sock = self._reconnect_locked(rank)
                     try:
                         sock.settimeout(rpc_to)
+                        is_reg = msg.get("op") == "register"
+                        t_reg = time.time_ns() if is_reg else 0
                         _send_msg(sock, msg)
                         if not reply:
                             if "seq" in msg:
@@ -1385,6 +1485,10 @@ class PSClient:
                         # socket stays); a standby refusal falls into
                         # the except below like a down endpoint
                         self._raise_flagged(rep, rank, msg.get("op"))
+                        if is_reg:
+                            # register round trip doubles as the clock
+                            # probe trace_merge aligns timelines with
+                            _note_clock(rep, t_reg, time.time_ns())
                         return rep
                     except (OSError, ConnectionError, socket.timeout,
                             _StandbyReply):
@@ -1408,11 +1512,15 @@ class PSClient:
                     f"({self._eps_str(rank)}) failed after {attempt} "
                     f"attempt(s): {last_err}") from last_err
             self.retries += 1
+            _monitor.stat_add("ps_client_retries")
             if attempt >= 2 and len(group) > 1:
                 # the active endpoint keeps failing: fail over to the
                 # next endpoint in the shard's list (promoted standby)
                 self._active[rank] = (self._active[rank] + 1) % len(group)
                 self.failovers += 1
+                _monitor.stat_add("ps_client_failovers")
             delay = min(self._backoff * (2 ** (attempt - 1)), 1.0)
             delay *= 0.5 + 0.5 * self._jitter.random()
+            if _monitor.metrics_enabled():
+                _monitor.hist_observe("ps_backoff_ms", delay * 1e3)
             time.sleep(min(delay, max(0.0, deadline - now)))

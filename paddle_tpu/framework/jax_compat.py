@@ -1,0 +1,59 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The codebase is written against the modern ``jax.shard_map`` module
+attribute (keyword API: ``mesh=/in_specs=/out_specs=/axis_names=/
+check_vma=``).  jax 0.4.37 — this container's pinned version — only
+ships ``jax.experimental.shard_map.shard_map`` with the older keyword
+surface (``check_rep=``, ``auto=``).  Installing the alias here keeps
+every call site on the one modern spelling and confines the version
+split to this module.
+
+Keyword translation (the two surfaces express the same machine):
+
+=================  ====================================================
+modern kwarg        jax 0.4.37 equivalent
+=================  ====================================================
+``axis_names=S``    ``auto = mesh.axis_names - S`` (manual set ->
+                    complement is auto)
+``check_vma=b``     ``check_rep=b`` (the VMA checker is the renamed
+                    replication checker)
+=================  ====================================================
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["install"]
+
+
+def _shard_map_compat(f=None, *, mesh, in_specs, out_specs,
+                      axis_names=None, check_vma=None, check_rep=None,
+                      auto=None, **kw):
+    from jax.experimental.shard_map import shard_map as _sm
+    if f is None:      # modern jax allows partial application
+        return functools.partial(
+            _shard_map_compat, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, axis_names=axis_names,
+            check_vma=check_vma, check_rep=check_rep, auto=auto, **kw)
+    if auto is None and axis_names is not None:
+        manual = frozenset(axis_names)
+        auto = frozenset(getattr(mesh, "axis_names", ())) - manual
+    if check_rep is None and check_vma is not None:
+        check_rep = check_vma
+    if auto is not None:
+        kw["auto"] = frozenset(auto)
+    if check_rep is not None:
+        kw["check_rep"] = bool(check_rep)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def install():
+    """Alias ``jax.shard_map`` when the running jax lacks it (<= 0.4.x).
+    Idempotent; a jax that already has the attribute is left alone."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+
+
+install()

@@ -7,26 +7,115 @@ TPU-native analog of the reference's monitor subsystem (SURVEY §5.5):
 - ``device_memory_stats``: where the reference reads its allocator
   counters, XLA owns HBM — the numbers come from
   ``jax.Device.memory_stats()`` (bytes_in_use, peak_bytes_in_use, …).
+
+Observability extension (ISSUE 5): beyond the original int counters the
+registry now carries **gauges** (last-written float, e.g. a queue depth)
+and **fixed-bucket histograms** (cumulative bucket counts + sum + count
+— p50/p99 derivable without storing samples, the Prometheus histogram
+model).  ``paddle_tpu.observability.metrics`` exports all three in
+Prometheus text format and as periodic JSONL snapshots.  High-frequency
+observation sites (per-RPC, per-request, per-step) gate themselves on
+:func:`metrics_enabled` (``PADDLE_METRICS=1`` or
+:func:`enable_metrics`) so the clean path stays untouched by default;
+rare-event counters/gauges (retries, failovers, guard skips) always
+record.
 """
 from __future__ import annotations
 
+import bisect
+import os
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
-__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "get_all_stats", "stats_with_prefix", "device_memory_stats",
+__all__ = ["StatRegistry", "Histogram", "stat_add", "stat_get",
+           "stat_reset", "get_all_stats", "stats_with_prefix",
+           "gauge_set", "gauge_add", "gauge_get", "hist_observe",
+           "get_histogram", "metrics_snapshot", "metrics_reset",
+           "metrics_enabled", "enable_metrics", "device_memory_stats",
            "max_memory_allocated", "memory_allocated"]
 
 _lock = threading.Lock()
+
+# opt-in switch for high-frequency metric observation sites
+_metrics_on = os.environ.get("PADDLE_METRICS", "0") == "1"
+
+
+def metrics_enabled() -> bool:
+    return _metrics_on
+
+
+def enable_metrics(on: bool = True):
+    global _metrics_on
+    _metrics_on = bool(on)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus model): per-bucket counts over
+    static upper bounds plus an overflow bucket, running sum and count.
+    Quantiles interpolate within the containing bucket — no per-sample
+    storage, O(len(buckets)) memory forever."""
+
+    # bounds chosen for millisecond latencies: 100us .. 10s
+    DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                       100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                       10000.0)
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        self.bounds = tuple(sorted(float(b) for b in
+                                   (buckets or self.DEFAULT_BUCKETS)))
+        self.counts = [0] * (len(self.bounds) + 1)   # [-1] = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float):
+        v = float(value)
+        # bisect_left: bucket upper bounds are INCLUSIVE (le semantics)
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def percentile(self, q: float) -> float:
+        """Quantile estimate (q in [0, 100]) by linear interpolation
+        inside the containing bucket; the overflow bucket clamps to its
+        lower bound (no upper bound exists to interpolate toward)."""
+        if self.count == 0:
+            return 0.0
+        rank = (q / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.bounds):         # overflow bucket
+                    return self.bounds[-1]
+                lo = 0.0 if i == 0 else self.bounds[i - 1]
+                hi = self.bounds[i]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self.bounds[-1]
+
+    def snapshot(self) -> Dict:
+        cum, buckets = 0, []
+        for i, b in enumerate(self.bounds):
+            cum += self.counts[i]
+            buckets.append([b, cum])
+        return {"buckets": buckets, "sum": self.sum,
+                "count": self.count}
 
 
 class StatRegistry:
     """Named monotonic/settable int64 counters (parity:
     platform/monitor.h:77; one global instance like the reference's
-    singleton)."""
+    singleton), plus float gauges and fixed-bucket histograms (ISSUE 5
+    observability extension)."""
 
     def __init__(self):
         self._stats: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
 
     def add(self, name: str, delta: int = 1) -> int:
         with _lock:
@@ -52,6 +141,53 @@ class StatRegistry:
     def snapshot(self) -> Dict[str, int]:
         with _lock:
             return dict(self._stats)
+
+    # -- gauges ---------------------------------------------------------
+    def gauge_set(self, name: str, value: float) -> float:
+        with _lock:
+            v = float(value)
+            self._gauges[name] = v
+            return v
+
+    def gauge_add(self, name: str, delta: float = 1.0) -> float:
+        with _lock:
+            v = self._gauges.get(name, 0.0) + float(delta)
+            self._gauges[name] = v
+            return v
+
+    def gauge_get(self, name: str, default: float = 0.0) -> float:
+        with _lock:
+            return self._gauges.get(name, default)
+
+    # -- histograms -----------------------------------------------------
+    def observe(self, name: str, value: float,
+                buckets: Optional[Sequence[float]] = None):
+        with _lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(buckets)
+            h.observe(value)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with _lock:
+            return self._hists.get(name)
+
+    def metrics_snapshot(self) -> Dict:
+        """Point-in-time view of all three metric families — what the
+        Prometheus exposition and the JSONL flusher render."""
+        with _lock:
+            return {
+                "counters": dict(self._stats),
+                "gauges": dict(self._gauges),
+                "histograms": {n: h.snapshot()
+                               for n, h in self._hists.items()},
+            }
+
+    def metrics_reset(self):
+        with _lock:
+            self._stats.clear()
+            self._gauges.clear()
+            self._hists.clear()
 
 
 _registry = StatRegistry()
@@ -80,6 +216,38 @@ def stats_with_prefix(prefix: str) -> Dict[str, int]:
     the monitoring surface a dashboard scrapes per subsystem."""
     return {k: v for k, v in _registry.snapshot().items()
             if k.startswith(prefix)}
+
+
+def gauge_set(name: str, value: float) -> float:
+    return _registry.gauge_set(name, value)
+
+
+def gauge_add(name: str, delta: float = 1.0) -> float:
+    return _registry.gauge_add(name, delta)
+
+
+def gauge_get(name: str, default: float = 0.0) -> float:
+    return _registry.gauge_get(name, default)
+
+
+def hist_observe(name: str, value: float,
+                 buckets: Optional[Sequence[float]] = None):
+    """Record one sample into the named fixed-bucket histogram (created
+    on first observe; ``buckets`` only applies then)."""
+    _registry.observe(name, value, buckets)
+
+
+def get_histogram(name: str) -> Optional[Histogram]:
+    return _registry.histogram(name)
+
+
+def metrics_snapshot() -> Dict:
+    return _registry.metrics_snapshot()
+
+
+def metrics_reset():
+    """Clear counters, gauges and histograms (tests / fresh scrape)."""
+    _registry.metrics_reset()
 
 
 def device_memory_stats(device=None) -> Dict[str, int]:

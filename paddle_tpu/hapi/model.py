@@ -15,8 +15,10 @@ from typing import List
 import numpy as np
 
 from ..framework.core import Tensor, no_grad
+from ..framework.monitor import gauge_get
 from ..metric import Metric
 from ..nn.layer.layers import Layer
+from ..observability.timeline import StepTimeline
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -57,6 +59,11 @@ class Model:
         self._guard = None          # train_guard.TrainGuard (prepare())
         self._guard_step = 0
         self.last_guard_verdict = None
+        # step timeline (ISSUE 5): data_wait/h2d/dispatch/optimizer
+        # phases for the fit loop; no-op unless PADDLE_TRACE/
+        # PADDLE_METRICS opted in
+        self._obs_tl = StepTimeline("fit")
+        self._obs_step = 0
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -154,23 +161,40 @@ class Model:
         assert self._optimizer is not None, \
             "model not ready, please call `model.prepare()` first"
         self.network.train()
-        inputs = [Tensor(x) if not isinstance(x, Tensor) else x
-                  for x in _to_list(inputs)]
-        labels = [Tensor(y) if not isinstance(y, Tensor) else y
-                  for y in _to_list(labels)]
+        tl = self._obs_tl
+        with tl.phase("h2d"):
+            inputs = [Tensor(x) if not isinstance(x, Tensor) else x
+                      for x in _to_list(inputs)]
+            labels = [Tensor(y) if not isinstance(y, Tensor) else y
+                      for y in _to_list(labels)]
         inputs = self._chaos_batch(inputs)
-        outputs = self._run_forward(inputs)
-        outputs = self._chaos_activation(outputs)
-        loss = self._compute_loss(outputs, labels)
-        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
+        with tl.phase("dispatch"):
+            outputs = self._run_forward(inputs)
+            outputs = self._chaos_activation(outputs)
+            loss = self._compute_loss(outputs, labels)
+            (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
-            if self._guard is not None:
-                self.last_guard_verdict = self._guard.step(
-                    loss, step=self._guard_step,
-                    optimizer=self._optimizer)
-            else:
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+            with tl.phase("optimizer"):
+                if self._guard is not None:
+                    # fit holds the batch, so blame needs no caller
+                    # hook: the default blame_fn bisects THESE rows
+                    # (an explicit guard.blame_fn still overrides)
+                    n_rows = None
+                    for x in inputs:
+                        shape = getattr(x, "shape", None)
+                        if shape:
+                            n_rows = int(shape[0])
+                            break
+                    bf = (self._guard.blame_fn
+                          or self._default_blame_fn(inputs, labels,
+                                                    n_rows))
+                    self.last_guard_verdict = self._guard.step(
+                        loss, step=self._guard_step,
+                        optimizer=self._optimizer,
+                        blame_fn=bf, n_rows=n_rows)
+                else:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
             self._guard_step += 1
         metrics = []
         with no_grad():
@@ -179,6 +203,37 @@ class Model:
                 metric.update(*_to_list(res))
                 metrics.append(metric.accumulate())
         return [_to_numpy(loss)], metrics
+
+    def _default_blame_fn(self, inputs, labels, n_rows):
+        """Row-sliced finiteness probe for TrainGuard batch blame
+        (ROADMAP open item): recompute forward+loss on a row subset of
+        the batch ``fit`` is holding and report the sub-batch healthy
+        iff the loss is finite.  Runs under ``no_grad`` in eval mode —
+        a skipped step must not advance BN running stats either."""
+        def _healthy(rows) -> bool:
+            rows = np.asarray(rows)
+
+            def take(t):
+                v = np.asarray(t._value)
+                if v.ndim >= 1 and n_rows and v.shape[0] == n_rows:
+                    return Tensor(v[rows])
+                return t        # non-batched leaf rides whole
+
+            sl_in = [take(x) for x in inputs]
+            sl_lb = [take(y) for y in labels]
+            was_training = getattr(self.network, "training", True)
+            self.network.eval()
+            try:
+                with no_grad():
+                    out = self._run_forward(sl_in)
+                    loss = self._compute_loss(out, sl_lb)
+                lv = np.asarray(loss._value if isinstance(loss, Tensor)
+                                else loss)
+                return bool(np.all(np.isfinite(lv)))
+            finally:
+                if was_training:
+                    self.network.train()
+        return _healthy
 
     def _eval_batch_impl(self, inputs, labels):
         """Returns (losses, metrics); losses is [] when loss=None."""
@@ -287,37 +342,72 @@ class Model:
         except TypeError:
             steps = None
         pending_update = False
-        for step, batch in enumerate(loader):
-            inputs, labels = self._split_batch(batch)
-            cbks.on_batch_begin(mode, step, logs)
-            if mode == "train":
-                # force the tail update so end-of-epoch gradients are
-                # never dropped (reference fit: `or step+1 == steps`)
-                update = ((step + 1) % accumulate_grad_batches == 0
-                          or (steps is not None and step + 1 == steps)
-                          or (num_iters is not None
-                              and step + 1 >= num_iters))
-                losses, metrics = self._train_batch_impl(
-                    inputs, labels, update=update,
-                    loss_scale=1.0 / accumulate_grad_batches)
-                pending_update = not update
-            else:
-                losses, metrics = self._eval_batch_impl(inputs, labels)
-            if losses:
-                logs["loss"] = float(np.asarray(losses[0]).reshape(-1)[0])
-            for m, res in zip(self._metrics, metrics):
-                for n, v in zip(_to_list(m.name()), _to_list(res)):
-                    logs[n] = v
-            bsz = None
-            for x in inputs:
-                shape = getattr(x, "shape", None)
-                if shape:
-                    bsz = shape[0]
+        tl = self._obs_tl
+        is_train = mode == "train"
+        it = iter(loader)
+        stop = object()
+        step = 0
+        while True:
+            # the step-timeline scope opens BEFORE the batch fetch so
+            # the data_wait phase (input pipeline stall) is attributed
+            # to the step it delays; eval stays uninstrumented
+            scope = tl.step(self._obs_step) if is_train else None
+            if scope is not None:
+                scope.__enter__()
+                self._obs_step += 1
+            try:
+                if is_train:
+                    with tl.phase("data_wait"):
+                        batch = next(it, stop)
+                else:
+                    batch = next(it, stop)
+                if batch is stop:
                     break
-            logs["batch_size"] = bsz or 1
-            cbks.on_batch_end(mode, step, logs)
+                inputs, labels = self._split_batch(batch)
+                cbks.on_batch_begin(mode, step, logs)
+                if is_train:
+                    # force the tail update so end-of-epoch gradients
+                    # are never dropped (reference fit:
+                    # `or step+1 == steps`)
+                    update = ((step + 1) % accumulate_grad_batches == 0
+                              or (steps is not None and step + 1 == steps)
+                              or (num_iters is not None
+                                  and step + 1 >= num_iters))
+                    losses, metrics = self._train_batch_impl(
+                        inputs, labels, update=update,
+                        loss_scale=1.0 / accumulate_grad_batches)
+                    pending_update = not update
+                else:
+                    losses, metrics = self._eval_batch_impl(inputs, labels)
+                if losses:
+                    logs["loss"] = float(
+                        np.asarray(losses[0]).reshape(-1)[0])
+                for m, res in zip(self._metrics, metrics):
+                    for n, v in zip(_to_list(m.name()), _to_list(res)):
+                        logs[n] = v
+                bsz = None
+                for x in inputs:
+                    shape = getattr(x, "shape", None)
+                    if shape:
+                        bsz = shape[0]
+                        break
+                logs["batch_size"] = bsz or 1
+                if is_train and self._guard is not None:
+                    # guard verdict counters ride the logs into ProgBar
+                    # and every callback (ROADMAP open item), read from
+                    # the metrics gauges the guard maintains
+                    logs["guard_skips"] = int(gauge_get("guard_skips"))
+                    logs["guard_rewinds"] = int(
+                        gauge_get("guard_rewinds"))
+                    logs["guard_blamed_rows"] = int(
+                        gauge_get("guard_blamed_rows"))
+                cbks.on_batch_end(mode, step, logs)
+            finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
             if num_iters is not None and step + 1 >= num_iters:
                 break
+            step += 1
         if pending_update:
             # length-less loader: epoch end reached with grads pending
             self._optimizer.step()

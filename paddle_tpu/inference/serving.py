@@ -37,6 +37,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..framework import monitor as _monitor
+from ..observability import trace as _trace
+
 __all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
            "ServerClosed", "RequestTimeout"]
 
@@ -243,9 +246,12 @@ class PredictorServer:
         except _queue.Full:
             with self._lock:
                 self._stats["shed_overload"] += 1
+            _monitor.stat_add("serve_shed_overload")
             raise ServerOverloaded(
                 f"queue depth cap {self._q.maxsize} reached; request "
                 "shed — back off and retry") from None
+        if _monitor.metrics_enabled():
+            _monitor.gauge_set("serve_queue_depth", self._q.qsize())
         return req.future
 
     def infer(self, inputs: Sequence[np.ndarray],
@@ -321,6 +327,7 @@ class PredictorServer:
             if t0 > r.deadline:
                 with self._lock:
                     self._stats["shed_timeout"] += 1
+                _monitor.stat_add("serve_shed_timeout")
                 r.future.set_exception(RequestTimeout(
                     "request spent its whole deadline queued — server "
                     "overloaded"))
@@ -332,31 +339,42 @@ class PredictorServer:
         rows = sum(r.n for r in live)
         bucket = self._bucket_for(rows)
         pad = bucket - rows
+        batch_sp = (_trace.Span("serve.batch", cat="serve",
+                                bucket=bucket, rows=rows,
+                                requests=len(live))
+                    if _trace.enabled() else None)
+        if batch_sp is not None:
+            batch_sp.__enter__()
+        try:
+            n_in = len(live[0].arrays)
+            padded = []
+            for i in range(n_in):
+                parts = [r.arrays[i] for r in live]
+                if pad:
+                    # pad with copies of the first row: REAL data, so a
+                    # model with input-dependent control ranges (log/
+                    # gather/embedding lookups) never sees out-of-domain
+                    # zeros in the dead rows
+                    fill = np.broadcast_to(
+                        parts[0][:1], (pad,) + parts[0].shape[1:])
+                    parts = parts + [fill]
+                padded.append(np.concatenate(parts, axis=0)
+                              if len(parts) > 1 else parts[0])
+            t1 = time.monotonic()
 
-        n_in = len(live[0].arrays)
-        padded = []
-        for i in range(n_in):
-            parts = [r.arrays[i] for r in live]
-            if pad:
-                # pad with copies of the first row: REAL data, so a
-                # model with input-dependent control ranges (log/
-                # gather/embedding lookups) never sees out-of-domain
-                # zeros in the dead rows
-                fill = np.broadcast_to(
-                    parts[0][:1], (pad,) + parts[0].shape[1:])
-                parts = parts + [fill]
-            padded.append(np.concatenate(parts, axis=0)
-                          if len(parts) > 1 else parts[0])
-        t1 = time.monotonic()
+            outs = self._pred.run(padded)
+            t2 = time.monotonic()
 
-        outs = self._pred.run(padded)
-        t2 = time.monotonic()
-
-        off = 0
-        for r in live:
-            r.future.set_result([o[off:off + r.n] for o in outs])
-            off += r.n
-        t3 = time.monotonic()
+            off = 0
+            for r in live:
+                r.future.set_result([o[off:off + r.n] for o in outs])
+                off += r.n
+            t3 = time.monotonic()
+        finally:
+            # a failed run must still close the span, or the batcher
+            # thread's span stack would mis-parent every later batch
+            if batch_sp is not None:
+                batch_sp.__exit__(None, None, None)
 
         with self._lock:
             s = self._stats
@@ -369,3 +387,13 @@ class PredictorServer:
             s["pad_ms"] += (t1 - t0) * 1e3
             s["run_ms"] += (t2 - t1) * 1e3
             s["unpad_ms"] += (t3 - t2) * 1e3
+        if _monitor.metrics_enabled():
+            # per-request end-to-end latency + queue-age histograms;
+            # the p50/p99 a serving dashboard actually alerts on
+            for r in live:
+                _monitor.hist_observe("serve_latency_ms",
+                                      (t3 - r.t_submit) * 1e3)
+            _monitor.hist_observe("serve_queue_ms",
+                                  queue_s / len(live) * 1e3)
+            _monitor.stat_add("serve_bucket_hits")
+            _monitor.gauge_set("serve_queue_depth", self._q.qsize())

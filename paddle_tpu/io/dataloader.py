@@ -20,6 +20,7 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from ..framework import monitor as _monitor
 from ..framework.core import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
@@ -345,8 +346,21 @@ class DataLoader:
         t = threading.Thread(target=feeder, daemon=True)
         t.start()
         try:
+            import time as _time
             while True:
-                item = q.get()
+                if _monitor.metrics_enabled():
+                    # data-wait: how long the consumer blocks on the
+                    # prefetch queue — nonzero p50 here means the input
+                    # pipeline, not the device, bounds the step
+                    _monitor.gauge_set("dataloader_queue_depth",
+                                       q.qsize())
+                    t0 = _time.perf_counter()
+                    item = q.get()
+                    _monitor.hist_observe(
+                        "dataloader_wait_ms",
+                        (_time.perf_counter() - t0) * 1e3)
+                else:
+                    item = q.get()
                 if item is stop:
                     break
                 if isinstance(item, Exception):

@@ -1,0 +1,57 @@
+"""paddle_tpu.observability — unified tracing, metrics and timelines.
+
+The ISSUE 5 subsystem, three pillars over one design rule (everything
+off by default, opt-in by env, ~zero cost when off):
+
+1. **Cross-process tracing** (:mod:`.trace`): ``Span`` trees with
+   trace/span-id propagation stamped through the PS RPC frame header,
+   per-process JSONL sinks, clock-offset samples from RPC round trips;
+   ``tools/trace_merge.py`` fuses the sinks into one Chrome/Perfetto
+   trace where a trainer's ``ps.client.push`` span contains the
+   server's ``ps.server.push`` apply span.
+2. **Metrics** (:mod:`.metrics` over the
+   :mod:`~paddle_tpu.framework.monitor` StatRegistry): counters,
+   gauges and fixed-bucket histograms from the hot seams (PS retries/
+   failovers, serving queue/latency, DataLoader prefetch, TrainGuard
+   verdicts), exported as a Prometheus ``/metrics`` endpoint and/or a
+   periodic JSONL flusher.
+3. **Step timeline** (:mod:`.timeline`): per-step phase attribution
+   (data wait / h2d / dispatch / health fetch / host) with
+   ``trace_every=N`` sampling.
+
+Env quick reference::
+
+    PADDLE_TRACE=1  PADDLE_TRACE_DIR=... PADDLE_TRACE_ROLE=...
+    PADDLE_TRACE_EVERY=16
+    PADDLE_METRICS=1  PADDLE_METRICS_PORT=9464  PADDLE_METRICS_FILE=...
+
+Importable without jax (PS server subprocesses stay lightweight).
+"""
+from __future__ import annotations
+
+from ..framework.monitor import (  # noqa: F401
+    Histogram, enable_metrics, gauge_add, gauge_get, gauge_set,
+    get_histogram, hist_observe, metrics_enabled, metrics_reset,
+    metrics_snapshot, stat_add, stat_get)
+from . import metrics, timeline, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsFlusher, MetricsServer, prometheus_text, start_metrics_server)
+from .timeline import StepTimeline  # noqa: F401
+from .trace import (  # noqa: F401
+    Span, disable as disable_tracing, enable as enable_tracing, enabled
+    as tracing_enabled, propagation_ctx, record_clock, server_span, span)
+
+__all__ = [
+    "trace", "metrics", "timeline",
+    "Span", "span", "server_span", "propagation_ctx", "record_clock",
+    "enable_tracing", "disable_tracing", "tracing_enabled",
+    "StepTimeline", "Histogram",
+    "MetricsServer", "MetricsFlusher", "prometheus_text",
+    "start_metrics_server",
+    "enable_metrics", "metrics_enabled", "metrics_snapshot",
+    "metrics_reset", "gauge_set", "gauge_add", "gauge_get",
+    "hist_observe", "get_histogram", "stat_add", "stat_get",
+]
+
+# honour PADDLE_METRICS / PADDLE_METRICS_PORT / PADDLE_METRICS_FILE
+metrics.enable_from_env()

@@ -1,0 +1,212 @@
+"""Metrics export: Prometheus text exposition + periodic JSONL flusher.
+
+The collection side lives in :mod:`paddle_tpu.framework.monitor` (the
+StatRegistry singleton, extended with gauges and fixed-bucket
+histograms); this module is the EXPORT side:
+
+- :func:`prometheus_text` renders the registry in Prometheus text
+  exposition format 0.0.4 (counters, gauges, and le-bucketed
+  histograms with ``_sum``/``_count``), names sanitized and prefixed
+  ``paddle_``;
+- :class:`MetricsServer` serves it at ``GET /metrics`` from a
+  background ``ThreadingHTTPServer`` — point a Prometheus scrape job at
+  ``http://host:port/metrics``;
+- :class:`MetricsFlusher` appends timestamped registry snapshots to a
+  JSONL file on a fixed cadence — the zero-infrastructure alternative
+  when no scraper exists (same spirit as the VisualDL callback).
+
+Opt-in (everything off by default)::
+
+    PADDLE_METRICS=1           enable high-frequency observation sites
+    PADDLE_METRICS_PORT=9464   also serve /metrics on this port
+    PADDLE_METRICS_FILE=path   also flush snapshots to this JSONL file
+    PADDLE_METRICS_FLUSH_S=10  flusher cadence (seconds)
+
+Must stay importable without jax (PS server subprocesses).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+from ..framework import monitor as _monitor
+
+__all__ = ["prometheus_text", "MetricsServer", "MetricsFlusher",
+           "start_metrics_server", "enable_from_env"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _NAME_RE.sub("_", str(name))
+    if not n.startswith("paddle_"):
+        n = "paddle_" + n
+    if n[len("paddle_"):][:1].isdigit():
+        n = "paddle_m" + n[len("paddle_"):]
+    return n
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(snapshot: Optional[Dict] = None) -> str:
+    """Render a registry snapshot (default: the live registry) as
+    Prometheus text exposition format."""
+    snap = snapshot if snapshot is not None \
+        else _monitor.metrics_snapshot()
+    lines = []
+    for name in sorted(snap.get("counters", {})):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {})):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(snap['gauges'][name])}")
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for le, cum in h["buckets"]:
+            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {repr(float(h['sum']))}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """``GET /metrics`` endpoint over the live registry.
+
+    ::
+
+        srv = MetricsServer(port=0).start()   # 0 = ephemeral
+        requests.get(f"http://127.0.0.1:{srv.port}/metrics")
+        srv.stop()
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._want_port = int(port)
+        self._host = host
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):            # noqa: N802 (stdlib API name)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stdout
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                          _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-metrics",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class MetricsFlusher:
+    """Append a timestamped registry snapshot to ``path`` every
+    ``interval_s`` seconds (and once at :meth:`stop`)."""
+
+    def __init__(self, path: str, interval_s: float = 10.0):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def flush_once(self):
+        rec = {"ts_us": time.time_ns() // 1000, "pid": os.getpid()}
+        rec.update(_monitor.metrics_snapshot())
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.flush_once()
+
+    def start(self) -> "MetricsFlusher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-metrics-flush",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.flush_once()
+
+
+_env_server: Optional[MetricsServer] = None
+_env_flusher: Optional[MetricsFlusher] = None
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "0.0.0.0") -> MetricsServer:
+    return MetricsServer(port=port, host=host).start()
+
+
+def enable_from_env():
+    """Honour the PADDLE_METRICS* env knobs (called at package import;
+    idempotent).  PADDLE_METRICS=1 alone only flips the collection
+    switch — the exporters need an explicit port/file."""
+    global _env_server, _env_flusher
+    if os.environ.get("PADDLE_METRICS", "0") == "1":
+        _monitor.enable_metrics(True)
+    port = os.environ.get("PADDLE_METRICS_PORT")
+    if port and _env_server is None:
+        try:
+            _env_server = start_metrics_server(int(port))
+        except OSError:          # port taken: metrics must never kill
+            _env_server = None   # the training job
+    path = os.environ.get("PADDLE_METRICS_FILE")
+    if path and _env_flusher is None:
+        _env_flusher = MetricsFlusher(
+            path, float(os.environ.get("PADDLE_METRICS_FLUSH_S",
+                                       "10") or 10)).start()

@@ -1,0 +1,123 @@
+"""Step timeline: attribute each training step to phases.
+
+"Why was step 4812 slow" decomposes into a handful of host-side phases
+— waiting on the DataLoader, staging the batch to device, dispatching
+the compiled step, fetching guard health, and the leftover host work
+(param rebinds, callbacks).  :class:`StepTimeline` measures those
+phases at the two loops that own them (``DistributedTrainStep.__call__``
+and the hapi fit loop) and emits them two ways:
+
+- **spans** (``step`` root + ``step.<phase>`` children) into the trace
+  sink — but only on SAMPLED steps (``trace_every=N``, env
+  ``PADDLE_TRACE_EVERY``): a clean-path step on the llama proxy is
+  ~8 ms, so tracing every step would spend a measurable fraction of it
+  serializing JSON; sampling 1/N keeps the overhead ≤1% while still
+  catching every systematic stall;
+- **histograms** (``step_<phase>_ms`` in the StatRegistry) on EVERY
+  step while metrics are enabled — p50/p99 per phase without storing
+  samples, the always-on production signal.
+
+Both off -> a phase costs one attribute check and no clock read.
+"""
+from __future__ import annotations
+
+from time import perf_counter_ns
+from typing import Optional
+
+from ..framework import monitor as _monitor
+from . import trace as _trace
+
+__all__ = ["StepTimeline"]
+
+
+class _NullPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullPhase()
+
+
+class _Phase:
+    __slots__ = ("_name", "_hist", "_span", "_t0")
+
+    def __init__(self, name: str, hist: bool, span):
+        self._name = name
+        self._hist = hist
+        self._span = span
+        self._t0 = 0
+
+    def __enter__(self):
+        if self._span is not None:
+            self._span.__enter__()
+        self._t0 = perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ms = (perf_counter_ns() - self._t0) / 1e6
+        if self._span is not None:
+            self._span.__exit__(*exc)
+        if self._hist:
+            _monitor.hist_observe(f"step_{self._name}_ms", dur_ms)
+        return False
+
+
+class _StepScope:
+    __slots__ = ("_span",)
+
+    def __init__(self, span):
+        self._span = span
+
+    def __enter__(self):
+        if self._span is not None:
+            self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._span is not None:
+            self._span.__exit__(*exc)
+        return False
+
+
+class StepTimeline:
+    """Per-loop phase attributor.
+
+    ::
+
+        tl = StepTimeline("train")
+        with tl.step(i):
+            with tl.phase("data_wait"): batch = next(it)
+            with tl.phase("dispatch"):  loss = step(*batch)
+    """
+
+    def __init__(self, name: str = "step", every: Optional[int] = None):
+        self.name = name
+        self._every = every        # None -> follow PADDLE_TRACE_EVERY
+        self._sampled = False      # current step emits spans?
+
+    def _period(self) -> int:
+        return self._every if self._every else _trace.trace_every()
+
+    def step(self, step_i: int):
+        """Scope for one whole step.  Decides the sampling verdict every
+        phase of this step inherits."""
+        self._sampled = (_trace.enabled()
+                         and step_i % self._period() == 0)
+        if not self._sampled:
+            return _NULL
+        return _StepScope(_trace.Span(self.name, cat="step",
+                                      step=int(step_i)))
+
+    def phase(self, name: str):
+        """Scope for one phase of the current step."""
+        hist = _monitor.metrics_enabled()
+        if not (hist or self._sampled):
+            return _NULL
+        sp = (_trace.Span(f"{self.name}.{name}", cat="step")
+              if self._sampled else None)
+        return _Phase(name, hist, sp)

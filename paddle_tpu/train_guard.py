@@ -49,8 +49,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .framework import monitor as _monitor
 from .framework.errors import EnforceNotMet
-from .framework.monitor import stat_add, stat_get
+from .framework.monitor import gauge_set, stat_add, stat_get
+from .observability import trace as _obs_trace
 
 __all__ = ["TrainGuard", "HealthState", "NumericalDivergence",
            "health_check", "fused_health", "chaos_corrupt",
@@ -127,6 +129,18 @@ def host_sync_count() -> int:
 def _host_fetch(dev_arr) -> np.ndarray:
     global _host_syncs
     _host_syncs += 1
+    if _obs_trace.enabled() or _monitor.metrics_enabled():
+        # the funnel doubles as the step timeline's "health fetch"
+        # phase: this transfer is the guard's only device sync, so its
+        # duration IS the time the host stalls on guard state
+        import time as _time
+        with _obs_trace.span("step.health_fetch", cat="step"):
+            t0 = _time.perf_counter()
+            out = np.asarray(dev_arr)
+        if _monitor.metrics_enabled():
+            _monitor.hist_observe("step_health_fetch_ms",
+                                  (_time.perf_counter() - t0) * 1e3)
+        return out
     return np.asarray(dev_arr)
 
 
@@ -323,12 +337,17 @@ class TrainGuard:
                  restore_fn=None, scaler=None, window: int = 32,
                  min_history: int = 8, spike_factor: float = 10.0,
                  mad_floor: float = 1e-3, max_consecutive_bad: int = 3,
-                 rewind_budget: int = 2, checkpoint_every: int = 1):
+                 rewind_budget: int = 2, checkpoint_every: int = 1,
+                 blame_fn: Optional[Callable] = None):
         self.optimizer = optimizer
         self.manager = manager
         self.state_fn = state_fn
         self.restore_fn = restore_fn
         self.scaler = scaler
+        # default blame hook: hapi's fit loop passes its own row-slicing
+        # blame_fn per batch UNLESS this explicit override is set (the
+        # PR 4 caller-provided contract, kept)
+        self.blame_fn = blame_fn
         self.window = int(window)
         self.min_history = int(min_history)
         self.spike_factor = float(spike_factor)
@@ -344,6 +363,10 @@ class TrainGuard:
         self.last_healthy_step: Optional[int] = None
         self.skips = 0
         self.rewinds = 0
+        # gauges mirror THIS guard's live counts (hapi/ProgBar read
+        # them); a fresh guard zeroes the previous run's values
+        for k in GUARD_STAT_NAMES:
+            gauge_set(k, 0)
         self.blamed_rows: List = []          # (step, [row indices])
         self.events: List[Dict] = []         # audit log of skip/rewind
         self.last_health: Optional[HealthState] = None
@@ -430,6 +453,7 @@ class TrainGuard:
         self.restore_fn(state)
         self.rewinds += 1
         stat_add("guard_rewinds")
+        gauge_set("guard_rewinds", self.rewinds)
         self.events.append({"step": at_step, "reason": "rewind",
                             "to_step": target})
         # the diverged region poisoned the rolling window; restart it
@@ -463,6 +487,8 @@ class TrainGuard:
         if bad:
             self.blamed_rows.append((step, sorted(bad)))
             stat_add("guard_blamed_rows", len(bad))
+        gauge_set("guard_blamed_rows",
+                  sum(len(r) for _, r in self.blamed_rows))
         return sorted(bad)
 
     def step(self, loss=None, step: Optional[int] = None,
@@ -478,6 +504,8 @@ class TrainGuard:
         "rewind": state restored to the last healthy checkpoint
         """
         opt = optimizer or self.optimizer
+        if blame_fn is None:
+            blame_fn = self.blame_fn         # explicit ctor override
         if opt is not None:
             _corrupt_optimizer_grads(opt)    # deterministic chaos hook
         if health is None:
@@ -512,6 +540,7 @@ class TrainGuard:
             return verdict
         self.skips += 1
         stat_add("guard_skips")
+        gauge_set("guard_skips", self.skips)
         if blame_fn is not None and n_rows:
             self.blame(blame_fn, n_rows, step=step)
         return verdict

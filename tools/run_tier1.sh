@@ -4,20 +4,27 @@
 # passed alone, failed in the combined suite) fails this script and
 # therefore can't ship again.
 #
-# Usage: tools/run_tier1.sh [--chaos] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--chaos] [--trace] [extra pytest args...]
 #        --chaos additionally runs the fault-injection suite (chaos
 #        harness + PS fault tolerance + crash-mid-save) as a third
 #        pass with its fixed, deterministic seeds
+#        --trace additionally runs the whole suite with PADDLE_TRACE=1
+#        PADDLE_METRICS=1 (sinks into a temp dir) — proving always-on
+#        telemetry neither breaks determinism nor leaks sink files
 # Env:   TIER1_SHUFFLE_SEED  fix the shuffle (default: date-derived,
 #                            printed so a red run is reproducible)
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 CHAOS=0
-if [ "${1:-}" = "--chaos" ]; then
-    CHAOS=1
-    shift
-fi
+TRACE=0
+while :; do
+    case "${1:-}" in
+        --chaos) CHAOS=1; shift ;;
+        --trace) TRACE=1; shift ;;
+        *) break ;;
+    esac
+done
 
 PYARGS=(-q -m 'not slow' --continue-on-collection-errors
         -p no:cacheprovider -p no:xdist "$@")
@@ -62,8 +69,33 @@ if [ "$CHAOS" -eq 1 ]; then
     rc3=$?
 fi
 
-echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3"
-if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ]; then
+rc4=0
+if [ "$TRACE" -eq 1 ]; then
+    # telemetry-on pass (ISSUE 5): same suite, tracing + metrics live.
+    # Red here means telemetry perturbs training math or test state;
+    # stray sink files outside the temp dir mean a test wrote its sink
+    # into the repo (a leak the default-off contract forbids).
+    echo "== tier-1 trace pass: PADDLE_TRACE=1 PADDLE_METRICS=1"
+    TRACE_DIR=$(mktemp -d -t tier1_trace.XXXXXX)
+    env JAX_PLATFORMS=cpu PADDLE_TRACE=1 PADDLE_METRICS=1 \
+        PADDLE_TRACE_DIR="$TRACE_DIR" \
+        python -m pytest tests/ "${PYARGS[@]}" -p no:randomly
+    rc4=$?
+    LEAKED=$(find . -maxdepth 2 -name 'trace-*.jsonl' -not -path \
+        './paddle_trace/*' 2>/dev/null; [ -d paddle_trace ] && echo \
+        paddle_trace)
+    if [ -n "$LEAKED" ]; then
+        echo "== trace pass leaked sink files into the repo:"
+        echo "$LEAKED"
+        rc4=1
+    fi
+    rm -rf "$TRACE_DIR"
+fi
+
+echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3," \
+     "trace rc=$rc4"
+if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ] \
+        || [ "$rc4" -ne 0 ]; then
     echo "== tier-1 FAILED (any pass being red fails the gate)"
     exit 1
 fi
