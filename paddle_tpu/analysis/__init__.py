@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — GraftLint: the static-analysis tier (ISSUE 6).
+
+Two pillars over one :class:`~paddle_tpu.analysis.findings.Finding`
+report format:
+
+- :mod:`~paddle_tpu.analysis.jaxpr_audit` — the jaxpr program auditor
+  (donation, dtype creep, host callbacks, collective inventory, baked
+  constants); surfaced as ``DistributedTrainStep.audit()`` and
+  ``Predictor.audit()``.
+- :mod:`~paddle_tpu.analysis.ast_lint` — the AST framework linter
+  (lock-ordering cycles of the PR 3 deadlock class, tracing hazards,
+  hot-path env reads); surfaced as ``tools/graft_lint.py`` and the
+  ``tools/run_tier1.sh --lint`` CI pass against
+  ``tools/lint_baseline.json``.
+
+This module imports jax-free (:mod:`.ast_lint` and :mod:`.findings`
+never touch jax; :mod:`.jaxpr_audit` imports it lazily inside the entry
+points) so the lint CLI stays cheap.
+"""
+from .findings import (Finding, SEV_ERROR, SEV_INFO,  # noqa: F401
+                       SEV_WARNING, apply_baseline, baseline_entry,
+                       format_findings, load_baseline)
+from .ast_lint import (DEFAULT_LINT_PATHS, LintConfig,  # noqa: F401
+                       lint_file, lint_paths, lint_source)
+from .jaxpr_audit import (AuditReport, audit_fn,  # noqa: F401
+                          audit_jaxpr, audit_traced,
+                          collective_inventory,
+                          hlo_collective_inventory)
+
+__all__ = [
+    "Finding", "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
+    "apply_baseline", "baseline_entry", "format_findings",
+    "load_baseline",
+    "LintConfig", "DEFAULT_LINT_PATHS", "lint_source", "lint_file",
+    "lint_paths",
+    "AuditReport", "audit_fn", "audit_traced", "audit_jaxpr",
+    "collective_inventory", "hlo_collective_inventory",
+]

@@ -1,0 +1,817 @@
+"""GraftLint pillar 2 — AST linter for concurrency and tracing hazards.
+
+Where :mod:`.jaxpr_audit` proves properties of the *compiled programs*,
+this module audits the *framework source*: the threaded modules (PS
+service, serving, heter, observability) for lock-ordering hazards of
+exactly the PR 3 deadlock class, and the jit-adjacent modules for
+tracing hazards (host syncs, impure time/random/env reads under trace).
+
+Lock analysis
+-------------
+Lock objects are discovered at their creation sites
+(``threading.Lock/RLock/Condition/Semaphore`` calls, including locks
+held in dict literals like ``rep = {"lock": threading.Lock()}`` and in
+list comprehensions).  Each function is then walked statement-by-
+statement with an abstract "held set": ``with lock:`` blocks and
+``.acquire()``/``.release()`` calls move locks in and out, and acquiring
+B while holding A records the edge ``A -> B``.  One interprocedural step
+propagates through same-module calls (``self._forward()`` under the
+apply lock contributes the locks ``_forward`` takes), which is exactly
+how the PR 3 ``_apply_lock`` vs replica-sink-lock deadlock arose.  A
+cycle in the resulting graph is ``lock.order-cycle``; an observed edge
+whose reverse is *declared* is the more specific
+``lock.order-violation``.
+
+Declarations and suppressions ride structured comments::
+
+    # lint: lock-order: PSServer._apply_lock -> rep[lock]
+    some_call()   # lint: ok(trace.host-sync) reason...
+
+Tracing hazards
+---------------
+Functions are "traced" when they are passed to ``jax.jit`` /
+``shard_map`` / ``jax.checkpoint`` / ``lax.cond``-style combinators
+(directly, via decorator, or transitively by being called from a traced
+function in the same module).  Inside traced code the rules flag:
+
+``trace.host-sync``     ``.item()/.tolist()``, ``np.asarray/np.array``,
+                        ``float()/int()/bool()`` on non-literals — each
+                        is a device->host sync per step (or a silent
+                        constant-folding of a traced value).
+``trace.impure-time``   ``time.time()/monotonic()/perf_counter()`` —
+                        baked in at trace time, frozen forever.
+``trace.impure-random`` stateful ``random``/``np.random`` — same.
+``trace.env-read``      ``os.environ``/``os.getenv`` — config frozen
+                        into the compiled program.
+
+Hot-path rules (outside traced code):
+
+``hot.env-read-loop``   an env read lexically inside a loop — per-step
+                        syscalls for what should be read once.
+``hot.host-sync-loop``  ``.item()`` inside a loop — a per-iteration
+                        device sync in eager host code.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import SEV_ERROR, SEV_WARNING, Finding
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "LintConfig",
+           "DEFAULT_LINT_PATHS"]
+
+# the repo module set the CLI and the clean-repo test lint by default:
+# the threaded modules (lock rules) + the hot-path/jit-adjacent modules
+# (tracing-hazard rules), per ISSUE 6
+DEFAULT_LINT_PATHS = (
+    "paddle_tpu/distributed/fleet/ps_service.py",
+    "paddle_tpu/distributed/fleet/heter.py",
+    "paddle_tpu/inference/serving.py",
+    "paddle_tpu/inference/__init__.py",
+    "paddle_tpu/observability/trace.py",
+    "paddle_tpu/observability/timeline.py",
+    "paddle_tpu/framework/monitor.py",
+    "paddle_tpu/distributed/fleet/dist_step.py",
+    "paddle_tpu/io/dataloader.py",
+    "paddle_tpu/train_guard.py",
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# Condition wraps an RLock; re-acquiring these is legal
+_REENTRANT_FACTORIES = {"RLock", "Condition"}
+
+_TRACER_ENTRY_FUNCS = {"jit", "checkpoint", "remat", "vmap", "pmap",
+                       "grad", "value_and_grad", "shard_map", "scan",
+                       "cond", "while_loop", "switch", "custom_jvp",
+                       "custom_vjp"}
+
+_DIRECTIVE_RE = re.compile(r"#\s*lint:\s*(.+)$")
+_OK_RE = re.compile(r"ok\(([^)]*)\)")
+_ORDER_RE = re.compile(r"lock-order:\s*(.+)$")
+
+
+@dataclass
+class LintConfig:
+    check_locks: bool = True
+    check_tracing: bool = True
+    check_hot: bool = True
+
+
+# ----------------------------------------------------------------------
+# directives
+# ----------------------------------------------------------------------
+
+def _parse_directives(src: str):
+    """-> (suppressions {lineno: set(rules)}, declared lock-order edges
+    [(a, b, lineno)])."""
+    suppress: Dict[int, Set[str]] = {}
+    declared: List[Tuple[str, str, int]] = []
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _DIRECTIVE_RE.search(line)
+        if not m:
+            continue
+        body = m.group(1).strip()
+        ok = _OK_RE.match(body)
+        if ok:
+            rules = {r.strip() for r in ok.group(1).split(",") if r.strip()}
+            suppress.setdefault(i, set()).update(rules or {"*"})
+            continue
+        order = _ORDER_RE.match(body)
+        if order:
+            chain = [p.strip() for p in order.group(1).split("->")]
+            for a, b in zip(chain, chain[1:]):
+                if a and b:
+                    declared.append((a, b, i))
+    return suppress, declared
+
+
+# ----------------------------------------------------------------------
+# expression canonicalization
+# ----------------------------------------------------------------------
+
+def _canon(expr, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted path of an expression, resolving simple local
+    aliases (``mon = self.monitor``) — None when it isn't a plain
+    name/attribute/subscript chain."""
+    if isinstance(expr, ast.Name):
+        return aliases.get(expr.id, expr.id)
+    if isinstance(expr, ast.Attribute):
+        base = _canon(expr.value, aliases)
+        return None if base is None else f"{base}.{expr.attr}"
+    if isinstance(expr, ast.Subscript):
+        base = _canon(expr.value, aliases)
+        if base is None:
+            return None
+        sl = expr.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                       (str, int)):
+            return f"{base}[{sl.value}]"
+        return f"{base}[]"
+    return None
+
+
+def _scope_name(canonical: str, class_name: Optional[str]) -> str:
+    """``self.x`` -> ``Class.x`` so the same attribute referenced from
+    different methods of one class lands on one graph node."""
+    if class_name and (canonical == "self"
+                       or canonical.startswith("self.")):
+        return class_name + canonical[4:]
+    return canonical
+
+
+def _lock_tail(name: str) -> str:
+    tail = name.rsplit(".", 1)[-1]
+    return tail.split("[", 1)[0]
+
+
+# ----------------------------------------------------------------------
+# per-function walk
+# ----------------------------------------------------------------------
+
+@dataclass
+class _FnInfo:
+    qualname: str
+    node: ast.AST
+    class_name: Optional[str]
+    acquires: Set[str] = field(default_factory=set)     # direct
+    edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    calls: List[Tuple[str, Tuple[str, ...], int]] = \
+        field(default_factory=list)   # (callee name, held-at-call, line)
+
+
+class _Module:
+    def __init__(self, path: str, src: str, config: LintConfig):
+        self.path = path
+        # stable display path for finding locs/baseline keys: the
+        # repo-relative tail when recognizable, else the basename —
+        # never cwd-relative (baseline keys must not depend on where
+        # the linter was invoked from)
+        norm = path.replace(os.sep, "/")
+        idx = norm.rfind("paddle_tpu/")
+        if idx < 0:
+            idx = norm.rfind("tests/")
+        if idx < 0:
+            idx = norm.rfind("tools/")
+        self.relpath = norm[idx:] if idx >= 0 else os.path.basename(norm)
+        self.src = src
+        self.tree = ast.parse(src)
+        self._parents = None
+        self.config = config
+        self.suppress, self.declared = _parse_directives(src)
+        self.findings: List[Finding] = []
+        self.locks: Dict[str, str] = {}       # canonical -> factory
+        self.fns: Dict[str, _FnInfo] = {}     # qualname -> info
+        self.by_name: Dict[str, List[str]] = {}  # bare name -> qualnames
+        self.traced: Set[str] = set()         # qualnames traced by jax
+
+    # -- finding emission ------------------------------------------------
+    def emit(self, severity, rule, scope, detail, line):
+        for sup_rules in (self.suppress.get(line, ()),):
+            if sup_rules and (rule in sup_rules or "*" in sup_rules):
+                return
+        self.findings.append(Finding(
+            severity, rule, f"{self.relpath}::{scope}", detail,
+            line=line))
+
+    # -- pass 0: function + lock discovery -------------------------------
+    def index(self):
+        for node, cls, qual in _walk_functions(self.tree):
+            info = _FnInfo(qual, node, cls)
+            self.fns[qual] = info
+            self.by_name.setdefault(node.name, []).append(qual)
+        for node in ast.walk(self.tree):
+            if _is_lock_factory(node):
+                name = self._lock_name_for(node)
+                if name:
+                    self.locks[name] = node.func.attr \
+                        if isinstance(node.func, ast.Attribute) \
+                        else node.func.id
+
+    def _lock_name_for(self, call: ast.Call) -> Optional[str]:
+        """Canonical name for a lock created at this call site, derived
+        from the assignment that stores it."""
+        if self._parents is None:
+            self._parents = _parent_map(self.tree)
+        parents = self._parents
+        node, key, in_container = call, None, False
+        while node in parents:
+            parent = parents[node]
+            if isinstance(parent, ast.Dict) and node in parent.values:
+                k = parent.keys[parent.values.index(node)]
+                if isinstance(k, ast.Constant):
+                    key = str(k.value)
+                in_container = True
+            elif isinstance(parent, (ast.List, ast.Tuple, ast.ListComp,
+                                     ast.comprehension)):
+                in_container = True
+            elif isinstance(parent, (ast.Assign, ast.AnnAssign)):
+                targets = parent.targets if isinstance(parent, ast.Assign) \
+                    else [parent.target]
+                for t in targets:
+                    base = _canon(t, {})
+                    if base is None:
+                        continue
+                    cls = _enclosing_class(parents, parent)
+                    base = _scope_name(base, cls)
+                    if key is not None:
+                        return f"{base}[{key}]"
+                    if in_container:
+                        return f"{base}[]"
+                    return base
+                return None
+            node = parent
+        return None
+
+    def _is_lock(self, canonical: Optional[str]) -> bool:
+        if canonical is None:
+            return False
+        if canonical in self.locks:
+            return True
+        tail = _lock_tail(canonical)
+        return any(_lock_tail(k) == tail for k in self.locks)
+
+    # -- pass 1: lock walk ----------------------------------------------
+    def analyze_locks(self):
+        for info in self.fns.values():
+            aliases: Dict[str, str] = {}
+            self._walk_stmts(list(_body_of(info.node)), [], info, aliases)
+
+        # interprocedural fixpoint: a function "acquires" everything its
+        # same-module callees acquire
+        total: Dict[str, Set[str]] = {q: set(i.acquires)
+                                      for q, i in self.fns.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.fns.items():
+                for callee, _, _ in info.calls:
+                    for cq in self.by_name.get(callee, ()):
+                        extra = total[cq] - total[q]
+                        if extra:
+                            total[q] |= extra
+                            changed = True
+        edges: List[Tuple[str, str, int, str]] = []
+        for q, info in self.fns.items():
+            for a, b, line in info.edges:
+                edges.append((a, b, line, q))
+            for callee, held, line in info.calls:
+                for cq in self.by_name.get(callee, ()):
+                    for a in held:
+                        for b in total[cq]:
+                            if a != b:
+                                edges.append((a, b, line,
+                                              f"{q} -> {callee}()"))
+        self._report_lock_graph(edges)
+
+    def _walk_stmts(self, stmts, held: List[str], info: _FnInfo,
+                    aliases: Dict[str, str]):
+        for st in stmts:
+            self._walk_stmt(st, held, info, aliases)
+
+    def _walk_stmt(self, st, held, info, aliases):
+        cls = info.class_name
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return      # nested defs are analyzed as their own functions
+        if isinstance(st, (ast.Assign, ast.AnnAssign)) :
+            value = st.value
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else ([st.target] if st.value else [])
+            if value is not None and len(targets) == 1 \
+                    and isinstance(targets[0], ast.Name):
+                rhs = _canon(value, aliases)
+                if rhs is not None:
+                    aliases[targets[0].id] = rhs
+            self._scan_calls(st, held, info, aliases)
+            return
+        if isinstance(st, ast.With):
+            pushed = []
+            for item in st.items:
+                ce = item.context_expr
+                lk = self._lock_of(ce, aliases, cls)
+                if lk is not None:
+                    self._acquire(lk, held, info, ce.lineno, cls)
+                    pushed.append(lk)
+                else:
+                    self._scan_calls(item.context_expr, held, info,
+                                     aliases)
+            self._walk_stmts(st.body, held, info, aliases)
+            for lk in reversed(pushed):
+                if lk in held:
+                    held.remove(lk)
+            return
+        if isinstance(st, ast.Try):
+            entry = list(held)
+            self._walk_stmts(st.body, held, info, aliases)
+            after_try = list(held)
+            for h in st.handlers:
+                held[:] = list(entry)
+                self._walk_stmts(h.body, held, info, aliases)
+            held[:] = after_try
+            self._walk_stmts(st.orelse, held, info, aliases)
+            fin_state = list(held)
+            self._walk_stmts(st.finalbody, held, info, aliases)
+            held[:] = fin_state
+            return
+        if isinstance(st, (ast.If, ast.For, ast.While, ast.AsyncFor)):
+            if hasattr(st, "test"):
+                self._scan_calls(st.test, held, info, aliases)
+            if hasattr(st, "iter"):
+                self._scan_calls(st.iter, held, info, aliases)
+            branch = list(held)
+            self._walk_stmts(st.body, branch, info, aliases)
+            branch2 = list(held)
+            self._walk_stmts(st.orelse, branch2, info, aliases)
+            return
+        # leaf statement: look for acquire/release + calls
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                tgt = self._lock_of(node.func.value, aliases, cls)
+                if tgt is not None and node.func.attr == "acquire":
+                    self._acquire(tgt, held, info, node.lineno, cls)
+                    continue
+                if tgt is not None and node.func.attr == "release":
+                    if tgt in held:
+                        held.remove(tgt)
+                    continue
+        self._scan_calls(st, held, info, aliases)
+
+    def _lock_of(self, expr, aliases, cls) -> Optional[str]:
+        c = _canon(expr, aliases)
+        if c is None:
+            return None
+        c = _scope_name(c, cls)
+        return c if self._is_lock(c) else None
+
+    def _acquire(self, lk: str, held: List[str], info: _FnInfo,
+                 line: int, cls):
+        for h in held:
+            if h == lk:
+                if self.locks.get(lk) in _REENTRANT_FACTORIES:
+                    continue
+                # tail-matched aliases of a reentrant factory also pass
+                tails = {_lock_tail(k): f for k, f in self.locks.items()}
+                if tails.get(_lock_tail(lk)) in _REENTRANT_FACTORIES:
+                    continue
+                self.emit(SEV_ERROR, "lock.reentrant-acquire",
+                          info.qualname,
+                          f"{lk} re-acquired while already held — "
+                          "threading.Lock self-deadlocks; use RLock or "
+                          "restructure", line)
+                continue
+            info.edges.append((h, lk, line))
+        info.acquires.add(lk)
+        held.append(lk)
+
+    def _scan_calls(self, node, held, info, aliases):
+        """Record same-module calls made while holding locks (for the
+        interprocedural edge pass)."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            name = None
+            if isinstance(call.func, ast.Attribute):
+                name = call.func.attr
+            elif isinstance(call.func, ast.Name):
+                name = call.func.id
+            if name and name in self.by_name:
+                info.calls.append((name, tuple(held), call.lineno))
+
+    def _report_lock_graph(self, edges):
+        declared = {(a, b) for a, b, _ in self.declared}
+        observed: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        for a, b, line, where in edges:
+            observed.setdefault((a, b), (line, where))
+
+        # declared-order violations first (more specific than a cycle)
+        violated = set()
+        for (a, b), (line, where) in sorted(observed.items()):
+            if (b, a) in declared:
+                violated.add((a, b))
+                self.emit(SEV_ERROR, "lock.order-violation",
+                          where,
+                          f"acquires {b} while holding {a}, but the "
+                          f"declared order is {b} -> {a} "
+                          "(# lint: lock-order directive)", line)
+
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in set(observed) | declared:
+            if (a, b) in violated or (b, a) in violated:
+                continue    # already reported as a violation
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for cyc in _find_cycles(graph):
+            locs = [observed.get((x, y), (None, None))
+                    for x, y in zip(cyc, cyc[1:] + cyc[:1])]
+            line = next((l for l, _ in locs if l), None)
+            wheres = sorted({w for _, w in locs if w})
+            self.emit(SEV_ERROR, "lock.order-cycle",
+                      ",".join(sorted(set(cyc))),
+                      "lock acquisition cycle "
+                      + " -> ".join(cyc + [cyc[0]])
+                      + (f" (observed in {', '.join(wheres)})"
+                         if wheres else "")
+                      + " — two threads taking these locks in opposing "
+                      "orders deadlock", line)
+
+    # -- pass 2: tracing hazards ----------------------------------------
+    def analyze_tracing(self):
+        self._mark_traced()
+        for qual in sorted(self.traced):
+            info = self.fns.get(qual)
+            if info is not None:
+                self._scan_traced(info)
+        if self.config.check_hot:
+            self._scan_hot()
+
+    def _mark_traced(self):
+        # seed: functions handed to jax.jit/shard_map/lax.cond/... or
+        # decorated with them
+        seeds: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and _is_tracer_entry(node.func):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        seeds.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        pass    # lambdas scanned via enclosing function
+        for info in self.fns.values():
+            node = info.node
+            for dec in getattr(node, "decorator_list", ()):
+                f = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_tracer_entry(f) or (
+                        isinstance(dec, ast.Call)
+                        and any(_is_tracer_entry(a) for a in dec.args)):
+                    seeds.add(node.name)
+        traced = {q for q, i in self.fns.items()
+                  if i.node.name in seeds}
+        # propagate through same-module calls: a helper called from a
+        # traced function runs under the tracer too
+        changed = True
+        while changed:
+            changed = False
+            for q in list(traced):
+                info = self.fns[q]
+                for call in ast.walk(info.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    nm = None
+                    if isinstance(call.func, ast.Name):
+                        nm = call.func.id
+                    elif isinstance(call.func, ast.Attribute) \
+                            and isinstance(call.func.value, ast.Name) \
+                            and call.func.value.id == "self":
+                        nm = call.func.attr
+                    if nm is None:
+                        continue
+                    for cq in self.by_name.get(nm, ()):
+                        if cq not in traced:
+                            traced.add(cq)
+                            changed = True
+        self.traced = traced
+
+    def _scan_traced(self, info: _FnInfo):
+        qual = info.qualname
+        body_nodes = []
+
+        def collect(node):
+            for child in ast.iter_child_nodes(node):
+                # the payload of a host callback IS host code — np/
+                # float on it is the point, not a hazard
+                if isinstance(child, ast.Call) and isinstance(
+                        child.func, (ast.Name, ast.Attribute)):
+                    nm = child.func.attr if isinstance(
+                        child.func, ast.Attribute) else child.func.id
+                    if nm in ("pure_callback", "io_callback",
+                              "debug_callback"):
+                        continue
+                body_nodes.append(child)
+                collect(child)
+
+        for st in _body_of(info.node):
+            body_nodes.append(st)
+            collect(st)
+        for node in body_nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue    # nested defs get their own traced pass
+            if isinstance(node, ast.Call):
+                self._check_traced_call(node, qual)
+            elif isinstance(node, ast.Subscript):
+                base = _canon(node.value, {})
+                if base in ("os.environ",):
+                    self.emit(SEV_ERROR, "trace.env-read", qual,
+                              "os.environ read inside traced code — the "
+                              "value is frozen into the compiled program",
+                              node.lineno)
+
+    def _check_traced_call(self, call: ast.Call, qual: str):
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            base = _canon(f.value, {})
+            if f.attr in ("item", "tolist") and not call.args:
+                self.emit(SEV_ERROR, "trace.host-sync", qual,
+                          f".{f.attr}() on a value inside traced code — "
+                          "a concretization error under jit, a per-step "
+                          "device->host sync outside it; keep values on "
+                          "device or fetch through _host_fetch",
+                          call.lineno)
+                return
+            if base in ("np", "numpy") and f.attr in ("asarray", "array"):
+                self.emit(SEV_ERROR, "trace.host-sync", qual,
+                          f"np.{f.attr}() inside traced code pulls the "
+                          "traced value to host — use jnp equivalents",
+                          call.lineno)
+                return
+            if base == "time" and f.attr in (
+                    "time", "time_ns", "monotonic", "monotonic_ns",
+                    "perf_counter", "perf_counter_ns"):
+                self.emit(SEV_ERROR, "trace.impure-time", qual,
+                          f"time.{f.attr}() inside traced code is "
+                          "evaluated ONCE at trace time and constant-"
+                          "folded forever", call.lineno)
+                return
+            if base in ("random", "np.random", "numpy.random"):
+                self.emit(SEV_ERROR, "trace.impure-random", qual,
+                          f"stateful {base}.{f.attr}() inside traced "
+                          "code — evaluated once at trace time; use "
+                          "jax.random with an explicit key",
+                          call.lineno)
+                return
+            if base == "os" and f.attr == "getenv":
+                self.emit(SEV_ERROR, "trace.env-read", qual,
+                          "os.getenv inside traced code — the value is "
+                          "frozen into the compiled program",
+                          call.lineno)
+                return
+            if base == "os.environ" and f.attr == "get":
+                self.emit(SEV_ERROR, "trace.env-read", qual,
+                          "os.environ.get inside traced code — the "
+                          "value is frozen into the compiled program",
+                          call.lineno)
+                return
+        elif isinstance(f, ast.Name) and f.id in ("float", "bool") \
+                and len(call.args) == 1:
+            # int() is deliberately NOT flagged: it is overwhelmingly
+            # static shape/config math; float()/bool() on a traced
+            # value are the classic concretization hazards (the old
+            # GradScaler paid one bool(isfinite) round trip PER PARAM)
+            a = call.args[0]
+            if not isinstance(a, (ast.Constant, ast.JoinedStr)) \
+                    and not _is_shape_like(a):
+                self.emit(SEV_ERROR, "trace.host-sync", qual,
+                          f"{f.id}() on a non-literal inside traced "
+                          "code concretizes the traced value (host "
+                          "sync / ConcretizationTypeError)",
+                          call.lineno)
+
+    def _scan_hot(self):
+        traced_nodes = {id(self.fns[q].node) for q in self.traced
+                        if q in self.fns}
+        for info in self.fns.values():
+            if id(info.node) in traced_nodes:
+                continue
+            for loop in ast.walk(info.node):
+                if not isinstance(loop, (ast.For, ast.While,
+                                         ast.AsyncFor)):
+                    continue
+                for node in ast.walk(loop):
+                    if isinstance(node, ast.Call):
+                        f = node.func
+                        if isinstance(f, ast.Attribute):
+                            base = _canon(f.value, {})
+                            if (base == "os" and f.attr == "getenv") or \
+                                    (base == "os.environ"
+                                     and f.attr == "get"):
+                                self.emit(
+                                    SEV_WARNING, "hot.env-read-loop",
+                                    info.qualname,
+                                    "env var read inside a loop — read "
+                                    "once outside the hot path",
+                                    node.lineno)
+                            elif f.attr == "item" and not node.args:
+                                self.emit(
+                                    SEV_WARNING, "hot.host-sync-loop",
+                                    info.qualname,
+                                    ".item() inside a loop — one "
+                                    "device sync per iteration",
+                                    node.lineno)
+                    elif isinstance(node, ast.Subscript):
+                        if _canon(node.value, {}) == "os.environ":
+                            self.emit(
+                                SEV_WARNING, "hot.env-read-loop",
+                                info.qualname,
+                                "os.environ[...] inside a loop — read "
+                                "once outside the hot path",
+                                node.lineno)
+
+    # -- driver ----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self.index()
+        if self.config.check_locks:
+            self.analyze_locks()
+        if self.config.check_tracing:
+            self.analyze_tracing()
+        # a nested def inside a traced fn is scanned inline AND as its
+        # own traced function — report each site once
+        seen = set()
+        out = []
+        for f in self.findings:
+            k = (f.rule, f.line, f.detail)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        self.findings = out
+        return self.findings
+
+
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
+
+def _body_of(node):
+    return getattr(node, "body", [])
+
+
+def _walk_functions(tree):
+    """Yield (node, enclosing class name, qualname) for every function,
+    including nested ones."""
+    def rec(node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from rec(child, child.name,
+                               prefix + (child.name,))
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = ".".join(prefix + (child.name,))
+                yield child, cls, qual
+                yield from rec(child, cls, prefix + (child.name,))
+            else:
+                yield from rec(child, cls, prefix)
+    yield from rec(tree, None, ())
+
+
+def _parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_class(parents, node) -> Optional[str]:
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, ast.ClassDef):
+            return node.name
+    return None
+
+
+def _is_lock_factory(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES:
+        base = _canon(f.value, {})
+        return base in ("threading", "_threading", "mp",
+                        "multiprocessing")
+    if isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES:
+        return True
+    return False
+
+
+def _is_tracer_entry(f) -> bool:
+    if isinstance(f, ast.Attribute):
+        return f.attr in _TRACER_ENTRY_FUNCS
+    if isinstance(f, ast.Name):
+        return f.id in _TRACER_ENTRY_FUNCS
+    return False
+
+
+def _is_shape_like(node) -> bool:
+    """int()/float() on shapes, len(), or dict lookups of config are
+    legitimate under trace (static values)."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "len":
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+    return False
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Cycles in the lock graph via Tarjan SCCs (one report per SCC) +
+    self-loops."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    for v, nbrs in graph.items():
+        if v in nbrs:
+            out.append([v])
+    return out
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+
+def lint_source(src: str, path: str = "<string>",
+                config: Optional[LintConfig] = None) -> List[Finding]:
+    return _Module(path, src, config or LintConfig()).run()
+
+
+def lint_file(path: str,
+              config: Optional[LintConfig] = None) -> List[Finding]:
+    with open(path) as f:
+        src = f.read()
+    return lint_source(src, path=path, config=config)
+
+
+def lint_paths(paths=None, root: Optional[str] = None,
+               config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint a set of files (default: the ISSUE 6 repo module set,
+    resolved against ``root`` or the repo checkout this package lives
+    in)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    findings: List[Finding] = []
+    for p in (paths or DEFAULT_LINT_PATHS):
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        findings.extend(lint_file(full, config=config))
+    return findings

@@ -1,0 +1,124 @@
+"""Shared report format for the GraftLint analyzers (ISSUE 6).
+
+Both pillars — the jaxpr program auditor (:mod:`.jaxpr_audit`) and the
+AST framework linter (:mod:`.ast_lint`) — emit :class:`Finding` records
+so one CLI / one baseline file / one CI gate covers the whole static
+analysis tier (the TPU-native analog of the reference's
+``framework/ir/pass.h`` pass diagnostics).
+
+A finding's :attr:`Finding.key` is its *stable identity* for baselining:
+``rule|loc`` with ``loc`` deliberately line-number-free (file::scope or
+program::input-path), so an unrelated edit that shifts lines never
+invalidates the baseline, while moving/renaming the offending code does.
+
+Baseline file (``tools/lint_baseline.json``)::
+
+    {"version": 1,
+     "entries": [{"key": "<rule>|<loc>", "reason": "<why accepted>"}]}
+
+Every entry MUST carry a non-empty reason — the baseline records
+*justified* findings, not a mute button.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "SEV_ERROR", "SEV_WARNING", "SEV_INFO",
+           "load_baseline", "apply_baseline", "baseline_entry",
+           "format_findings"]
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
+
+
+@dataclass
+class Finding:
+    """One analyzer diagnostic.
+
+    ``loc`` is the stable location (``file::scope`` for lint findings,
+    ``program::input-path`` for jaxpr findings); ``line`` is best-effort
+    display detail and never part of the baseline identity.
+    """
+
+    severity: str
+    rule: str
+    loc: str
+    detail: str
+    line: Optional[int] = None
+    data: Dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.loc}"
+
+    def format(self) -> str:
+        where = self.loc if self.line is None else f"{self.loc}:{self.line}"
+        return f"[{self.severity}] {self.rule} @ {where}: {self.detail}"
+
+    def asdict(self) -> Dict:
+        d = {"severity": self.severity, "rule": self.rule,
+             "loc": self.loc, "detail": self.detail, "key": self.key}
+        if self.line is not None:
+            d["line"] = self.line
+        if self.data:
+            d["data"] = self.data
+        return d
+
+
+def format_findings(findings: List[Finding]) -> str:
+    ordered = sorted(findings,
+                     key=lambda f: (_SEV_ORDER.get(f.severity, 9), f.key))
+    return "\n".join(f.format() for f in ordered)
+
+
+def baseline_entry(finding: Finding, reason: str) -> Dict:
+    if not reason or not str(reason).strip():
+        raise ValueError("a baseline entry needs a non-empty reason "
+                         f"(finding {finding.key})")
+    return {"key": finding.key, "reason": str(reason)}
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """Read a baseline file -> {finding key: reason}.  A missing file is
+    an empty baseline; a malformed file (or an entry without a reason)
+    raises — a silently ignored baseline would un-gate CI."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise ValueError(f"baseline {path}: expected "
+                         '{"version": 1, "entries": [...]}')
+    out: Dict[str, str] = {}
+    for e in doc["entries"]:
+        key, reason = e.get("key"), e.get("reason")
+        if not key or not reason or not str(reason).strip():
+            raise ValueError(
+                f"baseline {path}: entry {e!r} needs both a key and a "
+                "non-empty reason — the baseline pins JUSTIFIED findings")
+        out[str(key)] = str(reason)
+    return out
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, str],
+                   ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Split findings into (new, accepted) against a baseline and report
+    stale baseline keys that no longer match anything (informational —
+    prune them when amending)."""
+    new: List[Finding] = []
+    accepted: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.key in baseline:
+            accepted.append(f)
+            seen.add(f.key)
+        else:
+            new.append(f)
+    stale = [k for k in baseline if k not in seen]
+    return new, accepted, stale

@@ -780,6 +780,118 @@ class DistributedTrainStep:
                                  memory_kind="pinned_host")
         return NamedSharding(self._mesh, spec)
 
+    def _ensure_built(self, arg_vals, param_vals, buffer_vals,
+                      opt_state):
+        """Compile the step on first use and lay params/opt-state out on
+        their final shardings once (ZeRO-3 may add 'fsdp' dims on top of
+        layer-annotated 'tp' specs); afterwards every step's args
+        already match the jit shardings.  Returns the relaid opt_state
+        (the caller's ``param_vals`` dict is updated in place)."""
+        if self._compiled is not None:
+            return opt_state
+        self._compiled = self._build(arg_vals, opt_state)
+        pspecs = self._param_specs()
+        for n, p in self._params.items():
+            p._value = jax.device_put(
+                p._value, NamedSharding(self._mesh, pspecs[n]))
+            param_vals[n] = p._value
+        sspecs = self._opt_state_specs(opt_state, pspecs)
+        opt_state = [
+            {k: jax.device_put(v, self._state_sharding(d[k]))
+             if hasattr(v, "shape") else v for k, v in st.items()}
+            for st, d in zip(opt_state, sspecs)]
+        self._opt.load_opt_state(opt_state)
+        if self._k_steps > 1 and self._accum is None:
+            self._accum = {
+                n: jnp.zeros_like(
+                    v, device=NamedSharding(self._mesh, pspecs[n]))
+                for n, v in param_vals.items()}
+        if self._use_dgc and self._dgc_state is None:
+            self._dgc_state = {
+                ax: {n: jnp.zeros_like(
+                    v, device=NamedSharding(self._mesh, pspecs[n]))
+                    for n, v in param_vals.items()}
+                for ax in ("u", "v")}
+        return opt_state
+
+    def _assemble_call_args(self, param_vals, buffer_vals, opt_state,
+                            lr, key, arg_vals) -> tuple:
+        """The compiled step's positional argument tuple for the live
+        variant — the single source of truth ``__call__``,
+        :meth:`compile_abstract` and :meth:`audit` all share."""
+        if self._use_scaling:
+            return (param_vals, buffer_vals, opt_state, self._amp_state,
+                    lr, key, arg_vals)
+        if self._use_dgc or self._k_steps > 1:
+            if self._step_dev is None:
+                self._step_dev = jnp.asarray(self._step_i, jnp.int32)
+            extra = self._dgc_state if self._use_dgc else self._accum
+            return (param_vals, buffer_vals, opt_state, extra,
+                    self._step_dev, lr, key, arg_vals)
+        return (param_vals, buffer_vals, opt_state, lr, key, arg_vals)
+
+    def _arg_names(self) -> list:
+        names = ["params", "buffers", "opt_state"]
+        if self._use_scaling:
+            names.append("amp_state")
+        elif self._use_dgc:
+            names += ["dgc_state", "step"]
+        elif self._k_steps > 1:
+            names += ["accum", "step"]
+        return names + ["lr", "key", "batch"]
+
+    # static analysis ---------------------------------------------------
+    def audit(self, *args, include_hlo: bool = True, **thresholds):
+        """Run the jaxpr program auditor (GraftLint pillar 1,
+        :mod:`paddle_tpu.analysis`) over the compiled step program.
+
+        Returns an :class:`~paddle_tpu.analysis.AuditReport`: per-input
+        donation status, the collective inventory (jaxpr primitives +
+        post-SPMD HLO instructions when ``include_hlo``), widening-cast
+        count, and rule findings (undonated buffers, dtype creep, host
+        callbacks, baked-in constants).  This surface is also the hook
+        the auto-sharding planner (ROADMAP item 4) reuses for memory /
+        collective predictions.
+
+        After the step has run once, the audit covers the LIVE variant
+        and batch signature (``args`` are ignored); before the first
+        run, pass a sample batch — the step is built for it exactly as
+        ``__call__`` would.
+        """
+        from ...analysis.jaxpr_audit import audit_traced
+        if not hasattr(self, "_last_call_args"):
+            if not args:
+                raise RuntimeError(
+                    "audit() before the first step needs a sample "
+                    "batch: step.audit(*batch)")
+            arg_vals = _tree_to_values(list(args))
+            param_vals = {n: p._value for n, p in self._params.items()}
+            buffer_vals = {n: b._value for n, b in self._buffers.items()}
+            opt_state = self._storage_cast(self._opt.opt_state())
+            opt_state = self._ensure_built(arg_vals, param_vals,
+                                           buffer_vals, opt_state)
+            lr = jnp.asarray(float(self._opt.get_lr()), jnp.float32)
+            key = split_key()
+            call_args = self._assemble_call_args(
+                param_vals, buffer_vals, opt_state, lr, key, arg_vals)
+            specs = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                if hasattr(v, "shape") and hasattr(v, "dtype") else v,
+                call_args)
+        else:
+            specs = self._last_call_args
+        traced = self._compiled.trace(*specs)
+        hlo = None
+        if include_hlo:
+            try:
+                hlo = self._compiled.lower(
+                    *specs).compile().as_text()
+            except Exception:   # backend can't compile this geometry
+                hlo = None
+        return audit_traced(traced, program="DistributedTrainStep",
+                            arg_names=self._arg_names(), hlo_text=hlo,
+                            **thresholds)
+
     # rng / step checkpointing -----------------------------------------
     def rng_state(self) -> dict:
         """Serializable state of the device-resident RNG chain + step
@@ -818,33 +930,8 @@ class DistributedTrainStep:
             param_vals = {n: p._value for n, p in self._params.items()}
             buffer_vals = {n: b._value for n, b in self._buffers.items()}
             opt_state = self._storage_cast(self._opt.opt_state())
-        if self._compiled is None:
-            self._compiled = self._build(arg_vals, opt_state)
-            # lay params/opt-state out on their final shardings once (ZeRO-3
-            # may add 'fsdp' dims on top of layer-annotated 'tp' specs);
-            # afterwards every step's args already match the jit shardings
-            pspecs = self._param_specs()
-            for n, p in self._params.items():
-                p._value = jax.device_put(
-                    p._value, NamedSharding(self._mesh, pspecs[n]))
-                param_vals[n] = p._value
-            sspecs = self._opt_state_specs(opt_state, pspecs)
-            opt_state = [
-                {k: jax.device_put(v, self._state_sharding(d[k]))
-                 if hasattr(v, "shape") else v for k, v in st.items()}
-                for st, d in zip(opt_state, sspecs)]
-            self._opt.load_opt_state(opt_state)
-            if self._k_steps > 1 and self._accum is None:
-                self._accum = {
-                    n: jnp.zeros_like(
-                        v, device=NamedSharding(self._mesh, pspecs[n]))
-                    for n, v in param_vals.items()}
-            if self._use_dgc and self._dgc_state is None:
-                self._dgc_state = {
-                    ax: {n: jnp.zeros_like(
-                        v, device=NamedSharding(self._mesh, pspecs[n]))
-                        for n, v in param_vals.items()}
-                    for ax in ("u", "v")}
+        opt_state = self._ensure_built(arg_vals, param_vals, buffer_vals,
+                                       opt_state)
         # the key chain and step counter live on device (the compiled
         # step returns their successors); lr re-uploads only when the
         # scheduler moves — each would otherwise cost a host->device
@@ -859,33 +946,22 @@ class DistributedTrainStep:
         if self._lr_cache is None or self._lr_cache[0] != lr_f:
             self._lr_cache = (lr_f, jnp.asarray(lr_f, jnp.float32))
         lr = self._lr_cache[1]
-        if (self._use_dgc or self._k_steps > 1) and self._step_dev is None:
-            self._step_dev = jnp.asarray(self._step_i, jnp.int32)
+        call_args = self._assemble_call_args(param_vals, buffer_vals,
+                                             opt_state, lr, key, arg_vals)
         with obs.phase("dispatch"), no_grad():
             if self._use_scaling:
-                call_args = (param_vals, buffer_vals, opt_state,
-                             self._amp_state, lr, key, arg_vals)
                 (loss, new_p, new_b, new_s, self._amp_state,
                  self._key_dev) = self._compiled(*call_args)
             elif self._use_dgc:
-                call_args = (param_vals, buffer_vals, opt_state,
-                             self._dgc_state, self._step_dev, lr, key,
-                             arg_vals)
                 (loss, new_p, new_b, new_s, self._dgc_state,
                  self._key_dev, self._step_dev) = self._compiled(*call_args)
             elif self._k_steps > 1:
-                call_args = (param_vals, buffer_vals, opt_state, self._accum,
-                             self._step_dev, lr, key, arg_vals)
                 (loss, new_p, new_b, new_s, self._accum,
                  self._key_dev, self._step_dev) = self._compiled(*call_args)
             elif self._guard_health:
-                call_args = (param_vals, buffer_vals, opt_state, lr, key,
-                             arg_vals)
                 (loss, new_p, new_b, new_s, self.last_health,
                  self._key_dev) = self._compiled(*call_args)
             else:
-                call_args = (param_vals, buffer_vals, opt_state, lr, key,
-                             arg_vals)
                 (loss, new_p, new_b, new_s,
                  self._key_dev) = self._compiled(*call_args)
         with obs.phase("host"):
@@ -942,8 +1018,8 @@ class DistributedTrainStep:
             self._compiled = self._build(arg_vals, opt_state)
         lr = jnp.asarray(float(self._opt.get_lr()), jnp.float32)
         key = split_key()
-        call_args = (param_vals, buffer_vals, opt_state, lr, key,
-                     arg_vals)
+        call_args = self._assemble_call_args(param_vals, buffer_vals,
+                                             opt_state, lr, key, arg_vals)
         return self._compiled.lower(*call_args).compile()
 
     def cost_analysis(self):

@@ -416,6 +416,12 @@ class PSServer:
         # idempotency + replication state.  _apply_lock serializes
         # mutations so (dedup check, table apply, replica forward) is
         # one atomic commit with a total order the replica replays.
+        # INTENDED LOCK ORDER (machine-verified by tools/graft_lint.py,
+        # the PR 3 review deadlock class): a replica sink's stream lock
+        # (rep["lock"]) nests INSIDE the apply lock, never the reverse
+        # — _attach_replica's failure path must release the sink lock
+        # BEFORE re-taking the apply lock.
+        # lint: lock-order: PSServer._apply_lock -> rep[lock]
         self._apply_lock = threading.Lock()
         self._seqs: Dict[str, _SeqWindow] = {}
         self._replicas: List[dict] = []
@@ -1008,6 +1014,10 @@ class PSClient:
         # worker_id) get idempotent retries
         self._src = worker_id or f"cli-{os.getpid()}-{id(self):x}"
         self._seq = itertools.count(1)
+        # INTENDED LOCK ORDER: the per-shard data-socket lock may take
+        # the seq lock (re-register inside _reconnect_locked stamps a
+        # fresh seq), never the reverse.
+        # lint: lock-order: PSClient._lock[] -> PSClient._seq_lock
         self._seq_lock = threading.Lock()
         self._jitter = random.Random(
             hash(self._src) & 0xFFFFFFFF)   # deterministic per client

@@ -562,6 +562,39 @@ class Predictor:
             results.append(a)
         return results
 
+    # -- static analysis ---------------------------------------------
+    def audit(self, batch: Optional[int] = None,
+              include_hlo: bool = False, **thresholds):
+        """Run the jaxpr program auditor (GraftLint pillar 1,
+        :mod:`paddle_tpu.analysis`) over the serving program for one
+        batch bucket (default: the load batch).
+
+        Donation checking is off — a predictor's weights are reused
+        across calls by design, never donated — so the rules that apply
+        are dtype creep (an artifact exported f32 but silently upcast,
+        or f64 creep in a custom head), host callbacks inside the
+        serving program (a per-request host round trip), baked-in large
+        constants, and the collective inventory.  Returns an
+        :class:`~paddle_tpu.analysis.AuditReport`.
+        """
+        from ..analysis.jaxpr_audit import audit_traced
+        b = int(batch) if batch is not None else \
+            getattr(self._config, "_load_batch", 1)
+        specs = self._specs_for_batch(b)
+        traced = self._jit_call.trace(self._params, self._buffers,
+                                      self._rng, tuple(specs))
+        hlo = None
+        if include_hlo:
+            try:
+                hlo = traced.lower().compile().as_text()
+            except Exception:
+                hlo = None
+        prog = f"Predictor[{os.path.basename(self._config._path_prefix())}]"
+        return audit_traced(
+            traced, program=prog, check_donation=False, hlo_text=hlo,
+            arg_names=["params", "buffers", "rng", "inputs"],
+            **thresholds)
+
     def clone(self) -> "Predictor":
         return Predictor(self._config)
 

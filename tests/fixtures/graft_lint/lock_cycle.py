@@ -1,0 +1,47 @@
+"""Known-bad fixture: the PR 3 PRE-FIX lock-order deadlock, verbatim in
+shape — ``_attach_replica``'s failure path takes the apply lock while
+still holding the replica sink lock, the reverse of ``_apply_mutation``
+-> ``_forward`` (apply lock -> sink lock).  tools/graft_lint.py must
+flag exactly one ``lock.order-cycle`` here; the fixed ordering (release
+the sink lock FIRST) in the real ``fleet/ps_service.py`` must pass
+clean.  This file is lint fodder only — never imported.
+"""
+import threading
+
+
+def send(conn, msg):
+    raise NotImplementedError
+
+
+class Server:
+    def __init__(self):
+        self._apply_lock = threading.Lock()
+        self._replicas = []
+
+    def _forward(self, msg):
+        # apply lock (held by caller) -> sink lock
+        for rep in list(self._replicas):
+            with rep["lock"]:
+                send(rep["conn"], msg)
+
+    def _apply_mutation(self, msg):
+        with self._apply_lock:
+            self._forward(msg)
+
+    def _attach_replica(self, conn):
+        rep = {"conn": conn, "lock": threading.Lock()}
+        with self._apply_lock:
+            rep["lock"].acquire()
+            self._replicas.append(rep)
+        try:
+            send(conn, "snapshot")
+        except OSError:
+            # PRE-FIX BUG: re-takes the apply lock while still holding
+            # the sink lock — a concurrent _apply_mutation holds the
+            # apply lock and blocks on this sink's lock: deadlock.
+            with self._apply_lock:
+                self._replicas.remove(rep)
+            rep["lock"].release()
+            return False
+        rep["lock"].release()
+        return True

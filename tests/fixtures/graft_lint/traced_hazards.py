@@ -1,0 +1,29 @@
+"""Known-bad fixture: tracing hazards inside a jitted step — one site
+per rule, each of which tools/graft_lint.py must flag with the right
+rule id.  Lint fodder only — never imported.
+"""
+import os
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def bad_step(params, x):
+    v = x.item()                        # trace.host-sync (.item)
+    lr = float(params["lr"])            # trace.host-sync (float)
+    a = np.asarray(x)                   # trace.host-sync (np.asarray)
+    t = time.time()                     # trace.impure-time
+    r = random.random()                 # trace.impure-random
+    s = os.environ.get("SCALE", "1")    # trace.env-read
+    return x * v * lr * r
+
+
+bad_step_c = jax.jit(bad_step)
+
+
+def hot_loop(batches):
+    for b in batches:
+        key = os.environ.get("PADDLE_KEY")   # hot.env-read-loop
+        val = b.item()                       # hot.host-sync-loop

@@ -4,13 +4,17 @@
 # passed alone, failed in the combined suite) fails this script and
 # therefore can't ship again.
 #
-# Usage: tools/run_tier1.sh [--chaos] [--trace] [extra pytest args...]
+# Usage: tools/run_tier1.sh [--chaos] [--trace] [--lint] [extra pytest args...]
 #        --chaos additionally runs the fault-injection suite (chaos
 #        harness + PS fault tolerance + crash-mid-save) as a third
 #        pass with its fixed, deterministic seeds
 #        --trace additionally runs the whole suite with PADDLE_TRACE=1
 #        PADDLE_METRICS=1 (sinks into a temp dir) — proving always-on
 #        telemetry neither breaks determinism nor leaks sink files
+#        --lint runs GraftLint (ISSUE 6): the AST concurrency/tracing
+#        linter over the repo module set AND the jaxpr self-audit of
+#        the step programs, gated on tools/lint_baseline.json — any
+#        finding not in the baseline exits nonzero
 # Env:   TIER1_SHUFFLE_SEED  fix the shuffle (default: date-derived,
 #                            printed so a red run is reproducible)
 set -u -o pipefail
@@ -18,10 +22,12 @@ cd "$(dirname "$0")/.."
 
 CHAOS=0
 TRACE=0
+LINT=0
 while :; do
     case "${1:-}" in
         --chaos) CHAOS=1; shift ;;
         --trace) TRACE=1; shift ;;
+        --lint)  LINT=1;  shift ;;
         *) break ;;
     esac
 done
@@ -92,10 +98,24 @@ if [ "$TRACE" -eq 1 ]; then
     rm -rf "$TRACE_DIR"
 fi
 
+rc5=0
+if [ "$LINT" -eq 1 ]; then
+    # GraftLint gate: pillar 2 (lock-order + tracing-hazard AST lint
+    # over the configured module set) and pillar 1 (jaxpr self-audit
+    # of the mlp/lenet/llama_tiny step programs), both checked against
+    # the committed baseline — a NEW finding fails CI.  Amend with
+    #   python tools/graft_lint.py --write-baseline --reason "..."
+    # only for findings that are genuinely justified.
+    echo "== tier-1 lint pass: GraftLint (AST + jaxpr self-audit)"
+    env JAX_PLATFORMS=cpu python tools/graft_lint.py --audit \
+        --baseline tools/lint_baseline.json
+    rc5=$?
+fi
+
 echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3," \
-     "trace rc=$rc4"
+     "trace rc=$rc4, lint rc=$rc5"
 if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ] \
-        || [ "$rc4" -ne 0 ]; then
+        || [ "$rc4" -ne 0 ] || [ "$rc5" -ne 0 ]; then
     echo "== tier-1 FAILED (any pass being red fails the gate)"
     exit 1
 fi
