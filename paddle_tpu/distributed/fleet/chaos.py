@@ -164,6 +164,7 @@ class FaultPlan:
 
     def _match(self, site: str, op: str) -> Optional[Fault]:
         with self._lock:
+            fired = None
             for f in self.faults:
                 if f._site() != site:
                     continue
@@ -173,8 +174,16 @@ class FaultPlan:
                     continue
                 f.matches += 1
                 if f._should_fire():
-                    return f
-            return None
+                    fired = f
+                    break
+        if fired is not None:
+            # the flight-recorder ring keeps every injected fault, so a
+            # postmortem bundle shows the chaos that CAUSED the failure
+            # it autopsies (tests assert dump-on-injected-fault)
+            from ...observability import flight_recorder as _flight
+            _flight.record("chaos", fault=fired.kind, op=op,
+                           site=site, n=fired.fired, plan=self.name)
+        return fired
 
     # -- injection sites (called from ps_service) ----------------------
     def send(self, sock, obj, raw_send):

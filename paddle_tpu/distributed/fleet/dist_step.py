@@ -23,6 +23,7 @@ an in-place executor.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Dict, Optional
 
 import jax
@@ -33,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...framework.core import Tensor, no_grad
 from ...framework.random import split_key, use_key
 from ...jit import _tree_to_values
+from ...observability import flight_recorder as _flight
 from ...observability.timeline import StepTimeline
 from .. import mesh as mesh_mod
 
@@ -295,6 +297,12 @@ class DistributedTrainStep:
         # step timeline (ISSUE 5): phase spans/histograms, sampled by
         # PADDLE_TRACE_EVERY; both exporters off -> near-zero cost
         self._obs = StepTimeline("train_step")
+        # compile observatory (ISSUE 7): every distinct batch signature
+        # is one lowering/compile — classified first_build /
+        # new_shape_bucket / avoidable_retrace and logged to the flight
+        # recorder with wall time + XLA memory analysis
+        self._sig_seen: set = set()
+        self._shape_seen: set = set()
         self._use_scaling = False  # set by _build for float16 AMP
         # (loss_scale, consecutive_finite_steps, consecutive_bad_steps)
         self._amp_state = None
@@ -385,13 +393,12 @@ class DistributedTrainStep:
                 "strategy.dgc cannot combine with float16 loss scaling or "
                 "gradient_merge (the reference treats DGC as its own meta "
                 "optimizer too)")
-        if self._guard_health and (use_scaling or self._use_dgc
-                                   or k_steps > 1):
+        if self._guard_health and (self._use_dgc or k_steps > 1):
             raise NotImplementedError(
-                "guard_health covers the plain step (bf16 AMP / ZeRO / "
-                "TP / PP); fp16 scaling carries its own in-step finite "
-                "check, and DGC/gradient_merge accumulate state a "
-                "per-microbatch health vector would misrepresent")
+                "guard_health covers the plain and fp16-loss-scaling "
+                "steps (bf16 AMP / ZeRO / TP / PP); DGC and "
+                "gradient_merge accumulate state a per-microbatch "
+                "health vector would misrepresent")
 
         def _amp_cast(tree):
             return jax.tree_util.tree_map(
@@ -495,6 +502,7 @@ class DistributedTrainStep:
             incr_ratio = float(acfg["incr_ratio"])
             decr_ratio = float(acfg["decr_ratio"])
             decr_every = int(acfg["decr_every_n_nan_or_inf"])
+            guard_health = self._guard_health
 
             def step(pvals, bufs, opt_state, amp_state, lr, key, args):
                 scale, good, bad = amp_state
@@ -510,6 +518,20 @@ class DistributedTrainStep:
                 finite = jnp.all(jnp.stack(
                     [jnp.all(jnp.isfinite(g))
                      for g in jax.tree_util.tree_leaves(grads)]))
+                if guard_health:
+                    # fused health over the UNSCALED f32 grads + the
+                    # unscaled loss: rides the same compiled step, so
+                    # the scaling path now exposes step.last_health
+                    # exactly like the plain path (ROADMAP gap closed;
+                    # the skip policy reads the bad/ok indicator, the
+                    # scale state machine still owns its own finite
+                    # bit).  precise=True here: the isfinite masks were
+                    # already materialised for `finite` above, so the
+                    # masked norm costs no extra pass over the tree.
+                    from ...train_guard import fused_health
+                    health = fused_health(
+                        jax.tree_util.tree_leaves(grads),
+                        loss=slv / scale, precise=True)
 
                 def apply_branch(op):
                     pv, st = op
@@ -540,6 +562,9 @@ class DistributedTrainStep:
                     bad = jnp.where(shrink, 0, bad)
                 else:
                     new_scale = scale
+                if guard_health:
+                    return (slv / scale, new_p, nbufs, new_s,
+                            (new_scale, good, bad), health)
                 return (slv / scale, new_p, nbufs, new_s,
                         (new_scale, good, bad))
             donate = (0, 1, 2, 3)
@@ -713,6 +738,8 @@ class DistributedTrainStep:
         if use_scaling:
             in_specs += [(P(), P(), P()), P(), P(), bspec]  # amp_state,lr,key
             out_specs += [(P(), P(), P())]
+            if self._guard_health:
+                out_specs += [P()]   # the fused health vector (f32[3])
         elif self._use_dgc:
             dspec = {"u": pspecs, "v": pspecs}  # (u,v) shard like params
             in_specs += [dspec, P(), P(), P(), bspec]
@@ -840,6 +867,36 @@ class DistributedTrainStep:
             names += ["accum", "step"]
         return names + ["lr", "key", "batch"]
 
+    # compile observatory -----------------------------------------------
+    def _note_retrace(self, arg_sig, wall_ms: float):
+        """Classify + log one retrace (called when the batch signature
+        changed).  A signature seen before is a jit cache hit, not a
+        retrace — nothing is logged.  Same shapes with new dtypes is an
+        AVOIDABLE retrace (the caller could cast at the source); a new
+        shape tuple is a legitimate new bucket (pad-and-prime it away
+        if it recurs — the serving engine's bucket trick)."""
+        if arg_sig in self._sig_seen:
+            return
+        shapes = tuple(s for s, _ in arg_sig)
+        if not self._sig_seen:
+            cause = "first_build"
+        elif shapes in self._shape_seen:
+            cause = "avoidable_retrace"
+        else:
+            cause = "new_shape_bucket"
+        self._sig_seen.add(arg_sig)
+        self._shape_seen.add(shapes)
+        compiled, specs = self._compiled, self._last_call_args
+        # memory analysis needs the executable, which the jit call path
+        # does not hand out: reaching it costs one AOT compile (cached
+        # for later lower().compile() callers like cost_analysis), so
+        # it resolves lazily — immediately in full flight mode, on
+        # demand via flight_recorder.compile_log(resolve=True) else
+        _flight.note_compile(
+            "DistributedTrainStep", cause, wall_ms, key=shapes,
+            n_buckets=len(self._shape_seen),
+            mem_cb=lambda: compiled.lower(*specs).compile())
+
     # static analysis ---------------------------------------------------
     def audit(self, *args, include_hlo: bool = True, **thresholds):
         """Run the jaxpr program auditor (GraftLint pillar 1,
@@ -948,8 +1005,13 @@ class DistributedTrainStep:
         lr = self._lr_cache[1]
         call_args = self._assemble_call_args(param_vals, buffer_vals,
                                              opt_state, lr, key, arg_vals)
+        t_disp0 = _time.perf_counter()
         with obs.phase("dispatch"), no_grad():
-            if self._use_scaling:
+            if self._use_scaling and self._guard_health:
+                (loss, new_p, new_b, new_s, self._amp_state,
+                 self.last_health,
+                 self._key_dev) = self._compiled(*call_args)
+            elif self._use_scaling:
                 (loss, new_p, new_b, new_s, self._amp_state,
                  self._key_dev) = self._compiled(*call_args)
             elif self._use_dgc:
@@ -964,6 +1026,7 @@ class DistributedTrainStep:
             else:
                 (loss, new_p, new_b, new_s,
                  self._key_dev) = self._compiled(*call_args)
+        disp_ms = (_time.perf_counter() - t_disp0) * 1e3
         with obs.phase("host"):
             # cheap signature over just the batch args: params/opt-state
             # avals are fixed after _build, but a different batch shape
@@ -980,6 +1043,13 @@ class DistributedTrainStep:
                     lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
                     if hasattr(v, "shape") and hasattr(v, "dtype") else v,
                     call_args)
+                self._note_retrace(arg_sig, disp_ms)
+            if _flight.enabled():
+                # recent-step history for the postmortem ring (the
+                # dispatch wall includes trace+compile on a retrace
+                # step, which is exactly the anomaly worth seeing)
+                _flight.record("step", i=int(self._step_i),
+                               ms=round(disp_ms, 3))
             self._step_i += 1   # host mirror (authoritative: _step_dev)
             for n, p in self._params.items():
                 p._value = new_p[n]
@@ -1020,7 +1090,12 @@ class DistributedTrainStep:
         key = split_key()
         call_args = self._assemble_call_args(param_vals, buffer_vals,
                                              opt_state, lr, key, arg_vals)
-        return self._compiled.lower(*call_args).compile()
+        t0 = _time.perf_counter()
+        compiled = self._compiled.lower(*call_args).compile()
+        _flight.note_compile(
+            "DistributedTrainStep", "abstract",
+            (_time.perf_counter() - t0) * 1e3, compiled=compiled)
+        return compiled
 
     def cost_analysis(self):
         """XLA-reported cost of the compiled step program.

@@ -453,6 +453,11 @@ class SparseTable:
     # the_one_ps.py:758 warm-start)
     def save(self, path: str):
         np.savez(path, **self._snapshot_arrays())
+        # checkpoint writes are postmortem anchors: "did the table
+        # persist before it died" is the first question after a crash
+        from ...observability import flight_recorder as _flight
+        _flight.record("ps.save", path=str(path), rows=len(self),
+                       version=int(self.version))
 
     def state_bytes(self) -> bytes:
         """The whole table as npz bytes (the on-disk checkpoint format,
@@ -465,6 +470,9 @@ class SparseTable:
     def load(self, path: str):
         self._load_npz(
             np.load(path if path.endswith(".npz") else path + ".npz"))
+        from ...observability import flight_recorder as _flight
+        _flight.record("ps.load", path=str(path), rows=len(self),
+                       version=int(self.version))
 
     def load_state_bytes(self, data: bytes):
         """Restore from :meth:`state_bytes` (replication snapshot)."""

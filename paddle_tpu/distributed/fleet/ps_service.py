@@ -75,6 +75,7 @@ import queue
 import random
 import socket
 import struct
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -83,6 +84,7 @@ import numpy as np
 
 from . import chaos as _chaos
 from ...framework import monitor as _monitor
+from ...observability import flight_recorder as _flight
 from ...observability import trace as _trace
 
 __all__ = ["PSServer", "PSClient", "PSError", "PSConnectError",
@@ -105,9 +107,12 @@ def _note_clock(rep, t0_ns: int, t1_ns: int):
     if not isinstance(rep, dict) or "srv_us" not in rep:
         return
     t0_us, t1_us = t0_ns // 1000, t1_ns // 1000
-    _trace.record_clock(rep.get("srv_sink", "?"),
-                        rep["srv_us"] - (t0_us + t1_us) / 2.0,
-                        t1_us - t0_us)
+    off = rep["srv_us"] - (t0_us + t1_us) / 2.0
+    _trace.record_clock(rep.get("srv_sink", "?"), off, t1_us - t0_us)
+    # the flight ring keeps the same sample, so a postmortem merge can
+    # clock-correct bundles even when tracing was never enabled
+    _flight.record("clock", peer=str(rep.get("srv_sink", "?")),
+                   offset_us=float(off), rtt_us=float(t1_us - t0_us))
 
 
 class PSError(RuntimeError):
@@ -630,6 +635,12 @@ class PSServer:
                 # per-mutation gauge: a scrape of primary + replica
                 # reads replica lag as the difference of the two
                 _monitor.gauge_set("ps_applied_total", self.applied)
+            # ring event doubles as server-side progress: a primary
+            # that stops applying trips ITS watchdog too, not only the
+            # wedged client's
+            _flight.record("ps.apply", op=msg["op"],
+                           table=msg.get("table"), src=src, seq=seq,
+                           applied=self.applied)
             if self._replicas:
                 self._forward(msg)
         return True
@@ -773,7 +784,9 @@ class PSServer:
                     # promote and serve diverged state.  Dropping the
                     # connection (no ack) also detaches it primary-side.
                     self.replica_error = e
-                    import sys
+                    _flight.record("ps.replica_error",
+                                   err=type(e).__name__, detail=str(e))
+                    _flight.maybe_dump("replica_error")
                     print(f"paddle_tpu PSServer standby: replication "
                           f"stream failed, NOT promoting: {e!r}",
                           file=sys.stderr)
@@ -826,6 +839,8 @@ class PSServer:
 
     def promote(self):
         """Become the primary (the standby's stream ended)."""
+        _flight.record("ps.promote", was_replica_of=self.replica_of,
+                       applied=self.applied)
         self.promoted = True
         self.role = "primary"
 
@@ -1454,12 +1469,22 @@ class PSClient:
             sp.__enter__()
         mx = _monitor.metrics_enabled()
         t_rpc0 = time.perf_counter() if mx else 0.0
+        # flight-recorder op: begin/end pair in the ring; an RPC wedged
+        # mid-attempt (peer SIGKILLed, recv blocking) stays in the
+        # in-flight table, which is how a stall-watchdog bundle names
+        # the RPC it is stuck on
+        tok = (_flight.begin("rpc", op=msg.get("op"), shard=rank)
+               if _flight.enabled() else None)
         try:
             return self._rpc_attempts(rank, msg, reply, timeout)
         finally:
             if mx:
                 _monitor.hist_observe(
                     "ps_rpc_ms", (time.perf_counter() - t_rpc0) * 1e3)
+            if tok is not None:
+                et = sys.exc_info()[0]
+                _flight.end(tok, **({} if et is None
+                                    else {"err": et.__name__}))
             if sp is not None:
                 sp.__exit__(None, None, None)
 
@@ -1517,10 +1542,19 @@ class PSClient:
             now = time.monotonic()
             if attempt > self._max_retries or now >= deadline:
                 op = msg.get("op")
-                raise PSUnavailable(
+                _flight.record("rpc.error", op=op, shard=rank,
+                               attempts=attempt,
+                               err=type(last_err).__name__
+                               if last_err else None)
+                err = PSUnavailable(
                     f"PS rpc {op!r} to shard {rank} "
                     f"({self._eps_str(rank)}) failed after {attempt} "
-                    f"attempt(s): {last_err}") from last_err
+                    f"attempt(s): {last_err}")
+                # typed-failure dump trigger (full flight mode only):
+                # the bundle holds the retry/backoff history that led
+                # here plus every peer's last-known clock edge
+                _flight.maybe_dump("PSUnavailable")
+                raise err from last_err
             self.retries += 1
             _monitor.stat_add("ps_client_retries")
             if attempt >= 2 and len(group) > 1:

@@ -18,6 +18,7 @@ from ..framework.core import Tensor, no_grad
 from ..framework.monitor import gauge_get
 from ..metric import Metric
 from ..nn.layer.layers import Layer
+from ..observability import flight_recorder as _flight
 from ..observability.timeline import StepTimeline
 from .callbacks import config_callbacks
 
@@ -195,6 +196,13 @@ class Model:
                 else:
                     self._optimizer.step()
                     self._optimizer.clear_grad()
+            if _flight.enabled():
+                # recent-step history + stall-watchdog progress for the
+                # eager hapi loop (the dist path records its own)
+                ev = {"i": self._guard_step, "loop": "hapi"}
+                if self.last_guard_verdict is not None:
+                    ev["verdict"] = self.last_guard_verdict
+                _flight.record("step", **ev)
             self._guard_step += 1
         metrics = []
         with no_grad():

@@ -23,9 +23,12 @@ from __future__ import annotations
 
 import os
 import pickle
+import time as _time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from ..observability import flight_recorder as _flight
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version",
@@ -444,7 +447,7 @@ class Predictor:
         if meta.get("input_shapes"):
             try:
                 self._compile_for_specs(self._specs_for_batch(
-                    getattr(config, "_load_batch", 1)))
+                    getattr(config, "_load_batch", 1)), cause="load")
             except Exception as e:     # pragma: no cover - degraded path
                 import warnings
                 warnings.warn(
@@ -474,19 +477,29 @@ class Predictor:
         return tuple((tuple(int(d) for d in v.shape), str(v.dtype))
                      for v in vals)
 
-    def _compile_for_specs(self, specs):
+    def _compile_for_specs(self, specs, cause: str = "new_shape_bucket"):
         """AOT lower + compile ONE executable for this input-shape
-        signature; cache it and fix the output handle skeleton."""
+        signature; cache it and fix the output handle skeleton.  Each
+        compile is logged to the flight recorder's compile observatory
+        (cause = load / prewarm / new_shape_bucket, wall time, XLA
+        memory analysis — the Predictor HOLDS its executables, so the
+        memory observables are read off them for free)."""
         import jax
         key = self._shape_key(specs)
         exe = self._executables.get(key)
         if exe is not None:
             return exe
+        t0 = _time.perf_counter()
         lowered = self._jit_call.lower(self._params, self._buffers,
                                        self._rng, tuple(specs))
         exe = lowered.compile()
         self._compile_count += 1
         self._executables[key] = exe
+        _flight.note_compile(
+            f"Predictor[{os.path.basename(self._config._path_prefix())}]",
+            cause, (_time.perf_counter() - t0) * 1e3,
+            key=tuple(s for s, _ in key), compiled=exe,
+            n_buckets=self._compile_count)
         if not self._output_names:
             out_avals = jax.eval_shape(self._flat_call, self._params,
                                        self._buffers, self._rng,
@@ -509,7 +522,8 @@ class Predictor:
         ahead of traffic — a serving bucket never pays its compile
         inside a request."""
         for b in batch_sizes:
-            self._compile_for_specs(self._specs_for_batch(int(b)))
+            self._compile_for_specs(self._specs_for_batch(int(b)),
+                                    cause="prewarm")
         return self
 
     # -- handles -----------------------------------------------------

@@ -31,6 +31,7 @@ spends its step — the same discipline as ``tools/profile_ps.py``.
 from __future__ import annotations
 
 import queue as _queue
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -38,6 +39,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..framework import monitor as _monitor
+from ..observability import flight_recorder as _flight
 from ..observability import trace as _trace
 
 __all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
@@ -247,6 +249,11 @@ class PredictorServer:
             with self._lock:
                 self._stats["shed_overload"] += 1
             _monitor.stat_add("serve_shed_overload")
+            _flight.record("serve.shed", reason="overload",
+                           depth=self._q.qsize(), rows=n)
+            # typed-failure trigger (rate limited: a load spike sheds
+            # thousands of requests but warrants ONE bundle)
+            _flight.maybe_dump("ServerOverloaded")
             raise ServerOverloaded(
                 f"queue depth cap {self._q.maxsize} reached; request "
                 "shed — back off and retry") from None
@@ -328,6 +335,9 @@ class PredictorServer:
                 with self._lock:
                     self._stats["shed_timeout"] += 1
                 _monitor.stat_add("serve_shed_timeout")
+                _flight.record("serve.shed", reason="timeout",
+                               queued_ms=round(
+                                   (t0 - r.t_submit) * 1e3, 3))
                 r.future.set_exception(RequestTimeout(
                     "request spent its whole deadline queued — server "
                     "overloaded"))
@@ -346,6 +356,9 @@ class PredictorServer:
         if batch_sp is not None:
             batch_sp.__enter__()
         try:
+            tok = (_flight.begin("serve.batch", bucket=bucket,
+                                 rows=rows, requests=len(live))
+                   if _flight.enabled() else None)
             n_in = len(live[0].arrays)
             padded = []
             for i in range(n_in):
@@ -375,6 +388,12 @@ class PredictorServer:
             # thread's span stack would mis-parent every later batch
             if batch_sp is not None:
                 batch_sp.__exit__(None, None, None)
+            if tok is not None:
+                # an open serve.batch in a bundle = the batcher thread
+                # died/stalled mid-run; a closed one is queue history
+                et = sys.exc_info()[0]
+                _flight.end(tok, **({} if et is None
+                                    else {"err": et.__name__}))
 
         with self._lock:
             s = self._stats

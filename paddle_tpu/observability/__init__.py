@@ -33,7 +33,10 @@ from ..framework.monitor import (  # noqa: F401
     Histogram, enable_metrics, gauge_add, gauge_get, gauge_set,
     get_histogram, hist_observe, metrics_enabled, metrics_reset,
     metrics_snapshot, stat_add, stat_get)
-from . import metrics, timeline, trace  # noqa: F401
+from . import flight_recorder, metrics, timeline, trace  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder, Watchdog, compile_log, flight_dump, flight_enabled,
+    flight_record)
 from .metrics import (  # noqa: F401
     MetricsFlusher, MetricsServer, prometheus_text, start_metrics_server)
 from .timeline import StepTimeline  # noqa: F401
@@ -42,10 +45,12 @@ from .trace import (  # noqa: F401
     as tracing_enabled, propagation_ctx, record_clock, server_span, span)
 
 __all__ = [
-    "trace", "metrics", "timeline",
+    "trace", "metrics", "timeline", "flight_recorder",
     "Span", "span", "server_span", "propagation_ctx", "record_clock",
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "StepTimeline", "Histogram",
+    "FlightRecorder", "Watchdog", "flight_record", "flight_dump",
+    "flight_enabled", "compile_log",
     "MetricsServer", "MetricsFlusher", "prometheus_text",
     "start_metrics_server",
     "enable_metrics", "metrics_enabled", "metrics_snapshot",
@@ -55,3 +60,5 @@ __all__ = [
 
 # honour PADDLE_METRICS / PADDLE_METRICS_PORT / PADDLE_METRICS_FILE
 metrics.enable_from_env()
+# honour PADDLE_FLIGHT (full mode installs the dump triggers)
+flight_recorder.enable_from_env()

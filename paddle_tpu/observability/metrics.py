@@ -35,10 +35,37 @@ from typing import Dict, Optional
 
 from ..framework import monitor as _monitor
 
-__all__ = ["prometheus_text", "MetricsServer", "MetricsFlusher",
-           "start_metrics_server", "enable_from_env"]
+__all__ = ["prometheus_text", "build_info", "MetricsServer",
+           "MetricsFlusher", "start_metrics_server", "enable_from_env"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# process start, for /healthz uptime
+_START_MONO = time.monotonic()
+
+_build_info_cache: Optional[Dict[str, str]] = None
+
+
+def build_info() -> Dict[str, str]:
+    """Version identity for the ``paddle_build_info`` gauge: the
+    paddle_tpu version plus the jax/jaxlib DIST versions — read from
+    package metadata, never by importing jax (this module serves
+    /metrics from jax-free PS subprocesses)."""
+    global _build_info_cache
+    if _build_info_cache is None:
+        try:
+            from .. import __version__ as ver
+        except Exception:
+            ver = "unknown"
+        import importlib.metadata as _md
+        info = {"version": str(ver)}
+        for dist in ("jax", "jaxlib"):
+            try:
+                info[dist] = _md.version(dist)
+            except Exception:
+                info[dist] = "unavailable"
+        _build_info_cache = info
+    return dict(_build_info_cache)
 
 
 def _prom_name(name: str) -> str:
@@ -59,10 +86,16 @@ def _fmt(v: float) -> str:
 
 def prometheus_text(snapshot: Optional[Dict] = None) -> str:
     """Render a registry snapshot (default: the live registry) as
-    Prometheus text exposition format."""
+    Prometheus text exposition format.  A constant
+    ``paddle_build_info`` gauge (version + jax/jaxlib dist versions as
+    labels, value 1 — the standard ``*_build_info`` idiom) leads the
+    exposition so every scrape identifies WHAT produced the numbers."""
     snap = snapshot if snapshot is not None \
         else _monitor.metrics_snapshot()
-    lines = []
+    bi = build_info()
+    lines = ["# TYPE paddle_build_info gauge",
+             "paddle_build_info{"
+             + ",".join(f'{k}="{bi[k]}"' for k in sorted(bi)) + "} 1"]
     for name in sorted(snap.get("counters", {})):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} counter")
@@ -109,14 +142,29 @@ class MetricsServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):            # noqa: N802 (stdlib API name)
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    # liveness probe: 200 + identity (the thing a k8s
+                    # readiness check or a human's curl asks first)
+                    body = json.dumps({
+                        "status": "ok",
+                        "uptime_s": round(
+                            time.monotonic() - _START_MONO, 3),
+                        "role": os.environ.get("PADDLE_TRACE_ROLE",
+                                               "proc"),
+                        "pid": os.getpid(),
+                        **build_info(),
+                    }).encode()
+                    ctype = "application/json"
+                elif path in ("/metrics", "/"):
+                    body = prometheus_text().encode()
+                    ctype = ("text/plain; version=0.0.4; "
+                             "charset=utf-8")
+                else:
                     self.send_error(404)
                     return
-                body = prometheus_text().encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; "
-                                 "charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -52,6 +52,7 @@ import numpy as np
 from .framework import monitor as _monitor
 from .framework.errors import EnforceNotMet
 from .framework.monitor import gauge_set, stat_add, stat_get
+from .observability import flight_recorder as _flight
 from .observability import trace as _obs_trace
 
 __all__ = ["TrainGuard", "HealthState", "NumericalDivergence",
@@ -399,6 +400,7 @@ class TrainGuard:
         if reason is None:
             self._history.append(float(h[2]))
             self._bad_streak = 0
+            self._flight_health(step, h, "ok", None)
             return "ok"
         self._bad_streak += 1
         self.events.append({"step": step, "reason": reason,
@@ -407,8 +409,24 @@ class TrainGuard:
                             else -1, "streak": self._bad_streak})
         if (self._bad_streak >= self.max_consecutive_bad
                 and self._can_rewind()):
+            self._flight_health(step, h, "rewind", reason)
             return "rewind"
+        self._flight_health(step, h, "skip", reason)
         return "skip"
+
+    @staticmethod
+    def _flight_health(step, h, verdict, reason):
+        """Flight-recorder copy of the step's health vector + verdict —
+        the per-step history a postmortem bundle replays (a diverging
+        run's last N health vectors including the fatal one)."""
+        if not _flight.enabled():
+            return
+        ev = {"step": step, "norm": float(h[0]),
+              "nonfinite": float(h[1]), "loss": float(h[2]),
+              "verdict": verdict}
+        if reason is not None:
+            ev["reason"] = reason
+        _flight.record("health", **ev)
 
     def _can_rewind(self) -> bool:
         return (self.manager is not None and self.restore_fn is not None
@@ -440,10 +458,19 @@ class TrainGuard:
         and ``at_step`` are NOT replayed — the caller just continues
         with its next batch (the PaLM skip-data semantics)."""
         if not self._can_rewind():
+            _flight.record("divergence", step=at_step,
+                           detail="no rewind target")
+            _flight.maybe_dump("NumericalDivergence")
             raise NumericalDivergence(
                 "TrainGuard cannot rewind: no CheckpointManager/"
                 "restore_fn/healthy checkpoint available")
         if self.rewinds >= self.rewind_budget:
+            # the fatal path: the bundle written here carries the whole
+            # skip/rewind history plus the last health vectors
+            _flight.record("divergence", step=at_step,
+                           rewinds=self.rewinds,
+                           budget=self.rewind_budget)
+            _flight.maybe_dump("NumericalDivergence")
             raise NumericalDivergence(
                 f"rewind budget exhausted ({self.rewinds}/"
                 f"{self.rewind_budget}) and the run is still diverging "
@@ -456,6 +483,8 @@ class TrainGuard:
         gauge_set("guard_rewinds", self.rewinds)
         self.events.append({"step": at_step, "reason": "rewind",
                             "to_step": target})
+        _flight.record("rewind", step=at_step, to_step=target,
+                       rewinds=self.rewinds)
         # the diverged region poisoned the rolling window; restart it
         self._history.clear()
         self._bad_streak = 0
@@ -487,6 +516,7 @@ class TrainGuard:
         if bad:
             self.blamed_rows.append((step, sorted(bad)))
             stat_add("guard_blamed_rows", len(bad))
+            _flight.record("blame", step=step, rows=sorted(bad))
         gauge_set("guard_blamed_rows",
                   sum(len(r) for _, r in self.blamed_rows))
         return sorted(bad)

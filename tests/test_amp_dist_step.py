@@ -148,6 +148,70 @@ def test_fp16_static_scaling_constant_scale():
     assert float(scale) == pytest.approx(1024.0)  # constant throughout
 
 
+def test_fp16_scaling_guard_health_exposes_fused_vector():
+    """ISSUE 7 satellite: guard_health=True now composes with fp16
+    dynamic loss scaling (the smallest ROADMAP guard-coverage gap) —
+    the fused [global_norm, nonfinite_count, loss] vector rides the
+    same compiled step and lands on step.last_health."""
+    from paddle_tpu.train_guard import TrainGuard
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 2.0 ** 10}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh,
+                                guard_health=True)
+    guard = TrainGuard()
+    xs, ys = _data(3)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        loss = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        h = np.asarray(step.last_health)
+        assert h.shape == (3,)
+        # the health loss slot is the UNSCALED loss the caller sees
+        assert float(h[2]) == pytest.approx(loss, rel=1e-3)
+        assert float(h[1]) == 0.0 and np.isfinite(h[0])
+        assert guard.check(step.last_health, step=i) == "ok"
+    # a poisoned batch flags nonfinite through the same vector (and
+    # the scaling state machine still counts its own bad step)
+    xb = xs[0].copy()
+    xb[0, 0] = np.nan
+    step(paddle.to_tensor(xb), paddle.to_tensor(ys[0]))
+    h = np.asarray(step.last_health)
+    assert float(h[1]) > 0 or not np.isfinite(h[2])
+    assert guard.check(step.last_health) == "skip"
+    _, _, bad = step._amp_state
+    assert int(bad) == 1
+
+
+def test_fp16_static_scaling_guard_health_runs():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs = {"dtype": "float16", "init_loss_scaling": 512.0,
+                     "use_dynamic_loss_scaling": False}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh,
+                                guard_health=True)
+    xs, ys = _data(2)
+    for x, y in zip(xs, ys):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+    h = np.asarray(step.last_health)
+    assert h.shape == (3,) and float(h[1]) == 0.0
+
+
+def test_guard_health_still_rejected_under_gradient_merge():
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 2}
+    m, opt = _build()
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh,
+                                guard_health=True)
+    xs, ys = _data(1)
+    with pytest.raises(NotImplementedError, match="gradient_merge"):
+        step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
+
+
 def test_fp16_scaling_with_gradient_merge_rejected():
     s = DistributedStrategy()
     s.amp = True

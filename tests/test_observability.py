@@ -206,7 +206,11 @@ def test_prometheus_text_golden():
         "histograms": {"rpc_ms": {
             "buckets": [[1.0, 1], [5.0, 3]], "sum": 7.5, "count": 4}},
     }
+    bi = obs_metrics.build_info()
     expected = (
+        "# TYPE paddle_build_info gauge\n"
+        "paddle_build_info{"
+        + ",".join(f'{k}="{bi[k]}"' for k in sorted(bi)) + "} 1\n"
         "# TYPE paddle_ps_client_retries counter\n"
         "paddle_ps_client_retries 3\n"
         "# TYPE paddle_serve_queue_depth gauge\n"
@@ -221,6 +225,17 @@ def test_prometheus_text_golden():
     assert obs_metrics.prometheus_text(snap) == expected
 
 
+def test_build_info_gauge_names_real_versions():
+    bi = obs_metrics.build_info()
+    assert set(bi) == {"version", "jax", "jaxlib"}
+    import paddle_tpu
+    assert bi["version"] == paddle_tpu.__version__
+    # dist metadata, not an import: the PS server process must be able
+    # to answer a scrape without pulling jax in
+    import jax
+    assert bi["jax"] == jax.__version__
+
+
 def test_metrics_endpoint_serves_live_registry():
     monitor.stat_add("obs_endpoint_counter", 7)
     monitor.gauge_set("obs_endpoint_gauge", 1.25)
@@ -233,6 +248,28 @@ def test_metrics_endpoint_serves_live_registry():
             body = r.read().decode()
         assert "paddle_obs_endpoint_counter 7" in body
         assert "paddle_obs_endpoint_gauge 1.25" in body
+        assert "paddle_build_info{" in body
+    finally:
+        srv.stop()
+
+
+def test_metrics_healthz_endpoint():
+    import urllib.error
+    srv = obs_metrics.MetricsServer(port=0, host="127.0.0.1").start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            body = json.loads(r.read().decode())
+        assert body["status"] == "ok"
+        assert body["pid"] == os.getpid()
+        assert body["uptime_s"] >= 0
+        assert "role" in body and "version" in body
+        # unknown paths still 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
     finally:
         srv.stop()
 
@@ -306,6 +343,47 @@ def test_trace_merge_corrects_skewed_clocks(tmp_path):
     assert client["pid"] != server["pid"]
     xs = [e["ts"] for e in evs if e["ph"] == "X"]
     assert xs == sorted(xs)
+
+
+def test_trace_merge_degrades_on_sink_without_clock_edge(tmp_path):
+    """A sink with NO clock-offset path to the root must degrade, not
+    fail: the merge exits 0, warns on stderr, emits the island sink's
+    spans on its own (uncorrected) timeline, and lists it under
+    metadata.uncorrected."""
+    trainer = tmp_path / "trace-trainer-1.jsonl"
+    island = tmp_path / "trace-island-9.jsonl"
+    _write_sink(trainer, [
+        {"t": "meta", "sink": "trainer-1", "role": "trainer", "pid": 1},
+        {"t": "span", "name": "step", "cat": "step", "ts_us": 1000,
+         "dur_us": 500, "pid": 1, "tid": 1, "trace": "t1",
+         "span": "a"},
+        # a clock sample naming a peer that never wrote a sink must
+        # not confuse the solver either
+        {"t": "clock", "peer": "ghost-7", "offset_us": 42.0,
+         "rtt_us": 10},
+    ])
+    _write_sink(island, [
+        {"t": "meta", "sink": "island-9", "role": "serve", "pid": 9},
+        {"t": "span", "name": "serve.batch", "cat": "serve",
+         "ts_us": 77_000, "dur_us": 250, "pid": 9, "tid": 2,
+         "trace": "t2", "span": "b"},
+    ])
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, _MERGE, str(trainer), str(island),
+         "-o", str(out)],
+        capture_output=True, text=True, cwd=_REPO)
+    assert r.returncode == 0, r.stderr
+    assert "no clock path" in r.stderr and "island-9" in r.stderr
+    merged = json.load(open(out))
+    assert merged["metadata"]["clock_offsets_us"]["island-9"] is None
+    assert merged["metadata"]["uncorrected"] == ["island-9"]
+    evs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    # both spans survived; the island span kept its own clock
+    names = {e["name"] for e in evs}
+    assert names == {"step", "serve.batch"}
+    isl = next(e for e in evs if e["name"] == "serve.batch")
+    assert isl["ts"] == 77_000
 
 
 # ---------------------------------------------------------------------------

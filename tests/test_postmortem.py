@@ -1,0 +1,357 @@
+"""ISSUE 7 acceptance: postmortem bundles + tools/postmortem.py merge.
+
+(a) A seeded ``diverge``-style chaos run (poisoned batches from step 4
+    on, rewind budget 1) must die with NumericalDivergence AND leave a
+    bundle containing the fatal step's health vector, the skip/rewind
+    history and the injected chaos events; tools/postmortem.py renders
+    them into the merged timeline + report.
+
+(b) A SIGKILL'd PS primary with a wedged client (long rpc deadline, no
+    progress) must trip the client's stall watchdog; the merged
+    Perfetto timeline shows the in-flight RPC spanning the stall, and
+    the clock-offset edges recorded in the flight ring (no tracing on)
+    fuse the trainer's and the server's bundles onto one timeline.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_POSTMORTEM = os.path.join(_REPO, "tools", "postmortem.py")
+
+
+def _read_bundle(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _env(tmp_path, role, **extra):
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    env.pop("PADDLE_TRACE", None)
+    env.update(JAX_PLATFORMS="cpu", PADDLE_FLIGHT="1",
+               PADDLE_TRACE_DIR=str(tmp_path),
+               PADDLE_TRACE_ROLE=role)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _wait_for(pred, timeout=60.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# (a) chaos-induced divergence -> bundle with health vectors + history
+# ---------------------------------------------------------------------------
+
+_DIVERGE_SRC = r"""
+import sys
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.framework import random as prandom
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.train_guard import TrainGuard, chaos_corrupt
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                parameters=net.parameters())
+mgr = CheckpointManager(sys.argv[2], max_to_keep=2)
+
+def state_fn():
+    return {"model": net.state_dict(), "opt": opt.state_dict(),
+            "rng": {"key": prandom.get_rng_state()}}
+
+def restore_fn(state):
+    net.set_state_dict(state["model"])
+    opt.set_state_dict(state["opt"])
+    prandom.set_rng_state(state["rng"]["key"])
+
+guard = TrainGuard(optimizer=opt, manager=mgr, state_fn=state_fn,
+                   restore_fn=restore_fn, min_history=10**9,
+                   max_consecutive_bad=2, rewind_budget=1,
+                   checkpoint_every=1)
+# every batch from the 4th on (step index 3 — the schedule is
+# 1-based) is poisoned, forever: skip, skip -> rewind -> skip, skip ->
+# budget exhausted -> NumericalDivergence
+chaos.install(chaos.plan_from_spec("nan:batch:step=4:every=1:times=0"))
+for step in range(64):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    (x,), _ = chaos_corrupt("batch", [x])
+    loss = F.mse_loss(net(Tensor(x)), Tensor(y))
+    loss.backward()
+    guard.step(loss, step=step)
+print("NO-DIVERGENCE", flush=True)
+"""
+
+
+def test_chaos_divergence_yields_postmortem_bundle(tmp_path):
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "-c", _DIVERGE_SRC, _REPO, str(ck)],
+        capture_output=True, text=True, timeout=300,
+        env=_env(tmp_path, "trainer"))
+    assert proc.returncode != 0
+    assert "NumericalDivergence" in proc.stderr
+    assert "NO-DIVERGENCE" not in proc.stdout
+    bundles = sorted(tmp_path.glob("flight-trainer-*.jsonl"))
+    assert bundles, sorted(tmp_path.glob("*"))
+    # the NumericalDivergence raise-site dump is the authoritative one
+    per_reason = {}
+    for b in bundles:
+        recs = _read_bundle(b)
+        per_reason[recs[0]["reason"]] = recs
+    assert "NumericalDivergence" in per_reason
+    recs = per_reason["NumericalDivergence"]
+    evs = [r for r in recs if r.get("t") == "event"]
+
+    # the fatal step's health vector: nonfinite, verdict != ok
+    healths = [e for e in evs if e["kind"] == "health"]
+    assert healths, "no health vectors in the bundle"
+    fatal = healths[-1]
+    assert fatal["verdict"] in ("skip", "rewind")
+    assert fatal["nonfinite"] > 0 or fatal["loss"] != fatal["loss"]
+    # healthy prefix is in the ring too (steps 0..3 ok)
+    assert any(h["verdict"] == "ok" for h in healths)
+
+    # skip/rewind history: 2 skips -> rewind -> 2 skips -> divergence
+    assert sum(1 for h in healths if h["verdict"] == "skip") >= 2
+    rewinds = [e for e in evs if e["kind"] == "rewind"]
+    assert len(rewinds) == 1 and rewinds[0]["to_step"] == 2
+    divs = [e for e in evs if e["kind"] == "divergence"]
+    assert divs and divs[0]["rewinds"] == 1
+
+    # dump-on-injected-fault: the chaos events that CAUSED it are there
+    chaos_evs = [e for e in evs if e["kind"] == "chaos"]
+    assert chaos_evs and all(e["fault"] == "nan" and e["op"] == "batch"
+                             for e in chaos_evs)
+
+    # postmortem tool over the bundle dir: timeline + report
+    out = tmp_path / "merged.json"
+    rep = tmp_path / "report.txt"
+    r = subprocess.run(
+        [sys.executable, _POSTMORTEM, "--dir", str(tmp_path),
+         "-o", str(out), "--report", str(rep)],
+        capture_output=True, text=True, cwd=_REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    merged = json.load(open(out))
+    names = {e["name"] for e in merged["traceEvents"]}
+    assert {"health", "rewind", "divergence", "chaos"} <= names
+    text = rep.read_text()
+    assert "POSTMORTEM" in text
+    assert "divergence" in text and "rewind" in text
+    assert "NumericalDivergence" in text
+    assert "<-- BAD" in text
+
+
+# ---------------------------------------------------------------------------
+# (b) SIGKILL'd PS + wedged client -> stall watchdog + merged timeline
+# ---------------------------------------------------------------------------
+
+_PS_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+srv = PSServer({"emb": SparseTable(4, optimizer="adagrad", lr=0.1,
+                                   seed=23)}, host="127.0.0.1")
+srv.start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+_TRAINER_SRC = r"""
+import sys, time
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from paddle_tpu.distributed.fleet.ps_service import PSClient, \
+    PSUnavailable
+ep = sys.argv[2]
+cli = PSClient([ep], mode="sync", worker_id="w0",
+               connect_timeout=2.0, rpc_timeout=10.0, max_retries=200,
+               backoff_base=0.05, rpc_deadline=120.0)
+ids = np.arange(16, dtype=np.int64)
+step = 0
+while True:
+    cli.pull("emb", ids)
+    cli.push("emb", ids, np.full((16, 4), 0.125, np.float32))
+    if step < 3:
+        # only the first few lines: an unread full stdout pipe would
+        # wedge this loop on print and fake a stall
+        print(f"STEP {step}", flush=True)
+    step += 1
+    time.sleep(0.02)
+"""
+
+
+def test_sigkilled_ps_trips_stall_watchdog_and_merges(tmp_path):
+    ps = subprocess.Popen(
+        [sys.executable, "-c", _PS_SRC, _REPO],
+        stdout=subprocess.PIPE, text=True, env=_env(tmp_path, "ps0"))
+    trainer = None
+    try:
+        info = json.loads(ps.stdout.readline())
+        ep = f"127.0.0.1:{info['port']}"
+        trainer = subprocess.Popen(
+            [sys.executable, "-c", _TRAINER_SRC, _REPO, ep],
+            stdout=subprocess.PIPE, text=True,
+            env=_env(tmp_path, "trainer", PADDLE_FLIGHT_STALL_S="1.0"))
+        # let real traffic flow (progress events + clock edges recorded)
+        for _ in range(3):
+            line = trainer.stdout.readline()
+            assert line.startswith("STEP"), line
+        # the server's own bundle, on demand, while it is still alive
+        ps.send_signal(signal.SIGUSR2)
+        _wait_for(lambda: sorted(tmp_path.glob("flight-ps0-*.jsonl")),
+                  what="ps bundle")
+        # SIGKILL the primary: the client's next RPC can never
+        # complete; with a 120 s deadline it is wedged in the retry
+        # loop and makes no progress -> the watchdog must fire
+        ps.kill()
+        ps.wait(timeout=10)
+        t_kill = time.monotonic()
+
+        def stall_bundle():
+            for p in sorted(tmp_path.glob("flight-trainer-*.jsonl")):
+                recs = _read_bundle(p)
+                if recs and recs[0].get("reason") == "stall":
+                    return (p, recs)
+            return None
+
+        path, recs = _wait_for(stall_bundle, timeout=60.0,
+                               what="trainer stall bundle")
+        assert time.monotonic() - t_kill < 30.0
+    finally:
+        for p in (ps, trainer):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # the bundle names the wedged RPC in its in-flight table
+    (infl,) = [r for r in recs if r.get("t") == "inflight"]
+    stalled_ops = [o for o in infl["ops"] if o.get("kind") == "rpc"]
+    assert stalled_ops, infl
+    assert stalled_ops[0]["op"] in ("pull", "push")
+    assert recs[0]["progress_age_s"] >= 1.0
+    # the all-thread stacks captured the blocked client
+    (stacks,) = [r for r in recs if r.get("t") == "stacks"]
+    assert stacks["threads"]
+    # clock edges recorded WITHOUT tracing enabled
+    clocks = [r for r in recs
+              if r.get("t") == "event" and r.get("kind") == "clock"]
+    assert clocks and clocks[0]["peer"].startswith("ps0-")
+
+    # merged timeline: trainer + ps bundles on one corrected clock,
+    # with the stalled RPC spanning the stall
+    out = tmp_path / "merged.json"
+    rep = tmp_path / "report.txt"
+    r = subprocess.run(
+        [sys.executable, _POSTMORTEM, "--dir", str(tmp_path),
+         "-o", str(out), "--report", str(rep)],
+        capture_output=True, text=True, cwd=_REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    merged = json.load(open(out))
+    offs = merged["metadata"]["clock_offsets_us"]
+    trainer_sink = [s for s in offs if s.startswith("trainer-")]
+    ps_sink = [s for s in offs if s.startswith("ps0-")]
+    assert trainer_sink and ps_sink
+    # the ps sink found a clock path to the trainer root
+    assert offs[ps_sink[0]] is not None
+    assert merged["metadata"]["root"] == trainer_sink[0]
+    stalled = [e for e in merged["traceEvents"]
+               if e["ph"] == "X" and e.get("args", {}).get("stalled")]
+    assert stalled, "no stalled span in the merged timeline"
+    rpc = [e for e in stalled if e["name"] == "rpc"]
+    assert rpc and rpc[0]["dur"] >= 0.5e6   # spans the >=1 s stall
+    # both processes have tracks (the server contributes instants —
+    # its ps.apply history; the client contributes the rpc spans)
+    pids = {e["pid"] for e in merged["traceEvents"]
+            if e["ph"] in ("X", "i")}
+    assert len(pids) >= 2
+    text = rep.read_text()
+    assert "IN FLIGHT" in text and "stall" in text
+    # server-side applies made it into the server's bundle/report
+    assert "ps.apply" in text
+
+
+# ---------------------------------------------------------------------------
+# postmortem tool unit: merge + ordering from synthetic bundles
+# ---------------------------------------------------------------------------
+
+def _write_bundle(path, sink, role, pid, reason, events, ts_us,
+                  inflight=()):
+    recs = [{"t": "meta", "sink": sink, "role": role, "pid": pid,
+             "reason": reason, "seq": 1, "ts_us": ts_us}]
+    recs += [dict(e, t="event") for e in events]
+    if inflight:
+        recs.append({"t": "inflight", "ops": list(inflight)})
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_postmortem_orders_first_divergence_first(tmp_path):
+    """Two synthetic bundles: the SERVER diverged first (rpc.error at
+    t=2s) even though the trainer's bad event (t=5s) was dumped first —
+    the report must lead with the server."""
+    _write_bundle(
+        tmp_path / "flight-trainer-1-1.jsonl", "trainer-1", "trainer",
+        1, "stall",
+        [{"kind": "step", "ts_us": 1_000_000, "i": 0},
+         {"kind": "health", "ts_us": 5_000_000, "verdict": "skip",
+          "nonfinite": 3.0, "loss": 1.0, "norm": 0.5, "step": 5}],
+        ts_us=6_000_000)
+    _write_bundle(
+        tmp_path / "flight-ps0-2-1.jsonl", "ps0-2", "ps0", 2,
+        "SIGUSR2",
+        [{"kind": "ps.apply", "ts_us": 1_500_000, "op": "push"},
+         {"kind": "rpc.error", "ts_us": 2_000_000, "op": "push",
+          "attempts": 9}],
+        ts_us=6_500_000)
+    rep = tmp_path / "report.txt"
+    r = subprocess.run(
+        [sys.executable, _POSTMORTEM, "--dir", str(tmp_path),
+         "--report", str(rep)],
+        capture_output=True, text=True, cwd=_REPO, timeout=60)
+    assert r.returncode == 0, r.stderr
+    text = rep.read_text()
+    assert text.index("ps0 (ps0-2)") < text.index("trainer (trainer-1)")
+    assert text.count("<-- BAD") == 2
+
+
+def test_postmortem_synthesizes_span_for_unclosed_begin(tmp_path):
+    _write_bundle(
+        tmp_path / "flight-t-3-1.jsonl", "t-3", "trainer", 3, "stall",
+        [{"kind": "step", "ts_us": 900_000, "i": 0}],
+        ts_us=3_500_000,
+        inflight=[{"kind": "rpc", "ts_us": 1_000_000, "op": "pull",
+                   "shard": 0, "open_us": 2_500_000}])
+    out = tmp_path / "m.json"
+    r = subprocess.run(
+        [sys.executable, _POSTMORTEM, "--dir", str(tmp_path),
+         "-o", str(out), "--report", str(tmp_path / "r.txt")],
+        capture_output=True, text=True, cwd=_REPO, timeout=60)
+    assert r.returncode == 0, r.stderr
+    merged = json.load(open(out))
+    (span,) = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert span["name"] == "rpc" and span["args"]["stalled"] is True
+    assert span["ts"] == 1_000_000 and span["dur"] == 2_500_000

@@ -1,0 +1,364 @@
+#!/usr/bin/env python
+"""Fuse flight-recorder bundles (+ trace sinks) into one postmortem.
+
+Each process that died, stalled or was asked (``SIGUSR2``) wrote a
+postmortem bundle ``$PADDLE_TRACE_DIR/flight-<role>-<pid>-<n>.jsonl``
+(see ``paddle_tpu/observability/flight_recorder.py``); processes that
+also had PR 5 tracing on left ``trace-<role>-<pid>.jsonl`` sinks next
+to them.  This tool merges everything from a run — trainer + PS
+primary + replica + serving — into:
+
+1. **One clock-corrected Perfetto/Chrome timeline** (``-o``): trace
+   spans, flight begin/end op pairs (an UNCLOSED begin — the stalled
+   RPC a watchdog bundle caught in flight — becomes a span stretching
+   to the dump instant, marked ``stalled``), and every other ring
+   event as an instant.  Clock offsets are solved exactly like
+   ``tools/trace_merge.py`` (same BFS, reused code) over the union of
+   trace clock records and the bundles' ``clock`` events — the PS
+   register reply carries the server clock whether or not tracing was
+   on, so flight-only runs still fuse onto one timeline.  Sinks with
+   no path to the root keep their own clock, with a warning.
+
+2. **A human-readable report** (``--report``, default stdout): the
+   last 50 events per process, processes ordered FIRST DIVERGENCE
+   FIRST (the earliest bad event — nonfinite health, rpc.error,
+   divergence, stall, chaos injection — decides the order, because
+   the process that diverged first is where the autopsy starts), plus
+   each process's dump reasons, in-flight ops and exception.
+
+Usage::
+
+    python tools/postmortem.py --dir paddle_trace -o postmortem.json \
+        --report postmortem.txt
+    python tools/postmortem.py trainer_bundle.jsonl ps_bundle.jsonl
+
+Open the ``-o`` output in chrome://tracing or https://ui.perfetto.dev.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
+
+# ring-event kinds that mark a process as "diverging" for the report
+# order (first divergence first)
+_BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
+              "ps.replica_error", "serve.shed"}
+
+
+def _is_bad(ev: dict) -> bool:
+    k = ev.get("kind")
+    if k in _BAD_KINDS:
+        return True
+    if k == "health" and ev.get("verdict") not in (None, "ok"):
+        return True
+    return False
+
+
+def read_bundle(path: str) -> dict:
+    """Parse one flight bundle -> {sink, role, pid, reason, ts_us,
+    events, inflight, stacks, metrics, compiles, exc}."""
+    out = {"sink": None, "role": "proc", "pid": 0, "reason": "?",
+           "ts_us": 0, "events": [], "inflight": [], "stacks": None,
+           "metrics": None, "compiles": [], "exc": None, "path": path}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # torn tail (process died mid-dump)
+            t = rec.get("t")
+            if t == "meta":
+                out.update(sink=rec.get("sink"), role=rec.get("role",
+                           "proc"), pid=rec.get("pid", 0),
+                           reason=rec.get("reason", "?"),
+                           ts_us=rec.get("ts_us", 0))
+            elif t == "event":
+                out["events"].append(rec)
+            elif t == "inflight":
+                out["inflight"] = rec.get("ops", [])
+            elif t == "stacks":
+                out["stacks"] = rec.get("threads")
+            elif t == "metrics":
+                out["metrics"] = {k: v for k, v in rec.items()
+                                  if k != "t"}
+            elif t == "compiles":
+                out["compiles"] = rec.get("entries", [])
+            elif t == "exc":
+                out["exc"] = rec
+    if out["sink"] is None:
+        base = os.path.basename(path)
+        out["sink"] = base[len("flight-"):].rsplit("-", 1)[0] \
+            if base.startswith("flight-") else base
+    return out
+
+
+class _Proc:
+    """Everything known about one process (sink id): 0..n flight
+    bundles + 0..1 trace sink, reduced to spans/instants/clocks."""
+
+    def __init__(self, sink: str):
+        self.sink = sink
+        self.role = "proc"
+        self.pid = 0
+        self.bundles: List[dict] = []
+        self.trace_spans: List[dict] = []
+        self.clocks: List[dict] = []
+        self.events: List[dict] = []      # deduped ring events
+        self._seen = set()
+        self.inflight: List[dict] = []
+        self.exc = None
+        self.stacks = None
+        self.compiles: List[dict] = []
+        self.dump_ts_us = 0
+
+    def add_bundle(self, b: dict):
+        self.bundles.append(b)
+        self.role, self.pid = b["role"], b["pid"]
+        self.dump_ts_us = max(self.dump_ts_us, b.get("ts_us", 0))
+        for ev in b["events"]:
+            key = json.dumps(ev, sort_keys=True, default=str)
+            if key in self._seen:    # successive dumps overlap rings
+                continue
+            self._seen.add(key)
+            self.events.append(ev)
+            if ev.get("kind") == "clock":
+                self.clocks.append({"peer": ev.get("peer"),
+                                    "offset_us": ev.get("offset_us",
+                                                        0.0),
+                                    "rtt_us": ev.get("rtt_us", 0.0)})
+        self.inflight = b["inflight"] or self.inflight
+        self.exc = b["exc"] or self.exc
+        self.stacks = b["stacks"] or self.stacks
+        self.compiles = b["compiles"] or self.compiles
+
+    def add_trace_sink(self, s: dict):
+        self.role = self.role if self.bundles else s["role"]
+        self.pid = self.pid or s["pid"]
+        self.trace_spans.extend(s["spans"])
+        self.clocks.extend(s["clocks"])
+
+    def spans_and_instants(self):
+        """Ring events -> (spans, instants).  A completed op event
+        carries its begin timestamp + ``dur_us`` (one record per op);
+        an op the dump caught IN FLIGHT becomes a span stretching to
+        the dump instant, marked stalled."""
+        spans, instants = [], []
+        for ev in sorted(self.events, key=lambda e: e.get("ts_us", 0)):
+            if ev.get("kind") == "clock":
+                continue
+            if "dur_us" in ev:
+                args = {k: v for k, v in ev.items()
+                        if k not in ("t", "kind", "ts_us", "dur_us")}
+                spans.append({"name": ev.get("kind", "op"),
+                              "ts_us": ev.get("ts_us", 0),
+                              "dur_us": ev["dur_us"], "args": args})
+            else:
+                instants.append(ev)
+        end = self.dump_ts_us
+        have = {(s["ts_us"], s["name"]) for s in spans}
+        for op in self.inflight:
+            if (op.get("ts_us"), op.get("kind")) in have:
+                continue
+            args = {k: v for k, v in op.items()
+                    if k not in ("t", "kind", "ts_us", "open_us")}
+            args["stalled"] = True
+            spans.append({"name": op.get("kind", "op"),
+                          "ts_us": op.get("ts_us", end),
+                          "dur_us": max(0, end - op.get("ts_us", end)),
+                          "args": args})
+        return spans, instants
+
+
+def collect(paths: List[str]) -> List[_Proc]:
+    procs: Dict[str, _Proc] = {}
+
+    def proc(sink):
+        if sink not in procs:
+            procs[sink] = _Proc(sink)
+        return procs[sink]
+
+    for p in paths:
+        base = os.path.basename(p)
+        if base.startswith("flight-"):
+            b = read_bundle(p)
+            proc(b["sink"]).add_bundle(b)
+        else:
+            s = trace_merge.read_sink(p)
+            proc(s["sink"]).add_trace_sink(s)
+    return list(procs.values())
+
+
+def merge(procs: List[_Proc], root: Optional[str] = None) -> dict:
+    """One Chrome trace over every process's spans + instants, clock
+    corrected onto the root's timeline (root: named sink, else the
+    first trainer-role process, else the first)."""
+    if root is None:
+        trainers = [p.sink for p in procs if "train" in p.role]
+        root = trainers[0] if trainers else procs[0].sink
+    procs = sorted(procs, key=lambda p: p.sink != root)
+    pseudo = [{"sink": p.sink, "clocks": p.clocks} for p in procs]
+    offsets = trace_merge.solve_offsets(pseudo)
+    uncorrected = [s for s, v in offsets.items() if v is None]
+    for s in uncorrected:
+        print(f"postmortem: no clock path from {s} to root {root}; "
+              f"leaving its clock uncorrected", file=sys.stderr)
+
+    events = []
+    for i, p in enumerate(procs):
+        pid = i + 1
+        off = offsets[p.sink] or 0.0
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": f"{p.role} ({p.sink})"}})
+        spans, instants = p.spans_and_instants()
+        for sp in spans:
+            events.append({"ph": "X", "name": sp["name"],
+                           "cat": "flight", "pid": pid, "tid": 0,
+                           "ts": float(sp["ts_us"]) - off,
+                           "dur": float(sp["dur_us"]),
+                           "args": sp["args"]})
+        for ev in instants:
+            args = {k: v for k, v in ev.items()
+                    if k not in ("t", "kind", "ts_us")}
+            events.append({"ph": "i", "name": ev.get("kind", "event"),
+                           "cat": "flight", "pid": pid, "tid": 0,
+                           "s": "p",
+                           "ts": float(ev.get("ts_us", 0)) - off,
+                           "args": args})
+        for sp in p.trace_spans:
+            args = dict(sp.get("args") or {})
+            args["span"] = sp.get("span")
+            if sp.get("parent") is not None:
+                args["parent"] = sp["parent"]
+            events.append({"ph": "X", "name": sp["name"],
+                           "cat": sp.get("cat", "host"), "pid": pid,
+                           "tid": int(sp.get("tid", 0)) % (1 << 31),
+                           "ts": float(sp["ts_us"]) - off,
+                           "dur": float(sp.get("dur_us", 0)),
+                           "args": args})
+    events.sort(key=lambda e: (e.get("ts", 0.0), e["ph"] != "M"))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"root": root,
+                         "clock_offsets_us": dict(offsets),
+                         "uncorrected": uncorrected}}
+
+
+def _fmt_ev(ev: dict, t0_us: float, off: float) -> str:
+    rel = (ev.get("ts_us", 0) - off - t0_us) / 1e6
+    extra = {k: v for k, v in ev.items()
+             if k not in ("t", "kind", "ts_us")}
+    mark = " <-- BAD" if _is_bad(ev) else ""
+    return f"  +{rel:10.4f}s  {ev.get('kind', '?'):<12} " \
+           f"{json.dumps(extra, sort_keys=True, default=str)}{mark}"
+
+
+def report(procs: List[_Proc], merged: dict, last_n: int = 50) -> str:
+    """Last ``last_n`` events per process, first divergence first."""
+    offsets = merged["metadata"]["clock_offsets_us"]
+    all_ts = [e.get("ts_us", 0) - (offsets.get(p.sink) or 0.0)
+              for p in procs for e in p.events]
+    t0 = min(all_ts) if all_ts else 0.0
+
+    def first_bad(p: _Proc) -> float:
+        off = offsets.get(p.sink) or 0.0
+        bad = [e.get("ts_us", 0) - off for e in p.events if _is_bad(e)]
+        return min(bad) if bad else float("inf")
+
+    lines = ["=" * 72,
+             "POSTMORTEM  (first divergence first; timestamps relative "
+             "to the run's first recorded event, clock corrected)",
+             "=" * 72]
+    for p in sorted(procs, key=first_bad):
+        off = offsets.get(p.sink) or 0.0
+        reasons = sorted({b["reason"] for b in p.bundles})
+        lines.append("")
+        lines.append(f"-- {p.role} ({p.sink})"
+                     + (f"  dumps={len(p.bundles)}"
+                        f" reason={','.join(reasons)}" if p.bundles
+                        else "  (trace sink only)")
+                     + ("  [clock uncorrected]"
+                        if offsets.get(p.sink) is None else ""))
+        if p.exc:
+            lines.append(f"   exception: {p.exc.get('type')}: "
+                         f"{p.exc.get('value')}")
+        for op in p.inflight:
+            lines.append(
+                f"   IN FLIGHT at dump: {op.get('kind')} "
+                + json.dumps({k: v for k, v in op.items()
+                              if k not in ('t', 'kind', 'ts_us', 'ph',
+                                           'id')}, sort_keys=True,
+                             default=str))
+        evs = sorted(p.events, key=lambda e: e.get("ts_us", 0))
+        if len(evs) > last_n:
+            lines.append(f"   ... {len(evs) - last_n} older events "
+                         f"elided (ring kept {len(evs)}) ...")
+        for ev in evs[-last_n:]:
+            lines.append(_fmt_ev(ev, t0, off))
+        if p.compiles:
+            lines.append(f"   compiles ({len(p.compiles)}):")
+            for c in p.compiles[-8:]:
+                mem = (f" peak={c['peak_bytes']}B"
+                       if "peak_bytes" in c else "")
+                lines.append(f"     {c.get('program')}: "
+                             f"{c.get('cause')} {c.get('wall_ms')}ms "
+                             f"key={c.get('key')}{mem}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="*",
+                    help="flight-*.jsonl bundles and/or trace-*.jsonl "
+                         "sinks")
+    ap.add_argument("--dir", help="also merge every flight-*.jsonl / "
+                                  "trace-*.jsonl under DIR")
+    ap.add_argument("--root", help="sink id to anchor the timeline "
+                                   "(default: the first trainer role)")
+    ap.add_argument("-o", "--out", help="merged Chrome/Perfetto JSON "
+                                        "output path")
+    ap.add_argument("--report", help="write the text report here "
+                                     "(default: stdout)")
+    ap.add_argument("--last", type=int, default=50,
+                    help="events per process in the report")
+    args = ap.parse_args(argv)
+    paths = list(args.inputs)
+    if args.dir:
+        for pat in ("flight-*.jsonl", "trace-*.jsonl"):
+            for p in sorted(glob.glob(os.path.join(args.dir, pat))):
+                if p not in paths:
+                    paths.append(p)
+    if not paths:
+        ap.error("no inputs (positional or --dir)")
+    procs = collect(paths)
+    if not procs:
+        ap.error("no parseable bundles/sinks in the inputs")
+    merged = merge(procs, root=args.root)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        n_spans = sum(1 for e in merged["traceEvents"]
+                      if e["ph"] == "X")
+        print(f"postmortem: {len(procs)} process(es) -> {args.out} "
+              f"({n_spans} spans)", file=sys.stderr)
+    text = report(procs, merged, last_n=args.last)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

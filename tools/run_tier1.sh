@@ -9,8 +9,10 @@
 #        harness + PS fault tolerance + crash-mid-save) as a third
 #        pass with its fixed, deterministic seeds
 #        --trace additionally runs the whole suite with PADDLE_TRACE=1
-#        PADDLE_METRICS=1 (sinks into a temp dir) — proving always-on
-#        telemetry neither breaks determinism nor leaks sink files
+#        PADDLE_METRICS=1 AND the flight recorder in full mode
+#        (PADDLE_FLIGHT=1 — ISSUE 7: dump triggers armed, bundles into
+#        the same temp dir) — proving always-on telemetry neither
+#        breaks determinism nor leaks sink/bundle files into the repo
 #        --lint runs GraftLint (ISSUE 6): the AST concurrency/tracing
 #        linter over the repo module set AND the jaxpr self-audit of
 #        the step programs, gated on tools/lint_baseline.json — any
@@ -81,17 +83,22 @@ if [ "$TRACE" -eq 1 ]; then
     # Red here means telemetry perturbs training math or test state;
     # stray sink files outside the temp dir mean a test wrote its sink
     # into the repo (a leak the default-off contract forbids).
-    echo "== tier-1 trace pass: PADDLE_TRACE=1 PADDLE_METRICS=1"
+    echo "== tier-1 trace pass: PADDLE_TRACE=1 PADDLE_METRICS=1" \
+         "PADDLE_FLIGHT=1"
     TRACE_DIR=$(mktemp -d -t tier1_trace.XXXXXX)
     env JAX_PLATFORMS=cpu PADDLE_TRACE=1 PADDLE_METRICS=1 \
-        PADDLE_TRACE_DIR="$TRACE_DIR" \
+        PADDLE_FLIGHT=1 PADDLE_TRACE_DIR="$TRACE_DIR" \
         python -m pytest tests/ "${PYARGS[@]}" -p no:randomly
     rc4=$?
-    LEAKED=$(find . -maxdepth 2 -name 'trace-*.jsonl' -not -path \
+    # a green run must leak NEITHER trace sinks NOR flight bundles /
+    # faulthandler sidecars into the repo (tests that trigger dumps
+    # point PADDLE_TRACE_DIR at their own tmp dirs)
+    LEAKED=$(find . -maxdepth 2 \( -name 'trace-*.jsonl' -o -name \
+        'flight-*.jsonl' -o -name 'faulthandler-*.txt' \) -not -path \
         './paddle_trace/*' 2>/dev/null; [ -d paddle_trace ] && echo \
         paddle_trace)
     if [ -n "$LEAKED" ]; then
-        echo "== trace pass leaked sink files into the repo:"
+        echo "== trace pass leaked sink/bundle files into the repo:"
         echo "$LEAKED"
         rc4=1
     fi

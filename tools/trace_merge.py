@@ -104,10 +104,18 @@ def solve_offsets(sinks: List[dict]) -> Dict[str, Optional[float]]:
 
 
 def merge_sinks(sinks: List[dict]) -> dict:
-    """Merge parsed sinks into a Chrome trace event dict."""
+    """Merge parsed sinks into a Chrome trace event dict.
+
+    A sink with no clock-offset path to the root DEGRADES, never
+    fails: its spans are emitted on its own (uncorrected) timeline, a
+    warning goes to stderr, and the sink is listed under
+    ``metadata.uncorrected`` so tooling can tell estimated-aligned
+    tracks from as-recorded ones."""
     offsets = solve_offsets(sinks)
+    uncorrected = []
     for s in sinks:
         if offsets[s["sink"]] is None:
+            uncorrected.append(s["sink"])
             print(f"trace_merge: no clock path from {s['sink']} to "
                   f"root {sinks[0]['sink']}; leaving its clock "
                   f"uncorrected", file=sys.stderr)
@@ -162,7 +170,8 @@ def merge_sinks(sinks: List[dict]) -> dict:
     events.sort(key=lambda e: (e.get("ts", 0.0), e["ph"] != "M"))
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "metadata": {"clock_offsets_us": {
-                k: v for k, v in offsets.items()}}}
+                k: v for k, v in offsets.items()},
+                "uncorrected": uncorrected}}
 
 
 def merge_files(paths: List[str]) -> dict:
