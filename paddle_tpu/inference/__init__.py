@@ -32,7 +32,8 @@ from ..observability import flight_recorder as _flight
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version",
-           "PredictorServer", "ServeError", "ServerOverloaded",
+           "PredictorServer", "GenerationServer", "GenerationStream",
+           "ServeError", "ServerOverloaded",
            "ServerClosed", "RequestTimeout", "enable_compile_cache"]
 
 
@@ -426,6 +427,10 @@ class Predictor:
         self._jit_call = jax.jit(_flat_call)
         self._executables: Dict[tuple, object] = {}
         self._compile_count = 0
+        # per-executable compile provenance: shape key -> {cause,
+        # batch, wall_ms} (PredictorServer.stats() surfaces these as
+        # per-bucket prewarm/compile counts)
+        self._compile_info: Dict[tuple, dict] = {}
 
         n = meta.get("n_inputs", len(meta.get("input_names", [])) or 1)
         names = meta.get("input_names") or [f"x{i}" for i in range(n)]
@@ -495,6 +500,13 @@ class Predictor:
         exe = lowered.compile()
         self._compile_count += 1
         self._executables[key] = exe
+        try:
+            batch = int(key[0][0][0])
+        except (IndexError, TypeError, ValueError):
+            batch = None
+        self._compile_info[key] = {
+            "cause": str(cause), "batch": batch,
+            "wall_ms": round((_time.perf_counter() - t0) * 1e3, 3)}
         _flight.note_compile(
             f"Predictor[{os.path.basename(self._config._path_prefix())}]",
             cause, (_time.perf_counter() - t0) * 1e3,
@@ -516,6 +528,12 @@ class Predictor:
 
     def compiled_shapes(self) -> List[tuple]:
         return list(self._executables.keys())
+
+    def compile_records(self) -> List[dict]:
+        """One record per built executable: {cause, batch, wall_ms} —
+        cause is load / prewarm / new_shape_bucket.  The serving tier
+        aggregates these into per-bucket compile counts."""
+        return [dict(v) for v in self._compile_info.values()]
 
     def prewarm(self, batch_sizes) -> "Predictor":
         """Compile (or cache-load) the executable for each batch size
@@ -640,5 +658,7 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+from .generation_server import (GenerationServer,  # noqa: E402
+                                GenerationStream)
 from .serving import (PredictorServer, RequestTimeout,  # noqa: E402
                       ServeError, ServerClosed, ServerOverloaded)

@@ -1,0 +1,852 @@
+"""paddle_tpu.inference.generation_server — continuous-batching LLM
+serving: block-paged KV cache + iteration-level decode scheduler
+(ISSUE 8 tentpole; ROADMAP item 1).
+
+``PredictorServer`` micro-batches FIXED-shape requests; generative
+decoding is the other regime: every sequence advances one token per
+model call, sequences finish at different times, and a dedicated
+``[B, Smax]`` KV buffer per conversation would cap concurrency at
+HBM / (Smax * layers * heads).  This module is the Orca-style
+iteration-level scheduler + vLLM-style paged KV cache built on the
+same AOT discipline as the rest of ``inference/``:
+
+- **block-paged KV cache** — K/V live in per-layer pools
+  ``[num_blocks, block_size, KH, D]`` shared by every sequence; a
+  sequence owns a list of physical blocks and its cache reads are a
+  gather over its block table (``LlamaAttention.forward_paged``).
+  Physical block 0 is the TRASH block: never allocated, the target of
+  masked writes (prompt padding, idle decode slots), never read (the
+  slot <= position mask).  Thousands of conversations share one HBM
+  budget and freeing is O(blocks), not O(bytes).
+- **iteration-level scheduling** — admission/eviction decisions happen
+  every decode step, not per request: finished sequences free their
+  blocks immediately and waiting requests are admitted mid-flight.
+  PREFILL compiles one program per power-of-2 prompt bucket (B=1);
+  DECODE is ONE fixed-shape program over all ``num_slots`` batch slots
+  regardless of how many are live — steady state never retraces
+  (``num_compiles()`` is the proof, same contract as ``Predictor``).
+- **typed shed semantics** shared with ``PredictorServer``
+  (:class:`ServerOverloaded` at the waiting-queue cap,
+  :class:`RequestTimeout` for requests whose deadline passes while
+  waiting) extended with **block-pool-exhaustion eviction**: when a
+  running sequence needs a block and the pool is dry, the
+  lowest-priority sequence is evicted (blocks freed, back to the
+  waiting queue) and later re-admitted.
+- **bit-identical re-admission** — re-admission re-runs the ORIGINAL
+  prompt through the same prefill program (same bucket, same inputs =>
+  identical K/V and logits), then replays the already-emitted tokens
+  through the normal decode program with the sampled token overridden
+  by the stored one.  Because every decode slot's math depends only on
+  its own inputs (no cross-slot reduction), each replayed step is the
+  exact computation the uninterrupted run performed, so the resumed
+  stream is bit-identical — including sampling: the RNG key for token
+  j is ``fold_in(request_key, j-1)``, a pure function of the stream
+  position, so the RNG stream position survives eviction by
+  construction.  (A plain re-prefill over prompt+suffix would NOT be
+  bit-identical: prefill and decode use different attention kernels.)
+- **streaming responses** — :meth:`GenerationServer.submit` returns a
+  :class:`GenerationStream` immediately; tokens arrive on it as each
+  decode step completes (iterate it, or ``result()`` to block for the
+  full continuation).
+
+Observability rides the existing seams: serve histograms
+(``decode_step_ms`` / ``prefill_ms`` / ``serve_ttft_ms``), counters
+and gauges in the StatRegistry, and flight-recorder events
+(``serve.admit`` / ``serve.evict`` / ``serve.stream_end`` +
+sampled ``serve.decode``) so ``tools/postmortem.py`` can autopsy a
+pool-exhaustion shed.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import monitor as _monitor
+from ..observability import flight_recorder as _flight
+from .serving import (RequestTimeout, ServeError, ServerClosed,
+                      ServerOverloaded)
+
+__all__ = ["GenerationServer", "GenerationStream", "ServeError",
+           "ServerOverloaded", "ServerClosed", "RequestTimeout"]
+
+# one serve.decode ring event per this many decode steps: the ring is
+# postmortem context, not a per-token log (progress() still ticks the
+# stall watchdog every step)
+_FLIGHT_DECODE_EVERY = 32
+
+_END = object()
+
+
+class GenerationStream:
+    """Streaming handle for one generation request.
+
+    Iterating yields token ids as the scheduler produces them; the
+    iterator ends when the sequence finishes (``eos`` or
+    ``max_new_tokens``).  Errors (timeout while waiting, server
+    stopped) raise from the iterator / :meth:`result`.  ``tokens``
+    holds everything yielded so far.
+    """
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._q: _queue.Queue = _queue.Queue()
+        self.tokens: List[int] = []
+        self._exc: Optional[BaseException] = None
+        self._ended = False
+        self.finish_reason: Optional[str] = None
+
+    # -- producer side (scheduler thread) ----------------------------
+    def _emit(self, tok: int):
+        self.tokens.append(int(tok))
+        self._q.put(int(tok))
+
+    def _end(self, reason: str):
+        self.finish_reason = reason
+        self._q.put(_END)
+
+    def _fail(self, exc: BaseException):
+        self._exc = exc
+        self._q.put(_END)
+
+    # -- consumer side -----------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self, timeout: float = 600.0):
+        if self._ended:
+            raise StopIteration
+        item = self._q.get(timeout=timeout)
+        if item is _END:
+            self._ended = True
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream ends; returns the full token list."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._ended:
+            rem = (None if deadline is None
+                   else max(deadline - time.monotonic(), 0.0))
+            try:
+                self.__next__(timeout=600.0 if rem is None else rem)
+            except StopIteration:
+                break
+            except _queue.Empty:
+                raise RequestTimeout(
+                    f"stream {self.request_id} did not finish within "
+                    f"{timeout}s") from None
+        return list(self.tokens)
+
+
+class _GenSeq:
+    """Scheduler-internal sequence state (one per request)."""
+
+    __slots__ = (
+        "rid", "prompt", "L", "max_new", "eos", "do_sample", "temp",
+        "top_k", "top_p", "key_data", "priority", "arrival", "deadline",
+        "stream", "generated", "decoded", "blocks", "slot", "evictions",
+        "t_submit", "t_first_tok")
+
+    def __init__(self, rid, prompt, max_new, eos, do_sample, temp,
+                 top_k, top_p, key_data, priority, arrival, deadline):
+        self.rid = rid
+        self.prompt = prompt                  # np.int32 [L]
+        self.L = int(prompt.shape[0])
+        self.max_new = int(max_new)
+        self.eos = eos
+        self.do_sample = bool(do_sample)
+        self.temp = float(temp)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.key_data = key_data              # np.uint32 [W]
+        self.priority = int(priority)
+        self.arrival = arrival
+        self.deadline = deadline
+        self.stream = GenerationStream(rid)
+        self.generated: List[int] = []        # emitted tokens t1..tn
+        self.decoded = 0          # decode steps done since (re)prefill
+        self.blocks: List[int] = []
+        self.slot: Optional[int] = None
+        self.evictions = 0
+        self.t_submit = time.monotonic()
+        self.t_first_tok: Optional[float] = None
+
+
+def _pow2_buckets(lo: int, hi: int) -> List[int]:
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return sorted(set(out))
+
+
+class GenerationServer:
+    """Continuous-batching generative server over a KV-cache-capable
+    causal LM (``supports_kv_cache()`` / ``forward_paged``).
+
+    Usage::
+
+        server = GenerationServer(model, num_slots=8, block_size=16,
+                                  num_blocks=256, max_model_len=512)
+        server.start()                    # prewarms every program
+        stream = server.submit(prompt_ids, max_new_tokens=64)
+        for tok in stream:                # tokens stream per step
+            ...
+        server.stop()
+
+    Knobs:
+
+    - ``num_slots``: decode batch width — the ONE fixed-shape decode
+      program runs over this many slots every step, live or idle.
+    - ``block_size`` / ``num_blocks``: KV pool geometry.  Block 0 is
+      the trash block, so ``num_blocks - 1`` blocks are allocatable;
+      default ``num_blocks`` sizes the pool for ``num_slots``
+      full-length sequences (no oversubscription — oversubscribe
+      deliberately to exercise eviction).
+    - ``max_model_len``: prompt + generation cap per sequence; fixes
+      the block-table width ``ceil(max_model_len / block_size)``.
+    - ``prompt_buckets``: prefill compiles one program per bucket
+      (default: powers of two up to ``max_model_len``).
+    - ``max_waiting``: waiting-queue depth cap; past it ``submit``
+      sheds with :class:`ServerOverloaded`.
+    - ``request_timeout_s``: deadline enforced while a request WAITS
+      (initial queue or evicted); admitted sequences run to
+      completion.
+    - ``check_replay``: assert that every replayed (post-eviction)
+      step reproduces the stored token — the bit-identity contract
+      checked live, at one host compare per replayed token.
+    """
+
+    def __init__(self, model, num_slots: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_model_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_waiting: int = 256,
+                 request_timeout_s: float = 300.0,
+                 seed: int = 0, check_replay: bool = False):
+        if not bool(getattr(model, "supports_kv_cache",
+                            lambda: False)()):
+            # surface the model's own typed error (names the
+            # scan_layers=False workaround for stacked llamas)
+            init = getattr(model, "init_paged_cache", None)
+            if init is not None:
+                init(1, 1)   # raises KVCacheUnsupportedError
+            raise ServeError(
+                "GenerationServer requires a KV-cache-capable model "
+                "(supports_kv_cache() is False)")
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self._model = model
+        self._num_slots = int(num_slots)
+        self._bs = int(block_size)
+        if max_model_len is None:
+            max_model_len = int(getattr(model.config,
+                                        "max_position_embeddings", 2048))
+        self._max_len = int(max_model_len)
+        self._M = -(-self._max_len // self._bs)   # block-table width
+        if num_blocks is None:
+            num_blocks = self._num_slots * self._M + 1
+        self._num_blocks = int(num_blocks)
+        if self._num_blocks < self._M + 1:
+            raise ValueError(
+                f"num_blocks={self._num_blocks} cannot hold even one "
+                f"max-length sequence ({self._M} blocks) plus the "
+                "trash block; raise num_blocks or lower max_model_len")
+        bks = sorted(set(int(b) for b in (
+            prompt_buckets or _pow2_buckets(min(8, self._max_len),
+                                            self._max_len))))
+        if bks[-1] < self._max_len:
+            bks.append(self._max_len)
+        self._buckets = bks
+        self._max_waiting = int(max_waiting)
+        self._timeout_s = float(request_timeout_s)
+        self._seed = int(seed)
+        self._check_replay = bool(check_replay)
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._waiting: List[_GenSeq] = []
+        self._active: Dict[int, _GenSeq] = {}
+        self._free_slots = list(range(self._num_slots))
+        # block 0 is trash; LIFO free list for locality
+        self._free_blocks = list(range(self._num_blocks - 1, 0, -1))
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._rid = 0
+        self._arrival = 0
+        self._compiles = 0
+        self._compile_records: List[dict] = []
+        self._stats = {
+            "submitted": 0, "admitted": 0, "readmitted": 0,
+            "evicted": 0, "finished": 0, "shed_overload": 0,
+            "shed_timeout": 0, "tokens_generated": 0,
+            "decode_steps": 0, "replay_steps": 0,
+            "decode_ms": 0.0, "prefill_ms": 0.0,
+            "prefill_bucket_hits": {b: 0 for b in self._buckets},
+        }
+
+        # device state: params + pools + compiled step fns (lazy so the
+        # constructor stays cheap; start() builds everything)
+        self._pvals = None
+        self._pools = None
+        self._decode_fn = None
+        self._prefill_fn = None
+
+    # -- program construction ----------------------------------------
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor, no_grad
+
+        model = self._model
+        self._pvals = {k: t._value for k, t in model.state_dict().items()}
+        self._pools = model.init_paged_cache(self._num_blocks, self._bs)
+        server = self
+
+        def call_model(pvals, ids, pos, pools, tables, wm,
+                       gather_at=None):
+            st = model.state_dict()
+            old = {k: t._value for k, t in st.items()}
+            try:
+                for k, t in st.items():
+                    if k in pvals:
+                        t._value = pvals[k]
+                with no_grad():
+                    logits, pools = model.forward_paged(
+                        Tensor(ids), Tensor(pos), pools, tables, wm,
+                        gather_at=gather_at)
+            finally:
+                for k, t in st.items():
+                    t._value = old[k]
+            lv = logits._value if isinstance(logits, Tensor) else logits
+
+            def raw(v):
+                return v._value if isinstance(v, Tensor) else v
+            pools = [{kk: raw(vv) for kk, vv in d.items()}
+                     for d in pools]
+            return lv, pools
+
+        def sample(lg, kd, rng_steps, temp, top_k, top_p, do_sample):
+            """Per-slot next-token selection: exact argmax for greedy
+            slots, temperature/top-k/top-p categorical for sampling
+            slots — one program covers any mix.  The key for token j of
+            a request is fold_in(request_key, j-1): a pure function of
+            the stream position, so replay after eviction reproduces
+            the draw exactly."""
+            V = lg.shape[-1]
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            x = lg / jnp.maximum(temp, 1e-6)[:, None]
+            srt = jnp.sort(x, axis=-1)[:, ::-1]
+            kk = jnp.clip(top_k, 1, V).astype(jnp.int32)
+            kth = jnp.take_along_axis(srt, (kk - 1)[:, None], axis=-1)
+            use_k = ((top_k > 0) & (top_k < V))[:, None]
+            x = jnp.where(use_k & (x < kth), -jnp.inf, x)
+            srt2 = jnp.sort(x, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt2, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = jnp.maximum((cum < top_p[:, None]).sum(-1) + 1, 1)
+            kth2 = jnp.take_along_axis(srt2, (keep - 1)[:, None],
+                                       axis=-1)
+            use_p = (top_p < 1.0)[:, None]
+            x = jnp.where(use_p & (x < kth2), -jnp.inf, x)
+            impl = {2: "threefry2x32", 4: "rbg"}.get(
+                int(kd.shape[-1]), "threefry2x32")
+            base = jax.random.wrap_key_data(kd, impl=impl)
+            keys = jax.vmap(jax.random.fold_in)(base, rng_steps)
+            sampled = jax.vmap(jax.random.categorical)(keys, x)
+            return jnp.where(do_sample, sampled.astype(jnp.int32),
+                             greedy)
+
+        def decode_fn(pvals, pools, tokens, positions, tables, wm, kd,
+                      rng_steps, temp, top_k, top_p, do_sample):
+            # python side effect runs at TRACE time only: the counter
+            # proves steady-state decode never retraces
+            server._compiles += 1
+            server._note_compile("decode", tokens.shape[0])
+            logits, pools = call_model(pvals, tokens, positions, pools,
+                                       tables, wm)
+            lg = logits[:, -1, :].astype(jnp.float32)
+            nxt = sample(lg, kd, rng_steps, temp, top_k, top_p,
+                         do_sample)
+            return nxt, pools
+
+        def prefill_fn(pvals, pools, prompt, length, table, kd, temp,
+                       top_k, top_p, do_sample):
+            server._compiles += 1
+            server._note_compile("prefill", prompt.shape[1])
+            B, Lb = prompt.shape
+            pos = jnp.broadcast_to(
+                jnp.arange(Lb, dtype=jnp.int32)[None, :], (B, Lb))
+            wm = pos < length[:, None]
+            gather_at = jnp.clip(length - 1, 0, Lb - 1)
+            logits, pools = call_model(pvals, prompt, pos, pools, table,
+                                       wm, gather_at=gather_at)
+            lg = logits[:, -1, :].astype(jnp.float32)
+            first = sample(lg, kd, jnp.zeros_like(length), temp, top_k,
+                           top_p, do_sample)
+            return first, pools
+
+        # donate the pools: each step consumes the previous pool
+        # buffers in place (the CPU backend can't donate — skip the
+        # unusable-donation warning there)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._decode_fn = jax.jit(decode_fn, donate_argnums=donate)
+        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=donate)
+
+    def _note_compile(self, program: str, width: int):
+        """Runs inside a trace: log the compile to the server's shared
+        bucket-compile table and the flight recorder's observatory."""
+        cause = "prewarm" if not self._running else "new_shape_bucket"
+        self._compile_records.append(
+            {"program": program, "bucket": int(width), "cause": cause})
+        _flight.note_compile(f"GenerationServer[{program}]", cause, 0.0,
+                             key=(program, int(width)),
+                             n_buckets=self._compiles)
+
+    # -- lifecycle ---------------------------------------------------
+    def start(self, prewarm: bool = True) -> "GenerationServer":
+        if self._running:
+            return self
+        if self._decode_fn is None:
+            self._build_programs()
+        if prewarm:
+            self._prewarm()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="generation-server",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _prewarm(self):
+        """Compile every program before traffic: each prompt bucket's
+        prefill + the single decode program.  Dummy calls write only to
+        the trash block (write masks all False), so the pools' live
+        contents are untouched by construction."""
+        W = int(np.asarray(self._seq_key_data(0)).shape[-1])
+        for b in self._buckets:
+            first, self._pools = self._prefill_fn(
+                self._pvals, self._pools,
+                np.zeros((1, b), np.int32), np.zeros((1,), np.int32),
+                np.zeros((1, self._M), np.int32),
+                np.zeros((1, W), np.uint32),
+                np.ones((1,), np.float32), np.zeros((1,), np.int32),
+                np.ones((1,), np.float32), np.zeros((1,), bool))
+        B = self._num_slots
+        nxt, self._pools = self._decode_fn(
+            self._pvals, self._pools,
+            np.zeros((B, 1), np.int32), np.zeros((B, 1), np.int32),
+            np.zeros((B, self._M), np.int32), np.zeros((B, 1), bool),
+            np.zeros((B, W), np.uint32), np.zeros((B,), np.int32),
+            np.ones((B,), np.float32), np.zeros((B,), np.int32),
+            np.ones((B,), np.float32), np.zeros((B,), bool))
+        np.asarray(nxt)   # block until the warmup step really ran
+
+    def stop(self, drain: bool = False, timeout: float = 30.0):
+        if not self._running:
+            return
+        if drain:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._active and not self._waiting:
+                        break
+                time.sleep(0.005)
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        with self._lock:
+            leftovers = list(self._waiting) + list(self._active.values())
+            self._waiting.clear()
+        for seq in leftovers:
+            self._release(seq)
+            seq.stream._fail(ServerClosed("server stopped"))
+
+    def __enter__(self) -> "GenerationServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client surface ----------------------------------------------
+    def _seq_key_data(self, seed: int):
+        from ..framework.random import key_to_data, make_key
+        return np.asarray(key_to_data(make_key(seed))).astype(np.uint32)
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               do_sample: bool = False, temperature: float = 1.0,
+               top_k: int = 0, top_p: float = 1.0,
+               eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None, priority: int = 0,
+               timeout_s: Optional[float] = None) -> GenerationStream:
+        """Enqueue one generation request; returns a
+        :class:`GenerationStream` that yields tokens as decode steps
+        complete.  ``priority``: lower = more important (evicted last).
+        ``seed`` fixes the request's sampling RNG stream (default:
+        derived from the server seed + request id).  Raises
+        :class:`ServerOverloaded` at the waiting-queue cap."""
+        if not self._running:
+            raise ServerClosed("server not started")
+        p = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
+                       else prompt).astype(np.int32).reshape(-1)
+        if p.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if p.size + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt ({p.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_model_len={self._max_len}")
+        if do_sample and float(temperature) == 0.0:
+            do_sample = False      # temperature 0.0 IS greedy (exact)
+        to = self._timeout_s if timeout_s is None else float(timeout_s)
+        with self._cond:
+            if len(self._waiting) >= self._max_waiting:
+                self._stats["shed_overload"] += 1
+                shed_depth = len(self._waiting)
+            else:
+                self._rid += 1
+                self._arrival += 1
+                key_data = self._seq_key_data(
+                    self._seed * 1000003 + self._rid
+                    if seed is None else int(seed))
+                seq = _GenSeq(self._rid, p, max_new_tokens,
+                              eos_token_id, do_sample, temperature,
+                              top_k, top_p, key_data, priority,
+                              self._arrival, time.monotonic() + to)
+                self._waiting.append(seq)
+                self._stats["submitted"] += 1
+                self._cond.notify_all()
+                shed_depth = None
+        if shed_depth is not None:
+            _monitor.stat_add("serve_shed_overload")
+            _flight.record("serve.shed", reason="overload",
+                           depth=shed_depth, server="generation")
+            _flight.maybe_dump("ServerOverloaded")
+            raise ServerOverloaded(
+                f"waiting-queue cap {self._max_waiting} reached; "
+                "request shed — back off and retry") from None
+        if _monitor.metrics_enabled():
+            _monitor.gauge_set("serve_gen_waiting", len(self._waiting))
+        return seq.stream
+
+    def generate_sync(self, prompt, timeout: Optional[float] = None,
+                      **kw) -> List[int]:
+        """Blocking submit + collect (the per-client bench call)."""
+        return self.submit(prompt, **kw).result(timeout=timeout)
+
+    def num_compiles(self) -> int:
+        """Distinct program traces (prefill buckets + the decode
+        program).  Steady state after warmup: delta == 0."""
+        return self._compiles
+
+    def stats(self) -> Dict:
+        with self._lock:
+            s = {k: (dict(v) if isinstance(v, dict) else v)
+                 for k, v in self._stats.items()}
+            s["waiting"] = len(self._waiting)
+            s["active"] = len(self._active)
+            s["free_blocks"] = len(self._free_blocks)
+            s["allocated_blocks"] = (self._num_blocks - 1
+                                     - len(self._free_blocks))
+            records = list(self._compile_records)
+        s["total_blocks"] = self._num_blocks - 1   # trash excluded
+        s["block_size"] = self._bs
+        s["num_slots"] = self._num_slots
+        s["num_compiles"] = self._compiles
+        # shared bucket-compile accounting shape with
+        # PredictorServer.stats() (ISSUE 8 satellite): per program
+        # bucket -> {count, cause}
+        bc: Dict = {}
+        for r in records:
+            key = f"{r['program']}:{r['bucket']}"
+            ent = bc.setdefault(key, {"count": 0, "cause": r["cause"]})
+            ent["count"] += 1
+        s["bucket_compiles"] = bc
+        s["prewarm_compiles"] = sum(1 for r in records
+                                    if r["cause"] == "prewarm")
+        s["traffic_compiles"] = sum(1 for r in records
+                                    if r["cause"] != "prewarm")
+        return s
+
+    # -- scheduler ---------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    if not self._running:
+                        return
+                    if not self._active and not self._waiting:
+                        self._cond.wait(timeout=0.05)
+                        continue
+                self._expire_waiting()
+                self._admit()
+                if self._active:
+                    self._decode_once()
+        except BaseException as e:   # noqa: BLE001 — fail streams loudly
+            with self._lock:
+                victims = (list(self._waiting)
+                           + list(self._active.values()))
+                self._waiting.clear()
+                self._active.clear()
+                self._running = False
+            for seq in victims:
+                seq.stream._fail(ServeError(
+                    f"generation scheduler died: {e!r}"))
+            raise
+
+    def _expire_waiting(self):
+        now = time.monotonic()
+        with self._lock:
+            expired = [s for s in self._waiting if now > s.deadline]
+            if not expired:
+                return
+            self._waiting = [s for s in self._waiting
+                             if now <= s.deadline]
+            for s in expired:
+                self._stats["shed_timeout"] += 1
+        for s in expired:
+            _monitor.stat_add("serve_shed_timeout")
+            _flight.record("serve.shed", reason="timeout", rid=s.rid,
+                           waited_ms=round((now - s.t_submit) * 1e3, 1),
+                           evictions=s.evictions, server="generation")
+            _flight.record("serve.stream_end", rid=s.rid,
+                           reason="timeout", tokens=len(s.generated))
+            s.stream._fail(RequestTimeout(
+                f"request {s.rid} spent its whole deadline "
+                + ("evicted and waiting for re-admission"
+                   if s.evictions else "queued")
+                + " — pool/slots overloaded"))
+
+    def _admit(self):
+        while True:
+            with self._lock:
+                if not self._waiting or not self._free_slots:
+                    return
+                self._waiting.sort(key=lambda s: (s.priority, s.arrival))
+                seq = self._waiting[0]
+                # ceil(L/bs) blocks for the prompt, +1 headroom when L
+                # lands exactly on a block boundary (the first decode
+                # write would otherwise evict immediately)
+                need = seq.L // self._bs + 1
+                if len(self._free_blocks) < need:
+                    return   # strict priority order: no queue jumping
+                self._waiting.pop(0)
+                nb = -(-seq.L // self._bs)
+                seq.blocks = [self._free_blocks.pop()
+                              for _ in range(nb)]
+                seq.slot = self._free_slots.pop()
+                self._active[seq.slot] = seq
+            self._prefill(seq)
+
+    def _bucket_for(self, L: int) -> int:
+        for b in self._buckets:
+            if L <= b:
+                return b
+        return self._buckets[-1]
+
+    def _prefill(self, seq: _GenSeq):
+        bucket = self._bucket_for(seq.L)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :seq.L] = seq.prompt
+        table = np.zeros((1, self._M), np.int32)
+        table[0, :len(seq.blocks)] = seq.blocks
+        t0 = time.perf_counter()
+        first, self._pools = self._prefill_fn(
+            self._pvals, self._pools, prompt,
+            np.asarray([seq.L], np.int32), table,
+            seq.key_data[None, :], np.asarray([seq.temp], np.float32),
+            np.asarray([seq.top_k], np.int32),
+            np.asarray([seq.top_p], np.float32),
+            np.asarray([seq.do_sample], bool))
+        first = int(np.asarray(first)[0])
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        readmit = seq.evictions > 0
+        with self._lock:
+            self._stats["admitted"] += 1
+            self._stats["readmitted"] += int(readmit)
+            self._stats["prefill_ms"] += dt_ms
+            self._stats["prefill_bucket_hits"][bucket] = \
+                self._stats["prefill_bucket_hits"].get(bucket, 0) + 1
+        _monitor.stat_add("serve_gen_admitted")
+        _flight.record("serve.admit", rid=seq.rid, prompt_len=seq.L,
+                       bucket=bucket, blocks=len(seq.blocks),
+                       slot=seq.slot, readmit=readmit,
+                       priority=seq.priority)
+        if _monitor.metrics_enabled():
+            _monitor.hist_observe("prefill_ms", dt_ms)
+            _monitor.gauge_set("serve_gen_active", len(self._active))
+            _monitor.gauge_set("serve_gen_free_blocks",
+                               len(self._free_blocks))
+        seq.decoded = 0
+        if readmit:
+            # replay: prefill re-derives t1 from the identical program
+            # + inputs; the stored token is authoritative either way
+            if self._check_replay and first != seq.generated[0]:
+                raise AssertionError(
+                    f"re-prefill of request {seq.rid} resampled token 1 "
+                    f"as {first}, stream already emitted "
+                    f"{seq.generated[0]} — paged prefill is not "
+                    "bit-stable")
+        else:
+            self._emit(seq, first)
+
+    def _emit(self, seq: _GenSeq, tok: int):
+        seq.generated.append(tok)
+        if seq.t_first_tok is None:
+            seq.t_first_tok = time.monotonic()
+            if _monitor.metrics_enabled():
+                _monitor.hist_observe(
+                    "serve_ttft_ms",
+                    (seq.t_first_tok - seq.t_submit) * 1e3)
+        seq.stream._emit(tok)
+        with self._lock:
+            self._stats["tokens_generated"] += 1
+        if (seq.eos is not None and tok == seq.eos) \
+                or len(seq.generated) >= seq.max_new:
+            reason = ("eos" if seq.eos is not None and tok == seq.eos
+                      else "length")
+            self._finish(seq, reason)
+
+    def _finish(self, seq: _GenSeq, reason: str):
+        self._release(seq)
+        with self._lock:
+            self._stats["finished"] += 1
+        _monitor.stat_add("serve_gen_finished")
+        _flight.record("serve.stream_end", rid=seq.rid, reason=reason,
+                       tokens=len(seq.generated),
+                       evictions=seq.evictions)
+        seq.stream._end(reason)
+
+    def _release(self, seq: _GenSeq):
+        """Return a sequence's blocks + slot to the pools immediately."""
+        with self._lock:
+            if seq.blocks:
+                self._free_blocks.extend(seq.blocks)
+                seq.blocks = []
+            if seq.slot is not None:
+                self._active.pop(seq.slot, None)
+                self._free_slots.append(seq.slot)
+                seq.slot = None
+
+    def _evict(self, seq: _GenSeq):
+        """Block-pool exhaustion: free the victim's blocks and send it
+        back to the waiting queue (its generated tokens are kept; re-
+        admission re-prefills + replays them bit-identically)."""
+        freed = len(seq.blocks)
+        self._release(seq)
+        seq.decoded = 0
+        seq.evictions += 1
+        with self._lock:
+            self._stats["evicted"] += 1
+            self._waiting.append(seq)
+        _monitor.stat_add("serve_gen_evicted")
+        _flight.record("serve.evict", rid=seq.rid,
+                       reason="pool_exhausted", freed_blocks=freed,
+                       tokens_so_far=len(seq.generated),
+                       priority=seq.priority, evictions=seq.evictions)
+        _flight.maybe_dump("BlockPoolExhausted")
+
+    def _grow_or_evict(self):
+        """Before a decode step every live sequence must own the block
+        its next K/V write lands in; a dry pool evicts the lowest-
+        priority sequence (highest priority number, then youngest)."""
+        for seq in sorted(self._active.values(), key=lambda s: s.slot):
+            if seq.slot is None:
+                continue      # evicted below us this round
+            p = seq.L + seq.decoded          # position written next
+            need = p // self._bs + 1
+            while len(seq.blocks) < need and seq.slot is not None:
+                with self._lock:
+                    blk = (self._free_blocks.pop()
+                           if self._free_blocks else None)
+                    if blk is not None:
+                        seq.blocks.append(blk)
+                        continue
+                victim = max(self._active.values(),
+                             key=lambda s: (s.priority, s.arrival))
+                self._evict(victim)
+                # the growing sequence itself can be the lowest
+                # priority: it re-queues and this slot sits out
+
+    def _decode_once(self):
+        self._grow_or_evict()
+        with self._lock:
+            live = sorted(self._active.values(), key=lambda s: s.slot)
+        if not live:
+            return
+        B, M = self._num_slots, self._M
+        W = live[0].key_data.shape[-1]
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        tables = np.zeros((B, M), np.int32)
+        wm = np.zeros((B, 1), bool)
+        kd = np.zeros((B, W), np.uint32)
+        rng_steps = np.zeros((B,), np.int32)
+        temp = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        do_sample = np.zeros((B,), bool)
+        for seq in live:
+            s = seq.slot
+            tokens[s, 0] = seq.generated[seq.decoded]
+            positions[s, 0] = seq.L + seq.decoded
+            tables[s, :len(seq.blocks)] = seq.blocks
+            wm[s, 0] = True
+            kd[s] = seq.key_data
+            rng_steps[s] = seq.decoded + 1
+            temp[s] = seq.temp
+            top_k[s] = seq.top_k
+            top_p[s] = seq.top_p
+            do_sample[s] = seq.do_sample
+        t0 = time.perf_counter()
+        nxt, self._pools = self._decode_fn(
+            self._pvals, self._pools, tokens, positions, tables, wm,
+            kd, rng_steps, temp, top_k, top_p, do_sample)
+        nxt = np.asarray(nxt)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        replays = 0
+        for seq in live:
+            s = seq.slot
+            seq.decoded += 1
+            j = seq.decoded + 1          # 1-based index produced
+            if j <= len(seq.generated):
+                replays += 1             # catching up after eviction
+                if self._check_replay \
+                        and int(nxt[s]) != seq.generated[j - 1]:
+                    raise AssertionError(
+                        f"replayed decode step for request {seq.rid} "
+                        f"produced {int(nxt[s])}, stream already "
+                        f"emitted {seq.generated[j - 1]} — paged "
+                        "decode is not bit-stable")
+            else:
+                self._emit(seq, int(nxt[s]))
+        with self._lock:
+            self._stats["decode_steps"] += 1
+            self._stats["replay_steps"] += replays
+            self._stats["decode_ms"] += dt_ms
+            n_steps = self._stats["decode_steps"]
+        _flight.progress("serve.decode")
+        if n_steps % _FLIGHT_DECODE_EVERY == 0:
+            _flight.record("serve.decode", steps=n_steps,
+                           live=len(live),
+                           free_blocks=len(self._free_blocks),
+                           ms=round(dt_ms, 3))
+        if _monitor.metrics_enabled():
+            _monitor.hist_observe("decode_step_ms", dt_ms)
+            _monitor.gauge_set("serve_gen_active", len(self._active))
+            _monitor.gauge_set("serve_gen_free_blocks",
+                               len(self._free_blocks))

@@ -276,6 +276,27 @@ class PredictorServer:
                              if hasattr(self._pred, "num_compiles")
                              else None)
         s["queue_depth"] = self._q.qsize()
+        # per-bucket compile provenance (ISSUE 8 satellite, shared
+        # shape with GenerationServer.stats()["bucket_compiles"]):
+        # which buckets were prewarmed vs compiled under traffic —
+        # "traffic_compiles > 0" is the prewarm-gap smoking gun that
+        # hit counts alone cannot show
+        if hasattr(self._pred, "compile_records"):
+            records = self._pred.compile_records()
+            bc: Dict = {}
+            for r in records:
+                b = r.get("batch")
+                key = f"run:{b}" if b is not None else "run:?"
+                ent = bc.setdefault(key, {"count": 0,
+                                          "cause": r.get("cause")})
+                ent["count"] += 1
+            s["bucket_compiles"] = bc
+            s["prewarm_compiles"] = sum(
+                1 for r in records if r.get("cause") in ("prewarm",
+                                                         "load"))
+            s["traffic_compiles"] = sum(
+                1 for r in records if r.get("cause") not in ("prewarm",
+                                                             "load"))
         return s
 
     # -- batcher loop ------------------------------------------------
@@ -379,10 +400,28 @@ class PredictorServer:
             t2 = time.monotonic()
 
             off = 0
+            slices = []
             for r in live:
-                r.future.set_result([o[off:off + r.n] for o in outs])
+                slices.append([o[off:off + r.n] for o in outs])
                 off += r.n
             t3 = time.monotonic()
+            # commit stats BEFORE resolving futures: a client that has
+            # observed its result must never read stats that don't yet
+            # count its batch (read-after-completion consistency)
+            with self._lock:
+                s = self._stats
+                s["requests"] += len(live)
+                s["examples"] += rows
+                s["batches"] += 1
+                s["padded_examples"] += pad
+                s["bucket_hits"][bucket] = \
+                    s["bucket_hits"].get(bucket, 0) + 1
+                s["queue_ms"] += queue_s * 1e3
+                s["pad_ms"] += (t1 - t0) * 1e3
+                s["run_ms"] += (t2 - t1) * 1e3
+                s["unpad_ms"] += (t3 - t2) * 1e3
+            for r, sl in zip(live, slices):
+                r.future.set_result(sl)
         finally:
             # a failed run must still close the span, or the batcher
             # thread's span stack would mis-parent every later batch
@@ -395,17 +434,6 @@ class PredictorServer:
                 _flight.end(tok, **({} if et is None
                                     else {"err": et.__name__}))
 
-        with self._lock:
-            s = self._stats
-            s["requests"] += len(live)
-            s["examples"] += rows
-            s["batches"] += 1
-            s["padded_examples"] += pad
-            s["bucket_hits"][bucket] = s["bucket_hits"].get(bucket, 0) + 1
-            s["queue_ms"] += queue_s * 1e3
-            s["pad_ms"] += (t1 - t0) * 1e3
-            s["run_ms"] += (t2 - t1) * 1e3
-            s["unpad_ms"] += (t3 - t2) * 1e3
         if _monitor.metrics_enabled():
             # per-request end-to-end latency + queue-age histograms;
             # the p50/p99 a serving dashboard actually alerts on

@@ -98,8 +98,12 @@ _DEFAULT_CAPACITY = 1024
 _DEFAULT_MAX_BYTES = 256 * 1024
 
 # progress kinds: recording one of these proves the process is alive
-# (the stall watchdog measures the age of the newest one)
-_PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply"})
+# (the stall watchdog measures the age of the newest one).
+# serve.decode/serve.admit: the generation scheduler's per-step and
+# per-admission heartbeats (ISSUE 8) — decode mostly ticks via
+# progress(), but its sampled ring events count too
+_PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
+                             "serve.decode", "serve.admit"})
 
 # typed-failure dumps are rate limited per reason (a retry storm must
 # not turn every PSUnavailable into a bundle) and capped per process

@@ -32,7 +32,16 @@ __all__ = ["generate"]
 
 def _logits_at(model, buf, pos_idx):
     """Model forward over the full buffer; gather logits at pos_idx-1
-    (the last REAL token of each row)."""
+    (the last REAL token of each row).
+
+    Invariant: every ``pos_idx`` entry is >= 1 — the gather reads
+    ``pos_idx - 1`` and an empty row (pos 0) would silently wrap to the
+    LAST buffer position's logits.  Callers always pass pos >= prompt
+    length and ``generate`` rejects empty prompts, so this asserts
+    rather than masks."""
+    assert bool((pos_idx >= 1).all()), \
+        "_logits_at requires pos_idx >= 1 (no empty rows: the gather " \
+        "reads pos_idx - 1, which would wrap to the buffer tail)"
     out = model(Tensor(buf))
     # forward convention: bare logits, or (loss, logits) — logits LAST
     logits = out[-1] if isinstance(out, tuple) else out
@@ -43,7 +52,9 @@ def _logits_at(model, buf, pos_idx):
 
 def _filter_logits(logits, temperature, top_k, top_p):
     if temperature is not None and temperature != 1.0:
-        # temperature 0.0 means near-greedy, not "skip scaling"
+        # temperature 0.0 dispatches to the EXACT greedy path in
+        # generate() before reaching here; the 1e-6 floor only guards
+        # tiny-but-nonzero temperatures against an inf overflow
         logits = logits / max(float(temperature), 1e-6)
     V = logits.shape[-1]
     if top_k and 0 < top_k < V:
@@ -66,22 +77,37 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              top_k: int = 0, top_p: float = 1.0, num_beams: int = 1,
              eos_token_id: Optional[int] = None,
              pad_token_id: int = 0,
-             length_penalty: float = 1.0) -> Tensor:
+             length_penalty: float = 1.0,
+             use_cache: Optional[bool] = None) -> Tensor:
     """Generate continuations for ``input_ids`` [B, S0] -> [B, S0+new].
 
     ``do_sample`` enables temperature/top-k/top-p sampling; ``num_beams>1``
     runs beam search (mutually exclusive with sampling). Rows that hit
     ``eos_token_id`` are frozen (padded with ``pad_token_id``).
+
+    ``use_cache`` (default: auto) runs greedy AND sampling decoding on
+    the model's incremental KV-cache step — O(1) tokens per forward
+    instead of re-running the whole [B, S0+new] buffer — whenever
+    ``model.supports_kv_cache()``; pass False to force the full-prefix
+    recompute reference path.
     """
     ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                      else input_ids).astype(np.int32)
     B, S0 = ids.shape
+    if S0 < 1:
+        raise ValueError("generate requires a non-empty prompt "
+                         "(the first logits gather reads position S0-1)")
     total = S0 + max_new_tokens
     if num_beams > 1 and do_sample:
         raise ValueError("beam search and sampling are mutually exclusive")
     if num_beams > 1:
         return _beam_search(model, ids, max_new_tokens, num_beams,
                             eos_token_id, pad_token_id, length_penalty)
+    if do_sample and temperature is not None \
+            and float(temperature) == 0.0:
+        # temperature 0.0 IS greedy: dispatch to the exact argmax path
+        # (consumes no RNG) instead of near-greedy 1e-6-scaled sampling
+        do_sample = False
 
     # pad-fill the tail so an early all-done break leaves pad tokens,
     # not zeros (causality: tail values never affect earlier logits)
@@ -90,7 +116,14 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     done = jnp.zeros((B,), bool)
     # KV-cache fast path: prefill once, then O(1)-token decode steps
     # (models without cache support fall back to full-prefix recompute)
-    use_cache = bool(getattr(model, "supports_kv_cache", lambda: False)())
+    if use_cache is None:
+        use_cache = bool(getattr(model, "supports_kv_cache",
+                                 lambda: False)())
+    elif use_cache and not bool(getattr(model, "supports_kv_cache",
+                                        lambda: False)()):
+        raise ValueError(
+            "use_cache=True but the model does not support KV-cache "
+            "decode (supports_kv_cache() is False)")
     caches = None
     if use_cache:
         caches = model.init_cache(B, total)

@@ -41,9 +41,26 @@ from ...nn.initializer import Constant, Normal
 from ...nn.layer.layers import Layer, Parameter
 
 __all__ = [
+    "KVCacheUnsupportedError",
     "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "RMSNorm",
     "llama_tiny", "llama_7b", "llama_13b",
 ]
+
+
+class KVCacheUnsupportedError(NotImplementedError):
+    """Raised when incremental (KV-cache / paged) decode is requested on
+    a model configuration that cannot serve it.  Subclasses
+    NotImplementedError so pre-existing ``except NotImplementedError``
+    and ``except RuntimeError`` callers keep working; the message always
+    names the workaround (build with ``scan_layers=False``)."""
+
+
+# tests pin this message: it must keep naming the scan_layers=False
+# workaround verbatim
+_SCAN_LAYERS_KV_MSG = (
+    "KV-cache decoding is not supported with scan_layers=True (stacked "
+    "decoder: lax.scan carries no per-layer cache); build the model "
+    "with scan_layers=False for incremental generation")
 
 
 @dataclasses.dataclass
@@ -268,6 +285,106 @@ class LlamaAttention(Layer):
                                  op_name="llama_attention_cached")
         return self.o_proj(ctx), {"k": kbuf, "v": vbuf}
 
+    def forward_paged(self, hidden, positions, cache, block_tables,
+                      write_mask):
+        """Block-paged variant of :meth:`_forward_cached` (continuous-
+        batching serving, ISSUE 8).  K/V live in fixed-shape pools
+        ``[num_blocks, block_size, KH, D]`` shared by every sequence; a
+        per-sequence ``block_tables`` row [max_blocks] maps logical
+        block ``pos // block_size`` to its physical pool block, so a
+        sequence's cache is a gather over its table instead of a
+        dedicated ``[B, Smax]`` buffer.  Physical block ids never enter
+        the math — the gathered tensor is in logical position order —
+        which is what makes an evicted + re-admitted sequence's decode
+        bit-identical regardless of which blocks it lands on.
+
+        ``write_mask`` [B, S] routes masked-off positions' K/V writes
+        (prompt padding, inactive decode slots) to physical block 0,
+        which is reserved as a trash block and never allocated; the
+        validity mask (slot <= query position) guarantees trash is
+        never read.
+        """
+        c = self.config
+        q = self.q_proj(hidden)
+        k = self.k_proj(hidden)
+        v = self.v_proj(hidden)
+
+        def attn_paged(qv, kv, vv, pos, wm, kpool, vpool, tbl):
+            B, S = qv.shape[0], qv.shape[1]
+            bs = kpool.shape[1]
+            qh = qv.reshape(B, S, c.num_attention_heads, c.head_dim)
+            kh = kv.reshape(B, S, c.kv_heads, c.head_dim)
+            vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
+            qh = _rope(qh, pos, c.rope_theta)
+            kh = _rope(kh, pos, c.rope_theta)
+            qh = mesh_mod.constrain_dim(qh, 2, "tp")  # heads stay sharded
+            # scatter this call's K/V into the pools: physical block =
+            # table[logical block], offset = pos % block_size; masked
+            # writes divert to the trash block (0, 0)
+            blk_log = (pos // bs).astype(jnp.int32)
+            blk_phys = jnp.take_along_axis(tbl, blk_log, axis=1)
+            off = (pos % bs).astype(jnp.int32)
+            blk_phys = jnp.where(wm, blk_phys, 0)
+            off = jnp.where(wm, off, 0)
+            fb = blk_phys.reshape(-1)
+            fo = off.reshape(-1)
+            kpool = kpool.at[fb, fo].set(
+                kh.reshape(B * S, c.kv_heads, c.head_dim)
+                .astype(kpool.dtype))
+            vpool = vpool.at[fb, fo].set(
+                vh.reshape(B * S, c.kv_heads, c.head_dim)
+                .astype(vpool.dtype))
+            if S > 1:
+                # PREFILL: causal attention over the fresh block equals
+                # attention against the just-written cache (contiguous
+                # positions from 0) — use the flash/sdpa path; the
+                # scattered K/V stay behind for decode.  Right-padding
+                # is causal-safe: a real token never attends forward.
+                kh2, vh2 = kh, vh
+                if c.kv_heads != c.num_attention_heads:
+                    rep = c.num_attention_heads // c.kv_heads
+                    kh2 = jnp.repeat(kh, rep, axis=2)
+                    vh2 = jnp.repeat(vh, rep, axis=2)
+                from ...nn.functional.attention import _sdpa_ref
+                from ...ops.flash_attention import (flash_attention as
+                                                    _fa_t, flash_eligible)
+                if flash_eligible(S, c.head_dim):
+                    o = _fa_t(qh, kh2, vh2, causal=True)
+                else:
+                    o = _sdpa_ref(qh, kh2, vh2, None, 0.0, True, None)
+                return (o.reshape(B, S,
+                                  c.num_attention_heads * c.head_dim),
+                        kpool, vpool)
+            # DECODE: gather the sequence's cache through its block
+            # table — [B, M, bs, KH, D] -> [B, M*bs, KH, D] in logical
+            # position order — then the same grouped-query masked
+            # attention as :meth:`_forward_cached` (slot index ==
+            # absolute position, valid iff slot <= query position)
+            T = tbl.shape[1] * bs
+            kg = kpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
+            vg = vpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
+            G = c.kv_heads
+            R = c.num_attention_heads // G
+            qg = qh.reshape(B, S, G, R, c.head_dim)
+            scale = 1.0 / (c.head_dim ** 0.5)
+            logits = jnp.einsum(
+                "bsgrd,btgd->bgrst", qg.astype(jnp.float32),
+                kg.astype(jnp.float32)) * scale        # [B,G,R,S,T]
+            valid = (jnp.arange(T)[None, None, None, None, :]
+                     <= pos[:, None, None, :, None])
+            logits = jnp.where(valid, logits, -jnp.inf)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bgrst,btgd->bsgrd", w,
+                           vg.astype(jnp.float32)).astype(qv.dtype)
+            return (o.reshape(B, S, c.num_attention_heads * c.head_dim),
+                    kpool, vpool)
+
+        ctx, kpool, vpool = _apply(attn_paged, q, k, v, positions,
+                                   write_mask, cache["k"], cache["v"],
+                                   block_tables,
+                                   op_name="llama_attention_paged")
+        return self.o_proj(ctx), {"k": kpool, "v": vpool}
+
 
 class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
@@ -305,6 +422,14 @@ class LlamaDecoderLayer(Layer):
             return h + self.mlp(self.post_attention_layernorm(h))
         attn_out, cache = self.self_attn(self.input_layernorm(hidden),
                                          positions, cache)
+        h = hidden + attn_out
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
+
+    def forward_paged(self, hidden, positions, cache, block_tables,
+                      write_mask):
+        attn_out, cache = self.self_attn.forward_paged(
+            self.input_layernorm(hidden), positions, cache,
+            block_tables, write_mask)
         h = hidden + attn_out
         return h + self.mlp(self.post_attention_layernorm(h)), cache
 
@@ -433,10 +558,7 @@ class LlamaModel(Layer):
                             hidden)
         if caches is not None:
             if self.decoder is not None:
-                raise NotImplementedError(
-                    "KV-cache decoding is not supported with scan_layers "
-                    "(stacked decoder); build the model with "
-                    "scan_layers=False for incremental generation")
+                raise KVCacheUnsupportedError(_SCAN_LAYERS_KV_MSG)
             new_caches = []
             for layer, cache in zip(self.layers, caches):
                 hidden, cache = layer(hidden, positions, cache)
@@ -451,6 +573,24 @@ class LlamaModel(Layer):
                 else:
                     hidden = layer(hidden, positions)
         return self.norm(hidden)
+
+    def forward_paged(self, input_ids, positions, pools, block_tables,
+                      write_mask):
+        """Paged-KV forward: ``pools`` is one {"k","v"} pool dict per
+        layer, ``block_tables`` [B, max_blocks] int32, ``write_mask``
+        [B, S] bool.  Returns (hidden, new_pools)."""
+        c = self.config
+        if self.decoder is not None:
+            raise KVCacheUnsupportedError(_SCAN_LAYERS_KV_MSG)
+        hidden = self.embed_tokens(input_ids)
+        if c.compute_dtype:
+            hidden = hidden.astype(c.compute_dtype)
+        new_pools = []
+        for layer, pool in zip(self.layers, pools):
+            hidden, pool = layer.forward_paged(hidden, positions, pool,
+                                               block_tables, write_mask)
+            new_pools.append(pool)
+        return self.norm(hidden), new_pools
 
 
 def _remat_layer(layer: LlamaDecoderLayer, hidden: Tensor, positions):
@@ -590,6 +730,43 @@ class LlamaForCausalLM(Layer):
         if last_logits_only:
             hidden = hidden[:, -1:]
         return self._logits(hidden), caches
+
+    # -- block-paged KV cache API (continuous-batching serving) --------
+    def init_paged_cache(self, num_blocks: int, block_size: int):
+        """Per-layer K/V pools ``[num_blocks, block_size, KH, D]``
+        shared across every concurrent sequence (physical block 0 is
+        the conventional trash block — the scheduler must never hand it
+        out).  Under a tp mesh the kv-head dim is sharded like
+        :meth:`init_cache`."""
+        if not self.supports_kv_cache():
+            raise KVCacheUnsupportedError(_SCAN_LAYERS_KV_MSG)
+        c = self.config
+        dt = jnp.dtype(c.compute_dtype) if c.compute_dtype else jnp.float32
+        shape = (int(num_blocks), int(block_size), c.kv_heads, c.head_dim)
+
+        def make():
+            buf = jnp.zeros(shape, dt)
+            return mesh_mod.constrain_dim(buf, 2, "tp")
+
+        return [{"k": make(), "v": make()}
+                for _ in range(c.num_hidden_layers)]
+
+    def forward_paged(self, input_ids, positions, pools, block_tables,
+                      write_mask, gather_at=None):
+        """(logits, pools) through the block-paged cache.  With
+        ``gather_at`` [B] the hidden states are gathered at those
+        positions BEFORE the vocab projection (prefill only pays the
+        [B, 1, V] projection of its last real token, not [B, S, V])."""
+        hidden, pools = self.model.forward_paged(
+            input_ids, positions, pools, block_tables, write_mask)
+        if gather_at is not None:
+            hv = hidden._value if isinstance(hidden, Tensor) else hidden
+            ga = gather_at._value if isinstance(gather_at, Tensor) \
+                else gather_at
+            hv = jnp.take_along_axis(
+                hv, ga[:, None, None].astype(jnp.int32), axis=1)
+            hidden = Tensor(hv)
+        return self._logits(hidden), pools
 
 
 def _causal_lm_loss(logits, labels):

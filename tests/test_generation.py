@@ -67,6 +67,58 @@ def test_cold_temperature_like_greedy(lm, temp):
     np.testing.assert_array_equal(greedy, cold)
 
 
+def test_temperature_zero_dispatches_exact_greedy(lm):
+    """temperature=0.0 must take the EXACT argmax path, not 1e-6-scaled
+    near-greedy sampling: it consumes no RNG, so the global stream
+    position is untouched (a sampling run would advance it)."""
+    from paddle_tpu.framework.random import get_rng_state
+    paddle.seed(5)
+    ids = _prompt(b=1, seed=3)
+    before = np.asarray(get_rng_state())
+    out = lm.generate(ids, max_new_tokens=3, do_sample=True,
+                      temperature=0.0).numpy()
+    np.testing.assert_array_equal(np.asarray(get_rng_state()), before)
+    # a genuinely-sampling call DOES advance the stream
+    lm.generate(ids, max_new_tokens=1, do_sample=True, temperature=0.7)
+    assert not np.array_equal(np.asarray(get_rng_state()), before)
+    np.testing.assert_array_equal(
+        out, lm.generate(ids, max_new_tokens=3).numpy())
+
+
+def test_logits_at_guards_empty_rows(lm):
+    """_logits_at gathers at pos_idx - 1: pos 0 would silently wrap to
+    the buffer TAIL's logits — the invariant is asserted, not masked."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.text.generation import _logits_at
+    buf = jnp.asarray(_prompt(b=2).numpy())
+    # valid: pos >= 1 everywhere
+    _logits_at(lm, buf, jnp.asarray([4, 1], jnp.int32))
+    with pytest.raises(AssertionError, match="pos_idx >= 1"):
+        _logits_at(lm, buf, jnp.asarray([4, 0], jnp.int32))
+    with pytest.raises(ValueError, match="non-empty prompt"):
+        lm.generate(paddle.to_tensor(np.zeros((1, 0), np.int32)),
+                    max_new_tokens=2)
+
+
+def test_use_cache_kwarg(lm):
+    """use_cache=True/False force the two decode paths explicitly;
+    both must agree, and use_cache=True on a cacheless model is a
+    typed error, not a silent fallback."""
+    ids = _prompt(b=2, seed=8)
+    fast = lm.generate(ids, max_new_tokens=4, use_cache=True).numpy()
+    slow = lm.generate(ids, max_new_tokens=4, use_cache=False).numpy()
+    np.testing.assert_array_equal(fast, slow)
+
+    class NoCache:
+        def __call__(self, x):
+            return lm(x)
+
+    with pytest.raises(ValueError, match="supports_kv_cache"):
+        from paddle_tpu.text import generate as gen_fn
+        gen_fn(NoCache(), ids, max_new_tokens=2, use_cache=True)
+
+
 def test_eos_freezes_row(lm):
     ids = _prompt(b=1, seed=4)
     # find the first greedy token, use it as "eos": generation stops and

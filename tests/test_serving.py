@@ -230,3 +230,32 @@ def test_server_stop_fails_queued_requests(exported):
     server.stop(drain=False)
     with pytest.raises(ServerClosed):
         fut.result(timeout=1.0)
+
+
+def test_server_stats_expose_per_bucket_compiles(exported):
+    """ISSUE 8 satellite: stats() reports per-bucket compile
+    provenance (prewarm vs traffic), not just hit counts — shared
+    shape with GenerationServer.stats()["bucket_compiles"]."""
+    path, _ = exported
+    pred = create_predictor(_config(path))
+    server = PredictorServer(pred, max_batch=4, max_wait_ms=1.0).start()
+    try:
+        server.infer([np.zeros((1, 6), np.float32)])
+        st = server.stats()
+        # load-time batch-1 AOT + prewarmed buckets (1 shared with
+        # load) -> every record is load/prewarm, none from traffic
+        assert st["prewarm_compiles"] == st["num_compiles"]
+        assert st["traffic_compiles"] == 0
+        causes = {k: v["cause"] for k, v in st["bucket_compiles"].items()}
+        assert causes.pop("run:1") == "load"      # load batch first
+        assert set(causes.values()) == {"prewarm"}
+        assert {k for k in st["bucket_compiles"]} == \
+            {f"run:{b}" for b in (1, 2, 4)}
+        # an unwarmed shape arriving as traffic is attributed as such
+        pred.run([np.zeros((3, 6), np.float32)])
+        st = server.stats()
+        assert st["traffic_compiles"] == 1
+        assert st["bucket_compiles"]["run:3"]["cause"] == \
+            "new_shape_bucket"
+    finally:
+        server.stop()

@@ -49,7 +49,7 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 # ring-event kinds that mark a process as "diverging" for the report
 # order (first divergence first)
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
-              "ps.replica_error", "serve.shed"}
+              "ps.replica_error", "serve.shed", "serve.evict"}
 
 
 def _is_bad(ev: dict) -> bool:

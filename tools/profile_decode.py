@@ -1,0 +1,198 @@
+"""Decode-step attribution for the continuous-batching server
+(ISSUE 8 satellite).
+
+Where does a streamed token's wall time go?  Three layers of
+attribution over a ``GenerationServer`` run:
+
+1. server phases (from ``stats()``): prefill_ms, decode_ms (jit
+   dispatch + device compute, per step) and SCHEDULER PYTHON — the
+   wall-clock remainder spent building slot arrays, delivering tokens
+   and doing block accounting between device calls;
+2. decode-step micro-decomposition via standalone jitted probes on
+   the SAME shapes the server runs: a KV-GATHER probe (pool[table]
+   for every layer — the paged cache's added cost vs a contiguous
+   buffer), an ATTENTION probe (gather + masked GQA einsum + softmax)
+   and a SAMPLER probe (temperature/top-k/top-p + categorical), each
+   timed against the full decode step;
+3. the steady-state contract: compile counts before/after traffic.
+
+Numbers from this 1-core CPU container are attribution SHARES, not
+absolute TPU performance (PERF.md's standing roofline note).
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_decode.py [--smoke]
+Env: PROFILE_STREAMS, PROFILE_NEW, PROFILE_BLOCK, PROFILE_SLOTS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _t(fn, *args, n=20):
+    fn(*args)                                 # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    np.asarray(out[0] if isinstance(out, tuple) else out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    smoke = "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import GenerationServer
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+    streams = int(os.environ.get("PROFILE_STREAMS", "8"))
+    max_new = int(os.environ.get("PROFILE_NEW", "32"))
+    block = int(os.environ.get("PROFILE_BLOCK", "8"))
+    slots = int(os.environ.get("PROFILE_SLOTS", str(streams)))
+
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=256, hidden_size=64,
+                     intermediate_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=512)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    lens = [(8, 24, 16, 12)[i % 4] for i in range(streams)]
+    prompts = [rng.randint(1, cfg.vocab_size, (L,)).astype("int32")
+               for L in lens]
+    max_len = max(lens) + max_new
+
+    server = GenerationServer(model, num_slots=slots, block_size=block,
+                              max_model_len=max_len,
+                              request_timeout_s=600.0)
+    server.start()
+    n_warm = server.num_compiles()
+    hs = [server.submit(p, max_new_tokens=max_new) for p in prompts]
+    t0 = time.perf_counter()
+    for h in hs:
+        h.result(timeout=600.0)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    st = server.stats()
+    server.stop()
+
+    steps = max(st["decode_steps"], 1)
+    sched_ms = max(wall_ms - st["decode_ms"] - st["prefill_ms"], 0.0)
+    print(json.dumps({
+        "mode": "server_phases",
+        "streams": streams, "slots": slots, "block_size": block,
+        "tokens": st["tokens_generated"], "decode_steps": steps,
+        "tokens_per_s": round(st["tokens_generated"]
+                              / (wall_ms / 1e3), 1),
+        "decode_ms_per_step": round(st["decode_ms"] / steps, 3),
+        "prefill_ms_total": round(st["prefill_ms"], 1),
+        "scheduler_python_ms_per_step": round(sched_ms / steps, 3),
+        "phase_shares_of_wall": {
+            "decode": round(st["decode_ms"] / wall_ms, 4),
+            "prefill": round(st["prefill_ms"] / wall_ms, 4),
+            "scheduler_python": round(sched_ms / wall_ms, 4),
+        },
+        "compiles_warm": n_warm,
+        "compiles_after_traffic": st["num_compiles"],
+        "traffic_compiles": st["traffic_compiles"],
+    }), flush=True)
+
+    # -- micro probes on the server's decode shapes -------------------
+    B, M = slots, -(-max_len // block)
+    KH, D = cfg.kv_heads, cfg.head_dim
+    nblocks = slots * M + 1
+    L = cfg.num_hidden_layers
+    V = cfg.vocab_size
+    kpools = [jnp.asarray(rng.standard_normal((nblocks, block, KH, D)),
+                          jnp.bfloat16) for _ in range(L)]
+    tbl = jnp.asarray(rng.randint(1, nblocks, (B, M)), jnp.int32)
+    pos = jnp.asarray(rng.randint(8, max_len - 1, (B, 1)), jnp.int32)
+    q = jnp.asarray(rng.standard_normal(
+        (B, 1, cfg.num_attention_heads, D)), jnp.bfloat16)
+
+    @jax.jit
+    def gather_probe(pools, tbl):
+        # the paged cache's per-step read: one [B, M*bs, KH, D] gather
+        # per layer (a contiguous cache skips this)
+        acc = 0.0
+        for kp in pools:
+            kg = kp[tbl].reshape(B, M * block, KH, D)
+            acc = acc + kg.astype(jnp.float32).sum()
+        return acc
+
+    @jax.jit
+    def attention_probe(pools, tbl, q, pos):
+        # gather + masked GQA einsum + softmax + value einsum, per layer
+        T = M * block
+        G, R = KH, cfg.num_attention_heads // KH
+        out = 0.0
+        for kp in pools:
+            kg = kp[tbl].reshape(B, T, KH, D)
+            qg = q.reshape(B, 1, G, R, D)
+            lg = jnp.einsum("bsgrd,btgd->bgrst",
+                            qg.astype(jnp.float32),
+                            kg.astype(jnp.float32))
+            valid = (jnp.arange(T)[None, None, None, None, :]
+                     <= pos[:, None, None, :, None])
+            lg = jnp.where(valid, lg, -jnp.inf)
+            w = jax.nn.softmax(lg, axis=-1)
+            out = out + jnp.einsum("bgrst,btgd->bsgrd", w,
+                                   kg.astype(jnp.float32)).sum()
+        return out
+
+    logits = jnp.asarray(rng.standard_normal((B, V)), jnp.float32)
+    kd = jnp.asarray(rng.randint(0, 2**31, (B, 2)), jnp.uint32)
+
+    @jax.jit
+    def sampler_probe(lg, kd):
+        x = lg / 0.9
+        srt = jnp.sort(x, axis=-1)[:, ::-1]
+        kth = srt[:, 7][:, None]
+        x = jnp.where(x < kth, -jnp.inf, x)
+        keys = jax.vmap(jax.random.fold_in)(
+            jax.random.wrap_key_data(kd, impl="threefry2x32"),
+            jnp.arange(B))
+        return jax.vmap(jax.random.categorical)(keys, x)
+
+    gather_ms = _t(gather_probe, kpools, tbl)
+    attn_ms = _t(attention_probe, kpools, tbl, q, pos)
+    sampler_ms = _t(sampler_probe, logits, kd)
+    step_ms = st["decode_ms"] / steps
+    # "matmul/other" = whatever the full step spends beyond the probed
+    # attention+sampler work: the q/k/v/o projections, MLP, embeddings
+    # and the vocab head — the dense-compute share
+    other_ms = max(step_ms - attn_ms - sampler_ms, 0.0)
+    print(json.dumps({
+        "mode": "decode_step_probes",
+        "note": ("probes re-run the step's pieces standalone on the "
+                 "server's exact shapes; shares are indicative — XLA "
+                 "fuses differently inside the full program"),
+        "kv_gather_ms": round(gather_ms, 4),
+        "attention_ms": round(attn_ms, 4),
+        "sampler_ms": round(sampler_ms, 4),
+        "matmul_other_ms": round(other_ms, 4),
+        "decode_step_ms": round(step_ms, 4),
+        "shares_of_step": {
+            "kv_gather": round(min(gather_ms / step_ms, 1.0), 4),
+            "attention_minus_gather": round(
+                max(attn_ms - gather_ms, 0.0) / step_ms, 4),
+            "sampler": round(sampler_ms / step_ms, 4),
+            "matmul_other": round(other_ms / step_ms, 4),
+        },
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
