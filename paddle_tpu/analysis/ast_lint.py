@@ -69,6 +69,7 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "LintConfig",
 # (tracing-hazard rules), per ISSUE 6
 DEFAULT_LINT_PATHS = (
     "paddle_tpu/distributed/fleet/ps_service.py",
+    "paddle_tpu/distributed/fleet/elastic.py",
     "paddle_tpu/distributed/fleet/heter.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/inference/generation_server.py",

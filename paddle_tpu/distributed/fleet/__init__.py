@@ -42,6 +42,12 @@ __all__ += ["data_generator", "DataGenerator", "MultiSlotDataGenerator",
             "MultiSlotStringDataGenerator"]
 from . import metrics  # noqa: F401,E402
 from .role_maker import (  # noqa: F401,E402
-    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker, UtilBase)
+    ElasticRoleMaker, PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+    UtilBase)
 __all__ += ["metrics", "PaddleCloudRoleMaker", "Role",
-            "UserDefinedRoleMaker", "UtilBase"]
+            "UserDefinedRoleMaker", "UtilBase", "ElasticRoleMaker"]
+from . import elastic  # noqa: F401,E402
+from .elastic import (  # noqa: F401,E402
+    ElasticClient, ElasticCoordinator, ElasticTrainer)
+__all__ += ["elastic", "ElasticCoordinator", "ElasticClient",
+            "ElasticTrainer"]

@@ -29,6 +29,15 @@ Fault kinds (``Fault.kind``):
   crash   hard-kill the current process (``os._exit(137)``) when the
           server receives the matching request — SIGKILL-grade server
           loss for subprocess harnesses (tools/chaos_ps.py).
+  kill    ELASTIC site (ISSUE 9): SIGKILL the current worker process at
+          the matching training step — the elastic membership
+          controller's acceptance-test fault.  ``op`` is ``worker``;
+          the match counter advances once per EXECUTED training step in
+          this process (replayed steps after a rewind count), so
+          ``kill:worker:every=K`` kills each incarnation after K steps
+          and the run finishes iff checkpoints land more often than
+          kills.  Fired via ``maybe_kill_worker()`` from the elastic
+          step loop.
   nan     NUMERIC site (PR 4): inject NaN into a matching array stream.
           ``op`` names the stream — ``grad`` (parameter gradients, hook
           in train_guard), ``batch`` (input rows, hook in hapi/Model and
@@ -69,7 +78,7 @@ import time
 from typing import List, Optional
 
 __all__ = ["Fault", "FaultPlan", "install", "uninstall", "active",
-           "named_plan", "plan_from_spec"]
+           "named_plan", "plan_from_spec", "maybe_kill_worker"]
 
 # frames the protocol never answers: safe to duplicate on the wire
 _ONE_WAY_OPS = {"heartbeat"}
@@ -89,7 +98,7 @@ class Fault:
     """One deterministic fault rule (see module docstring)."""
 
     KINDS = ("delay", "dup", "cut", "drop", "refuse", "crash",
-             "nan", "inf")
+             "kill", "nan", "inf")
 
     def __init__(self, kind: str, op: str = "*", first: int = 1,
                  every: int = 0, times: int = 1, arg: float = 0.0):
@@ -110,6 +119,8 @@ class Fault:
             return "connect"
         if self.kind == "crash":
             return "serve"
+        if self.kind == "kill":
+            return "elastic"
         if self.kind in ("nan", "inf"):
             return "numeric"
         return "send"
@@ -247,6 +258,18 @@ class FaultPlan:
             return f
         return None
 
+    def match_elastic(self, op: str = "worker") -> Optional[Fault]:
+        """Elastic-site hook (:func:`maybe_kill_worker`): consult the
+        schedule for stream ``op`` (currently ``worker``).  Called
+        exactly once per EXECUTED training step, so ``every=K`` fires
+        after K steps of this process's current incarnation.  Returns
+        the firing Fault (kind ``kill``) or None; the caller delivers
+        the signal (stats would die with the process anyway)."""
+        f = self._match("elastic", op)
+        if f is not None and f.kind == "kill":
+            return f
+        return None
+
     def on_serve(self, msg):
         """Server-side hook, called once per received request."""
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
@@ -288,6 +311,16 @@ def named_plan(name: str, seed: int = 0) -> FaultPlan:
                         times=0)]
     elif name.startswith("crash@"):
         faults = [Fault("crash", op="push", first=int(name[6:]))]
+    # -- elastic plans (ISSUE 9, fleet/elastic.py) ----------------------
+    elif name.startswith("kill_worker@every="):
+        # SIGKILL this worker at its K-th executed step, then every K
+        # after that, forever (each launcher restart re-arms the plan
+        # from the env, so every incarnation dies after K steps — the
+        # run only finishes because checkpoints land more often than
+        # kills and the final incarnation's remaining step count is
+        # below K)
+        k = int(name[len("kill_worker@every="):])
+        faults = [Fault("kill", op="worker", first=k, every=k, times=0)]
     # -- numeric plans (PR 4, tools/chaos_numerics.py) ------------------
     elif name.startswith("nan_grad@"):
         faults = [Fault("nan", op="grad", first=int(name[9:]))]
@@ -306,9 +339,25 @@ def named_plan(name: str, seed: int = 0) -> FaultPlan:
                         every=1, times=4, arg=1)]
     else:
         raise ValueError(f"unknown chaos plan {name!r} (flaky, dup, "
-                         f"lost_ack, crash@N, nan_grad@N, inf_grad@N, "
-                         f"nan_batch@N, diverge@N)")
+                         f"lost_ack, crash@N, kill_worker@every=K, "
+                         f"nan_grad@N, inf_grad@N, nan_batch@N, "
+                         f"diverge@N)")
     return FaultPlan(faults, seed=seed, name=name)
+
+
+def maybe_kill_worker(op: str = "worker"):
+    """Elastic step-loop hook: SIGKILL the current process when the
+    active plan schedules a ``kill`` fault for this step.  SIGKILL (not
+    ``os._exit``) so the launcher watchdog sees exactly what a
+    machine-level worker loss delivers: a negative waitpid status it
+    must normalise to 128+9."""
+    plan = active()
+    if plan is None:
+        return
+    f = plan.match_elastic(op)
+    if f is not None:
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def plan_from_spec(spec: str) -> FaultPlan:

@@ -38,7 +38,10 @@ from ...observability import flight_recorder as _flight
 from ...observability.timeline import StepTimeline
 from .. import mesh as mesh_mod
 
-__all__ = ["DistributedTrainStep", "param_partition_spec"]
+__all__ = ["DistributedTrainStep", "param_partition_spec",
+           "zero_shard_ranges", "flatten_zero_state",
+           "unflatten_zero_state", "zero_shard", "zero_unshard",
+           "zero_reshard"]
 
 # storage suffix for 8-bit optimizer-state scales ("m" -> "m@scale");
 # "@" cannot collide with real slot names
@@ -146,6 +149,92 @@ def _transform_slots(st, pshape, mdt, direction):
         else:
             d[k] = v.astype(mdt)
     return d
+
+
+# -- deterministic ZeRO host-shard math (ISSUE 9 elastic training) -----
+#
+# The elastic membership controller (fleet/elastic.py) partitions the
+# GLOBAL flattened parameter / optimizer-state vector over the live
+# worker set.  These helpers are the single source of truth for that
+# partition: a reshard after a membership change is a PURE function of
+# (global state, new world size), so an N->M transition loads exactly
+# the shards a fresh M-worker run would load from the same checkpoint.
+# The partition rule (contiguous ranges, remainder spread over the
+# leading ranks) deliberately matches UtilBase.get_file_shard.
+
+def zero_shard_ranges(total: int, world: int):
+    """Contiguous ``[start, stop)`` ranges partitioning a flat
+    length-``total`` vector over ``world`` ranks.  Covers every element
+    exactly once for ANY (total, world) — world need not divide total;
+    ranks beyond ``total`` get empty ranges."""
+    total, world = int(total), int(world)
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    base, rem = divmod(total, world)
+    out, start = [], 0
+    for r in range(world):
+        size = base + (1 if r < rem else 0)
+        out.append((start, start + size))
+        start += size
+    return out
+
+
+def flatten_zero_state(tree: Dict[str, Any]):
+    """``{name: ndarray}`` -> ``(flat f32 vector, meta)`` with a
+    deterministic (sorted-name) layout.  ``meta`` is
+    ``[(name, shape), ...]`` — feed it back to
+    :func:`unflatten_zero_state`.  All leaves must share one dtype (the
+    elastic data plane is f32): mixing dtypes in one flat vector would
+    silently upcast shards."""
+    meta, parts, dtype = [], [], None
+    for name in sorted(tree):
+        v = np.asarray(tree[name])
+        if dtype is None:
+            dtype = v.dtype
+        elif v.dtype != dtype:
+            raise ValueError(
+                f"flatten_zero_state needs one dtype; {name!r} is "
+                f"{v.dtype}, expected {dtype}")
+        meta.append((name, tuple(v.shape)))
+        parts.append(v.reshape(-1))
+    flat = (np.concatenate(parts) if parts
+            else np.zeros(0, dtype or np.float32))
+    return flat, meta
+
+
+def unflatten_zero_state(flat: np.ndarray, meta) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_zero_state` (views into ``flat``)."""
+    out, ofs = {}, 0
+    for name, shape in meta:
+        n = int(np.prod(shape)) if shape else 1
+        out[name] = flat[ofs:ofs + n].reshape(shape)
+        ofs += n
+    if ofs != flat.size:
+        raise ValueError(
+            f"flat vector has {flat.size} elements, meta describes {ofs}")
+    return out
+
+
+def zero_shard(flat: np.ndarray, rank: int, world: int) -> np.ndarray:
+    """Rank ``rank``'s contiguous shard of the global flat vector."""
+    lo, hi = zero_shard_ranges(flat.size, world)[rank]
+    return flat[lo:hi]
+
+
+def zero_unshard(shards) -> np.ndarray:
+    """Reassemble the global flat vector from rank-ordered shards."""
+    shards = list(shards)
+    return (np.concatenate([np.asarray(s).reshape(-1) for s in shards])
+            if shards else np.zeros(0, np.float32))
+
+
+def zero_reshard(shards, new_world: int):
+    """Reshard rank-ordered shards from their current world size to
+    ``new_world``: merge to the global vector, re-partition.  Pure —
+    bit-exact round trips (N->M->N) and identical to what a fresh
+    ``new_world`` run would shard from the same global vector."""
+    flat = zero_unshard(shards)
+    return [zero_shard(flat, r, new_world) for r in range(new_world)]
 
 
 def _tree_to_tensors(obj):

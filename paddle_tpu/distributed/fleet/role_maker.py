@@ -18,7 +18,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
-           "UtilBase", "endpoint_groups", "replica_primary_for"]
+           "ElasticRoleMaker", "UtilBase", "endpoint_groups",
+           "replica_primary_for"]
 
 
 def endpoint_groups(endpoints: Sequence[str]) -> List[List[str]]:
@@ -159,6 +160,46 @@ class UserDefinedRoleMaker(RoleMakerBase):
 
     def worker_num(self) -> int:
         return self._worker_num
+
+
+class ElasticRoleMaker(RoleMakerBase):
+    """Membership-aware role maker for elastic jobs (ISSUE 9).
+
+    Static role makers read a fixed topology once; under elastic
+    training rank and world size are ASSIGNED by the
+    :class:`~paddle_tpu.distributed.fleet.elastic.ElasticCoordinator`
+    and change on every membership generation (worker join / leave /
+    fail).  The elastic trainer calls :meth:`update_membership` on each
+    transition; everything consulting the RoleMakerBase surface
+    (worker_index / worker_num / is_first_worker) then sees the
+    post-transition world.  ``generation()`` fences stale readers: a
+    cached rank is only valid while the generation it was read under
+    is still current."""
+
+    def __init__(self, worker_endpoints: Optional[Sequence[str]] = None):
+        super().__init__()
+        self._worker_endpoints = list(worker_endpoints or [])
+        self._generation = 0
+        self._world = 1
+
+    def update_membership(self, rank: int, world: int, generation: int):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if not 0 <= int(rank) < int(world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        self._current_id = int(rank)
+        self._world = int(world)
+        self._generation = int(generation)
+
+    def generation(self) -> int:
+        return self._generation
+
+    def worker_num(self) -> int:
+        return self._world
+
+    def to_string(self) -> str:
+        return (f"{super().to_string()} world={self._world} "
+                f"generation={self._generation}")
 
 
 class UtilBase:

@@ -11,6 +11,21 @@ The launcher's only real jobs are (a) choosing the coordinator address for
 ncclUniqueId TCP broadcast (platform/gen_comm_id_helper.cc:284) — and (b)
 exporting the PADDLE_* env the script and ``init_parallel_env`` read.  On a
 single host it simply execs the script.
+
+Elastic mode (ISSUE 9): the reference's watchdog aborts the whole job
+when any worker dies (launch_utils.py watch-local-trainers semantics).
+``--elastic`` replaces die-on-first-failure with a restart loop: a
+worker that exits non-zero is relaunched (up to ``--max_restarts``,
+with exponential backoff from ``--restart_backoff``) and rejoins the
+run through the elastic rendezvous at ``PADDLE_COORDINATOR``
+(fleet/elastic.py); the membership controller reshards state from the
+last pinned checkpoint and training resumes bit-identically.  When no
+coordinator is running, the rank-0 launcher starts one in-process.
+
+Watchdog contract (regression-tested): a worker killed by signal exits
+the launcher with ``128 + signum`` (never a raw negative waitpid code),
+and the per-worker log handle is closed even when ``proc.wait()``
+raises.
 """
 from __future__ import annotations
 
@@ -19,6 +34,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 __all__ = ["launch", "main"]
 
@@ -34,9 +50,71 @@ def _parse_args(argv):
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="kept for reference-CLI parity; on TPU each host "
                         "runs ONE process driving all its chips")
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise the worker elastically: restart on "
+                        "failure and rejoin via PADDLE_COORDINATOR "
+                        "instead of aborting the job")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic restart budget (per launcher)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds between restarts (doubles per "
+                        "consecutive failure, capped at 30s)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _open_log(path):
+    """Split out so the watchdog tests can hand in a tracking file."""
+    return open(path, "a")
+
+
+def _normalize_exit(ret: int) -> int:
+    """Signal deaths surface as ``128 + signum`` (shell convention);
+    the raw negative ``Popen.returncode`` would read as success-ish to
+    ``$? > 128`` checks and confuse restart policies."""
+    return 128 - ret if ret < 0 else ret
+
+
+def _run_worker(cmd, env, log_path, forward_signals=True):
+    """Spawn one worker, watchdog it, return its normalized exit code.
+
+    The log handle closes in ``finally`` — an exception out of
+    ``proc.wait()`` (KeyboardInterrupt, a dying pytest harness) must
+    not leak the descriptor across restart iterations."""
+    log = _open_log(log_path) if log_path else None
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=log or None,
+                                stderr=subprocess.STDOUT if log else None)
+
+        # watchdog parity (reference launch_utils.py:526
+        # watch_local_trainers): propagate signals, reap child
+        def _forward(sig, _frame):
+            try:
+                proc.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
+        if forward_signals:
+            for s in (signal.SIGINT, signal.SIGTERM):
+                signal.signal(s, _forward)
+        return _normalize_exit(proc.wait())
+    finally:
+        if log:
+            log.close()
+
+
+def _ensure_coordinator(env, nhosts):
+    """Elastic mode with no live coordinator: the rank-0 launcher hosts
+    one in-process (it outlives every worker incarnation) and exports
+    its address."""
+    if env.get("PADDLE_COORDINATOR"):
+        return None
+    from .fleet.elastic import ElasticCoordinator
+    coord = ElasticCoordinator(expected_world=nhosts)
+    coord.start()
+    env["PADDLE_COORDINATOR"] = f"127.0.0.1:{coord.port}"
+    return coord
 
 
 def launch(argv=None):
@@ -58,24 +136,41 @@ def launch(argv=None):
 
     cmd = [sys.executable, "-u", args.training_script] \
         + args.training_script_args
-    log = None
+    log_path = None
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-        log = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
-    proc = subprocess.Popen(cmd, env=env, stdout=log or None,
-                            stderr=subprocess.STDOUT if log else None)
+        log_path = os.path.join(args.log_dir, f"worker.{rank}.log")
 
-    # watchdog parity (reference launch_utils.py:526 watch_local_trainers):
-    # propagate signals, reap child, mirror its exit code.
-    def _forward(sig, _frame):
-        proc.send_signal(sig)
+    coord = None
+    try:
+        if args.elastic:
+            env["PADDLE_ELASTIC"] = "1"
+            if rank == 0:
+                coord = _ensure_coordinator(env, nhosts)
 
-    for s in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(s, _forward)
-    ret = proc.wait()
-    if log:
-        log.close()
-    sys.exit(ret)
+            restarts = 0
+            while True:
+                env["PADDLE_ELASTIC_RESTART"] = str(restarts)
+                code = _run_worker(cmd, env, log_path)
+                if code == 0:
+                    sys.exit(0)
+                if restarts >= args.max_restarts:
+                    print(f"[launch] worker rank {rank} failed with "
+                          f"exit {code}; restart budget "
+                          f"({args.max_restarts}) exhausted",
+                          file=sys.stderr)
+                    sys.exit(code)
+                delay = min(args.restart_backoff * (2 ** restarts), 30.0)
+                restarts += 1
+                print(f"[launch] worker rank {rank} exited {code}; "
+                      f"elastic restart {restarts}/{args.max_restarts} "
+                      f"in {delay:.1f}s", file=sys.stderr)
+                time.sleep(delay)
+        else:
+            sys.exit(_run_worker(cmd, env, log_path))
+    finally:
+        if coord is not None:
+            coord.stop()
 
 
 def main():

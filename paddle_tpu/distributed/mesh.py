@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "AXES", "init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
     "data_axes", "batch_spec", "named_sharding", "maybe_constrain",
+    "reform_mesh",
 ]
 
 # canonical axis order: batch-like axes first, then model axes
@@ -79,6 +80,24 @@ def init_mesh(degrees: Optional[Dict[str, int]] = None,
     arr = np.asarray(devices).reshape(sizes)
     _global_mesh = Mesh(arr, AXES)
     return _global_mesh
+
+
+def reform_mesh(degrees: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Re-form the global mesh after an elastic membership change.
+
+    The elastic controller (fleet/elastic.py) calls this on every
+    generation transition: the installed mesh is dropped and rebuilt
+    from the CURRENT device set, so anything reading ``get_mesh()``
+    afterwards sees the post-transition topology.  On a multi-host TPU
+    this is the site where the runtime re-initialises the coordination
+    service for the surviving hosts; in single-host worlds it
+    re-derives the all-``dp`` mesh.  Compiled programs holding the old
+    mesh must be rebuilt by their owners (DistributedTrainStep compiles
+    per-mesh; the elastic trainer re-enters its generation loop)."""
+    set_mesh(None)
+    return init_mesh(degrees if degrees is not None else {"dp": -1},
+                     devices=devices)
 
 
 def set_mesh(mesh: Optional[Mesh]):

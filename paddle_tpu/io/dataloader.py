@@ -132,8 +132,17 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False, use_process=False,
-                 mp_start_method="fork"):
+                 mp_start_method="fork", seed=None):
         self.dataset = dataset
+        # batch-cursor resume (ISSUE 9): ``seed`` makes every epoch's
+        # shuffle permutation a pure function of (seed, epoch) so
+        # ``state_dict()/load_state_dict()`` can resume mid-epoch
+        # bit-exactly.  None keeps the legacy global-RNG behaviour
+        # (and state_dict() on a shuffling loader then raises).
+        self.seed = seed
+        self._pos_epoch = 0   # epoch the live/next iteration runs
+        self._pos_batch = 0   # batches already yielded within it
+        self._resume = None   # (epoch, batch) pending from load_state_dict
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
@@ -171,10 +180,70 @@ class DataLoader:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
 
+    # -- exact batch-cursor resume (ISSUE 9) ---------------------------
+    def state_dict(self) -> dict:
+        """The loader's exact batch cursor: ``{"epoch", "batch",
+        "seed"}`` where ``batch`` counts batches already YIELDED to the
+        consumer this epoch (prefetched-but-undelivered batches do not
+        count).  Feeding it to :meth:`load_state_dict` on a freshly
+        constructed identical loader resumes the stream element-wise —
+        no sample skipped, none double-seen.  A shuffling loader must
+        be constructed with ``seed=`` (or a seeded sampler): a
+        global-RNG permutation cannot be reproduced on resume."""
+        if self.seed is None and not self._iterable_mode:
+            from .sampler import RandomSampler
+            s = getattr(self.batch_sampler, "sampler", None)
+            if isinstance(s, RandomSampler) and s.generator is None:
+                raise ValueError(
+                    "state_dict() on a shuffling DataLoader requires "
+                    "seed=... (an unseeded global-RNG epoch permutation "
+                    "cannot be reproduced when resuming)")
+        return {"epoch": int(self._pos_epoch),
+                "batch": int(self._pos_batch), "seed": self.seed}
+
+    def load_state_dict(self, state: dict):
+        """Arm the cursor: the NEXT ``iter()`` resumes at
+        ``state["epoch"]`` with ``state["batch"]`` batches skipped.
+        Map-style loaders fast-forward at the sampler-index level (the
+        dataset is never touched for skipped batches); iterable-style
+        loaders consume and discard the skipped batches' raw items."""
+        if state.get("seed") is not None and self.seed is not None \
+                and state["seed"] != self.seed:
+            raise ValueError(
+                f"checkpoint cursor was taken under seed="
+                f"{state['seed']!r} but this loader has seed="
+                f"{self.seed!r}; the shuffle streams would diverge")
+        self._resume = (int(state["epoch"]), int(state["batch"]))
+        self._pos_epoch, self._pos_batch = self._resume
+
+    def _setup_epoch(self, epoch: int):
+        """Per-epoch RNG derivation (only when ``seed`` is set, so
+        legacy loaders keep their exact global-RNG behaviour):
+        epoch-aware samplers get ``set_epoch``; an internally created
+        RandomSampler draws from ``default_rng([seed, epoch])`` — the
+        permutation is a pure function of (seed, epoch)."""
+        if self.seed is None or self._iterable_mode:
+            return
+        bs = self.batch_sampler
+        if hasattr(bs, "set_epoch"):
+            bs.set_epoch(epoch)
+        from .sampler import RandomSampler
+        s = getattr(bs, "sampler", None)
+        if isinstance(s, RandomSampler):
+            s.generator = np.random.default_rng(
+                [int(self.seed) & 0xFFFFFFFF, int(epoch)])
+
     # ------------------------------------------------------------------
-    def _iter_batches_sync(self):
+    def _iter_batches_sync(self, sampler_iter=None, skip=0):
         if self._iterable_mode:
             it = iter(self.dataset)
+            if skip:
+                # cursor resume: an arbitrary iterable cannot be
+                # fast-forwarded — consume the skipped batches' raw
+                # items (only full batches can precede the cursor, so
+                # skip * batch_size is exact)
+                for _ in itertools.islice(it, skip * self.batch_size):
+                    pass
             while True:
                 chunk = list(itertools.islice(it, self.batch_size))
                 if not chunk:
@@ -183,10 +252,10 @@ class DataLoader:
                     return
                 yield self.collate_fn(chunk)
         else:
-            for idxs in self.batch_sampler:
+            for idxs in sampler_iter:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
-    def _iter_batches_workers(self):
+    def _iter_batches_workers(self, sampler_iter):
         """Thread-pool workers.
 
         The reference forks OS processes and ships batches through shared
@@ -212,7 +281,7 @@ class DataLoader:
                 return self.collate_fn([self.dataset[i] for i in idxs])
 
             pending = []
-            it = iter(self.batch_sampler)
+            it = sampler_iter
             depth = self.num_workers * self.prefetch_factor
             for idxs in itertools.islice(it, depth):
                 pending.append(pool.submit(make, idxs))
@@ -225,7 +294,7 @@ class DataLoader:
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
 
-    def _iter_batches_process(self):
+    def _iter_batches_process(self, sampler_iter):
         """Forked worker processes with per-worker index queues, a shared
         result queue, and an in-order reorder buffer (the reference's
         _DataLoaderIterMultiProcess structure, dataloader_iter.py:469).
@@ -251,7 +320,7 @@ class DataLoader:
             index_queues.append(iq)
 
         try:
-            it = enumerate(iter(self.batch_sampler))
+            it = enumerate(sampler_iter)
             send_idx = 0            # next batch number to dispatch
             recv_idx = 0            # next batch number to yield
             reorder: dict = {}
@@ -306,11 +375,30 @@ class DataLoader:
                 q_.close()
 
     def __iter__(self):
-        if self.num_workers > 0 and not self._iterable_mode:
-            gen = (self._iter_batches_process() if self.use_process
-                   else self._iter_batches_workers())
+        # batch cursor: a pending load_state_dict resumes at its
+        # (epoch, batch); otherwise continue from the live position
+        # (a fresh pass of epoch N, or epoch N+1 after exhaustion)
+        resume = self._resume
+        self._resume = None
+        epoch = resume[0] if resume else self._pos_epoch
+        skip = resume[1] if resume else 0
+        self._pos_epoch, self._pos_batch = epoch, skip
+        self._setup_epoch(epoch)
+        if not self._iterable_mode:
+            sampler_iter = iter(self.batch_sampler)
+            if skip:
+                # fast-forward at the index level: skipped batches cost
+                # sampler draws only, never a dataset __getitem__
+                for _ in itertools.islice(sampler_iter, skip):
+                    pass
+            if self.num_workers > 0:
+                gen = (self._iter_batches_process(sampler_iter)
+                       if self.use_process
+                       else self._iter_batches_workers(sampler_iter))
+            else:
+                gen = self._iter_batches_sync(sampler_iter=sampler_iter)
         else:
-            gen = self._iter_batches_sync()
+            gen = self._iter_batches_sync(skip=skip)
 
         # prefetch-to-device pipeline (double buffering). The feeder checks
         # ``abandoned`` around every blocking put so an early `break` in the
@@ -362,9 +450,14 @@ class DataLoader:
                 else:
                     item = q.get()
                 if item is stop:
+                    # clean exhaustion: the cursor rolls to the next
+                    # epoch (an abandoned iterator keeps its position)
+                    self._pos_epoch += 1
+                    self._pos_batch = 0
                     break
                 if isinstance(item, Exception):
                     raise item
+                self._pos_batch += 1
                 yield item
         finally:
             abandoned.set()

@@ -35,6 +35,11 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        # a np.random.Generator; when set, every pass draws from it
+        # instead of the process-global stream — the hook DataLoader's
+        # seeded batch-cursor resume (state_dict/load_state_dict) uses
+        # to make epoch permutations a pure function of (seed, epoch)
+        self.generator = generator
 
     @property
     def num_samples(self):
@@ -42,8 +47,13 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        g = self.generator
         if self.replacement:
+            if g is not None:
+                return iter(g.integers(0, n, self.num_samples).tolist())
             return iter(np.random.randint(0, n, self.num_samples).tolist())
+        if g is not None:
+            return iter(g.permutation(n)[:self.num_samples].tolist())
         return iter(np.random.permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):

@@ -102,8 +102,16 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # serve.decode/serve.admit: the generation scheduler's per-step and
 # per-admission heartbeats (ISSUE 8) — decode mostly ticks via
 # progress(), but its sampled ring events count too
+# elastic.join/reshard/resume (ISSUE 9): a membership transition can
+# legitimately stall the step stream for seconds (restore + reshard
+# from the pinned checkpoint) — these events tell the watchdog the
+# transition itself is making progress.  elastic.leave is deliberately
+# NOT progress: a worker loss with no reshard following it is exactly
+# the stall worth dumping.
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
-                             "serve.decode", "serve.admit"})
+                             "serve.decode", "serve.admit",
+                             "elastic.join", "elastic.reshard",
+                             "elastic.resume"})
 
 # typed-failure dumps are rate limited per reason (a retry storm must
 # not turn every PSUnavailable into a bundle) and capped per process

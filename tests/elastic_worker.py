@@ -1,0 +1,87 @@
+"""Elastic-training worker entry point for the subprocess tests.
+
+Launched via ``python -m paddle_tpu.distributed.launch [--elastic] ...
+elastic_worker.py <config.json>`` (or directly).  Builds the shared
+deterministic linear-regression problem, runs an
+:class:`~paddle_tpu.distributed.fleet.elastic.ElasticTrainer` against
+the coordinator at ``PADDLE_COORDINATOR``, and writes the final params
++ this worker's transition log to ``<result>.<uid-less rank tag>.npz``.
+
+Determinism contract: every worker constructs the IDENTICAL dataset,
+loader seed and init, so the run's trajectory is a pure function of the
+global step — the chaos test asserts the faulted run's final state is
+``np.array_equal`` to the fault-free one.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.fleet.elastic import ElasticTrainer  # noqa: E402
+from paddle_tpu.io.dataloader import DataLoader  # noqa: E402
+from paddle_tpu.io.dataset import Dataset  # noqa: E402
+
+DIM = 4
+
+
+class RegressionSet(Dataset):
+    """Fixed synthetic regression data — identical in every process."""
+
+    def __init__(self, n=64, d=DIM):
+        rng = np.random.default_rng(7)
+        self.x = rng.standard_normal((n, d)).astype(np.float32)
+        w = np.arange(1, d + 1, dtype=np.float32)
+        self.y = (self.x @ w + 0.5).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def grad_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    err = (pred - y).astype(np.float32)
+    n = np.float32(x.shape[0])
+    return {"w": (x.T @ err / n).astype(np.float32),
+            "b": np.asarray(err.sum() / n, np.float32).reshape(())}
+
+
+def make_trainer(cfg):
+    loader = DataLoader(RegressionSet(), batch_size=cfg["batch_size"],
+                        shuffle=True, seed=cfg["loader_seed"],
+                        drop_last=True)
+    return ElasticTrainer(
+        {"w": np.zeros(DIM, np.float32),
+         "b": np.zeros((), np.float32)},
+        grad_fn, loader, ckpt_dir=cfg["ckpt_dir"],
+        optimizer=cfg.get("optimizer", "adam"), lr=cfg.get("lr", 0.05),
+        micro_batches=cfg["micro_batches"],
+        ckpt_every=cfg["ckpt_every"],
+        coordinator=cfg.get("coordinator"),
+        expected_world=cfg.get("expected_world"),
+        client_timeout=cfg.get("client_timeout", 60.0))
+
+
+def main():
+    with open(sys.argv[1]) as f:
+        cfg = json.load(f)
+    trainer = make_trainer(cfg)
+    params = trainer.run(cfg["total_steps"])
+    shard = trainer.opt_shard()
+    rank_tag = os.environ.get("PADDLE_TRAINER_ID", "0")
+    out = cfg["result"] + f".rank{rank_tag}.npz"
+    np.savez(out + ".tmp.npz", w=params["w"], b=params["b"],
+             transitions=json.dumps(trainer.transitions),
+             opt_t=int(shard["t"]))
+    os.replace(out + ".tmp.npz", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,428 @@
+"""Elastic training (ISSUE 9): membership transitions + deterministic
+reshard.
+
+The determinism bar is the one PR 3 (PS failover) and PR 4 (TrainGuard
+rewind) set: ``np.array_equal``, not allclose.  The acceptance test
+SIGKILLs a worker every K steps in a subprocess run driven by the
+launcher's ``--elastic`` mode and proves the final weights/opt-state
+equal the fault-free run bit-for-bit.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.distributed.checkpoint import CheckpointManager  # noqa: E402
+from paddle_tpu.distributed.fleet import chaos  # noqa: E402
+from paddle_tpu.distributed.fleet.dist_step import (  # noqa: E402
+    flatten_zero_state, unflatten_zero_state, zero_reshard, zero_shard,
+    zero_shard_ranges, zero_unshard)
+from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
+    ElasticClient, ElasticCoordinator, ElasticTrainer, _FlatAdam)
+from paddle_tpu.framework import monitor as _monitor  # noqa: E402
+from paddle_tpu.io.dataloader import DataLoader  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import elastic_worker  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# pure reshard math (dist_step.zero_*)
+# ---------------------------------------------------------------------------
+
+def test_zero_shard_ranges_cover_and_partition():
+    for total, world in [(10, 1), (10, 2), (10, 3), (7, 4), (3, 5),
+                         (0, 2), (64, 8)]:
+        ranges = zero_shard_ranges(total, world)
+        assert len(ranges) == world
+        # contiguous, ordered, exactly covering [0, total)
+        pos = 0
+        for lo, hi in ranges:
+            assert lo == pos and hi >= lo
+            pos = hi
+        assert pos == total
+        # remainder spread over the leading ranks (UtilBase rule)
+        sizes = [hi - lo for lo, hi in ranges]
+        assert max(sizes) - min(sizes) <= 1
+        assert sorted(sizes, reverse=True) == sizes
+
+
+def test_zero_reshard_round_trip_bit_exact():
+    rng = np.random.default_rng(3)
+    tree = {"w": rng.standard_normal((5, 3)).astype(np.float32),
+            "b": rng.standard_normal(7).astype(np.float32),
+            "s": np.float32(rng.standard_normal()).reshape(())}
+    flat, meta = flatten_zero_state(tree)
+    # N=3 -> M=2 -> N=3 round trip is bit-exact, with 2 and 3 both not
+    # dividing the 23-element state
+    assert flat.size == 23
+    shards3 = [zero_shard(flat, r, 3) for r in range(3)]
+    shards2 = zero_reshard(shards3, 2)
+    back3 = zero_reshard(shards2, 3)
+    for a, b in zip(shards3, back3):
+        assert np.array_equal(a, b)
+    # the resharded M-world shards ARE what a fresh M-world run shards
+    for r in range(2):
+        assert np.array_equal(shards2[r], zero_shard(flat, r, 2))
+    assert np.array_equal(zero_unshard(shards2), flat)
+    # flatten/unflatten round trip restores every leaf bit-exactly
+    back = unflatten_zero_state(flat, meta)
+    for k in tree:
+        assert np.array_equal(back[k], tree[k])
+        assert back[k].shape == tree[k].shape
+
+
+def test_flatten_zero_state_rejects_mixed_dtypes():
+    with pytest.raises(ValueError, match="one dtype"):
+        flatten_zero_state({"a": np.zeros(2, np.float32),
+                            "b": np.zeros(2, np.float64)})
+
+
+def test_flat_adam_shard_update_equals_full_update():
+    """The ZeRO invariant the elastic data plane rests on: the update
+    is elementwise, so concatenated shard updates == the full-vector
+    update bit-for-bit, for any world size."""
+    rng = np.random.default_rng(5)
+    n, steps = 37, 4
+    p0 = rng.standard_normal(n).astype(np.float32)
+    grads = [rng.standard_normal(n).astype(np.float32)
+             for _ in range(steps)]
+
+    def run(world):
+        shards, opts = [], []
+        for r in range(world):
+            lo, hi = zero_shard_ranges(n, world)[r]
+            o = _FlatAdam(0.05)
+            o.load({"m": np.zeros(hi - lo, np.float32),
+                    "v": np.zeros(hi - lo, np.float32)}, t=0)
+            opts.append((o, lo, hi))
+            shards.append(p0[lo:hi].copy())
+        for g in grads:
+            for r, (o, lo, hi) in enumerate(opts):
+                shards[r] = o.update(shards[r], g[lo:hi])
+        return (np.concatenate(shards),
+                np.concatenate([o.m for o, _, _ in opts]),
+                np.concatenate([o.v for o, _, _ in opts]))
+
+    ref = run(1)
+    for world in (2, 3, 5):
+        got = run(world)
+        for a, b in zip(ref, got):
+            assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# in-process multi-worker harness
+# ---------------------------------------------------------------------------
+
+def _make_trainer(ckpt, ep, world, grad_fn=None, **kw):
+    loader = DataLoader(elastic_worker.RegressionSet(), batch_size=16,
+                        shuffle=True, seed=11, drop_last=True)
+    defaults = dict(ckpt_dir=ckpt, optimizer="adam", lr=0.05,
+                    micro_batches=4, ckpt_every=2, coordinator=ep,
+                    expected_world=world, client_timeout=60.0)
+    defaults.update(kw)
+    return ElasticTrainer(
+        {"w": np.zeros(elastic_worker.DIM, np.float32),
+         "b": np.zeros((), np.float32)},
+        grad_fn or elastic_worker.grad_fn, loader, **defaults)
+
+
+def _run_world(ckpt, world, steps, grad_fn=None, coord=None, **kw):
+    own = coord is None
+    if own:
+        coord = ElasticCoordinator(expected_world=world).start()
+    ep = f"127.0.0.1:{coord.port}"
+    trainers = [_make_trainer(ckpt, ep, world, grad_fn=grad_fn, **kw)
+                for _ in range(world)]
+    results = [None] * world
+    errs = [None] * world
+
+    def go(i):
+        try:
+            results[i] = trainers[i].run(steps)
+        except BaseException as e:  # surfaced below
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,), daemon=True)
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(not t.is_alive() for t in ts), "elastic run hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    if own:
+        coord.stop()
+    return results, trainers, coord
+
+
+def test_world_invariance_and_observability(tmp_path):
+    """An N-worker run and an M-worker run produce bit-identical
+    trajectories (the property every elastic transition relies on),
+    and the run emits the elastic metrics."""
+    before = _monitor.stat_get("elastic_transitions")
+    (r1,), _, _ = _run_world(str(tmp_path / "ck1"), 1, 10)
+    r2, trainers, _ = _run_world(str(tmp_path / "ck2"), 2, 10)
+    for r in r2:
+        assert np.array_equal(r["w"], r1["w"])
+        assert np.array_equal(r["b"], r1["b"])
+    for tr in trainers:
+        assert tr.transitions and tr.transitions[0]["world"] == 2
+        assert tr.role_maker.worker_num() == 2
+        assert tr.role_maker.generation() >= 1
+    assert _monitor.stat_get("elastic_transitions") > before
+    h = _monitor.get_histogram("reshard_ms")
+    assert h is not None and h.snapshot()["count"] > 0
+
+
+def test_checkpoint_content_is_world_size_invariant(tmp_path):
+    """The on-disk pinned checkpoint at step S is bit-identical whether
+    an N=2 or an M=3 world wrote it — THE property that makes reshard a
+    pure function of (global state, new world size)."""
+    _run_world(str(tmp_path / "ck2"), 2, 6)
+    _run_world(str(tmp_path / "ck3"), 3, 6)
+    m2 = CheckpointManager(str(tmp_path / "ck2"))
+    m3 = CheckpointManager(str(tmp_path / "ck3"))
+    assert 6 in m2.all_steps() and 6 in m3.all_steps()
+    s2, s3 = m2.restore(6), m3.restore(6)
+    assert np.array_equal(s2["model"]["flat"], s3["model"]["flat"])
+    for k in ("m", "v"):
+        assert np.array_equal(s2["opt"][k], s3["opt"][k])
+    assert s2["meta"] == s3["meta"]
+
+
+def test_reshard_n_to_m_matches_fresh_world_restore(tmp_path):
+    """A world-3 run resumed from a world-2 run's pinned step loads
+    exactly the shards a fresh 3-world run would load, and continues to
+    the same final state an uninterrupted 3-world run reaches."""
+    ck = str(tmp_path / "ck")
+    _run_world(ck, 2, 6)           # ckpts pinned at 2, 4, 6
+    st = CheckpointManager(ck).restore(6)
+    flat = np.asarray(st["model"]["flat"], np.float32)
+    # the pure reshard: N=2 shards merged == the saved global vector,
+    # and the fresh M=3 partition comes straight off it
+    shards2 = [zero_shard(flat, r, 2) for r in range(2)]
+    for r, s in enumerate(zero_reshard(shards2, 3)):
+        assert np.array_equal(s, zero_shard(flat, r, 3))
+    # resume at world 3 from the same pinned step (a restarted
+    # coordinator names it), train to 10
+    coord = ElasticCoordinator(expected_world=3, ckpt_step=6).start()
+    r3, trainers, _ = _run_world(ck, 3, 10, coord=coord)
+    coord.stop()
+    for tr in trainers:
+        assert tr.transitions[0]["resume_step"] == 6
+    # uninterrupted world-3 (== any world) run to 10
+    (ref,), _, _ = _run_world(str(tmp_path / "ref"), 1, 10)
+    for r in r3:
+        assert np.array_equal(r["w"], ref["w"])
+        assert np.array_equal(r["b"], ref["b"])
+
+
+def _slow_grad_fn(params, batch):
+    time.sleep(0.02)
+    return elastic_worker.grad_fn(params, batch)
+
+
+def test_join_mid_run_matches_fresh_run(tmp_path):
+    """Training at N=2 picks up worker 3 mid-run: everyone reforms from
+    the pinned step, and the post-join trajectory (== the whole run, by
+    world invariance) equals a fresh run's bit-for-bit."""
+    ck = str(tmp_path / "ck")
+    steps = 14
+    coord = ElasticCoordinator(expected_world=2).start()
+    ep = f"127.0.0.1:{coord.port}"
+    results = {}
+    errs = []
+
+    def worker(name):
+        try:
+            tr = _make_trainer(ck, ep, 2, grad_fn=_slow_grad_fn)
+            results[name] = (tr.run(steps), tr)
+        except BaseException as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(f"w{i}",), daemon=True)
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    # wait until the 2-world run is demonstrably mid-flight, then join
+    deadline = time.monotonic() + 30
+    while coord.status()["last_step"] < 3:
+        assert time.monotonic() < deadline, "run never reached step 3"
+        time.sleep(0.005)
+    tj = threading.Thread(target=worker, args=("joiner",), daemon=True)
+    tj.start()
+    for t in ts + [tj]:
+        t.join(timeout=90)
+    assert all(not t.is_alive() for t in ts + [tj]), "join run hung"
+    for e in errs:
+        raise e
+    coord.stop()
+    # the joiner was admitted into a live 3-world generation at a
+    # pinned step, and the originals reformed to world 3 with it
+    _, jt = results["joiner"]
+    assert jt.transitions[0]["world"] == 3
+    assert jt.transitions[0]["resume_step"] % 2 == 0
+    assert any(t["world"] == 3 for _, tr in results.values()
+               for t in tr.transitions)
+    (ref,), _, _ = _run_world(str(tmp_path / "ref"), 1, steps)
+    for r, _ in results.values():
+        assert np.array_equal(r["w"], ref["w"])
+        assert np.array_equal(r["b"], ref["b"])
+
+
+def test_graceful_leave_and_lease_eviction_reform(tmp_path):
+    """A registered-but-silent worker: lease expiry evicts it exactly
+    like a crash (the survivors reshard and finish correctly); a
+    graceful ``leave`` from a registered client likewise reforms."""
+    ck = str(tmp_path / "ck")
+    coord = ElasticCoordinator(expected_world=2, lease_s=0.4).start()
+    ep = f"127.0.0.1:{coord.port}"
+    wedged = ElasticClient(ep, timeout=30.0)
+    out = {}
+    errs = []
+
+    def survivor():
+        try:
+            tr = _make_trainer(ck, ep, 2)
+            out["r"] = (tr.run(8), tr)
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=survivor, daemon=True)
+    t.start()
+    # the wedged member registers (completing the expected world of 2)
+    # and then never exchanges — the lease must evict it
+    wedged.register(2)
+    t.join(timeout=60)
+    assert not t.is_alive(), "survivor hung behind the wedged worker"
+    for e in errs:
+        raise e
+    assert any(k == "lease" for k, _, _ in coord.events)
+    r, tr = out["r"]
+    assert any(tt["world"] == 1 for tt in tr.transitions)
+    (ref,), _, _ = _run_world(str(tmp_path / "ref"), 1, 8)
+    assert np.array_equal(r["w"], ref["w"])
+    wedged.close()
+    coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos plan + observability wiring
+# ---------------------------------------------------------------------------
+
+def test_kill_worker_chaos_plan():
+    plan = chaos.named_plan("kill_worker@every=3")
+    f = plan.faults[0]
+    assert (f.kind, f.op, f.first, f.every, f.times) == \
+        ("kill", "worker", 3, 3, 0)
+    # fires on calls 3, 6, 9, ... of the incarnation
+    fired = [bool(plan.match_elastic()) for _ in range(9)]
+    assert fired == [False, False, True, False, False, True,
+                     False, False, True]
+    # env-spec spelling parses to the same schedule
+    p2 = chaos.plan_from_spec("plan=kill_worker@every=5")
+    assert p2.faults[0].every == 5
+    p3 = chaos.plan_from_spec("kill:worker:first=2:every=4")
+    assert (p3.faults[0].kind, p3.faults[0].first) == ("kill", 2)
+    # no active plan: the hook is a no-op (it must not kill the test!)
+    chaos.uninstall()
+    chaos.maybe_kill_worker()
+
+
+def test_elastic_observability_wiring():
+    from paddle_tpu.observability.flight_recorder import _PROGRESS_KINDS
+    assert {"elastic.join", "elastic.reshard",
+            "elastic.resume"} <= set(_PROGRESS_KINDS)
+    assert "elastic.leave" not in _PROGRESS_KINDS
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import postmortem
+    assert postmortem._is_bad({"kind": "elastic.leave"})
+    # elastic.py is part of the default GraftLint module set and must
+    # lint clean (the shipped baseline stays empty)
+    from paddle_tpu.analysis import DEFAULT_LINT_PATHS, lint_file
+    assert "paddle_tpu/distributed/fleet/elastic.py" in DEFAULT_LINT_PATHS
+    findings = lint_file(
+        os.path.join(_REPO, "paddle_tpu/distributed/fleet/elastic.py"))
+    assert findings == [], [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: launcher --elastic + SIGKILL every K steps (subprocess)
+# ---------------------------------------------------------------------------
+
+def _launch_elastic(tag, tmp, world, steps, ckpt_every, chaos_rank=None,
+                    kill_every=5):
+    coord = ElasticCoordinator(expected_world=world).start()
+    ck = os.path.join(tmp, f"ck_{tag}")
+    res = os.path.join(tmp, f"res_{tag}")
+    cfg = {"batch_size": 16, "loader_seed": 11, "ckpt_dir": ck,
+           "micro_batches": 4, "ckpt_every": ckpt_every,
+           "coordinator": f"127.0.0.1:{coord.port}",
+           "expected_world": world, "total_steps": steps,
+           "result": res, "client_timeout": 60.0}
+    cfgp = os.path.join(tmp, f"cfg_{tag}.json")
+    with open(cfgp, "w") as f:
+        json.dump(cfg, f)
+    ips = ",".join(["127.0.0.1"] * world)
+    procs = []
+    for r in range(world):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO
+        env.pop("PADDLE_CHAOS", None)
+        env.pop("PADDLE_COORDINATOR", None)
+        if chaos_rank == r:
+            env["PADDLE_CHAOS"] = f"plan=kill_worker@every={kill_every}"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--elastic", "--max_restarts", "8",
+             "--restart_backoff", "0.05", "--ips", ips,
+             "--host_rank", str(r),
+             "--log_dir", os.path.join(tmp, f"log_{tag}"),
+             os.path.join(_REPO, "tests", "elastic_worker.py"), cfgp],
+            env=env, cwd=tmp))
+    rcs = [p.wait(timeout=120) for p in procs]
+    coord.stop()
+    outs = [np.load(res + f".rank{r}.npz") for r in range(world)]
+    return rcs, outs, coord.events
+
+
+def test_chaos_kill_every_k_steps_matches_fault_free_run(tmp_path):
+    """THE acceptance criterion: a 2-worker run whose rank-1 worker is
+    SIGKILLed every 5 executed steps (launcher --elastic restarts it,
+    survivors reshard from the pinned step each loss) finishes with
+    final weights AND optimizer step count np.array_equal to the
+    fault-free run."""
+    tmp = str(tmp_path)
+    steps = 12
+    rcs_ref, outs_ref, _ = _launch_elastic("ref", tmp, 2, steps, 2)
+    assert rcs_ref == [0, 0]
+    rcs, outs, events = _launch_elastic("chaos", tmp, 2, steps, 2,
+                                        chaos_rank=1, kill_every=5)
+    assert rcs == [0, 0], "elastic launcher did not recover the worker"
+    # at least one SIGKILL actually landed and reformed the membership
+    assert any(k == "leave" for k, _, _ in events)
+    joins = [u for k, u, _ in events if k == "join"]
+    assert len(joins) >= 3, "killed worker never rejoined"
+    for o in outs:
+        assert np.array_equal(o["w"], outs_ref[0]["w"])
+        assert np.array_equal(o["b"], outs_ref[0]["b"])
+        assert int(o["opt_t"]) == steps
+        trans = json.loads(str(o["transitions"]))
+        assert trans[0]["world"] in (1, 2)
+    # the faulted run actually went through a reduced-world generation
+    all_trans = [t for o in outs
+                 for t in json.loads(str(o["transitions"]))]
+    assert any(t["world"] == 1 for t in all_trans), \
+        "no worker ever trained in a shrunken world"

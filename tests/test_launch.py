@@ -1,0 +1,123 @@
+"""Launcher watchdog regressions (ISSUE 9 satellite 2) + elastic mode.
+
+Pinned behaviours: a worker killed by signal exits the launcher with
+``128 + signum`` (not a raw negative code), the per-worker log handle
+closes even when ``proc.wait()`` raises, and ``--elastic`` restarts a
+failed worker within the budget.
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(args, script_body, tmp_path, env_extra=None, name="w.py"):
+    script = tmp_path / name
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         *args, str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=60)
+    return proc
+
+
+@pytest.mark.parametrize("sig,code", [(signal.SIGKILL, 137),
+                                      (signal.SIGTERM, 143)])
+def test_signal_death_normalizes_to_128_plus_signum(tmp_path, sig, code):
+    proc = _launch([], f"""
+        import os, signal
+        os.kill(os.getpid(), {int(sig)})
+    """, tmp_path)
+    assert proc.returncode == code, proc.stderr
+
+
+def test_plain_exit_code_passes_through(tmp_path):
+    proc = _launch([], "raise SystemExit(7)", tmp_path)
+    assert proc.returncode == 7
+
+
+def test_log_handle_closed_when_wait_raises(tmp_path, monkeypatch):
+    """The watchdog used to leak the worker log descriptor when
+    ``proc.wait()`` raised; it must close in ``finally``."""
+    from paddle_tpu.distributed import launch as launch_mod
+
+    opened = []
+    real_open = launch_mod._open_log
+    monkeypatch.setattr(launch_mod, "_open_log",
+                        lambda p: opened.append(real_open(p)) or opened[-1])
+
+    class _Boom:
+        returncode = None
+
+        def __init__(self, *a, **kw):
+            pass
+
+        def wait(self):
+            raise KeyboardInterrupt
+
+        def send_signal(self, sig):
+            pass
+
+    monkeypatch.setattr(launch_mod.subprocess, "Popen", _Boom)
+    with pytest.raises(KeyboardInterrupt):
+        launch_mod._run_worker(
+            [sys.executable, "-c", "pass"], dict(os.environ),
+            str(tmp_path / "worker.log"), forward_signals=False)
+    assert len(opened) == 1 and opened[0].closed
+
+
+def test_elastic_restarts_failed_worker_until_success(tmp_path):
+    """--elastic: a worker that dies (once) is restarted with
+    PADDLE_ELASTIC_RESTART bumped and the launcher exits 0 when the
+    retry succeeds; the restart appends to the same log."""
+    proc = _launch(
+        ["--elastic", "--max_restarts", "2", "--restart_backoff", "0.05",
+         "--log_dir", str(tmp_path / "logs")], """
+        import os, signal
+        n = int(os.environ["PADDLE_ELASTIC_RESTART"])
+        assert os.environ.get("PADDLE_ELASTIC") == "1"
+        print(f"incarnation {n}", flush=True)
+        if n == 0:
+            os.kill(os.getpid(), signal.SIGKILL)
+        print("recovered", flush=True)
+    """, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "elastic restart 1/2" in proc.stderr
+    log = (tmp_path / "logs" / "worker.0.log").read_text()
+    assert "incarnation 0" in log and "incarnation 1" in log
+    assert "recovered" in log
+
+
+def test_elastic_budget_exhaustion_surfaces_failure_code(tmp_path):
+    proc = _launch(
+        ["--elastic", "--max_restarts", "1",
+         "--restart_backoff", "0.05"], """
+        import os, signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    """, tmp_path)
+    assert proc.returncode == 137
+    assert "restart budget" in proc.stderr
+
+
+def test_elastic_rank0_hosts_coordinator_when_env_unset(tmp_path):
+    """--elastic with no PADDLE_COORDINATOR: the rank-0 launcher starts
+    an in-process coordinator and exports its address to the worker."""
+    env = dict(os.environ)
+    env.pop("PADDLE_COORDINATOR", None)
+    proc = _launch(["--elastic", "--max_restarts", "0"], """
+        import os, socket
+        ep = os.environ["PADDLE_COORDINATOR"]
+        host, port = ep.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.close()
+    """, tmp_path, env_extra={"PADDLE_COORDINATOR": ""})
+    assert proc.returncode == 0, proc.stderr

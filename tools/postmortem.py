@@ -48,8 +48,12 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 
 # ring-event kinds that mark a process as "diverging" for the report
 # order (first divergence first)
+# elastic.leave (ISSUE 9): a worker leaving the membership — crash or
+# graceful — is the first event of every elastic incident, so a bundle
+# containing one sorts to the front of the report
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
-              "ps.replica_error", "serve.shed", "serve.evict"}
+              "ps.replica_error", "serve.shed", "serve.evict",
+              "elastic.leave"}
 
 
 def _is_bad(ev: dict) -> bool:

@@ -69,10 +69,15 @@ if [ "$CHAOS" -eq 1 ]; then
     # probabilistic sleeps) — a red run here reproduces as-is.
     # test_train_guard.py is the NUMERIC chaos suite (PR 4): NaN/Inf
     # injection into grads/batches/activations, skip/rewind/blame.
+    # test_elastic.py is the MEMBERSHIP chaos suite (ISSUE 9):
+    # SIGKILL-every-K workers under the elastic launcher, lease
+    # eviction, join/leave reforms — all proven bit-equal to the
+    # fault-free run.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
         tests/test_crash_mid_save.py tests/test_train_guard.py \
+        tests/test_elastic.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
