@@ -920,6 +920,156 @@ def _bench_ps_scaling(smoke, peak_tflops):
     }
 
 
+def _ps_read_worker(cfg_json, worker_id):
+    """Subprocess body for _bench_ps_read: bounded-staleness pulls
+    through the consistent-hash read fan-out (numpy only, no device)."""
+    import json as _json
+    import time as _time
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet.ps_service import PSClient
+
+    cfg = _json.loads(cfg_json)
+    c = PSClient([cfg["primary"]], mode="read",
+                 max_lag=cfg["max_lag"],
+                 read_replicas=[cfg["replicas"]],
+                 worker_id=worker_id)
+    rng = np.random.RandomState(zlib.crc32(worker_id.encode()))
+    c.pull("emb", np.arange(64, dtype=np.int64))    # warmup/connect
+    c.worker_barrier(timeout=60.0)                  # simultaneous start
+    t0 = _time.time()
+    for _ in range(cfg["steps"]):
+        ids = (np.clip(rng.zipf(1.3, cfg["batch"]), 1, cfg["vocab"])
+               - 1).astype(np.int64)
+        c.pull("emb", ids)
+    t1 = _time.time()
+    stale = c.stale_retries
+    c.worker_barrier(timeout=600.0)                 # simultaneous finish
+    c.close()
+    print(f"PSR {t0:.6f} {t1:.6f} {stale}", flush=True)
+
+
+_PS_READ_REPLICA_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+srv = PSServer({"emb": SparseTable(**cfg["table"])}, host="127.0.0.1",
+               replica_of=cfg["replica_of"], replica_mode="read")
+srv.start()
+srv.replica_ready.wait(60.0)
+print(json.dumps({"port": srv.port}), flush=True)
+srv._stop.wait()
+"""
+
+
+def _bench_ps_read(smoke, peak_tflops):
+    """Online serving tier read QPS vs read-replica count (ISSUE 10):
+    one primary holds a seeded embedding table; N read replicas catch
+    up over the async mutation stream; 2 reader PROCESSES fan
+    bounded-staleness pulls (max_lag) across the replicas by consistent
+    hash.  Reported: combined pulls/sec for 1 and 2 replicas.
+
+    CPU-only by design (it measures the serving tier, not the chip).
+    Honesty note: the bench host has ONE core — primary + replicas +
+    readers timeshare it, so the 2-replica ratio records protocol/IO
+    overlap, NOT the ~linear core-level scaling the fan-out gives a
+    real fleet (each replica is its own process doing an independent C
+    gather; on separate hosts the aggregate scales with replica
+    count)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet.ps import SparseTable
+    from paddle_tpu.distributed.fleet.ps_service import PSServer
+
+    steps = 20 if smoke else 100
+    batch = 512 if smoke else 2048
+    dim = 8 if smoke else 16
+    vocab = 50_000
+    n_readers = 2
+    spec = dict(dim=dim, optimizer="sgd", lr=0.05, seed=0)
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def run(n_replicas):
+        table = SparseTable(**spec)
+        # seed every row the zipf draw can touch BEFORE replicas attach
+        all_ids = np.arange(vocab, dtype=np.int64)
+        table.push(all_ids, np.full((vocab, dim), 0.01, np.float32))
+        prim = PSServer({"emb": table}, expected_workers=n_readers)
+        prim.start()
+        pep = f"127.0.0.1:{prim.port}"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_CHAOS", None)
+        rep_procs, rep_eps = [], []
+        reader_procs = []
+        try:
+            for _ in range(n_replicas):
+                cfg = {"table": spec, "replica_of": pep}
+                p = subprocess.Popen(
+                    [sys.executable, "-c", _PS_READ_REPLICA_SRC, here,
+                     json.dumps(cfg)], stdout=subprocess.PIPE,
+                    text=True, env=env)
+                rep_procs.append(p)
+                rep_eps.append(
+                    f"127.0.0.1:{json.loads(p.stdout.readline())['port']}")
+            rcfg = json.dumps({"primary": pep,
+                               "replicas": "|".join(rep_eps),
+                               "max_lag": 64, "steps": steps,
+                               "batch": batch, "vocab": vocab})
+            reader_procs = [subprocess.Popen(
+                [sys.executable, "-c",
+                 f"import bench; bench._ps_read_worker({rcfg!r}, "
+                 f"{f'r{i}'!r})"],
+                env=env, cwd=here, stdout=subprocess.PIPE, text=True)
+                for i in range(n_readers)]
+            outs = [p.communicate(timeout=900)[0] for p in reader_procs]
+            rcs = [p.returncode for p in reader_procs]
+        finally:
+            for p in reader_procs + rep_procs:
+                if p.poll() is None:
+                    p.kill()
+            prim.stop()
+        if any(rcs):
+            raise RuntimeError(f"ps read worker failed: {rcs} {outs}")
+        spans, stale = [], 0
+        for o in outs:
+            for line in o.splitlines():
+                if line.startswith("PSR "):
+                    _, a, b, s = line.split()
+                    spans.append((float(a), float(b)))
+                    stale += int(s)
+        if len(spans) != n_readers:
+            raise RuntimeError(
+                f"ps read: {len(spans)}/{n_readers} workers reported; "
+                f"outputs: {outs!r}")
+        dt = max(b for _, b in spans) - min(a for a, _ in spans)
+        return n_readers * steps * batch / dt, stale
+
+    one, stale1 = run(1)
+    two, stale2 = run(2)
+    return {
+        "metric": "ps_read_replica_throughput",
+        "value": round(two, 2),
+        "unit": "pulls/sec_2replicas",
+        "vs_baseline": None,
+        "one_replica_pulls_s": round(one, 2),
+        "scaling_2r_over_1r": round(two / one, 3) if one else None,
+        "stale_retries": [stale1, stale2],
+        "steps_per_reader": steps, "batch": batch, "emb_dim": dim,
+        "note": ("single-core host: primary+replicas+readers timeshare "
+                 "one CPU; ratio reflects IO overlap, not the per-host "
+                 "linear scaling of a real replica fleet"),
+    }
+
+
 def _bench_inference(smoke, peak_tflops):
     """Inference latency (reference analog: the analyzer_*_tester.cc
     latency gates + mkldnn int8 deploy): ResNet-50 and BERT-base
@@ -1429,7 +1579,7 @@ def _bench_llama_serve(smoke, peak_tflops):
 # of identical code); the reported object is the median-by-value trial,
 # annotated with every trial's value and the spread.
 _TUNNEL_TRIALS = {"wide_deep": 3, "infer": 3, "serve": 3,
-                  "llama_serve": 3}
+                  "llama_serve": 3, "ps_read": 3}
 
 
 def _flatten(out):
@@ -1516,7 +1666,7 @@ def main():
         return
     default = ("resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,"
                "serve,llama_serve")
-    known = set(default.split(",")) | {"ps_scaling"}
+    known = set(default.split(",")) | {"ps_scaling", "ps_read"}
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")
              if w.strip()] or default.split(",")
@@ -1667,6 +1817,8 @@ def _main():
         results.append(_bench_llama_serve(smoke, peak))
     if "ps_scaling" in which:
         results.append(_bench_ps_scaling(smoke, peak))
+    if "ps_read" in which:
+        results.append(_bench_ps_read(smoke, peak))
     if not results:  # unknown names: still honor the one-JSON-line contract
         results.append(_bench_resnet(smoke, peak))
 

@@ -70,6 +70,7 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "LintConfig",
 DEFAULT_LINT_PATHS = (
     "paddle_tpu/distributed/fleet/ps_service.py",
     "paddle_tpu/distributed/fleet/elastic.py",
+    "paddle_tpu/distributed/fleet/geo.py",
     "paddle_tpu/distributed/fleet/heter.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/inference/generation_server.py",

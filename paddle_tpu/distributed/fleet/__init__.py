@@ -51,3 +51,6 @@ from .elastic import (  # noqa: F401,E402
     ElasticClient, ElasticCoordinator, ElasticTrainer)
 __all__ += ["elastic", "ElasticCoordinator", "ElasticClient",
             "ElasticTrainer"]
+from . import geo  # noqa: F401,E402
+from .geo import GeoPusher  # noqa: F401,E402
+__all__ += ["geo", "GeoPusher"]

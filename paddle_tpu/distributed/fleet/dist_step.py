@@ -23,6 +23,7 @@ an in-place executor.
 """
 from __future__ import annotations
 
+import math
 import time as _time
 from typing import Any, Dict, Optional
 
@@ -41,7 +42,7 @@ from .. import mesh as mesh_mod
 __all__ = ["DistributedTrainStep", "param_partition_spec",
            "zero_shard_ranges", "flatten_zero_state",
            "unflatten_zero_state", "zero_shard", "zero_unshard",
-           "zero_reshard"]
+           "zero_reshard", "LRSchedule", "make_lr_schedule"]
 
 # storage suffix for 8-bit optimizer-state scales ("m" -> "m@scale");
 # "@" cannot collide with real slot names
@@ -161,6 +162,89 @@ def _transform_slots(st, pshape, mdt, direction):
 # the shards a fresh M-worker run would load from the same checkpoint.
 # The partition rule (contiguous ranges, remainder spread over the
 # leading ranks) deliberately matches UtilBase.get_file_shard.
+
+class LRSchedule:
+    """t-indexed learning-rate schedule for the flat elastic
+    optimizers (ISSUE 10 satellite; PR 9 follow-up (b)).
+
+    The value is a PURE function of the 1-based global step count
+    ``t`` and the construction config — no internal state, nothing to
+    checkpoint beyond ``t`` itself (which the elastic checkpoints
+    already carry as ``opt_t``).  That makes the schedule
+    world-invariant BY CONSTRUCTION: every worker of every generation
+    evaluates the identical f32 lr for step t, so an N->M reshard
+    mid-schedule stays bit-exact with the fault-free run.
+
+    Kinds (``warmup_steps`` prepends a linear ramp to all of them):
+
+    ``constant``  ``base_lr``
+    ``step``      ``base_lr * gamma ** ((t - warmup) // step_size)``
+    ``cosine``    ``min_lr + (base_lr - min_lr) * (1 + cos(pi*p)) / 2``
+                  with progress ``p = (t - warmup) / (total - warmup)``
+                  clipped to [0, 1] (requires ``total_steps``)
+    ``linear``    ``base_lr + (min_lr - base_lr) * p`` (same ``p``)
+
+    Math runs in float64 and rounds ONCE to f32 at the end — the same
+    value on every host, every world size.
+    """
+
+    KINDS = ("constant", "step", "cosine", "linear")
+
+    def __init__(self, kind: str, base_lr: float,
+                 warmup_steps: int = 0,
+                 total_steps: Optional[int] = None,
+                 min_lr: float = 0.0, step_size: int = 1000,
+                 gamma: float = 0.5):
+        if kind not in self.KINDS:
+            raise ValueError(f"lr schedule kind must be one of "
+                             f"{self.KINDS}, got {kind!r}")
+        if kind in ("cosine", "linear") and not total_steps:
+            raise ValueError(f"{kind!r} schedule needs total_steps")
+        if step_size < 1:
+            raise ValueError("step_size must be >= 1")
+        self.kind = kind
+        self.base_lr = float(base_lr)
+        self.warmup_steps = int(warmup_steps)
+        self.total_steps = None if total_steps is None else \
+            int(total_steps)
+        self.min_lr = float(min_lr)
+        self.step_size = int(step_size)
+        self.gamma = float(gamma)
+
+    def __call__(self, t: int) -> np.float32:
+        t = int(t)
+        w = self.warmup_steps
+        if w > 0 and t <= w:
+            return np.float32(self.base_lr * t / w)
+        if self.kind == "constant":
+            return np.float32(self.base_lr)
+        if self.kind == "step":
+            return np.float32(
+                self.base_lr * self.gamma ** ((t - w - 1)
+                                              // self.step_size))
+        span = max(1, self.total_steps - w)
+        p = min(1.0, max(0.0, (t - w) / span))
+        if self.kind == "cosine":
+            return np.float32(
+                self.min_lr + (self.base_lr - self.min_lr)
+                * 0.5 * (1.0 + math.cos(math.pi * p)))
+        # linear
+        return np.float32(
+            self.base_lr + (self.min_lr - self.base_lr) * p)
+
+    def __repr__(self):
+        return (f"LRSchedule({self.kind!r}, base_lr={self.base_lr}, "
+                f"warmup_steps={self.warmup_steps}, "
+                f"total_steps={self.total_steps}, "
+                f"min_lr={self.min_lr}, step_size={self.step_size}, "
+                f"gamma={self.gamma})")
+
+
+def make_lr_schedule(kind: str, base_lr: float, **kw) -> LRSchedule:
+    """Build an :class:`LRSchedule`; accepts a plain config dict via
+    ``make_lr_schedule(**cfg)`` (the launcher/worker-config spelling)."""
+    return LRSchedule(kind, base_lr, **kw)
+
 
 def zero_shard_ranges(total: int, world: int):
     """Contiguous ``[start, stop)`` ranges partitioning a flat

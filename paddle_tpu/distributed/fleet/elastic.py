@@ -81,10 +81,34 @@ the worker at every K-th executed step
 launcher's ``--elastic`` mode restarts it and it rejoins.  Progress
 under sustained kills needs ``ckpt_every`` < K.
 
-Env knobs: ``PADDLE_COORDINATOR`` (host:port rendezvous address, set
-by the launcher), ``PADDLE_TRAINERS_NUM`` (expected initial world),
-``PADDLE_ELASTIC`` / ``PADDLE_ELASTIC_RESTART`` (exported by the
-launcher's elastic watchdog).
+Coordinator HA (ISSUE 10 — the PR 9 rendezvous SPOF, closed with the
+PR 3 hot-standby pattern): ``ElasticCoordinator(standby_of="h:p")``
+starts a STANDBY that subscribes to the primary's replicated
+membership log (``op=co_replicate``: a snapshot of the tiny durable
+state — generation, uid counter, pinned checkpoint step — then every
+change as it commits).  An un-promoted standby answers every worker op
+with ``{"status": "standby"}``; on primary EOF it promotes: bumps the
+generation past everything the primary ever fenced (a zombie primary's
+rounds can never match) and starts serving.  Workers hold the
+coordinator endpoint LIST (``"h:p1|h:p2"`` in ``PADDLE_COORDINATOR``);
+a dead or standby coordinator makes the client rotate, re-register and
+raise :class:`Reform`, so the trainer reshards from the replicated
+pinned step exactly as it does for a worker loss — the run's final
+weights stay bit-equal to the fault-free run because everything since
+that step replays deterministically.
+
+``ElasticCoordinator(ckpt_dir=...)`` (ISSUE 10 satellite): a
+coordinator (re)started over a populated checkpoint directory scans it
+via :meth:`CheckpointManager.all_steps`/``pinned_steps`` and resumes
+from the latest pinned step automatically — no manual ``ckpt_step=``;
+a promoting standby does the same scan and takes the max of scan and
+replicated log.
+
+Env knobs: ``PADDLE_COORDINATOR`` (host:port rendezvous address — may
+be a ``|``-separated failover list, set by the launcher),
+``PADDLE_TRAINERS_NUM`` (expected initial world), ``PADDLE_ELASTIC`` /
+``PADDLE_ELASTIC_RESTART`` (exported by the launcher's elastic
+watchdog).
 
 Observability: flight-recorder events ``elastic.join`` /
 ``elastic.leave`` / ``elastic.reshard`` / ``elastic.resume`` (join/
@@ -95,6 +119,7 @@ postmortem bad kind), the ``elastic_transitions`` counter and the
 from __future__ import annotations
 
 import os
+import queue
 import re
 import socket
 import threading
@@ -114,7 +139,7 @@ from .ps_service import _parse_ep, _recv_msg, _send_msg_raw
 from .role_maker import ElasticRoleMaker
 
 __all__ = ["ElasticCoordinator", "ElasticClient", "ElasticTrainer",
-           "Reform"]
+           "Reform", "CoordinatorLost"]
 
 # elastic locks are LEAVES of the process-wide lock order: nothing may
 # call into the PS / serving layers while holding them (the coordinator
@@ -132,6 +157,22 @@ class Reform(Exception):
     def __init__(self, info: dict):
         super().__init__(f"membership reform -> {info}")
         self.info = dict(info)
+
+
+class CoordinatorLost(ConnectionError):
+    """The coordinator connection died or the endpoint answered as an
+    un-promoted standby — the caller must :meth:`ElasticClient.rejoin`
+    (rotate + re-register) and reform."""
+
+
+def _scan_ckpt_dir(ckpt_dir: str) -> Optional[int]:
+    """Latest restorable step in a checkpoint directory: the newest
+    PINNED step (the elastic trainer pins every global checkpoint and
+    unpins old ones), falling back to :meth:`CheckpointManager.
+    all_steps` for directories without pin records."""
+    mgr = CheckpointManager(ckpt_dir)
+    steps = mgr.pinned_steps() or mgr.all_steps()
+    return max(steps) if steps else None
 
 
 class _Member:
@@ -168,7 +209,9 @@ class ElasticCoordinator:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  expected_world: Optional[int] = None,
                  lease_s: float = 0.0,
-                 ckpt_step: Optional[int] = None):
+                 ckpt_step: Optional[int] = None,
+                 ckpt_dir: Optional[str] = None,
+                 standby_of: Optional[str] = None):
         self._host = host
         self._cond = threading.Condition()
         self._gen = 0
@@ -178,8 +221,10 @@ class ElasticCoordinator:
         # ``ckpt_step``: resume an EXISTING run — a coordinator restarted
         # over a populated checkpoint directory names the pinned step the
         # first generation reshards from (None = fresh run, rank 0
-        # bootstraps step 0)
+        # bootstraps step 0).  ``ckpt_dir`` derives it automatically by
+        # scanning the CheckpointManager directory on (re)start.
         self._ckpt_step: Optional[int] = ckpt_step
+        self._ckpt_dir = ckpt_dir
         self._rounds: Dict[Tuple[int, str], _Round] = {}
         self._last_step = -1
         self._expected = expected_world
@@ -190,9 +235,20 @@ class ElasticCoordinator:
         self.port = port
         # membership log for tests/debugging: (kind, uid, gen) tuples
         self.events: List[Tuple[str, int, int]] = []
+        # HA (ISSUE 10): a standby binds + listens but answers every
+        # worker op with {"status": "standby"} until it promotes
+        self.standby_of = standby_of
+        self.promoted = standby_of is None
+        self._co_sinks: List[dict] = []   # replication subscribers
+
+    @property
+    def role(self) -> str:
+        return "primary" if self.promoted else "standby"
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
+        if self._ckpt_step is None and self._ckpt_dir and self.promoted:
+            self._ckpt_step = _scan_ckpt_dir(self._ckpt_dir)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((self._host, self.port))
@@ -208,6 +264,11 @@ class ElasticCoordinator:
                                   name="elastic-coord-lease")
             lt.start()
             self._threads.append(lt)
+        if self.standby_of is not None:
+            st = threading.Thread(target=self._standby_loop, daemon=True,
+                                  name="elastic-coord-standby")
+            st.start()
+            self._threads.append(st)
         return self
 
     def stop(self):
@@ -215,6 +276,7 @@ class ElasticCoordinator:
         with self._cond:
             conns = [m.conn for m in list(self._members.values())
                      + list(self._pending.values())]
+            conns += [s["conn"] for s in self._co_sinks]
             self._cond.notify_all()
         for c in conns:
             try:
@@ -232,7 +294,8 @@ class ElasticCoordinator:
             return {"gen": self._gen, "world": len(self._members),
                     "pending": len(self._pending),
                     "ckpt_step": self._ckpt_step,
-                    "last_step": self._last_step}
+                    "last_step": self._last_step,
+                    "role": self.role}
 
     # -- accept / serve -------------------------------------------------
     def _accept_loop(self):
@@ -250,12 +313,27 @@ class ElasticCoordinator:
     def _serve_conn(self, conn):
         uid = None
         left = False
+        handed_off = False
         try:
             while not self._stop_evt.is_set():
                 msg = _recv_msg(conn)
                 if msg is None:
                     break
                 op = msg.get("op")
+                if op == "co_replicate":
+                    handed_off = self._attach_co_sink(conn)
+                    if handed_off:
+                        return
+                    continue
+                if not self.promoted and op != "status":
+                    # un-promoted standby: workers must keep rotating
+                    # until they reach the promoted coordinator — a
+                    # standby that admitted members would split the
+                    # rendezvous brain exactly like a PS standby
+                    # serving writes
+                    _send_msg_raw(conn, {"status": "standby",
+                                         "standby_of": self.standby_of})
+                    continue
                 if op == "register":
                     uid = self._handle_register(conn, msg)
                 elif op == "exchange":
@@ -274,10 +352,11 @@ class ElasticCoordinator:
         except (OSError, ConnectionError, EOFError):
             pass
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            if not handed_off:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             if uid is not None:
                 self._on_disconnect(uid, "leave" if left else "fail")
 
@@ -292,6 +371,7 @@ class ElasticCoordinator:
         for r, uid in enumerate(sorted(self._members)):
             self._members[uid].rank = r
         self._rounds.clear()
+        self._co_publish_locked()
         self._cond.notify_all()
 
     def _maybe_admit_locked(self):
@@ -327,6 +407,7 @@ class ElasticCoordinator:
                     # zombie request can never match a dead generation
                     self._gen += 1
                     self._rounds.clear()
+                    self._co_publish_locked()
                     self._cond.notify_all()
         if m is not None:
             # telemetry strictly OUTSIDE the condition (lock-order leaf)
@@ -337,6 +418,7 @@ class ElasticCoordinator:
         with self._cond:
             uid = self._uid_next
             self._uid_next += 1
+            self._co_publish_locked()
             self._pending[uid] = _Member(uid, conn)
             if self._expected is None:
                 self._expected = max(1, int(msg.get("world", 1)))
@@ -416,7 +498,143 @@ class ElasticCoordinator:
         with self._cond:
             if self._ckpt_step is None or step > self._ckpt_step:
                 self._ckpt_step = step
+                self._co_publish_locked()
         _send_msg_raw(conn, {"status": "ok"})
+
+    # -- HA: replicated membership log (ISSUE 10) -----------------------
+    def _co_state_locked(self) -> dict:
+        return {"gen": self._gen, "uid_next": self._uid_next,
+                "ckpt_step": self._ckpt_step}
+
+    def _co_publish_locked(self):
+        """Queue the durable-state snapshot to every standby sink
+        (called under ``self._cond``).  Tiny and idempotent — the
+        standby only needs the LATEST values, so a full snapshot per
+        change beats a fragile event log.  A sink whose queue is full
+        is dead or wedged: drop it (the standby reconnects)."""
+        if not self._co_sinks:
+            return
+        snap = self._co_state_locked()
+        for sink in list(self._co_sinks):
+            try:
+                sink["q"].put_nowait(snap)
+            except queue.Full:
+                self._co_sinks.remove(sink)
+                try:
+                    sink["conn"].close()
+                except OSError:
+                    pass
+
+    def _attach_co_sink(self, conn) -> bool:
+        """Register a standby subscriber: snapshot + update stream.
+        Returns True when the connection was handed to a sender
+        thread."""
+        sink = {"conn": conn, "q": queue.Queue(maxsize=64)}
+        with self._cond:
+            if not self.promoted:
+                snap = None     # a standby cannot seed another standby
+            else:
+                snap = self._co_state_locked()
+                self._co_sinks.append(sink)
+        if snap is None:
+            _send_msg_raw(conn, {"status": "standby"})
+            return False
+        _send_msg_raw(conn, {"status": "ok", **snap})
+        t = threading.Thread(target=self._co_sender, args=(sink,),
+                             daemon=True, name="elastic-coord-co-sender")
+        t.start()
+        self._threads.append(t)
+        return True
+
+    def _co_sender(self, sink):
+        conn, q = sink["conn"], sink["q"]
+        try:
+            while not self._stop_evt.is_set():
+                try:
+                    snap = q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                _send_msg_raw(conn, snap)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._cond:
+                if sink in self._co_sinks:
+                    self._co_sinks.remove(sink)
+
+    def _standby_loop(self):
+        """Standby side: subscribe to the primary's replicated log;
+        promote on EOF."""
+        host, port = _parse_ep(self.standby_of)
+        last: dict = {}
+        while not self._stop_evt.is_set():
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=5.0)
+            except OSError:
+                time.sleep(0.2)
+                continue
+            try:
+                sock.settimeout(10.0)
+                _send_msg_raw(sock, {"op": "co_replicate"})
+                head = _recv_msg(sock)
+                if head is None or head.get("status") != "ok":
+                    time.sleep(0.2)
+                    continue
+                self._apply_co_state(head)
+                last = head
+                sock.settimeout(None)
+                while not self._stop_evt.is_set():
+                    upd = _recv_msg(sock)
+                    if upd is None:
+                        break       # primary is gone
+                    self._apply_co_state(upd)
+                    last = upd
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if not self._stop_evt.is_set() and last:
+                # the primary died AFTER we were caught up: take over
+                self._promote()
+                return
+            time.sleep(0.2)
+
+    def _apply_co_state(self, st: dict):
+        with self._cond:
+            self._gen = max(self._gen, int(st.get("gen", 0)))
+            self._uid_next = max(self._uid_next,
+                                 int(st.get("uid_next", 0)))
+            cs = st.get("ckpt_step")
+            if cs is not None and (self._ckpt_step is None
+                                   or int(cs) > self._ckpt_step):
+                self._ckpt_step = int(cs)
+
+    def _promote(self):
+        with self._cond:
+            # fence PAST everything the dead primary ever handed out: a
+            # zombie worker's stale (gen, round) can never match here
+            self._gen += 1
+            if self._ckpt_dir:
+                scanned = _scan_ckpt_dir(self._ckpt_dir)
+                if scanned is not None and (
+                        self._ckpt_step is None
+                        or scanned > self._ckpt_step):
+                    self._ckpt_step = scanned
+            self.promoted = True
+            gen, step = self._gen, self._ckpt_step
+            self._cond.notify_all()
+        _flight.record("elastic.promote", was_standby_of=self.standby_of,
+                       gen=int(gen),
+                       ckpt_step=(None if step is None else int(step)))
+        _monitor.stat_add("elastic_coord_promotions")
 
     def _lease_loop(self):
         """Lease-based liveness for wedged-but-connected workers: a
@@ -444,6 +662,7 @@ class ElasticCoordinator:
                 elif evicted:
                     self._gen += 1
                     self._rounds.clear()
+                    self._co_publish_locked()
                     self._cond.notify_all()
             for m in evicted:
                 _flight.record("elastic.leave", uid=int(m.uid),
@@ -455,45 +674,115 @@ class ElasticCoordinator:
 
 
 class ElasticClient:
-    """Worker-side connection to the :class:`ElasticCoordinator`."""
+    """Worker-side connection to the :class:`ElasticCoordinator`.
+
+    ``endpoint`` may be a failover LIST (``"h:p1|h:p2"``, ISSUE 10):
+    the client connects to the first endpoint that answers as a
+    PROMOTED coordinator.  Any transport death — or a ``standby``
+    answer after a failover — surfaces as :class:`CoordinatorLost`;
+    :meth:`rejoin` then rotates through the list, re-registers (the
+    promoted standby assigns a fresh uid under a fenced generation) and
+    returns the new membership info for the trainer to reform under.
+    """
 
     def __init__(self, endpoint: str, timeout: float = 120.0,
                  connect_retries: int = 40, retry_delay: float = 0.25):
-        host, port = _parse_ep(endpoint)
-        last: Optional[BaseException] = None
-        sock = None
-        for _ in range(max(1, connect_retries)):
-            try:
-                sock = socket.create_connection((host, port), timeout=5.0)
-                break
-            except OSError as e:
-                last = e
-                time.sleep(retry_delay)
-        if sock is None:
-            raise ConnectionError(
-                f"elastic coordinator unreachable at {endpoint}: {last}")
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.settimeout(timeout)
-        self._sock = sock
+        self._eps = [e for e in str(endpoint).split("|") if e]
+        if not self._eps:
+            raise ValueError(f"empty coordinator endpoint {endpoint!r}")
+        self._active = 0
+        self._timeout = float(timeout)
+        self._retries = max(1, int(connect_retries))
+        self._retry_delay = float(retry_delay)
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
         self.uid: Optional[int] = None
+        self._connect_any()
+
+    def _connect_any(self):
+        """(Re)connect to the first reachable endpoint, rotating
+        through the list.  Caller must not hold ``self._lock``."""
+        last: Optional[BaseException] = None
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            for attempt in range(self._retries * len(self._eps)):
+                ep = self._eps[self._active]
+                try:
+                    sock = socket.create_connection(_parse_ep(ep),
+                                                    timeout=5.0)
+                except OSError as e:
+                    last = e
+                    self._active = (self._active + 1) % len(self._eps)
+                    time.sleep(self._retry_delay)
+                    continue
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                1)
+                sock.settimeout(self._timeout)
+                self._sock = sock
+                return
+        raise ConnectionError(
+            f"elastic coordinator unreachable at "
+            f"{'|'.join(self._eps)}: {last}")
 
     def _rpc(self, msg) -> dict:
         with self._lock:
-            _send_msg_raw(self._sock, msg)
-            rep = _recv_msg(self._sock)
+            if self._sock is None:
+                raise CoordinatorLost("not connected")
+            try:
+                _send_msg_raw(self._sock, msg)
+                rep = _recv_msg(self._sock)
+            except (OSError, ConnectionError) as e:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise CoordinatorLost(
+                    f"elastic coordinator connection died: {e}") from e
         if rep is None:
-            raise ConnectionError(
+            with self._lock:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+            raise CoordinatorLost(
                 "elastic coordinator closed the connection")
         return rep
 
     def register(self, expected_world: int = 1) -> dict:
-        rep = self._rpc({"op": "register",
-                         "world": int(expected_world)})
-        if rep.get("status") != "ok":
-            raise ConnectionError(f"elastic register rejected: {rep}")
-        self.uid = rep["uid"]
-        return rep
+        deadline = time.monotonic() + self._timeout
+        while True:
+            rep = self._rpc({"op": "register",
+                             "world": int(expected_world)})
+            if rep.get("status") == "standby":
+                # rotated onto an un-promoted standby (failover in
+                # flight): try the next endpoint until one has promoted
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"no promoted coordinator in "
+                        f"{'|'.join(self._eps)}")
+                self._active = (self._active + 1) % len(self._eps)
+                time.sleep(self._retry_delay)
+                self._connect_any()
+                continue
+            if rep.get("status") != "ok":
+                raise ConnectionError(f"elastic register rejected: {rep}")
+            self.uid = rep["uid"]
+            return rep
+
+    def rejoin(self, expected_world: int = 1) -> dict:
+        """After :class:`CoordinatorLost`: rotate to the promoted
+        coordinator and register as a fresh member."""
+        self._active = (self._active + 1) % len(self._eps)
+        self._connect_any()
+        return self.register(expected_world)
 
     def exchange(self, gen: int, step: int, tag: str,
                  arrays: Optional[Dict[str, np.ndarray]] = None):
@@ -548,9 +837,19 @@ class ElasticClient:
 class _FlatSGD:
     SLOTS: Tuple[str, ...] = ()
 
-    def __init__(self, lr, **_):
+    def __init__(self, lr, lr_schedule=None, **_):
         self.lr = np.float32(lr)
+        # t-indexed schedule (ISSUE 10 satellite): a pure function of
+        # the 1-based global step — see dist_step.LRSchedule.  Because
+        # ``t`` is world-size invariant (checkpointed as opt_t) and the
+        # schedule is stateless config, lr(t) is bit-identical across
+        # any N->M reshard mid-schedule.
+        self.sched = lr_schedule
         self.t = 0
+
+    def lr_at(self, t: int) -> np.float32:
+        return self.lr if self.sched is None else np.float32(
+            self.sched(t))
 
     def load(self, slots: Dict[str, np.ndarray], t: int):
         if set(slots) != set(self.SLOTS):
@@ -567,28 +866,28 @@ class _FlatSGD:
 
     def update(self, p: np.ndarray, g: np.ndarray) -> np.ndarray:
         self.t += 1
-        return (p - self.lr * g).astype(np.float32)
+        return (p - self.lr_at(self.t) * g).astype(np.float32)
 
 
 class _FlatMomentum(_FlatSGD):
     SLOTS = ("u",)
 
-    def __init__(self, lr, momentum=0.9, **_):
-        super().__init__(lr)
+    def __init__(self, lr, momentum=0.9, **kw):
+        super().__init__(lr, **kw)
         self.mu = np.float32(momentum)
         self.u = None
 
     def update(self, p, g):
         self.t += 1
         self.u = (self.mu * self.u + g).astype(np.float32)
-        return (p - self.lr * self.u).astype(np.float32)
+        return (p - self.lr_at(self.t) * self.u).astype(np.float32)
 
 
 class _FlatAdam(_FlatSGD):
     SLOTS = ("m", "v")
 
-    def __init__(self, lr, betas=(0.9, 0.999), eps=1e-8, **_):
-        super().__init__(lr)
+    def __init__(self, lr, betas=(0.9, 0.999), eps=1e-8, **kw):
+        super().__init__(lr, **kw)
         self.b1 = float(betas[0])
         self.b2 = float(betas[1])
         self.eps = np.float32(eps)
@@ -607,8 +906,8 @@ class _FlatAdam(_FlatSGD):
         c2 = np.float32(1.0 - self.b2 ** self.t)
         mhat = self.m / c1
         vhat = self.v / c2
-        return (p - self.lr * mhat / (np.sqrt(vhat) + self.eps)) \
-            .astype(np.float32)
+        return (p - self.lr_at(self.t) * mhat
+                / (np.sqrt(vhat) + self.eps)).astype(np.float32)
 
 
 _FLAT_OPTS = {"sgd": _FlatSGD, "momentum": _FlatMomentum,
@@ -633,7 +932,8 @@ class ElasticTrainer:
                  grad_fn: Callable[[Dict[str, np.ndarray], Any],
                                    Dict[str, np.ndarray]],
                  loader, *, ckpt_dir: str, optimizer: str = "adam",
-                 lr: float = 0.01, betas=(0.9, 0.999), eps: float = 1e-8,
+                 lr: float = 0.01, lr_schedule=None,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
                  momentum: float = 0.9, micro_batches: int = 1,
                  ckpt_every: int = 10, max_to_keep: int = 5,
                  coordinator: Optional[str] = None,
@@ -653,8 +953,12 @@ class ElasticTrainer:
         if optimizer not in _FLAT_OPTS:
             raise ValueError(f"optimizer must be one of "
                              f"{sorted(_FLAT_OPTS)}, got {optimizer!r}")
+        if isinstance(lr_schedule, dict):
+            from .dist_step import make_lr_schedule
+            lr_schedule = make_lr_schedule(**lr_schedule)
         self._opt = _FLAT_OPTS[optimizer](lr, betas=betas, eps=eps,
-                                          momentum=momentum)
+                                          momentum=momentum,
+                                          lr_schedule=lr_schedule)
         self._mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep)
         self._ckpt_every = int(ckpt_every)
         self._endpoint = coordinator
@@ -723,7 +1027,7 @@ class ElasticTrainer:
             # re-saves after a reform mid-bootstrap are atomic no-ops)
             if rank == 0:
                 self._save_checkpoint(0, bootstrap=True)
-                self._client.report_ckpt(0)
+                self._report_ckpt(0)
             self._exchange(gen, 0, "bootstrap", {})
             ckpt_step = 0
         start = self._restore(int(ckpt_step), rank, world, gen)
@@ -788,7 +1092,7 @@ class ElasticTrainer:
                                    for r in range(world)])
                 for k in self._opt.SLOTS}
             self._save_checkpoint(done)
-            self._client.report_ckpt(done)
+            self._report_ckpt(done)
 
     def _restore(self, ckpt_step: int, rank: int, world: int, gen: int):
         t0 = time.perf_counter()
@@ -847,7 +1151,14 @@ class ElasticTrainer:
 
     # -- exchange wrapper -----------------------------------------------
     def _exchange(self, gen, step, tag, arrays) -> List[dict]:
-        status, rep = self._client.exchange(gen, step, tag, arrays)
+        try:
+            status, rep = self._client.exchange(gen, step, tag, arrays)
+        except ConnectionError:
+            # the coordinator died (CoordinatorLost) — rotate to its
+            # promoted standby, register fresh under the fenced
+            # generation and reform from the replicated pinned step,
+            # exactly the worker-loss path (ISSUE 10 coordinator HA)
+            raise Reform(self._rejoin())
         if status == "ok":
             return rep
         if status == "reform":
@@ -858,7 +1169,24 @@ class ElasticTrainer:
             # our membership lapsed (lease) — rejoin from scratch
             info = self._client.register(self._expected_world or 1)
             raise Reform(info)
+        if status == "standby":
+            raise Reform(self._rejoin())
         raise RuntimeError(f"elastic exchange failed: {rep}")
+
+    def _rejoin(self) -> dict:
+        info = self._client.rejoin(self._expected_world or 1)
+        _flight.record("elastic.join", uid=int(info.get("uid", -1)),
+                       gen=int(info["gen"]), world=int(info["world"]))
+        return info
+
+    def _report_ckpt(self, done: int):
+        try:
+            self._client.report_ckpt(done)
+        except ConnectionError:
+            # the checkpoint is on disk; membership reforms and the
+            # promoted coordinator's ckpt_dir scan (or a later report)
+            # picks it up — losing the report must not kill the run
+            raise Reform(self._rejoin())
 
 
 # -- numpy batch utilities ----------------------------------------------

@@ -1,0 +1,267 @@
+"""Geo-async replication: cross-cluster delta push for the PS tier.
+
+Reference: the GeoCommunicator (distributed/service/communicator.h:495,
+SURVEY §2.6) — training clusters exchange *step deltas* instead of full
+state, asynchronously, so a WAN link's latency and loss never sit on
+any cluster's commit path.
+
+:class:`GeoPusher` runs next to a cluster's primary
+:class:`~paddle_tpu.distributed.fleet.ps_service.PSServer` and keeps a
+remote (follower) cluster converged:
+
+* a **commit listener** on the primary collects the ids each committed
+  mutation touched (a set-union under the apply lock — O(batch), no
+  values copied, nothing ever blocks on the WAN);
+* a flush thread wakes every ``interval_s``: per table it takes up to
+  ``max_ids_per_flush`` dirty ids (the per-table rate limit), reads
+  their CURRENT rows straight from the primary's table, computes the
+  delta against a local **mirror** of what the remote already holds,
+  and ships one batched ``push_delta`` through a sync-mode
+  :class:`~paddle_tpu.distributed.fleet.ps_service.PSClient` — whose
+  (src, seq)-stamped idempotent retries mean a lossy/delayed geo link
+  can duplicate or re-send frames without EVER double-applying a delta;
+* only after the remote acks does the mirror advance, so an
+  unacknowledged flush is re-computed (same ids re-dirty, delta derived
+  from the unchanged mirror) instead of lost.
+
+The mirror is a :meth:`~paddle_tpu.distributed.fleet.ps.SparseTable.
+clone_config` twin of the primary table: the follower cluster's table
+must be built from the same config, because a row's FIRST delta assumes
+both sides materialise the identical deterministic init for that id.
+The native table core guarantees per-id deterministic init; the pure
+Python fallback only does for ``init_std=0`` (the constructor checks).
+
+Staleness / convergence bound: with a dirty backlog of ``B`` ids and a
+per-table rate of ``R = max_ids_per_flush`` per ``interval_s``, the
+follower trails the primary by at most ``ceil(B / R)`` flush intervals
+once writes quiesce — :meth:`drain` makes that bound a blocking call
+and the geo chaos test asserts it under an injected lossy link.
+
+Observability: ``ps.geo.push`` flight events (a stall-watchdog progress
+kind — a wedged geo link with a growing backlog is exactly the stall a
+bundle should show), ``ps_geo_pushed_ids`` / ``ps_geo_flushes`` /
+``ps_geo_push_failures`` counters and the ``ps_geo_backlog_ids`` gauge.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...framework import monitor as _monitor
+from ...observability import flight_recorder as _flight
+from .ps import SparseTable
+from .ps_service import PSClient, PSError, PSUnavailable
+
+__all__ = ["GeoPusher"]
+
+# INTENDED LOCK ORDER (machine-auditable, tools/graft_lint.py): the
+# commit listener runs under the primary's apply lock and takes only
+# the pusher's dirty-set lock — a leaf.  The flush thread never calls
+# back into the server while holding it.
+# lint: lock-order: PSServer._apply_lock -> GeoPusher._lock
+
+
+class GeoPusher:
+    """Asynchronous cross-cluster delta pusher (see module docstring).
+
+    ``server``: the LOCAL cluster's primary :class:`PSServer` (the
+    pusher reads committed rows straight from its tables).
+    ``endpoints``: the REMOTE cluster's PS endpoints (one entry per
+    shard, ``"h:p1|h:p2"`` failover groups supported) — or pass a
+    ready-made ``client``.
+    ``tables``: restrict replication to these table names (default: all
+    tables the server holds when a mutation touches them).
+    """
+
+    def __init__(self, server, endpoints=None, tables=None,
+                 interval_s: float = 0.05,
+                 max_ids_per_flush: int = 65536,
+                 src: Optional[str] = None,
+                 client: Optional[PSClient] = None,
+                 **client_kw):
+        if client is None and endpoints is None:
+            raise ValueError("GeoPusher needs remote endpoints or a "
+                             "ready client")
+        self._server = server
+        # the client is created LAZILY: a geo link that is down when
+        # the pusher starts must queue a backlog, not kill the ctor
+        self._client = client
+        self._endpoints = endpoints
+        self._src = src or f"geo-{server.port}"
+        self._client_kw = dict(client_kw)
+        self._own_client = client is None
+        self._tables = None if tables is None else set(tables)
+        self._interval = float(interval_s)
+        self._rate = int(max_ids_per_flush)
+        self._lock = threading.Lock()
+        self._dirty: Dict[str, set] = {}
+        self._mirrors: Dict[str, SparseTable] = {}
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._flush_lock = threading.Lock()   # flush() is not reentrant
+        self.pushed_ids = 0
+        self.flushes = 0
+        self.push_failures = 0
+        server.add_commit_listener(self._on_commit)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "GeoPusher":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="geo-pusher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        if drain:
+            try:
+                self.drain(timeout=timeout)
+            except (PSError, PSUnavailable):
+                pass   # remote gone: the backlog stays reported
+        self._stop_evt.set()
+        self._server.remove_commit_listener(self._on_commit)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._own_client and self._client is not None:
+            self._client.close()
+
+    def _ensure_client(self) -> PSClient:
+        if self._client is None:
+            self._client = PSClient(self._endpoints, mode="sync",
+                                    worker_id=self._src,
+                                    **self._client_kw)
+        return self._client
+
+    # -- commit feed (runs under PSServer._apply_lock) ------------------
+    def _on_commit(self, op, table, ids):
+        if self._tables is not None and table not in self._tables:
+            return
+        with self._lock:
+            self._dirty.setdefault(table, set()).update(
+                np.asarray(ids).reshape(-1).tolist())
+
+    def backlog(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._dirty.values())
+
+    # -- flush ----------------------------------------------------------
+    def _mirror(self, table: str) -> SparseTable:
+        m = self._mirrors.get(table)
+        if m is None:
+            src = self._server._tables[table]
+            if not src.is_native and src._init_std != 0.0:
+                raise PSError(
+                    f"geo replication of table {table!r} needs per-id "
+                    f"deterministic row init (native backend, or "
+                    f"init_std=0): the python fallback's init depends "
+                    f"on materialisation order, so the follower's init "
+                    f"for a first-seen id would diverge")
+            m = self._mirrors[table] = src.clone_config()
+        return m
+
+    def flush(self) -> int:
+        """One flush pass: per table, ship up to the rate limit of
+        dirty ids' deltas.  Returns how many ids were pushed.  A
+        remote failure (typed, after the client's own retry budget)
+        re-queues the ids and advances nothing — the delta stays
+        derivable from the unmoved mirror."""
+        with self._flush_lock:
+            total = 0
+            for table in sorted(self._dirty_tables()):
+                with self._lock:
+                    d = self._dirty.get(table)
+                    if not d:
+                        continue
+                    take = [d.pop() for _ in range(min(len(d),
+                                                       self._rate))]
+                ids = np.asarray(sorted(take), np.int64)
+                try:
+                    # pop-BEFORE-read: a commit landing between the pop
+                    # and the row read re-dirties the id (listener runs
+                    # after apply), so the next flush re-ships it —
+                    # values can lag one flush, never be lost
+                    cur = self._server._tables[table].pull(ids)
+                    mirror = self._mirror(table)
+                    n_pushed = self._ship(table, mirror, ids, cur)
+                except (PSError, PSUnavailable):
+                    # remote outage / config error: re-queue, never
+                    # drop — the mirror did not advance past anything
+                    # unacked, so the retry re-derives the same deltas
+                    self.push_failures += 1
+                    _monitor.stat_add("ps_geo_push_failures")
+                    with self._lock:
+                        self._dirty.setdefault(table, set()).update(
+                            ids.tolist())
+                    raise
+                total += n_pushed
+                if n_pushed:
+                    self.pushed_ids += n_pushed
+                    self.flushes += 1
+                    _monitor.stat_add("ps_geo_flushes")
+                    _monitor.stat_add("ps_geo_pushed_ids", n_pushed)
+                    _flight.record("ps.geo.push", table=table,
+                                   n=int(n_pushed),
+                                   backlog=self.backlog())
+            if _monitor.metrics_enabled():
+                _monitor.gauge_set("ps_geo_backlog_ids", self.backlog())
+            return total
+
+    def _ship(self, table: str, mirror: SparseTable, ids: np.ndarray,
+              cur: np.ndarray) -> int:
+        """Push rows to BIT-EXACT convergence.  ``prev + (cur - prev)``
+        does not telescope in f32, so after the main delta a residual
+        pass ships ``cur - mirror`` again: the difference of two nearby
+        floats is exactly representable (Sterbenz), so one or two
+        corrections land the follower on the primary's exact bits.  The
+        mirror advances only after the remote acked the same delta, and
+        applies it through the identical ``push_delta`` add, so mirror
+        == follower bit-for-bit at every point."""
+        delta = (cur - mirror.pull(ids)).astype(np.float32)
+        pushed = 0
+        for _ in range(8):
+            nz = np.flatnonzero(np.any(delta != 0, axis=1))
+            if nz.size == 0:
+                return pushed
+            sub_ids = np.ascontiguousarray(ids[nz])
+            sub = np.ascontiguousarray(delta[nz])
+            self._ensure_client().push_delta(table, sub_ids, sub,
+                                             sync=True)
+            mirror.push_delta(sub_ids, sub)
+            pushed = max(pushed, int(nz.size))
+            delta = (cur - mirror.pull(ids)).astype(np.float32)
+        # should be unreachable: re-queue whatever refused to converge
+        with self._lock:
+            self._dirty.setdefault(table, set()).update(
+                ids[np.any(delta != 0, axis=1)].tolist())
+        return pushed
+
+    def _dirty_tables(self) -> List[str]:
+        with self._lock:
+            return [t for t, s in self._dirty.items() if s]
+
+    def drain(self, timeout: float = 30.0):
+        """Flush until the dirty backlog is empty (writes must have
+        quiesced for this to terminate) — the convergence-bound
+        primitive the geo tests block on."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.flush()
+            if self.backlog() == 0:
+                return
+            if time.monotonic() > deadline:
+                raise PSUnavailable(
+                    f"geo drain did not converge within {timeout}s "
+                    f"({self.backlog()} dirty ids left)")
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.flush()
+            except (PSError, PSUnavailable):
+                # remote unreachable: backlog holds, retry next tick
+                continue

@@ -394,6 +394,21 @@ class SparseTable:
                     init_std=np.float64(self._init_std),
                     seed=np.int64(self._seed))
 
+    def clone_config(self) -> "SparseTable":
+        """A NEW empty table with this table's exact construction
+        config (dim, optimizer math, deterministic init seed) — the
+        geo tier's mirror primitive: a remote cluster built from the
+        same config materialises byte-identical rows for ids it first
+        sees via ``push_delta``, so state can converge by shipping
+        deltas only.  Custom Python initializers are not clonable
+        (their state is opaque); use the stock seeded init for
+        geo-replicated tables."""
+        return SparseTable(self.dim, optimizer=self._opt, lr=self._lr,
+                           seed=self._seed, init_std=self._init_std,
+                           beta1=self._beta1, beta2=self._beta2,
+                           epsilon=self._eps,
+                           use_native=self._native is not None)
+
     @staticmethod
     def from_config(d) -> "SparseTable":
         """Build a table from a snapshot's npz dict: exact dim even for
@@ -411,12 +426,31 @@ class SparseTable:
                       seed=int(d["seed"]))
         return SparseTable(dim, **kw)
 
-    def _snapshot_arrays(self):
+    def _opt_state_width(self) -> int:
+        """Floats of optimizer state per row in the REPLICATION snapshot
+        layout (mirrors the native arena stride minus the value):
+        sgd ``[step]``, adagrad ``[acc(dim), step]``, adam
+        ``[m(dim), v(dim), step]`` — identical for both backends so a
+        python replica of a native primary (or vice versa) inherits the
+        exact optimizer trajectory."""
+        if self._native is not None:
+            return int(self._lib.pts_stride(self._native)) - self.dim
+        return {"adam": 2 * self.dim + 1,
+                "adagrad": self.dim + 1}.get(self._opt, 1)
+
+    def _snapshot_arrays(self, full_state: bool = False):
         """The checkpoint payload (ids/vals/entry state/config/version)
         as one consistent dict — shared by file save and replication
-        snapshots."""
+        snapshots.  ``full_state`` additionally exports the per-row
+        optimizer state (``opt_state``, layout per
+        :meth:`_opt_state_width`): the DISK format deliberately keeps
+        the reference's values-only semantics (state rebuilds on warm
+        start), but a hot replica of a stateful optimizer MUST inherit
+        the moments or its post-snapshot applies diverge from the
+        primary's trajectory."""
         import ctypes
         if self._native is not None:
+            stride = int(self._lib.pts_stride(self._native))
             with self._lock:
                 # entry state FIRST, then rows: an id admitted during the
                 # export window is then missing from the admitted set
@@ -425,18 +459,32 @@ class SparseTable:
                 entry = self._entry_state_locked()
                 n = int(self._lib.pts_size(self._native))
                 ids = np.empty(n, np.int64)
-                vals = np.empty((n, self.dim), np.float32)
-                if n:
-                    # cap=n: the table may grow concurrently; export
-                    # writes at most n rows (the snapshot is whatever fit)
-                    w = self._lib.pts_export(self._native,
-                                             self._c(ids, ctypes.c_int64),
-                                             self._c(vals, ctypes.c_float),
-                                             n)
-                    ids, vals = ids[:w], vals[:w]
+                if full_state:
+                    rows = np.empty((n, stride), np.float32)
+                    if n:
+                        w = self._lib.pts_export_full(
+                            self._native, self._c(ids, ctypes.c_int64),
+                            self._c(rows, ctypes.c_float), n)
+                        ids, rows = ids[:w], rows[:w]
+                    vals = np.ascontiguousarray(rows[:, :self.dim])
+                    opt_state = np.ascontiguousarray(rows[:, self.dim:])
+                else:
+                    vals = np.empty((n, self.dim), np.float32)
+                    opt_state = None
+                    if n:
+                        # cap=n: the table may grow concurrently; export
+                        # writes at most n rows (the snapshot is
+                        # whatever fit)
+                        w = self._lib.pts_export(
+                            self._native, self._c(ids, ctypes.c_int64),
+                            self._c(vals, ctypes.c_float), n)
+                        ids, vals = ids[:w], vals[:w]
                 ver = int(self._lib.pts_version(self._native))
-            return dict(ids=ids, vals=vals, version=np.int64(ver),
-                        **self.config_arrays(), **entry)
+            out = dict(ids=ids, vals=vals, version=np.int64(ver),
+                       **self.config_arrays(), **entry)
+            if opt_state is not None:
+                out["opt_state"] = opt_state
+            return out
         with self._lock:
             # one lock section: the rows snapshot and the admission
             # state must agree (and concurrent push must not mutate the
@@ -444,10 +492,27 @@ class SparseTable:
             ids = np.fromiter(self._rows, np.int64, len(self._rows))
             vals = np.stack([self._rows[int(i)] for i in ids]) \
                 if len(ids) else np.zeros((0, self.dim), np.float32)
+            opt_state = None
+            if full_state:
+                w = self._opt_state_width()
+                opt_state = np.zeros((ids.size, w), np.float32)
+                for i, k in enumerate(ids.tolist()):
+                    if self._opt in ("adagrad", "adam"):
+                        m = self._moments.get(k)
+                        if m is not None:
+                            opt_state[i, :self.dim] = m
+                    if self._opt == "adam":
+                        v = self._moments2.get(k)
+                        if v is not None:
+                            opt_state[i, self.dim:2 * self.dim] = v
+                    opt_state[i, -1] = float(self._steps.get(k, 0))
             entry = self._entry_state_locked()
             ver = self._version
-        return dict(ids=ids, vals=vals, version=np.int64(ver),
-                    **self.config_arrays(), **entry)
+        out = dict(ids=ids, vals=vals, version=np.int64(ver),
+                   **self.config_arrays(), **entry)
+        if opt_state is not None:
+            out["opt_state"] = opt_state
+        return out
 
     # checkpoint (reference: servers persist their shard,
     # the_one_ps.py:758 warm-start)
@@ -460,11 +525,16 @@ class SparseTable:
                        version=int(self.version))
 
     def state_bytes(self) -> bytes:
-        """The whole table as npz bytes (the on-disk checkpoint format,
-        in memory) — what a hot standby catches up from."""
+        """The whole table as npz bytes — what a hot standby or read
+        replica catches up from.  Extends the on-disk checkpoint format
+        with ``opt_state`` (per-row optimizer moments + step counters):
+        a replica attaching MID-RUN to a stateful-optimizer table must
+        inherit the moments, or every post-snapshot apply diverges
+        (fresh zero moments take bigger adagrad/adam steps — caught by
+        the read-replica re-attach drive)."""
         import io
         buf = io.BytesIO()
-        np.savez(buf, **self._snapshot_arrays())
+        np.savez(buf, **self._snapshot_arrays(full_state=True))
         return buf.getvalue()
 
     def load(self, path: str):
@@ -490,12 +560,30 @@ class SparseTable:
                 f"(rows={ids.size}, dim={self.dim}); was it saved from a "
                 f"table with a different embedding dim?")
         ver = int(d["version"]) if "version" in d else 0
+        opt_state = None
+        if "opt_state" in d:
+            opt_state = np.ascontiguousarray(d["opt_state"], np.float32)
+            if opt_state.shape != (ids.size, self._opt_state_width()):
+                raise ValueError(
+                    f"snapshot opt_state layout {opt_state.shape} does "
+                    f"not match optimizer {self._opt!r} (want "
+                    f"({ids.size}, {self._opt_state_width()})) — was it "
+                    f"taken from a table with a different optimizer?")
         if self._native is not None:
             # restore REPLACES (reference warm-start semantics,
             # the_one_ps.py:758) — never merges into existing rows
             self._lib.pts_clear(self._native)
-            self._lib.pts_import(self._native, self._c(ids, ctypes.c_int64),
-                                 ids.size, self._c(vals, ctypes.c_float))
+            if opt_state is not None:
+                rows = np.ascontiguousarray(
+                    np.concatenate([vals, opt_state], axis=1))
+                self._lib.pts_import_full(
+                    self._native, self._c(ids, ctypes.c_int64),
+                    ids.size, self._c(rows, ctypes.c_float))
+            else:
+                self._lib.pts_import(self._native,
+                                     self._c(ids, ctypes.c_int64),
+                                     ids.size,
+                                     self._c(vals, ctypes.c_float))
             self._lib.pts_set_version(self._native, ver)
             self._restore_entry_state(d, ids)
             return
@@ -507,6 +595,16 @@ class SparseTable:
             self._moments.clear()
             self._moments2.clear()
             self._steps.clear()
+            if opt_state is not None:
+                for i, k in enumerate(ids.tolist()):
+                    if self._opt in ("adagrad", "adam"):
+                        self._moments[k] = opt_state[i, :self.dim].copy()
+                    if self._opt == "adam":
+                        self._moments2[k] = \
+                            opt_state[i, self.dim:2 * self.dim].copy()
+                    step = int(opt_state[i, -1])
+                    if step:
+                        self._steps[k] = step
             self._version = ver
             self._restore_entry_state_locked(d, ids)
 

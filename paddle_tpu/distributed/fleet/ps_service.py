@@ -57,6 +57,41 @@ watchdog's server restarts, launch_utils.py:526):
     (:mod:`~paddle_tpu.distributed.fleet.chaos`) so all of the above
     is provable under injected failure.
 
+Online serving tier (ISSUE 10 — the reference's §3.5 serve path):
+
+  * a server can run as a **read replica** (``replica_of=...,
+    replica_mode="read"``): it catches up from a snapshot like the hot
+    standby, but the primary feeds it the mutation log through a
+    bounded per-sink queue drained by a dedicated sender thread — a
+    slow or lossy replica link never stalls the primary's commit path
+    (the hot standby's stream stays synchronous: an acked write must
+    survive primary loss).  Read replicas never promote; on stream EOF
+    they re-resolve the primary group (the promoted standby after a
+    failover) and re-attach from a fresh snapshot;
+  * every streamed record carries the primary's commit seq (``cs``, the
+    count of applied mutations) and current head (``head``); idle links
+    carry periodic ``wm`` watermark heartbeats.  A replica therefore
+    tracks ``watermark`` (last applied cs) and ``head`` (newest head it
+    has heard), and serves a **bounded-staleness read**: a ``pull``
+    carrying ``max_lag`` is answered iff the stream is live and fresh
+    (heard within ``stale_after_s``) and ``head - watermark <=
+    max_lag`` — otherwise the reply is a retryable ``stale`` refusal,
+    NEVER a wrong-but-silent stale row.  The successful-read contract:
+    the rows are at most ``max_lag`` mutations behind the primary's
+    commit head as of ``stale_after_s`` ago.  Plain pulls (no
+    ``max_lag``) on an un-promoted replica stay refused — the PR 3
+    split-brain guard is unchanged;
+  * :class:`PSClient` grows a pull-only read mode: ``read_replicas``
+    (one endpoint group per shard) + ``max_lag`` fan a pull out across
+    the shard's replicas by **consistent hashing** (per-id hash ring,
+    64 vnodes per replica — adding/removing a replica remaps ~1/N of
+    the id space).  A stale or dead replica is skipped per-call (dead
+    ones back off with per-replica health state, so a reader pinned to
+    a dead replica rotates WITHOUT a failed read) and the residue
+    falls through ring-order to fresher replicas, then to the primary
+    endpoint group with the full retry layer — graceful degradation,
+    zero failed reads under replica churn and primary failover.
+
 Worker liveness (parity: operators/distributed/heart_beat_monitor.cc):
 clients register a worker id and a background thread beats every
 ``heartbeat_interval``; the server's monitor thread marks a worker dead
@@ -131,6 +166,18 @@ class _StandbyReply(PSError):
     """Internal: the endpoint answered "I am an un-promoted standby".
     The retry loop treats it like a down endpoint (drop the socket,
     back off, rotate) — it must never be surfaced as success."""
+
+
+class _StaleRead(PSError):
+    """Internal: a read replica answered "too stale for this bound".
+    The read fan-out falls through to a fresher replica / the primary;
+    it must never surface as a failed read while anything fresher is
+    reachable."""
+
+
+class _ReplicaDown(PSError):
+    """Internal: a read replica's transport died mid-RPC.  The replica
+    is marked down (bounded backoff) and the ids retry elsewhere."""
 
 
 # RPCs with server-side effects: they carry (src, seq) so a retry can be
@@ -310,6 +357,62 @@ class _SeqWindow:
         return cls(x[0], x[1])
 
 
+# -- consistent-hash read ring ------------------------------------------
+#
+# The read fan-out must pick the same replica for the same id in every
+# client process (cache affinity; the serving fleet shares row working
+# sets), and adding/removing a replica must remap ~1/N of the id space,
+# not reshuffle it.  Ring points come from blake2b over the endpoint
+# string (stable across processes/pythons — hash() is salted); id
+# placement uses a vectorized splitmix64 so a serving-batch lookup is
+# numpy, not a per-id digest.
+
+_RING_VNODES = 64
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over a uint64 array (wrapping arithmetic)."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _build_ring(endpoints) -> Tuple[np.ndarray, np.ndarray]:
+    """(sorted ring points uint64, owner replica index per point)."""
+    import hashlib
+    pts, owners = [], []
+    for j, ep in enumerate(endpoints):
+        for v in range(_RING_VNODES):
+            d = hashlib.blake2b(f"{ep}#{v}".encode(),
+                                digest_size=8).digest()
+            pts.append(int.from_bytes(d, "big"))
+            owners.append(j)
+    pts = np.asarray(pts, np.uint64)
+    owners = np.asarray(owners, np.int64)
+    order = np.argsort(pts, kind="stable")
+    return pts[order], owners[order]
+
+
+def _ring_positions(ring, ids: np.ndarray) -> np.ndarray:
+    """Each id's position on the ring (index of its successor point)."""
+    pts, _ = ring
+    h = _mix64(np.ascontiguousarray(ids, np.int64).astype(np.uint64))
+    return np.searchsorted(pts, h, side="left") % len(pts)
+
+
+def _ring_owner_from(ring, pos: int, excluded) -> Optional[int]:
+    """First owner clockwise from ``pos`` not in ``excluded`` (None when
+    every replica is excluded — the caller falls to the primary)."""
+    pts, owners = ring
+    n = len(pts)
+    for k in range(n):
+        o = int(owners[(pos + k) % n])
+        if o not in excluded:
+            return o
+    return None
+
+
 class HeartBeatMonitor:
     """Tracks trainer liveness on the server.
 
@@ -387,6 +490,17 @@ class PSServer:
     acked mutations.  When the primary connection dies the standby
     promotes itself (``promoted``/``role``) and keeps serving — clients
     holding an endpoint list fail over to it transparently.
+
+    ``replica_mode="read"`` (ISSUE 10) makes this a READ replica
+    instead: ``replica_of`` may name the primary's whole failover group
+    (``"h:p1|h:p2"``), the mutation stream is fed asynchronously
+    (bounded per-sink queue on the primary — a slow link can't stall
+    commits; overflow detaches the sink and this replica re-attaches
+    from a fresh snapshot), it NEVER promotes, and it serves
+    bounded-staleness pulls (``max_lag`` + ``stale_after_s``, module
+    docstring) while un-promoted.  A hot standby serves bounded reads
+    too (its synchronous stream keeps it at lag ~0); plain pulls stay
+    refused on any un-promoted replica (split-brain guard).
     """
 
     def __init__(self, tables: Dict[str, "SparseTable"],
@@ -394,7 +508,12 @@ class PSServer:
                  heartbeat_timeout: float = 10.0,
                  on_dead: str = "evict",
                  expected_workers: Optional[int] = None,
-                 replica_of: Optional[str] = None):
+                 replica_of: Optional[str] = None,
+                 replica_mode: str = "standby",
+                 serve_reads: bool = True,
+                 stale_after_s: float = 2.0,
+                 wm_interval_s: float = 0.25,
+                 sink_queue: int = 8192):
         if on_dead not in ("evict", "fail"):
             raise ValueError(f"on_dead must be 'evict' or 'fail', "
                              f"got {on_dead!r}")
@@ -433,11 +552,31 @@ class PSServer:
         self.applied = 0      # mutations committed
         self.dup_acks = 0     # duplicates acked without re-applying
         self.replica_of = replica_of
+        if replica_mode not in ("standby", "read"):
+            raise ValueError(f"replica_mode must be 'standby' or "
+                             f"'read', got {replica_mode!r}")
+        self.replica_mode = replica_mode
         self.role = "replica" if replica_of else "primary"
         self.promoted = False
         self.replica_error: Optional[Exception] = None
         self.replica_ready = threading.Event()
         self._repl_sock: Optional[socket.socket] = None
+        # bounded-staleness read state (replica side): watermark = last
+        # applied commit seq, head = newest primary commit seq heard on
+        # the stream (records + wm heartbeats), _last_stream = when.
+        # All written by the single replica-loop thread; int/float reads
+        # elsewhere are atomic under the GIL.
+        self._serve_reads = bool(serve_reads)
+        self._stale_after = float(stale_after_s)
+        self._wm_interval = float(wm_interval_s)
+        self._sink_queue = int(sink_queue)
+        self._watermark = 0
+        self._head = 0
+        self._stream_live = False
+        self._last_stream = 0.0
+        # commit listeners (geo tier): fn(op, table, ids) called under
+        # the apply lock after each committed mutation — keep them FAST
+        self._commit_listeners: List = []
         if replica_of is None:
             self.replica_ready.set()
 
@@ -450,6 +589,12 @@ class PSServer:
             rt = threading.Thread(target=self._replica_loop, daemon=True)
             rt.start()
             self._threads.append(rt)
+        # watermark heartbeats keep SYNC standbys' freshness clocks
+        # ticking through write silence (no mutations != stale); read
+        # sinks heartbeat from their own sender threads
+        wt = threading.Thread(target=self._wm_loop, daemon=True)
+        wt.start()
+        self._threads.append(wt)
         if block:
             t.join()
 
@@ -494,8 +639,15 @@ class PSServer:
                         with self.monitor.cond:
                             self._ever_registered.add(w)
                     self.monitor.touch(w)
+                # a pull carrying max_lag is a BOUNDED read: an
+                # un-promoted replica may serve it iff fresh enough
+                # (checked in the handler); anything else gated stays
+                # refused — the split-brain guard is unchanged
+                bounded_read = (op == "pull"
+                                and msg.get("max_lag") is not None
+                                and self._serve_reads)
                 if (self.role == "replica" and not self.promoted
-                        and op in _GATED_OPS):
+                        and op in _GATED_OPS and not bounded_read):
                     # split-brain guard: a client that rotated here too
                     # eagerly (slow-but-alive primary) gets a retryable
                     # refusal and keeps rotating until it reaches the
@@ -519,8 +671,23 @@ class PSServer:
                     srv_sp.__enter__()
                 try:
                     if op == "pull":
-                        t = self._table(msg["table"])
-                        _send_msg(conn, {"vals": t.pull(msg["ids"])})
+                        stale = None
+                        if self.role == "replica" and not self.promoted:
+                            lag, fresh = self._read_lag()
+                            bound = int(msg.get("max_lag") or 0)
+                            if not fresh or lag > bound:
+                                stale = {"ok": False, "retryable": True,
+                                         "stale": True, "lag": int(lag),
+                                         "fresh": bool(fresh),
+                                         "error": f"replica lag {lag} "
+                                                  f"exceeds bound {bound}"
+                                         if fresh else
+                                         "replica stream is not fresh"}
+                        if stale is not None:
+                            _send_msg(conn, stale)
+                        else:
+                            t = self._table(msg["table"])
+                            _send_msg(conn, {"vals": t.pull(msg["ids"])})
                     elif op in ("push", "push_delta"):
                         applied = self._apply_mutation(msg)
                         if msg.get("sync"):
@@ -555,8 +722,19 @@ class PSServer:
                         _send_msg(conn, self._worker_barrier(
                             msg["worker"], msg.get("timeout")))
                     elif op == "replicate":
-                        handed_off = self._attach_replica(conn)
-                        return
+                        if self.role == "replica" and not self.promoted:
+                            # an un-promoted replica is not authoritative
+                            # — a read replica attaching mid-failover
+                            # must keep resolving until it reaches the
+                            # promoted server, never chain off a peer
+                            _send_msg(conn, {
+                                "ok": False, "retryable": True,
+                                "error": "un-promoted replica cannot "
+                                         "seed a replica"})
+                        else:
+                            handed_off = self._attach_replica(
+                                conn, mode=msg.get("mode", "standby"))
+                            return
                     elif op == "stats":
                         _send_msg(conn, self._stats())
                     elif op == "stop":
@@ -641,17 +819,43 @@ class PSServer:
             _flight.record("ps.apply", op=msg["op"],
                            table=msg.get("table"), src=src, seq=seq,
                            applied=self.applied)
+            for fn in self._commit_listeners:
+                # geo tier hook: runs under the apply lock — listeners
+                # must only buffer (a failing listener must not fail or
+                # slow the commit)
+                try:
+                    fn(msg["op"], msg.get("table"), msg["ids"])
+                except Exception:
+                    pass
             if self._replicas:
                 self._forward(msg)
         return True
 
+    def add_commit_listener(self, fn):
+        """Subscribe ``fn(op, table, ids)`` to every committed mutation
+        (called under the apply lock — buffer, don't block; the geo
+        delta pusher's dirty-id feed)."""
+        with self._apply_lock:
+            self._commit_listeners.append(fn)
+
+    def remove_commit_listener(self, fn):
+        with self._apply_lock:
+            if fn in self._commit_listeners:
+                self._commit_listeners.remove(fn)
+
     def _forward(self, msg):
-        """Stream one committed mutation to every replica and wait for
-        each ack (called under the apply lock).  A replica that errors
-        is detached — it will re-sync from a fresh snapshot if it comes
-        back."""
+        """Stream one committed mutation to every replica (called under
+        the apply lock).  Sync sinks (hot standby) are sent inline and
+        awaited — an acked write survives primary loss.  Read sinks get
+        a copy queued for their sender thread — a slow replica link
+        never stalls the commit path; a sink whose queue overflows has
+        fallen too far behind and is detached (it re-attaches from a
+        fresh snapshot).  Every record carries the commit seq ``cs``
+        (this server's applied count) the replicas' staleness bound is
+        measured in."""
         rec = {k: msg[k] for k in ("op", "table", "ids", "grads",
                                    "deltas", "src", "seq") if k in msg}
+        rec["cs"] = self.applied
         # the forward span is a child of the server's apply span (tls),
         # and its context rides the record so the REPLICA's apply span
         # parents here — client -> primary -> replica is one chain in
@@ -662,6 +866,16 @@ class PSServer:
             if ctx is not None:
                 rec[_TRACE_KEY] = ctx
             for rep in list(self._replicas):
+                if rep.get("mode") == "read":
+                    try:
+                        rep["q"].put_nowait(dict(rec))
+                    except queue.Full:
+                        self._replicas.remove(rep)
+                        try:
+                            rep["conn"].close()
+                        except OSError:
+                            pass
+                    continue
                 with rep["lock"]:
                     try:
                         _send_msg_raw(rep["conn"], rec)
@@ -676,25 +890,31 @@ class PSServer:
                         except OSError:
                             pass
 
-    def _attach_replica(self, conn) -> bool:
+    def _attach_replica(self, conn, mode: str = "standby") -> bool:
         """Handshake for ``op=replicate``: under the apply lock snapshot
         every table (npz bytes — the PR 1 checkpoint format) plus the
         seq windows, register the connection as a stream sink, then send
         the snapshot.  The sink's lock is held until the snapshot is on
-        the wire so a concurrent mutation's forward cannot overtake it.
-        Returns True when the connection was handed off to the stream.
+        the wire so a concurrent mutation's forward cannot overtake it
+        (read sinks buffer concurrent records in their queue instead —
+        their sender thread only starts after the snapshot is acked, so
+        stream order still holds).  Returns True when the connection was
+        handed off to the stream.
         """
-        rep = {"conn": conn, "lock": threading.Lock()}
+        rep = {"conn": conn, "lock": threading.Lock(), "mode": mode}
+        if mode == "read":
+            rep["q"] = queue.Queue(maxsize=self._sink_queue)
         with self._apply_lock:
             names = sorted(self._tables)
             blobs = [(n, self._tables[n].state_bytes()) for n in names]
             seqs = {s: w.export() for s, w in self._seqs.items()}
+            head = self.applied
             rep["lock"].acquire()
             self._replicas.append(rep)
         try:
             conn.settimeout(30.0)
             _send_msg_raw(conn, {"op": "snapshot", "tables": names,
-                                 "seqs": seqs,
+                                 "seqs": seqs, "head": head,
                                  "srv_us": time.time_ns() // 1000,
                                  "srv_sink": _trace.sink_id()})
             for n, b in blobs:
@@ -721,33 +941,141 @@ class PSServer:
                     self._replicas.remove(rep)
             return False
         rep["lock"].release()
+        _flight.record("ps.replica.attach", mode=mode, head=int(head),
+                       tables=len(names))
+        if mode == "read":
+            st = threading.Thread(target=self._sink_sender, args=(rep,),
+                                  daemon=True)
+            st.start()
+            self._threads.append(st)
         return True
 
+    def _sink_sender(self, rep):
+        """Per-read-sink sender: drains the sink's record queue onto the
+        wire; on queue silence it sends ``wm`` watermark heartbeats so
+        the replica's freshness clock keeps ticking through write
+        silence.  Every outgoing frame is stamped with the CURRENT
+        commit head — an in-order consumer always knows how far behind
+        it is.  Frames go through the chaos-aware ``_send_msg`` so a
+        delayed/lossy replica link is injectable."""
+        conn, q = rep["conn"], rep["q"]
+        try:
+            while not self._stop.is_set():
+                try:
+                    rec = q.get(timeout=self._wm_interval)
+                except queue.Empty:
+                    rec = {"op": "wm"}
+                rec["head"] = self.applied
+                _send_msg(conn, rec)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            self._detach_sink(rep)
+
+    def _detach_sink(self, rep):
+        """Close + deregister a sink from a context that holds NO locks
+        (sender/wm threads) — conn first, then the apply lock, per the
+        declared order."""
+        try:
+            rep["conn"].close()
+        except OSError:
+            pass
+        with self._apply_lock:
+            if rep in self._replicas:
+                self._replicas.remove(rep)
+
+    def _wm_loop(self):
+        """Watermark heartbeats to SYNC sinks (read sinks heartbeat from
+        their sender threads).  wm frames generate no ack, so they can
+        interleave the forward/ack stream freely; the replica side
+        updates its head + freshness clock and does not reply."""
+        while not self._stop.wait(self._wm_interval):
+            dead = []
+            for rep in list(self._replicas):
+                if rep.get("mode") == "read":
+                    continue
+                with rep["lock"]:
+                    try:
+                        _send_msg_raw(rep["conn"],
+                                      {"op": "wm", "head": self.applied})
+                    except (OSError, ConnectionError):
+                        dead.append(rep)
+            for rep in dead:
+                self._detach_sink(rep)
+
+    def _read_lag(self) -> Tuple[int, bool]:
+        """(seq lag, fresh?) for the bounded-read gate.  A primary (or
+        promoted standby) is trivially lag-0 fresh; a replica is fresh
+        iff its stream is attached and heard from within
+        ``stale_after_s`` — stream EOF (primary death) makes it unfresh
+        IMMEDIATELY, so the failover window can never serve a
+        beyond-bound answer."""
+        if self.role != "replica" or self.promoted:
+            return 0, True
+        lag = max(0, self._head - self._watermark)
+        if not self._stream_live:
+            return lag, False
+        return lag, (time.monotonic() - self._last_stream
+                     <= self._stale_after)
+
     def _replica_loop(self):
-        """Standby side: attach to the primary, load the snapshot, then
-        apply the mutation stream until the primary dies — at which
-        point this server promotes itself."""
-        ep = _parse_ep(self.replica_of)
-        sock = None
+        """Replica side: attach to the primary (first reachable member
+        of the ``replica_of`` group), load the snapshot, then apply the
+        mutation stream.  A hot STANDBY promotes itself when the stream
+        dies after a successful catch-up; a READ replica never promotes
+        — it re-resolves the group (the promoted standby after a
+        failover) and re-attaches from a fresh snapshot, forever."""
+        group = [x for x in str(self.replica_of).split("|") if x]
+        read_mode = self.replica_mode == "read"
         deadline = time.monotonic() + 60.0
         while not self._stop.is_set():
-            try:
-                sock = socket.create_connection(ep, timeout=5.0)
-                break
-            except OSError:
-                if time.monotonic() > deadline:
-                    return
-                time.sleep(0.2)
-        if sock is None:
-            return
-        self._repl_sock = sock
+            streamed = False
+            for ep in group:
+                try:
+                    sock = socket.create_connection(_parse_ep(ep),
+                                                    timeout=5.0)
+                except OSError:
+                    continue
+                self._repl_sock = sock
+                try:
+                    streamed = self._attach_and_stream(sock, ep)
+                finally:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    self._repl_sock = None
+                if self.replica_error is not None:
+                    return   # out of sync: never promote, never serve
+                if streamed or self._stop.is_set():
+                    break
+            if self._stop.is_set():
+                return
+            if streamed and not read_mode:
+                # standby semantics (PR 3): the primary died after we
+                # were caught up — take over
+                self.promote()
+                return
+            if not streamed and not read_mode \
+                    and time.monotonic() > deadline:
+                return   # never attached: stay a mute standby
+            time.sleep(0.2)
+
+    def _attach_and_stream(self, sock, ep: str) -> bool:
+        """One attach + stream session.  Returns True iff the snapshot
+        was fully applied (the stream ending afterwards is the signal a
+        standby promotes on)."""
+        read_mode = self.replica_mode == "read"
+        caught_up = False
         try:
             sock.settimeout(60.0)
             t0 = time.time_ns()
-            _send_msg_raw(sock, {"op": "replicate"})
+            _send_msg_raw(sock, {"op": "replicate",
+                                 "mode": self.replica_mode})
             head = _recv_msg(sock)
-            if head is None:
-                return
+            if head is None or head.get("ok") is False \
+                    or "tables" not in head:
+                return False   # refused (un-promoted peer) or dead
             # clock edge replica -> primary (the primary snapshots
             # under its apply lock before answering, so the rtt is
             # inflated and the midpoint estimate coarse — good enough
@@ -757,19 +1085,38 @@ class PSServer:
             for _ in head.get("tables", []):
                 fr = _recv_msg(sock)
                 if fr is None:
-                    return
+                    return False
                 self._load_snapshot_table(fr["table"],
                                           fr["blob"].tobytes())
             with self._apply_lock:
                 self._seqs = {s: _SeqWindow.from_export(x)
                               for s, x in head.get("seqs", {}).items()}
+            self._watermark = self._head = int(head.get("head", 0))
+            self._last_stream = time.monotonic()
+            self._stream_live = True
             _send_msg_raw(sock, {"ok": True})
+            caught_up = True
             self.replica_ready.set()
+            _flight.record("ps.replica.attach", primary=str(ep),
+                           mode=self.replica_mode, head=self._head)
             sock.settimeout(None)
+            mx = _monitor.metrics_enabled()
             while not self._stop.is_set():
                 rec = _recv_msg(sock)
                 if rec is None:
                     break   # primary is gone
+                self._last_stream = time.monotonic()
+                if "head" in rec:
+                    h = int(rec["head"])
+                    if h > self._head:
+                        self._head = h
+                if rec.get("op") == "wm":
+                    # heartbeat: freshness + head only, never acked
+                    if mx:
+                        _monitor.gauge_set(
+                            "ps_replica_lag_seq",
+                            max(0, self._head - self._watermark))
+                    continue
                 tctx = rec.pop(_TRACE_KEY, None)
                 rep_sp = (_trace.server_span("ps.replica.apply", tctx,
                                              table=rec.get("table"))
@@ -779,31 +1126,42 @@ class PSServer:
                 try:
                     self._apply_mutation(rec)
                 except Exception as e:
-                    # a record this standby cannot apply means it is
+                    # a record this replica cannot apply means it is
                     # OUT OF SYNC (config mismatch, bug): it must never
                     # promote and serve diverged state.  Dropping the
                     # connection (no ack) also detaches it primary-side.
                     self.replica_error = e
+                    self._stream_live = False
                     _flight.record("ps.replica_error",
                                    err=type(e).__name__, detail=str(e))
                     _flight.maybe_dump("replica_error")
-                    print(f"paddle_tpu PSServer standby: replication "
+                    print(f"paddle_tpu PSServer replica: replication "
                           f"stream failed, NOT promoting: {e!r}",
                           file=sys.stderr)
-                    return
+                    return caught_up
                 finally:
                     if rep_sp is not None:
                         rep_sp.__exit__(None, None, None)
-                _send_msg_raw(sock, {"ok": True})
+                if "cs" in rec:
+                    cs = int(rec["cs"])
+                    if cs > self._watermark:
+                        self._watermark = cs
+                    if cs > self._head:
+                        self._head = cs
+                if mx:
+                    _monitor.gauge_set(
+                        "ps_replica_lag_seq",
+                        max(0, self._head - self._watermark))
+                if not read_mode:
+                    _send_msg_raw(sock, {"ok": True})
         except (OSError, ConnectionError):
             pass
         finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
-            if not self._stop.is_set() and self.replica_error is None:
-                self.promote()
+            # the stream is gone: bounded reads must refuse from THIS
+            # instant — the primary may be dead and a new one taking
+            # writes this replica cannot see yet
+            self._stream_live = False
+        return caught_up
 
     def _load_snapshot_table(self, name: str, blob: bytes):
         t = self._tables.get(name)
@@ -845,12 +1203,19 @@ class PSServer:
         self.role = "primary"
 
     def _stats(self) -> dict:
+        lag, fresh = self._read_lag()
         with self._apply_lock:
             return {"ok": True, "role": self.role,
                     "promoted": self.promoted,
                     "applied": self.applied,
                     "dup_acks": self.dup_acks,
                     "n_replicas": len(self._replicas),
+                    "replica_mode": (self.replica_mode
+                                     if self.replica_of else None),
+                    "watermark": int(self._watermark),
+                    "head": int(self._head),
+                    "read_lag": int(lag),
+                    "read_fresh": bool(fresh),
                     "versions": {n: t.version
                                  for n, t in self._tables.items()
                                  if hasattr(t, "version")}}
@@ -993,6 +1358,18 @@ class PSClient:
     and raises :class:`PSUnavailable` if any was lost, so a barrier
     that returns cleanly proves exactly-once delivery of everything
     pushed before it.
+
+    Serving read mode (ISSUE 10): ``mode="read"`` makes the client
+    pull-only (mutating calls raise), and ``read_replicas`` (one
+    endpoint group per shard, same ``"h:p1|h:p2"`` format) +
+    ``max_lag`` fan every pull out across the shard's read replicas by
+    consistent hashing with bounded-staleness semantics — see the
+    module docstring.  Ids a replica answers stale (or whose replica is
+    down) fall through ring-order, then to the primary endpoint group
+    through the normal retry layer, so a read only fails when NOTHING
+    within the bound is reachable.  ``max_lag`` alone (no replicas)
+    marks pulls as bounded reads, which also lets an un-promoted hot
+    standby serve them during a failover window.
     """
 
     def __init__(self, endpoints, mode: str = "sync", send_queue_size=16,
@@ -1002,7 +1379,8 @@ class PSClient:
                  rpc_timeout: Optional[float] = None,
                  max_retries: Optional[int] = None,
                  backoff_base: Optional[float] = None,
-                 rpc_deadline: Optional[float] = None):
+                 rpc_deadline: Optional[float] = None,
+                 read_replicas=None, max_lag: Optional[int] = None):
         self._ep_lists: List[List[Tuple[str, int]]] = []
         for e in endpoints:
             if isinstance(e, (list, tuple)):
@@ -1081,6 +1459,32 @@ class PSClient:
         self._geo_k = geo_k_steps
         self._geo_acc: Dict[str, Dict[int, np.ndarray]] = {}
         self._geo_pushes = 0
+        # serving read tier (ISSUE 10): per-shard replica sets + rings
+        self._max_lag = None if max_lag is None else int(max_lag)
+        self._read_sets: Optional[List[List[dict]]] = None
+        self._read_rings: Optional[List] = None
+        self.read_fanout = 0      # replica sub-pulls issued
+        self.stale_retries = 0    # stale/refused answers fallen through
+        self.replica_failures = 0  # replica transport deaths
+        if read_replicas is not None:
+            groups = []
+            for e in read_replicas:
+                if isinstance(e, (list, tuple)):
+                    g = [str(x) for x in e]
+                else:
+                    g = [x for x in str(e).split("|") if x]
+                groups.append(g)
+            if len(groups) != len(self._ep_lists):
+                raise ValueError(
+                    f"read_replicas must name one group per shard "
+                    f"({len(self._ep_lists)}), got {len(groups)}")
+            self._read_sets = [
+                [{"ep": _parse_ep(x), "name": x, "sock": None,
+                  "lock": threading.Lock(), "down_until": 0.0,
+                  "fails": 0} for x in g] for g in groups]
+            self._read_rings = [_build_ring(g) for g in groups]
+            if self._max_lag is None:
+                self._max_lag = 0
         if mode in ("async", "half_async"):
             self._drainer = threading.Thread(target=self._drain, daemon=True)
             self._drainer.start()
@@ -1203,9 +1607,25 @@ class PSClient:
 
     def pull(self, table: str, ids) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
+        if self._read_sets is not None and ids.size:
+            ids = np.ascontiguousarray(ids, np.int64)
+            if len(self._socks) == 1:
+                return self._read_pull_shard(0, table, ids)
+            shard = self._shard(ids)
+            vals = None
+            for r in range(len(self._socks)):
+                m = shard == r
+                if not m.any():
+                    continue
+                v = self._read_pull_shard(r, table,
+                                          np.ascontiguousarray(ids[m]))
+                if vals is None:
+                    vals = np.empty((ids.size, v.shape[1]), np.float32)
+                vals[m] = v
+            return vals
         if len(self._socks) == 1 or ids.size == 0:
             # empty pulls still round-trip so the (0, dim) shape comes back
-            return self._rpc(0, {"op": "pull", "table": table, "ids": ids},
+            return self._rpc(0, self._pull_msg(table, ids),
                              reply=True)["vals"]
         shard = self._shard(ids)
         vals = None
@@ -1213,14 +1633,150 @@ class PSClient:
             m = shard == r
             if not m.any():
                 continue
-            v = self._rpc(r, {"op": "pull", "table": table,
-                              "ids": ids[m]}, reply=True)["vals"]
+            v = self._rpc(r, self._pull_msg(table, ids[m]),
+                          reply=True)["vals"]
             if vals is None:
                 vals = np.empty((ids.size, v.shape[1]), np.float32)
             vals[m] = v
         return vals
 
+    def _pull_msg(self, table: str, ids) -> dict:
+        """A bounded-read client stamps max_lag on EVERY pull — on the
+        primary it is a no-op, and during a failover window it lets the
+        caught-up-but-unpromoted standby answer instead of refusing."""
+        msg = {"op": "pull", "table": table, "ids": ids}
+        if self._max_lag is not None:
+            msg["max_lag"] = self._max_lag
+        return msg
+
+    # -- read fan-out (ISSUE 10) ----------------------------------------
+    def _read_pull_shard(self, rank: int, table: str,
+                         ids: np.ndarray) -> np.ndarray:
+        """Bounded-staleness pull of one shard's ids across its read
+        replicas: partition by consistent hash, sub-pull each replica,
+        fall through ring-order on stale/dead answers, and answer the
+        residue from the primary group (full retry layer).  Never
+        raises while anything within the bound is reachable."""
+        ents = self._read_sets[rank]
+        ring = self._read_rings[rank]
+        n = ids.size
+        result: Optional[np.ndarray] = None
+        pending = np.arange(n)
+        if ents:
+            pos = _ring_positions(ring, ids)
+            tried: set = set()
+            while pending.size:
+                now = time.monotonic()
+                excluded = set(tried)
+                excluded.update(j for j, e in enumerate(ents)
+                                if e["down_until"] > now)
+                if len(excluded) >= len(ents):
+                    break
+                own = np.empty(pending.size, np.int64)
+                for i, p in enumerate(pending):
+                    o = _ring_owner_from(ring, int(pos[p]), excluded)
+                    own[i] = -1 if o is None else o
+                leftover = []
+                for j in np.unique(own):
+                    j = int(j)
+                    sel = pending[own == j]
+                    if j < 0:
+                        leftover.append(sel)
+                        continue
+                    try:
+                        rep = self._replica_rpc(rank, j, {
+                            "op": "pull", "table": table, "ids": ids[sel],
+                            "max_lag": self._max_lag})
+                    except _StaleRead:
+                        self.stale_retries += 1
+                        _monitor.stat_add("ps_read_stale_retry")
+                        tried.add(j)
+                        leftover.append(sel)
+                        continue
+                    except _ReplicaDown:
+                        tried.add(j)
+                        leftover.append(sel)
+                        continue
+                    v = rep["vals"]
+                    if result is None:
+                        result = np.empty((n, v.shape[1]), np.float32)
+                    result[sel] = v
+                pending = (np.concatenate(leftover) if leftover
+                           else np.empty(0, np.int64))
+        if pending.size:
+            # every replica stale/down for these ids: the primary group
+            # answers through the normal retry/failover layer
+            try:
+                rep = self._rpc(rank, self._pull_msg(table, ids[pending]),
+                                reply=True)
+            except PSUnavailable:
+                # a bounded read found NOTHING within the bound — the
+                # one outcome the serving tier treats as an incident
+                _flight.record("ps.read_stale_exhausted", table=table,
+                               shard=rank, n=int(pending.size),
+                               stale_retries=self.stale_retries)
+                _flight.maybe_dump("read_stale_exhausted")
+                raise
+            v = rep["vals"]
+            if result is None:
+                result = np.empty((n, v.shape[1]), np.float32)
+            result[pending] = v
+        return result
+
+    def _replica_rpc(self, rank: int, j: int, msg) -> dict:
+        """One-shot RPC to read replica ``j`` of shard ``rank`` — no
+        internal retries: a failure marks the replica down (bounded
+        backoff) and raises so the caller's fan-out falls through to
+        the next ring member.  That fall-through IS the retry, which is
+        what lets a reader pinned to a dead replica rotate without ever
+        surfacing a failed read."""
+        ent = self._read_sets[rank][j]
+        plan = _chaos.active()
+        self.read_fanout += 1
+        _monitor.stat_add("ps_read_fanout")
+        with ent["lock"]:
+            sock = ent["sock"]
+            try:
+                if sock is None:
+                    if plan is not None:
+                        plan.check_connect(ent["ep"])
+                    sock = socket.create_connection(
+                        ent["ep"], timeout=self._connect_timeout)
+                    ent["sock"] = sock
+                sock.settimeout(self._rpc_timeout)
+                _send_msg(sock, msg)
+                rep = _recv_msg(sock)
+                if rep is None:
+                    raise ConnectionError("replica closed the connection")
+            except (OSError, ConnectionError, socket.timeout) as e:
+                ent["sock"] = None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                ent["fails"] += 1
+                ent["down_until"] = time.monotonic() + min(
+                    0.25 * (2 ** min(ent["fails"] - 1, 5)), 5.0)
+                self.replica_failures += 1
+                _monitor.stat_add("ps_read_replica_failures")
+                raise _ReplicaDown(
+                    f"read replica {ent['name']} (shard {rank}): "
+                    f"{e}") from e
+            ent["fails"] = 0
+            if isinstance(rep, dict) and rep.get("ok") is False:
+                if rep.get("fatal"):
+                    raise PSError(
+                        f"read replica {ent['name']} rejected pull: "
+                        f"{rep.get('error')}")
+                # stale (beyond bound / unfresh stream) or un-promoted
+                # refusal: a fresher source must answer instead
+                raise _StaleRead(rep.get("error") or "stale")
+            return rep
+
     def push(self, table: str, ids, grads):
+        if self._mode == "read":
+            raise PSError("read-mode PSClient is pull-only")
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32)
         if self._mode == "geo":
@@ -1242,6 +1798,8 @@ class PSClient:
     def push_delta(self, table: str, ids, deltas, sync: bool = True):
         """Raw additive push (server-side push_delta), sharded like
         pull — the primitive UtilBase's collectives build on."""
+        if self._mode == "read":
+            raise PSError("read-mode PSClient is pull-only")
         ids = np.asarray(ids).reshape(-1)
         if ids.size == 0:
             # nothing to add: skip the RPC instead of shipping a
@@ -1430,7 +1988,9 @@ class PSClient:
     def close(self):
         self._stop.set()
         self._beat_stop.set()
-        for s in self._socks + self._beat_socks:
+        rsocks = [] if self._read_sets is None else \
+            [e["sock"] for g in self._read_sets for e in g]
+        for s in self._socks + self._beat_socks + rsocks:
             if s is None:
                 continue
             try:

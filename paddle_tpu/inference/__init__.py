@@ -33,7 +33,7 @@ from ..observability import flight_recorder as _flight
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version",
            "PredictorServer", "GenerationServer", "GenerationStream",
-           "ServeError", "ServerOverloaded",
+           "ServeError", "ServerOverloaded", "UpstreamUnavailable",
            "ServerClosed", "RequestTimeout", "enable_compile_cache"]
 
 
@@ -661,4 +661,5 @@ def create_predictor(config: Config) -> Predictor:
 from .generation_server import (GenerationServer,  # noqa: E402
                                 GenerationStream)
 from .serving import (PredictorServer, RequestTimeout,  # noqa: E402
-                      ServeError, ServerClosed, ServerOverloaded)
+                      ServeError, ServerClosed, ServerOverloaded,
+                      UpstreamUnavailable)

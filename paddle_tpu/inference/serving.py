@@ -27,6 +27,20 @@ batch slot.
 Phase accounting (``stats()``): wall time attributes to queue / pad /
 run / unpad so ``tools/profile_serve.py`` can say WHERE a slow server
 spends its step — the same discipline as ``tools/profile_ps.py``.
+
+PS-backed embedding serving (ISSUE 10): ``ps_client`` + ``ps_tables``
+wire a pull-only parameter-server client into the micro-batcher — an
+input position holding int ids is swapped for freshly pulled embedding
+rows (shape ``ids.shape + (dim,)``) right before the device runs, so a
+wide_deep-style model serves embeddings the TRAINING cluster updated
+seconds ago without any checkpoint round trip.  The pull happens once
+per coalesced batch (the whole point of batching: one fan-out RPC set
+amortized over every rider), its wall time lands in ``stats()["ps_ms"]``
+and the shed/timeout discipline extends to the new failure mode: a
+PS read that fails past the read tier's own fan-out/failover fails
+that batch's requests with typed :class:`UpstreamUnavailable` — the
+server keeps serving, the client backs off exactly like an overload
+shed.
 """
 from __future__ import annotations
 
@@ -43,7 +57,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import trace as _trace
 
 __all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
-           "ServerClosed", "RequestTimeout"]
+           "ServerClosed", "RequestTimeout", "UpstreamUnavailable"]
 
 
 class ServeError(RuntimeError):
@@ -62,6 +76,13 @@ class ServerClosed(ServeError):
 
 class RequestTimeout(ServeError, TimeoutError):
     """The request's deadline passed before its batch executed."""
+
+
+class UpstreamUnavailable(ServeError):
+    """A PS embedding read failed past the read tier's own fan-out and
+    failover (every replica stale/down AND the primary unreachable).
+    The batch's requests fail typed; the server keeps serving — clients
+    treat it like an overload shed and back off."""
 
 
 class _Future:
@@ -141,6 +162,14 @@ class PredictorServer:
     - ``request_timeout_s``: per-request deadline; enforced both while
       queued (stale requests are dropped with :class:`RequestTimeout`
       before wasting a batch slot) and in :meth:`infer`'s wait.
+    - ``ps_client`` / ``ps_tables``: PS-backed embedding inputs —
+      ``ps_tables`` maps an input POSITION (index into the request's
+      array list) to a PS table name; that input must carry int ids and
+      is replaced by pulled rows before the predictor runs (module
+      docstring).  Use a pull-only read-mode
+      :class:`~paddle_tpu.distributed.fleet.ps_service.PSClient` with
+      ``read_replicas`` + ``max_lag`` for replica fan-out with bounded
+      staleness.
     """
 
     def __init__(self, predictor, max_batch: int = 32,
@@ -148,9 +177,22 @@ class PredictorServer:
                  buckets: Optional[Sequence[int]] = None,
                  max_queue: int = 256,
                  request_timeout_s: float = 30.0,
-                 prewarm: bool = True):
+                 prewarm: bool = True,
+                 ps_client=None,
+                 ps_tables: Optional[Dict[int, str]] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if ps_tables and ps_client is None:
+            raise ValueError("ps_tables needs a ps_client")
+        self._ps = ps_client
+        self._ps_tables = dict(ps_tables or {})
+        if self._ps_tables:
+            # the typed errors the read tier surfaces (import kept off
+            # the serving module's import path until PS serving is used)
+            from ..distributed.fleet.ps_service import (PSError,
+                                                        PSUnavailable)
+            self._ps_errors = (PSError, PSUnavailable, OSError,
+                               ConnectionError)
         self._pred = predictor
         self._max_batch = int(max_batch)
         self._max_wait_s = float(max_wait_ms) / 1e3
@@ -169,8 +211,9 @@ class PredictorServer:
         self._stats = {
             "requests": 0, "examples": 0, "batches": 0,
             "padded_examples": 0, "shed_overload": 0, "shed_timeout": 0,
+            "shed_ps": 0,
             "bucket_hits": {b: 0 for b in self._buckets},
-            "queue_ms": 0.0, "pad_ms": 0.0, "run_ms": 0.0,
+            "queue_ms": 0.0, "pad_ms": 0.0, "ps_ms": 0.0, "run_ms": 0.0,
             "unpad_ms": 0.0,
         }
 
@@ -396,6 +439,38 @@ class PredictorServer:
                               if len(parts) > 1 else parts[0])
             t1 = time.monotonic()
 
+            ps_s = 0.0
+            if self._ps_tables:
+                # swap id inputs for freshly pulled embedding rows —
+                # one read fan-out per coalesced batch, amortized over
+                # every rider (and the pad rows, which are copies of a
+                # real row, so their ids are in-domain by construction)
+                try:
+                    for idx in sorted(self._ps_tables):
+                        table = self._ps_tables[idx]
+                        ids = np.ascontiguousarray(padded[idx],
+                                                   np.int64)
+                        pulled = self._ps.pull(table, ids.reshape(-1))
+                        padded[idx] = np.ascontiguousarray(
+                            pulled, np.float32).reshape(
+                                ids.shape + (pulled.shape[-1],))
+                except self._ps_errors as e:
+                    with self._lock:
+                        self._stats["shed_ps"] += len(live)
+                    _monitor.stat_add("serve_shed_ps", len(live))
+                    _flight.record("serve.shed", reason="ps_read",
+                                   err=type(e).__name__,
+                                   requests=len(live))
+                    _flight.maybe_dump("UpstreamUnavailable")
+                    err = UpstreamUnavailable(
+                        f"PS embedding read failed past replica "
+                        f"fan-out and primary failover: {e}")
+                    err.__cause__ = e
+                    for r in live:
+                        r.future.set_exception(err)
+                    return
+                ps_s = time.monotonic() - t1
+
             outs = self._pred.run(padded)
             t2 = time.monotonic()
 
@@ -418,7 +493,8 @@ class PredictorServer:
                     s["bucket_hits"].get(bucket, 0) + 1
                 s["queue_ms"] += queue_s * 1e3
                 s["pad_ms"] += (t1 - t0) * 1e3
-                s["run_ms"] += (t2 - t1) * 1e3
+                s["ps_ms"] += ps_s * 1e3
+                s["run_ms"] += (t2 - t1 - ps_s) * 1e3
                 s["unpad_ms"] += (t3 - t2) * 1e3
             for r, sl in zip(live, slices):
                 r.future.set_result(sl)

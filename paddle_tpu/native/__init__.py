@@ -111,6 +111,11 @@ def ps_core() -> Optional[ctypes.CDLL]:
     lib.pts_entry_import.argtypes = [c.c_void_p, i64p, c.c_int64, i64p,
                                      i64p, c.c_int64]
     lib.pts_import.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
+    lib.pts_stride.restype = c.c_int
+    lib.pts_stride.argtypes = [c.c_void_p]
+    lib.pts_export_full.restype = c.c_int64
+    lib.pts_export_full.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
+    lib.pts_import_full.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_clear.argtypes = [c.c_void_p]
     lib.ps_segsum_inv.argtypes = [i64p, c.c_int64, c.c_int, f32p, f32p]
     lib._pts_ready = True

@@ -481,6 +481,51 @@ int64_t pts_export(void* h, int64_t* ids_out, float* vals_out,
   return n;
 }
 
+// FULL-ROW export/import for REPLICATION snapshots (ISSUE 10).  Unlike
+// pts_export (the disk checkpoint format: values persisted, optimizer
+// state rebuilt — the reference's save semantics), a hot replica of a
+// STATEFUL optimizer (adagrad/adam) must inherit the moments and
+// per-row step counters, or every post-snapshot apply diverges from
+// the primary's trajectory (fresh zero moments take bigger steps).
+// rows_out carries the whole stride per row: [value(dim) | state | step].
+int pts_stride(void* h) { return ((Table*)h)->stride; }
+
+int64_t pts_export_full(void* h, int64_t* ids_out, float* rows_out,
+                        int64_t cap) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    if (ids_out == nullptr && rows_out == nullptr) {
+      n += (int64_t)s.rows_used;
+      continue;
+    }
+    for (auto& sl : s.slots) {
+      if (!(sl.flags & kOccupied) || sl.row < 0) continue;
+      if (n >= cap) return n;
+      if (ids_out) ids_out[n] = sl.id;
+      if (rows_out)
+        std::memcpy(rows_out + (size_t)n * t->stride,
+                    t->row_ptr(s, sl.row), sizeof(float) * t->stride);
+      ++n;
+    }
+  }
+  return n;
+}
+
+void pts_import_full(void* h, const int64_t* ids, int64_t n,
+                     const float* rows) {
+  Table* t = (Table*)h;
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    for (int64_t p : pos) {
+      float* r = t->row_of(sh, t->insert(sh, ids[p]), /*init=*/false);
+      std::memcpy(r, rows + (size_t)p * t->stride,
+                  sizeof(float) * t->stride);
+    }
+  });
+}
+
 // admission-state export, same two-phase contract as pts_export.
 // which=0: admitted ids. which=1: pre-admission sighting counters
 // (ids_out + cnt_out). Null ids_out queries the count.

@@ -108,10 +108,17 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # transition itself is making progress.  elastic.leave is deliberately
 # NOT progress: a worker loss with no reshard following it is exactly
 # the stall worth dumping.
+# ps.replica.attach / ps.promote / ps.geo.push / elastic.promote
+# (ISSUE 10): a failover or a geo catch-up legitimately pauses the
+# data stream while the serving tier reorganises — these events ARE
+# the recovery making progress; ps.replica_error and the client's
+# read_stale_exhausted stay bad kinds (tools/postmortem.py).
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
                              "serve.decode", "serve.admit",
                              "elastic.join", "elastic.reshard",
-                             "elastic.resume"})
+                             "elastic.resume", "elastic.promote",
+                             "ps.replica.attach", "ps.promote",
+                             "ps.geo.push"})
 
 # typed-failure dumps are rate limited per reason (a retry storm must
 # not turn every PSUnavailable into a bundle) and capped per process

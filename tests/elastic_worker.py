@@ -57,11 +57,22 @@ def make_trainer(cfg):
     loader = DataLoader(RegressionSet(), batch_size=cfg["batch_size"],
                         shuffle=True, seed=cfg["loader_seed"],
                         drop_last=True)
+    gfn = grad_fn
+    sleep_s = float(cfg.get("step_sleep_s", 0) or 0)
+    if sleep_s > 0:
+        # paced steps: fault-injection tests need the run to still be
+        # in flight when the fault lands (values are unaffected)
+        import time as _t
+
+        def gfn(params, batch, _g=grad_fn, _s=sleep_s):
+            _t.sleep(_s)
+            return _g(params, batch)
     return ElasticTrainer(
         {"w": np.zeros(DIM, np.float32),
          "b": np.zeros((), np.float32)},
-        grad_fn, loader, ckpt_dir=cfg["ckpt_dir"],
+        gfn, loader, ckpt_dir=cfg["ckpt_dir"],
         optimizer=cfg.get("optimizer", "adam"), lr=cfg.get("lr", 0.05),
+        lr_schedule=cfg.get("lr_schedule"),
         micro_batches=cfg["micro_batches"],
         ckpt_every=cfg["ckpt_every"],
         coordinator=cfg.get("coordinator"),

@@ -426,3 +426,58 @@ def test_chaos_kill_every_k_steps_matches_fault_free_run(tmp_path):
                  for t in json.loads(str(o["transitions"]))]
     assert any(t["world"] == 1 for t in all_trans), \
         "no worker ever trained in a shrunken world"
+
+
+# ---------------------------------------------------------------------------
+# t-indexed lr schedules (ISSUE 10 satellite; PR 9 follow-up (b))
+# ---------------------------------------------------------------------------
+
+def test_lr_schedule_kinds_and_purity():
+    from paddle_tpu.distributed.fleet.dist_step import make_lr_schedule
+    cos = make_lr_schedule("cosine", 0.1, warmup_steps=4,
+                           total_steps=20, min_lr=0.01)
+    # warmup ramp, then cosine down to min_lr, clipped past the end
+    assert cos(1) == np.float32(0.1 * 1 / 4)
+    assert cos(4) == np.float32(0.1)
+    assert cos(20) == np.float32(0.01)
+    assert cos(50) == np.float32(0.01)
+    vals = [cos(t) for t in range(4, 21)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))  # monotone
+    # pure: same t, same f32 bits, every call
+    assert all(cos(t) == cos(t) and cos(t).dtype == np.float32
+               for t in range(1, 25))
+    step = make_lr_schedule("step", 1.0, step_size=3, gamma=0.5)
+    assert [float(step(t)) for t in range(1, 8)] == \
+        [1.0, 1.0, 1.0, 0.5, 0.5, 0.5, 0.25]
+    lin = make_lr_schedule("linear", 1.0, total_steps=10, min_lr=0.0)
+    assert lin(10) == np.float32(0.0)
+    const = make_lr_schedule("constant", 0.3)
+    assert const(999) == np.float32(0.3)
+    with pytest.raises(ValueError, match="total_steps"):
+        make_lr_schedule("cosine", 0.1)
+    with pytest.raises(ValueError, match="kind"):
+        make_lr_schedule("warble", 0.1)
+
+
+def test_lr_schedule_bit_exact_across_reshard_mid_schedule(tmp_path):
+    """THE satellite acceptance: a cosine schedule rides the flat
+    elastic optimizers as a pure function of the global step, so a
+    world-2 run resumed at world-3 MID-SCHEDULE stays bit-identical to
+    an uninterrupted run — lr(t) never depends on who computes it."""
+    sched = {"kind": "cosine", "base_lr": 0.08, "warmup_steps": 2,
+             "total_steps": 10, "min_lr": 0.005}
+    ck = str(tmp_path / "ck")
+    _run_world(ck, 2, 6, lr_schedule=sched)      # pinned at 2, 4, 6
+    coord = ElasticCoordinator(expected_world=3, ckpt_step=6).start()
+    r3, trainers, _ = _run_world(ck, 3, 10, coord=coord,
+                                 lr_schedule=sched)
+    coord.stop()
+    assert trainers[0].transitions[0]["resume_step"] == 6
+    (ref,), reft, _ = _run_world(str(tmp_path / "ref"), 1, 10,
+                                 lr_schedule=sched)
+    for r in r3:
+        assert np.array_equal(r["w"], ref["w"])
+        assert np.array_equal(r["b"], ref["b"])
+    # and a schedule-less run genuinely differs (the schedule was live)
+    (flat,), _, _ = _run_world(str(tmp_path / "flat"), 1, 10)
+    assert not np.array_equal(flat["w"], ref["w"])

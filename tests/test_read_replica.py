@@ -1,0 +1,602 @@
+"""Online serving tier (ISSUE 10): read-replica fan-out with
+bounded-staleness reads.
+
+Covers the tentpole contracts directly:
+
+- bounded pulls (``max_lag``) fan out across read replicas by
+  consistent hash and are served only when the replica is fresh AND
+  within the lag bound — a stale replica answers a typed retryable
+  refusal, never a wrong-but-silent row;
+- a reader pinned to a dead replica rotates WITHOUT a failed read
+  (per-replica health/backoff + ring fall-through + primary fallback);
+- replica catch-up edge cases: attach from an EMPTY snapshot
+  mid-traffic, and a replica restarted after falling arbitrarily far
+  behind re-syncs from a fresh snapshot;
+- THE chaos acceptance: with 2 read replicas serving wide_deep-style
+  pulls, the primary is SIGKILLed mid-traffic — zero failed reads,
+  zero stale-beyond-bound answers, and writes resume after failover
+  bit-equal to the fault-free run.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import (
+    PSClient, PSError, PSServer, _build_ring, _ring_owner_from,
+    _ring_positions)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAST = dict(connect_timeout=2.0, rpc_timeout=1.0, max_retries=6,
+             backoff_base=0.02, rpc_deadline=20.0)
+
+# counting table: sgd lr=1, grad=-1, init_std=0 -> a row's value equals
+# the number of pushes applied to it, so staleness is READABLE in
+# commit-seq units straight off the data
+_COUNT = dict(dim=4, optimizer="sgd", lr=1.0, seed=0, init_std=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _server(replica_of=None, mode="standby", **kw):
+    srv = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                   replica_of=replica_of, replica_mode=mode, **kw)
+    srv.start()
+    return srv, f"127.0.0.1:{srv.port}"
+
+
+def _push_n(cli, n, ids):
+    for _ in range(n):
+        cli.push("emb", ids, np.full((ids.size, 4), -1.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+def test_ring_is_deterministic_and_covers():
+    eps = ["10.0.0.1:7", "10.0.0.2:7", "10.0.0.3:7"]
+    r1, r2 = _build_ring(eps), _build_ring(eps)
+    assert np.array_equal(r1[0], r2[0]) and np.array_equal(r1[1], r2[1])
+    ids = np.arange(10_000, dtype=np.int64)
+    pos = _ring_positions(r1, ids)
+    owners = r1[1][pos]
+    # every replica owns a non-trivial share (vnode balance)
+    counts = np.bincount(owners, minlength=3)
+    assert (counts > 1500).all(), counts
+    # same id -> same owner, every process, every call
+    assert np.array_equal(owners, r1[1][_ring_positions(r1, ids)])
+
+
+def test_ring_removal_moves_only_the_lost_share():
+    eps = ["a:1", "b:2", "c:3"]
+    ring = _build_ring(eps)
+    ids = np.arange(5000, dtype=np.int64)
+    pos = _ring_positions(ring, ids)
+    before = ring[1][pos]
+    # excluding replica 1 must remap ONLY ids it owned (consistent
+    # hashing's point: no global reshuffle on membership change)
+    after = np.asarray([_ring_owner_from(ring, int(p), {1})
+                        for p in pos])
+    moved = before != after
+    assert np.array_equal(moved, before == 1)
+    assert set(np.unique(after)) <= {0, 2}
+
+
+# ---------------------------------------------------------------------------
+# bounded-staleness serving
+# ---------------------------------------------------------------------------
+
+def test_read_replica_serves_bounded_reads():
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read")
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(8, dtype=np.int64)
+        _push_n(w, 5, ids)
+        rd = PSClient([pep], mode="read", max_lag=2,
+                      read_replicas=[rep_ep], **_FAST)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            vals = rd.pull("emb", ids)
+            if np.all(vals == 5.0):
+                break
+            time.sleep(0.05)
+        assert np.all(vals == 5.0), vals
+        assert rd.read_fanout >= 1
+        # the replica tracked the stream watermark
+        st = rd._replica_rpc(0, 0, {"op": "stats"})
+        assert st["role"] == "replica" and not st["promoted"]
+        assert st["watermark"] == st["head"] == 5
+        assert st["read_fresh"] and st["read_lag"] == 0
+        rd.close()
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_read_mode_client_is_pull_only():
+    prim, pep = _server()
+    try:
+        rd = PSClient([pep], mode="read", max_lag=0, **_FAST)
+        ids = np.arange(4, dtype=np.int64)
+        with pytest.raises(PSError, match="pull-only"):
+            rd.push("emb", ids, np.zeros((4, 4), np.float32))
+        with pytest.raises(PSError, match="pull-only"):
+            rd.push_delta("emb", ids, np.zeros((4, 4), np.float32))
+        rd.close()
+    finally:
+        prim.stop()
+
+
+def test_stale_replica_refuses_and_client_falls_through():
+    """A replica whose lag exceeds the bound answers a retryable stale
+    refusal; the client's fan-out falls through to the primary and the
+    read still succeeds — graceful degradation, never a wrong answer."""
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read")
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(8, dtype=np.int64)
+        _push_n(w, 3, ids)
+        time.sleep(0.3)
+        # simulate a lagging stream: the replica knows the head moved
+        # but has not applied that far
+        rep._head += 10
+        rd = PSClient([pep], mode="read", max_lag=2,
+                      read_replicas=[rep_ep], **_FAST)
+        vals = rd.pull("emb", ids)
+        assert np.all(vals == 3.0)
+        assert rd.stale_retries >= 1
+        assert rd.replica_failures == 0   # stale != down
+        # direct probe: the refusal is typed + carries the lag
+        raw = PSClient([rep_ep], **_FAST)
+        from paddle_tpu.distributed.fleet import ps_service as svc
+        s = raw._socks[0]
+        svc._send_msg(s, {"op": "pull", "table": "emb", "ids": ids,
+                          "max_lag": 2})
+        reply = svc._recv_msg(s)
+        assert reply["ok"] is False and reply["retryable"] \
+            and reply["stale"] and reply["lag"] >= 10
+        raw.close()
+        rd.close()
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_plain_pull_still_refused_on_unpromoted_replica():
+    """The PR 3 split-brain guard is UNCHANGED for plain pulls: only a
+    max_lag-carrying bounded read may be served by an un-promoted
+    replica."""
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read")
+    try:
+        assert rep.replica_ready.wait(10.0)
+        cli = PSClient([rep_ep], connect_timeout=1.0, rpc_timeout=0.5,
+                       max_retries=1, backoff_base=0.01,
+                       rpc_deadline=2.0)
+        from paddle_tpu.distributed.fleet.ps_service import PSUnavailable
+        with pytest.raises(PSUnavailable):
+            cli.pull("emb", np.arange(4, dtype=np.int64))
+        cli.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_reader_pinned_to_dead_replica_rotates_without_failed_read():
+    """Satellite: per-replica health — killing the replica that owns a
+    reader's ids must NOT surface a failed read; the fan-out falls to
+    the surviving replica / primary transparently."""
+    prim, pep = _server()
+    r1, ep1 = _server(replica_of=pep, mode="read")
+    r2, ep2 = _server(replica_of=pep, mode="read")
+    try:
+        assert r1.replica_ready.wait(10.0) and r2.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(32, dtype=np.int64)
+        _push_n(w, 4, ids)
+        rd = PSClient([pep], mode="read", max_lag=4,
+                      read_replicas=[f"{ep1}|{ep2}"], **_FAST)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if np.all(rd.pull("emb", ids) == 4.0):
+                break
+            time.sleep(0.05)
+        # the hash ring splits this batch across both replicas
+        assert rd.read_fanout >= 2
+        r1.stop()   # kill one replica its readers are pinned to
+        for _ in range(5):
+            vals = rd.pull("emb", ids)   # must never raise
+            assert np.all(vals == 4.0), vals
+        assert rd.replica_failures >= 1
+        # the down replica is remembered: later pulls skip it entirely
+        fails_before = rd.replica_failures
+        rd.pull("emb", ids)
+        assert rd.replica_failures == fails_before
+        rd.close()
+        w.close()
+    finally:
+        r2.stop()
+        prim.stop()
+
+
+# ---------------------------------------------------------------------------
+# catch-up edge cases (satellite)
+# ---------------------------------------------------------------------------
+
+def test_replica_attaches_from_empty_snapshot_mid_traffic():
+    prim, pep = _server()
+    rep = None
+    try:
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(16, dtype=np.int64)
+        stop = threading.Event()
+        pushed = [0]
+
+        def writer():
+            while not stop.is_set() and pushed[0] < 60:
+                _push_n(w, 1, ids)
+                pushed[0] += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        while pushed[0] < 5:     # traffic is live before the attach
+            time.sleep(0.01)
+        rep, rep_ep = _server(replica_of=pep, mode="read")
+        assert rep.replica_ready.wait(10.0)
+        t.join(20.0)
+        stop.set()
+        final = pushed[0]
+        rd = PSClient([pep], mode="read", max_lag=0,
+                      read_replicas=[rep_ep], **_FAST)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            vals = rd.pull("emb", ids)
+            if np.all(vals == float(final)):
+                break
+            time.sleep(0.05)
+        assert np.all(vals == float(final)), (vals, final)
+        # with max_lag=0 and quiesced writes the replica itself must be
+        # exactly caught up
+        assert rep._watermark == rep._head
+        rd.close()
+        w.close()
+    finally:
+        if rep is not None:
+            rep.stop()
+        prim.stop()
+
+
+def test_midrun_attach_inherits_optimizer_state_bit_exact():
+    """Regression (found by the e2e drive): a replica attaching MID-RUN
+    to a stateful-optimizer table must inherit the per-row moments +
+    step counters through the snapshot — with values-only snapshots its
+    fresh zero moments make every post-snapshot adagrad/adam apply take
+    a bigger step and the replica silently diverges from the primary."""
+    spec = dict(dim=6, optimizer="adagrad", lr=0.1, seed=5)
+    prim = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1")
+    prim.start()
+    pep = f"127.0.0.1:{prim.port}"
+    rep = None
+    try:
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(16, dtype=np.int64)
+        for s in range(5):          # history BEFORE the attach: the
+            w.push("emb", ids,      # moments are non-trivial
+                   np.full((16, 6), 0.03 * (s + 1), np.float32))
+        rep = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1",
+                       replica_of=pep, replica_mode="read")
+        rep.start()
+        assert rep.replica_ready.wait(10.0)
+        for s in range(5):          # post-snapshot stream applies
+            w.push("emb", ids,
+                   np.full((16, 6), 0.05 * (s + 1), np.float32))
+        deadline = time.monotonic() + 10.0
+        while rep._watermark < 10 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        a = prim._tables["emb"].pull(ids)
+        b = rep._tables["emb"].pull(ids)
+        assert np.array_equal(a, b), (
+            "mid-run attach diverged: optimizer state not inherited")
+        w.close()
+    finally:
+        if rep is not None:
+            rep.stop()
+        prim.stop()
+
+
+def test_state_bytes_roundtrip_preserves_optimizer_state():
+    """The snapshot format contract: state_bytes (replication) carries
+    opt_state and continuing training from it stays bit-equal per
+    backend pair (adam cross-backend inherits PR 1's allclose parity);
+    the DISK format stays values-only (reference warm-start
+    semantics)."""
+    spec = dict(dim=6, optimizer="adagrad", lr=0.1, seed=5)
+    ids = np.arange(12, dtype=np.int64)
+    for src_native in (True, False):
+        for dst_native in (True, False):
+            src = SparseTable(use_native=src_native, **spec)
+            for s in range(4):
+                src.push(ids, np.full((12, 6), 0.03 * (s + 1),
+                                      np.float32))
+            dst = SparseTable(use_native=dst_native, **spec)
+            dst.load_state_bytes(src.state_bytes())
+            for s in range(4):
+                g = np.full((12, 6), 0.05 * (s + 1), np.float32)
+                src.push(ids, g)
+                dst.push(ids, g)
+            assert np.array_equal(src.pull(ids), dst.pull(ids)), \
+                (src_native, dst_native)
+    # disk checkpoints keep the values-only reference format
+    t = SparseTable(**spec)
+    t.push(ids, np.ones((12, 6), np.float32))
+    assert "opt_state" not in t._snapshot_arrays()
+    assert "opt_state" in t._snapshot_arrays(full_state=True)
+    # a mismatched-optimizer snapshot is a typed error, not silent
+    # garbage moments
+    other = SparseTable(6, optimizer="adam", lr=0.1, seed=5)
+    with pytest.raises(ValueError, match="opt_state"):
+        other.load_state_bytes(t.state_bytes())
+
+
+def test_replica_restarted_after_falling_arbitrarily_far_behind():
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read")
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(8, dtype=np.int64)
+        _push_n(w, 3, ids)
+        rep.stop()                       # replica dies
+        _push_n(w, 40, ids)              # falls arbitrarily far behind
+        rep2, rep2_ep = _server(replica_of=pep, mode="read")
+        assert rep2.replica_ready.wait(10.0)
+        rd = PSClient([pep], mode="read", max_lag=0,
+                      read_replicas=[rep2_ep], **_FAST)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            vals = rd.pull("emb", ids)
+            if np.all(vals == 43.0):
+                break
+            time.sleep(0.05)
+        assert np.all(vals == 43.0), vals
+        # the fresh snapshot carried the full history, not a re-stream
+        assert rep2._watermark == rep2._head == prim.applied == 43
+        rd.close()
+        w.close()
+        rep2.stop()
+    finally:
+        prim.stop()
+
+
+def test_unfresh_replica_refuses_and_reads_fall_to_primary():
+    """The FRESHNESS half of the bound: a replica that has not heard
+    from the primary within stale_after_s refuses bounded reads even
+    at a generous max_lag — silence means it cannot know how far
+    behind it is.  Deterministic: the primary's watermark heartbeats
+    are configured far apart, so after the last record the replica's
+    freshness window provably expires."""
+    prim, pep = _server(wm_interval_s=30.0)
+    rep, rep_ep = _server(replica_of=pep, mode="read",
+                          stale_after_s=0.2)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(8, dtype=np.int64)
+        _push_n(w, 2, ids)
+        deadline = time.monotonic() + 5.0
+        while rep._watermark < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rep._watermark == 2
+        time.sleep(0.4)          # freshness expired; no wm coming
+        lag, fresh = rep._read_lag()
+        assert not fresh
+        rd = PSClient([pep], mode="read", max_lag=10,
+                      read_replicas=[rep_ep], **_FAST)
+        vals = rd.pull("emb", ids)          # falls to the primary
+        assert np.all(vals == 2.0)
+        assert rd.stale_retries >= 1
+        assert rd.replica_failures == 0
+        rd.close()
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_delayed_replica_link_never_fails_reads():
+    """Chaos on the replica link (every streamed record delayed):
+    bounded reads keep succeeding and never trail the acked writes by
+    more than the one record in flight — the documented time+seq
+    contract under a slow link."""
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read")
+    try:
+        assert rep.replica_ready.wait(10.0)
+        chaos.install(chaos.plan_from_spec(
+            "seed=1;delay:push:first=1:every=1:times=0:arg=0.05"))
+        w = PSClient([pep], **_FAST)
+        rd = PSClient([pep], mode="read", max_lag=1, **dict(
+            _FAST, read_replicas=[rep_ep]))
+        ids = np.arange(8, dtype=np.int64)
+        for step in range(1, 11):
+            _push_n(w, 1, ids)               # writer is serial + sync,
+            vals = rd.pull("emb", ids)       # so at most ONE record is
+            assert float(vals.min()) >= step - 1, (step, vals)  # in flight
+            assert float(vals.max()) <= step
+        chaos.uninstall()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            vals = rd.pull("emb", ids)
+            if np.all(vals == 10.0):
+                break
+            time.sleep(0.05)
+        assert np.all(vals == 10.0), vals    # converged after quiesce
+        rd.close()
+        w.close()
+    finally:
+        chaos.uninstall()
+        rep.stop()
+        prim.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance: SIGKILL the primary mid-read-traffic
+# ---------------------------------------------------------------------------
+
+_SERVER_PROC_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+tables = {n: SparseTable(**kw) for n, kw in cfg["tables"].items()}
+srv = PSServer(tables, host="127.0.0.1",
+               replica_of=cfg.get("replica_of"),
+               replica_mode=cfg.get("replica_mode", "standby"))
+srv.start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+
+def _spawn_server(replica_of=None, replica_mode="standby"):
+    cfg = {"tables": {"emb": _COUNT}, "replica_of": replica_of,
+           "replica_mode": replica_mode}
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_PROC_SRC, _REPO, json.dumps(cfg)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    info = json.loads(proc.stdout.readline())
+    return proc, f"127.0.0.1:{info['port']}"
+
+
+def test_sigkill_primary_mid_read_traffic_acceptance():
+    """ISSUE 10 chaos acceptance: N=2 read replicas serve wide_deep
+    style bounded pulls while a writer trains; the primary is SIGKILLed
+    mid-traffic.  Asserts zero failed reads, zero stale-beyond-bound
+    answers (each row's value is checked against the acked-write
+    history and the lag bound), and the post-failover final state
+    bit-equal to a fault-free run."""
+    stale_after = 1.0
+    max_lag = 4
+    steps, kill_at = 30, 12
+    ids = np.arange(32, dtype=np.int64)
+
+    # fault-free reference
+    ref_proc, ref_ep = _spawn_server()
+    try:
+        wref = PSClient([ref_ep], **_FAST)
+        _push_n(wref, steps, ids)
+        ref_final = wref.pull("emb", ids).copy()
+        wref.close()
+    finally:
+        ref_proc.kill()
+        ref_proc.wait(timeout=10)
+
+    prim_proc, prim_ep = _spawn_server()
+    stby = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                    replica_of=prim_ep)
+    stby.start()
+    group = f"{prim_ep}|127.0.0.1:{stby.port}"
+    reps = [PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                     replica_of=group, replica_mode="read",
+                     stale_after_s=stale_after) for _ in range(2)]
+    for r in reps:
+        r.start()
+    try:
+        assert stby.replica_ready.wait(15.0)
+        for r in reps:
+            assert r.replica_ready.wait(15.0)
+        # acked-write history: (monotonic ts, acked count)
+        acked: list = [(time.monotonic(), 0)]
+        read_errors: list = []
+        violations: list = []
+        stop = threading.Event()
+
+        def reader(idx):
+            rd = PSClient([group], mode="read", max_lag=max_lag,
+                          read_replicas=[
+                              "|".join(f"127.0.0.1:{r.port}"
+                                       for r in reps)], **_FAST)
+            try:
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        vals = rd.pull("emb", ids)
+                    except Exception as e:      # noqa: BLE001
+                        read_errors.append((idx, repr(e)))
+                        return
+                    # bound check: every row >= what was acked
+                    # stale_after ago minus the lag bound (commit-seq
+                    # units == row value by construction)
+                    a_old = 0
+                    for ts, cnt in acked:
+                        if ts <= t0 - stale_after:
+                            a_old = cnt
+                    vmin = float(vals.min())
+                    if vmin < a_old - max_lag:
+                        violations.append((idx, vmin, a_old))
+                    time.sleep(0.002)
+            finally:
+                rd.close()
+
+        readers = [threading.Thread(target=reader, args=(i,),
+                                    daemon=True) for i in range(2)]
+        for t in readers:
+            t.start()
+        w = PSClient([group], **_FAST)
+        for step in range(steps):
+            w.push("emb", ids, np.full((32, 4), -1.0, np.float32))
+            acked.append((time.monotonic(), step + 1))
+            time.sleep(0.005)
+            if step == kill_at:
+                os.kill(prim_proc.pid, signal.SIGKILL)
+                prim_proc.wait(timeout=10)
+        # read replicas re-attach to the promoted standby and converge
+        deadline = time.monotonic() + 15.0
+        caught_up = False
+        while time.monotonic() < deadline and not caught_up:
+            caught_up = all(r._watermark == steps for r in reps)
+            time.sleep(0.1)
+        time.sleep(3 * 0.002 + 0.1)   # let readers observe final state
+        stop.set()
+        for t in readers:
+            t.join(10.0)
+        assert not read_errors, read_errors       # ZERO failed reads
+        assert not violations, violations[:5]     # ZERO beyond-bound
+        assert stby.promoted
+        got = w.pull("emb", ids).copy()
+        assert np.array_equal(got, ref_final), (
+            "post-failover writes diverged from the fault-free run")
+        assert np.all(got == float(steps))
+        assert caught_up, [
+            (r._watermark, r._head) for r in reps]
+        w.close()
+    finally:
+        prim_proc.kill()
+        prim_proc.wait(timeout=10)
+        for r in reps:
+            r.stop()
+        stby.stop()

@@ -50,10 +50,13 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 # order (first divergence first)
 # elastic.leave (ISSUE 9): a worker leaving the membership — crash or
 # graceful — is the first event of every elastic incident, so a bundle
-# containing one sorts to the front of the report
+# containing one sorts to the front of the report.
+# ps.read_stale_exhausted (ISSUE 10): a bounded-staleness read found
+# NOTHING within the bound — every replica stale/down AND the primary
+# unreachable — the serving tier's defining incident
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
               "ps.replica_error", "serve.shed", "serve.evict",
-              "elastic.leave"}
+              "elastic.leave", "ps.read_stale_exhausted"}
 
 
 def _is_bad(ev: dict) -> bool:

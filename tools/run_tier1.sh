@@ -73,11 +73,17 @@ if [ "$CHAOS" -eq 1 ]; then
     # SIGKILL-every-K workers under the elastic launcher, lease
     # eviction, join/leave reforms — all proven bit-equal to the
     # fault-free run.
+    # test_read_replica.py / test_geo.py / test_coordinator_ha.py /
+    # test_serving_ps.py are the ONLINE SERVING TIER suite (ISSUE 10):
+    # primary SIGKILL under live read traffic, lossy/delayed replica
+    # and geo links, coordinator failover — all seeded + deterministic.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
         tests/test_crash_mid_save.py tests/test_train_guard.py \
-        tests/test_elastic.py \
+        tests/test_elastic.py tests/test_read_replica.py \
+        tests/test_geo.py tests/test_coordinator_ha.py \
+        tests/test_serving_ps.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
