@@ -1574,12 +1574,194 @@ def _bench_llama_serve(smoke, peak_tflops):
     }
 
 
+def _bench_llama_gateway(smoke, peak_tflops):
+    """Inference gateway A/B (ISSUE 11 tentpole): a shared-system-
+    prompt chat workload — 8 streams whose prompts share a 75% prefix
+    (24-token system prompt + 8-token unique tail), two waves so the
+    prefix cache serves warm traffic — through three arms on the SAME
+    target model:
+
+    - ``plain``   — the PR 8 ``llama_serve`` server (B=1 prefill, no
+      sharing, no speculation): the baseline;
+    - ``prefix``  — copy-on-write prefix sharing + batched prefill;
+    - ``gateway`` — prefix + speculative decoding with a 1-layer
+      draft sharing the target's embeddings/head/first layer.
+
+    Honest decomposition: prefix-vs-plain isolates the prefill-
+    compute/TTFT win; gateway-vs-prefix isolates the speculation win
+    AT THE MEASURED ACCEPT RATE.  The proxy pair is constructed for
+    the trained-model regime (the draft must approximate the target
+    for speculation to pay): decoder-layer weights are damped so the
+    residual stream is embedding-dominated, giving a measured accept
+    rate instead of the ~0 a pair of independent random nets shows.
+    Prefix/gateway arm outputs are asserted bit-identical (cold ==
+    warm == speculated) and every arm must run ZERO steady-state
+    compiles.  Budget: honored by the parent driver's trial/timeout
+    machinery (this metric is in ``_TUNNEL_TRIALS``).
+
+    REGIME NOTE (same class as round 12's batching factor): on a
+    1-core CPU every FLOP is serial, so a verify forward costs ~S x a
+    decode forward and the draft's dispatches are not hidden — wall-
+    clock speculation speedup here is bounded near 1.0 no matter the
+    accept rate.  The quantity that transfers to accelerators is
+    ``target_iteration_speedup`` (plain decode steps / verify steps):
+    batch-1/short-S decode underutilizes the MXU, so verifying k+1
+    positions rides compute the TPU was wasting.  Both numbers are
+    reported; PERF.md round 14 carries the full caveat.
+
+    Env knobs: BENCH_GATEWAY_STREAMS, BENCH_GATEWAY_NEW.
+    """
+    import time as _time
+
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import GenerationServer
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+    reduced = smoke or jax.default_backend() != "tpu"
+    n_streams = int(os.environ.get("BENCH_GATEWAY_STREAMS", "8"))
+    max_new = int(os.environ.get("BENCH_GATEWAY_NEW",
+                                 "24" if reduced else "64"))
+    paddle.seed(0)
+    if reduced:
+        cfg = llama_tiny(vocab_size=256, hidden_size=128,
+                         intermediate_size=256, num_hidden_layers=4,
+                         num_attention_heads=8, num_key_value_heads=4,
+                         max_position_embeddings=256)
+    else:
+        cfg = llama_tiny(vocab_size=32000, hidden_size=1024,
+                         intermediate_size=2816, num_hidden_layers=8,
+                         num_attention_heads=16, num_key_value_heads=8,
+                         max_position_embeddings=1024)
+    import dataclasses
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    # damp decoder layers: embedding-dominated residual stream = the
+    # regime where a truncated draft approximates the target (see
+    # docstring) — applied to the TARGET, so every arm shares it
+    for name, p in model.state_dict().items():
+        if ".layers." in name and "layernorm" not in name:
+            p._value = p._value * 0.15
+    draft = LlamaForCausalLM(dataclasses.replace(
+        cfg, num_hidden_layers=1))
+    draft.eval()
+    sd_t = dict(model.state_dict())
+    for name, p in draft.state_dict().items():
+        if name in sd_t:
+            p._value = sd_t[name]._value
+
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, cfg.vocab_size, (24,)).astype("int32")
+    prompts = [np.concatenate([
+        shared, rng.randint(1, cfg.vocab_size, (8,)).astype("int32")])
+        for _ in range(n_streams)]
+    max_len = 32 + max_new
+    bs = 8 if reduced else 16
+
+    def run_wave(server):
+        t0 = _time.perf_counter()
+        marks = []
+        streams = []
+        for p in prompts:
+            ts = _time.perf_counter()
+            st = server.submit(p, max_new_tokens=max_new)
+            streams.append((ts, st))
+        outs = []
+        for ts, st in streams:
+            it = iter(st)
+            next(it)
+            marks.append((_time.perf_counter() - ts) * 1e3)
+            outs.append([st.tokens[0]] + list(it))
+        return _time.perf_counter() - t0, marks, outs
+
+    def run_arm(**kw):
+        srv = GenerationServer(model, num_slots=n_streams,
+                               block_size=bs, max_model_len=max_len,
+                               request_timeout_s=600.0, **kw)
+        srv.start()
+        n_warm = srv.num_compiles()
+        w1, ttft1, out1 = run_wave(srv)        # cold
+        w2, ttft2, out2 = run_wave(srv)        # warm (prefix hits)
+        st = srv.stats()
+        srv.stop()
+        assert srv.num_compiles() == n_warm, \
+            "gateway traffic compiled — prewarm is broken"
+        total = 2 * n_streams * max_new
+        return {"tok_s": total / (w1 + w2), "wall_cold": w1,
+                "wall_warm": w2, "ttft_cold": ttft1,
+                "ttft_warm": ttft2, "out_cold": out1,
+                "out_warm": out2, "stats": st}
+
+    plain = run_arm(max_prefill_batch=1)
+    prefix = run_arm(prefix_cache=True, max_prefill_batch=4)
+    gateway = run_arm(prefix_cache=True, max_prefill_batch=4,
+                      draft_model=draft, spec_k=3)
+    # bit-exactness inside the chunked-prefill family: cold == warm,
+    # and speculation changes NOTHING but speed
+    assert prefix["out_cold"] == prefix["out_warm"]
+    assert gateway["out_cold"] == prefix["out_cold"]
+    assert gateway["out_warm"] == prefix["out_warm"]
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q))
+
+    gst, pst = gateway["stats"], prefix["stats"]
+    return {
+        "metric": "llama_gateway_tokens_per_s",
+        "value": round(gateway["tok_s"], 2),
+        "unit": "aggregate_new_tokens/sec",
+        "vs_baseline": None,
+        "plain_tok_s": round(plain["tok_s"], 2),
+        "prefix_tok_s": round(prefix["tok_s"], 2),
+        "gateway_speedup_vs_plain": round(
+            gateway["tok_s"] / plain["tok_s"], 3),
+        "prefix_speedup_vs_plain": round(
+            prefix["tok_s"] / plain["tok_s"], 3),
+        "spec_speedup_vs_prefix": round(
+            gateway["tok_s"] / prefix["tok_s"], 3),
+        "ttft_ms_plain_p50": round(pct(plain["ttft_cold"]
+                                       + plain["ttft_warm"], 50), 2),
+        "ttft_ms_plain_p99": round(pct(plain["ttft_cold"]
+                                       + plain["ttft_warm"], 99), 2),
+        "ttft_ms_warm_p50": round(pct(prefix["ttft_warm"], 50), 2),
+        "ttft_ms_warm_p99": round(pct(prefix["ttft_warm"], 99), 2),
+        "prefix_hit_rate": round(pst["prefix_hit_rate"], 3),
+        "prefill_tokens_skipped": pst["prefill_tokens_skipped"],
+        "prefill_tokens_computed": pst["prefill_tokens"],
+        "prefill_batches": pst["prefill_batches"],
+        "spec_accept_rate": round(gst["spec_accept_rate"], 3),
+        "spec_verify_steps": gst["spec_verify_steps"],
+        "plain_decode_steps": plain["stats"]["decode_steps"],
+        # target-model iterations per emitted token: the accelerator-
+        # transferable speculation win (see docstring regime note)
+        "target_iteration_speedup": round(
+            plain["stats"]["decode_steps"]
+            / max(gst["spec_verify_steps"], 1), 3),
+        "decode_ms_per_tok_plain": round(
+            plain["stats"]["decode_ms"]
+            / max(plain["stats"]["tokens_generated"]
+                  - plain["stats"]["admitted"], 1), 3),
+        "decode_ms_per_tok_gateway": round(
+            gst["decode_ms"]
+            / max(gst["tokens_generated"] - gst["admitted"], 1), 3),
+        "cow_forks": gst["cow_forks"],
+        "streams": n_streams, "max_new_tokens": max_new,
+        "shared_prefix_tokens": 24, "prompt_len": 32,
+        "num_compiles_gateway": gst["num_compiles"],
+        "traffic_compiles": gst["traffic_compiles"],
+        "host_backend": jax.default_backend(),
+    }
+
+
 # Tunnel-sensitive metrics re-run in N fresh subprocesses (fresh backend
 # each — the r4 artifacts showed a 1.8x spread between single-trial runs
 # of identical code); the reported object is the median-by-value trial,
 # annotated with every trial's value and the spread.
 _TUNNEL_TRIALS = {"wide_deep": 3, "infer": 3, "serve": 3,
-                  "llama_serve": 3, "ps_read": 3}
+                  "llama_serve": 3, "llama_gateway": 3, "ps_read": 3}
 
 
 def _flatten(out):
@@ -1665,7 +1847,7 @@ def main():
         _main()
         return
     default = ("resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,"
-               "serve,llama_serve")
+               "serve,llama_serve,llama_gateway")
     known = set(default.split(",")) | {"ps_scaling", "ps_read"}
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")
@@ -1791,7 +1973,7 @@ def _main():
         jax.config.update("jax_platforms", "cpu")
     peak, peak_src = _detect_peak_tflops()
     default = ("resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,"
-               "serve,llama_serve")
+               "serve,llama_serve,llama_gateway")
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")]
     which = [w for w in which if w] or default.split(",")
@@ -1815,6 +1997,8 @@ def _main():
         results.extend(_bench_serve(smoke, peak))
     if "llama_serve" in which:
         results.append(_bench_llama_serve(smoke, peak))
+    if "llama_gateway" in which:
+        results.append(_bench_llama_gateway(smoke, peak))
     if "ps_scaling" in which:
         results.append(_bench_ps_scaling(smoke, peak))
     if "ps_read" in which:

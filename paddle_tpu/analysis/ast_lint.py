@@ -74,6 +74,7 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/distributed/fleet/heter.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/inference/generation_server.py",
+    "paddle_tpu/inference/prefix_cache.py",
     "paddle_tpu/inference/__init__.py",
     "paddle_tpu/observability/trace.py",
     "paddle_tpu/observability/timeline.py",

@@ -1056,6 +1056,15 @@ class ElasticTrainer:
             done = step + 1
             if done % self._ckpt_every == 0 or done == total:
                 self._checkpoint_round(gen, step, rank, world, done)
+        # completion fence: rank 0's final _report_ckpt is an RPC that
+        # runs AFTER the last ckpt barrier — without this barrier a
+        # faster peer's leave() reforms the generation under that RPC
+        # and rolls rank 0 into a spurious world-1 generation at the
+        # finish line (observed as a completion-window flake).  Every
+        # member of this generation reaches the fence (a rejoiner that
+        # restored the final checkpoint runs zero steps and lands here
+        # too), so nobody leaves before the report is durable.
+        self._exchange(gen, total, "done", {})
         self._client.leave()
         return self.params()
 

@@ -481,6 +481,80 @@ class HeartBeatMonitor:
                     self.cond.notify_all()
 
 
+class _ReadCoalescer:
+    """Replica-side pull coalescing (ISSUE 11 satellite; PR 10
+    follow-up).  Concurrent pulls arriving within ``window_s`` merge
+    into ONE table gather over the union of their ids; each reader's
+    rows are sliced back out of the union result, bit-equal to an
+    uncoalesced pull of the same snapshot (a gather of a gather is the
+    same gather).
+
+    The first arriving reader becomes the LEADER: it sleeps the
+    window, drains the pending set, executes one ``pull(unique_ids)``
+    per table, and scatters rows to every rider via
+    ``searchsorted(unique_ids, ids)`` (np.unique returns sorted ids,
+    so the mapping is exact, duplicates included).  Riders block on an
+    event.  A failed gather propagates the SAME exception to every
+    rider — nobody hangs.
+
+    ``_lock`` only guards the pending list (append/drain); the gather
+    itself runs outside it, and no other ps_service lock is taken
+    while holding it — the coalescer lock is a leaf.
+    """
+
+    def __init__(self, table_fn, window_s: float):
+        self._table_fn = table_fn
+        self._window = float(window_s)
+        self._lock = threading.Lock()
+        self._pending: List[dict] = []
+        self._leading = False
+
+    def pull(self, table: str, ids):
+        req = {"table": table, "ids": ids,
+               "ev": threading.Event(), "vals": None, "err": None}
+        with self._lock:
+            self._pending.append(req)
+            lead = not self._leading
+            if lead:
+                self._leading = True
+        if not lead:
+            req["ev"].wait()
+            if req["err"] is not None:
+                raise req["err"]
+            return req["vals"]
+        time.sleep(self._window)
+        with self._lock:
+            batch, self._pending = self._pending, []
+            self._leading = False
+        self._execute(batch)
+        if req["err"] is not None:
+            raise req["err"]
+        return req["vals"]
+
+    def _execute(self, batch: List[dict]):
+        groups: Dict[str, List[dict]] = {}
+        for r in batch:
+            groups.setdefault(r["table"], []).append(r)
+        for name, reqs in groups.items():
+            try:
+                t = self._table_fn(name)
+                flat = [np.asarray(r["ids"]).reshape(-1) for r in reqs]
+                uniq = np.unique(np.concatenate(flat))
+                rows = t.pull(uniq)
+                for r, ids in zip(reqs, flat):
+                    r["vals"] = rows[np.searchsorted(uniq, ids)]
+            except Exception as e:   # propagate, never strand a rider
+                for r in reqs:
+                    r["err"] = e
+            finally:
+                for r in reqs:
+                    r["ev"].set()
+        _monitor.stat_add("ps_read_coalesce_batches", len(groups))
+        _monitor.stat_add("ps_read_coalesced_pulls", len(batch))
+        if _monitor.metrics_enabled():
+            _monitor.hist_observe("ps_read_coalesce_size", len(batch))
+
+
 class PSServer:
     """Serves SparseTable pull/push (parity: brpc_ps_server.cc).
 
@@ -513,7 +587,8 @@ class PSServer:
                  serve_reads: bool = True,
                  stale_after_s: float = 2.0,
                  wm_interval_s: float = 0.25,
-                 sink_queue: int = 8192):
+                 sink_queue: int = 8192,
+                 read_coalesce_ms: float = 0.0):
         if on_dead not in ("evict", "fail"):
             raise ValueError(f"on_dead must be 'evict' or 'fail', "
                              f"got {on_dead!r}")
@@ -577,6 +652,14 @@ class PSServer:
         # commit listeners (geo tier): fn(op, table, ids) called under
         # the apply lock after each committed mutation — keep them FAST
         self._commit_listeners: List = []
+        # replica-side read coalescing (ISSUE 11 satellite, PR 10
+        # follow-up): concurrent pulls landing within the window merge
+        # into ONE gather over the union of their ids; off by default
+        # (it trades up to window_ms latency for gather amortization —
+        # a read replica under fan-out load opts in)
+        self._coalescer = (_ReadCoalescer(self._table,
+                                          read_coalesce_ms / 1e3)
+                           if read_coalesce_ms > 0 else None)
         if replica_of is None:
             self.replica_ready.set()
 
@@ -685,6 +768,9 @@ class PSServer:
                                          "replica stream is not fresh"}
                         if stale is not None:
                             _send_msg(conn, stale)
+                        elif self._coalescer is not None:
+                            _send_msg(conn, {"vals": self._coalescer.pull(
+                                msg["table"], msg["ids"])})
                         else:
                             t = self._table(msg["table"])
                             _send_msg(conn, {"vals": t.pull(msg["ids"])})

@@ -33,8 +33,9 @@ from ..observability import flight_recorder as _flight
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "get_version",
            "PredictorServer", "GenerationServer", "GenerationStream",
-           "ServeError", "ServerOverloaded", "UpstreamUnavailable",
-           "ServerClosed", "RequestTimeout", "enable_compile_cache"]
+           "PrefixCache", "ServeError", "ServerOverloaded",
+           "UpstreamUnavailable", "ServerClosed", "RequestTimeout",
+           "enable_compile_cache"]
 
 
 def get_version() -> str:
@@ -660,6 +661,7 @@ def create_predictor(config: Config) -> Predictor:
 
 from .generation_server import (GenerationServer,  # noqa: E402
                                 GenerationStream)
+from .prefix_cache import PrefixCache  # noqa: E402
 from .serving import (PredictorServer, RequestTimeout,  # noqa: E402
                       ServeError, ServerClosed, ServerOverloaded,
                       UpstreamUnavailable)

@@ -1,6 +1,7 @@
-"""paddle_tpu.inference.generation_server — continuous-batching LLM
-serving: block-paged KV cache + iteration-level decode scheduler
-(ISSUE 8 tentpole; ROADMAP item 1).
+"""paddle_tpu.inference.generation_server — the inference gateway:
+continuous-batching LLM serving with copy-on-write prefix sharing,
+batched prefill, and speculative decoding (ISSUE 8 engine, grown by
+ISSUE 11; ROADMAP item 4).
 
 ``PredictorServer`` micro-batches FIXED-shape requests; generative
 decoding is the other regime: every sequence advances one token per
@@ -18,10 +19,46 @@ same AOT discipline as the rest of ``inference/``:
   masked writes (prompt padding, idle decode slots), never read (the
   slot <= position mask).  Thousands of conversations share one HBM
   budget and freeing is O(blocks), not O(bytes).
+- **copy-on-write prefix sharing** (``prefix_cache=True``) — a
+  content-hash chain index over the pools (``prefix_cache.py``) maps
+  full blocks of token prefix to physical blocks; a new prompt's
+  cached prefix blocks are ALIASED (refcounted) instead of re-
+  prefilled, so a shared system prompt is ONE set of physical blocks
+  across every conversation and prefill only processes the uncached
+  suffix.  A write into a shared block (refcount > 1, which includes
+  the index's own reference) forks it first: allocate, device-copy,
+  remap the block table — the trash-block and slot<=position
+  invariants are untouched because tables only ever remap.  Prefill
+  on a prefix-sharing server runs the CHUNKED program (the cache-
+  gather attention path) for cold prompts too, so cold and warm runs
+  of the same stream are bit-identical (the flash prefill path is a
+  different floating-point formulation — measured ~1e-4 apart on this
+  container — so it stays reserved for prefix_cache=False servers).
+- **batched prefill** — prefill compiles one program per (power-of-2
+  prompt bucket, power-of-2 batch <= ``max_prefill_batch``) shape, so
+  a burst of short prompts costs ONE dispatch instead of B; padding
+  rows write only to the trash block.  Verified bit-equal to B=1
+  prefill row-for-row (same program family, row-independent math).
+- **speculative decoding** (``draft_model=``) — a draft model rides
+  the same block tables with its own (smaller) pools; each iteration
+  it proposes up to ``spec_k`` tokens autoregressively, and the
+  target model scores all of them in ONE verify forward (an S=k+1
+  block through the cache-gather attention — bit-identical per
+  position to S=1 decode, measured).  Deterministic positional-
+  stream acceptance keeps the output BIT-IDENTICAL to plain decode:
+  the verify program samples the target's own token at every
+  position with the same ``fold_in(request_key, position)`` stream
+  plain decode uses, a proposal is accepted iff it EQUALS that
+  token, and the first mismatch simply emits the target's token (the
+  classical stochastic accept/resample of Leviathan et al. trades
+  that bit-identity for a higher accept rate; this repo's replay and
+  eviction contracts are built on bit-identity, so determinism
+  wins).  Rejected tokens' pool writes are invisible by
+  construction: slot index == position, the next write at that
+  position lands first, and slot <= position masks the rest.
 - **iteration-level scheduling** — admission/eviction decisions happen
   every decode step, not per request: finished sequences free their
   blocks immediately and waiting requests are admitted mid-flight.
-  PREFILL compiles one program per power-of-2 prompt bucket (B=1);
   DECODE is ONE fixed-shape program over all ``num_slots`` batch slots
   regardless of how many are live — steady state never retraces
   (``num_compiles()`` is the proof, same contract as ``Predictor``).
@@ -33,17 +70,14 @@ same AOT discipline as the rest of ``inference/``:
   lowest-priority sequence is evicted (blocks freed, back to the
   waiting queue) and later re-admitted.
 - **bit-identical re-admission** — re-admission re-runs the ORIGINAL
-  prompt through the same prefill program (same bucket, same inputs =>
-  identical K/V and logits), then replays the already-emitted tokens
-  through the normal decode program with the sampled token overridden
-  by the stored one.  Because every decode slot's math depends only on
-  its own inputs (no cross-slot reduction), each replayed step is the
-  exact computation the uninterrupted run performed, so the resumed
-  stream is bit-identical — including sampling: the RNG key for token
-  j is ``fold_in(request_key, j-1)``, a pure function of the stream
-  position, so the RNG stream position survives eviction by
-  construction.  (A plain re-prefill over prompt+suffix would NOT be
-  bit-identical: prefill and decode use different attention kernels.)
+  prompt through the same prefill family (same bucket, same inputs =>
+  identical K/V and logits; with prefix sharing the cached prefix is
+  aliased back and only the suffix recomputes), then replays the
+  already-emitted tokens through the normal decode/verify program with
+  the sampled token overridden by the stored one.  The RNG key for
+  token j is ``fold_in(request_key, j-1)``, a pure function of the
+  stream position, so the RNG stream position survives eviction by
+  construction.
 - **streaming responses** — :meth:`GenerationServer.submit` returns a
   :class:`GenerationStream` immediately; tokens arrive on it as each
   decode step completes (iterate it, or ``result()`` to block for the
@@ -51,9 +85,13 @@ same AOT discipline as the rest of ``inference/``:
 
 Observability rides the existing seams: serve histograms
 (``decode_step_ms`` / ``prefill_ms`` / ``serve_ttft_ms``), counters
-and gauges in the StatRegistry, and flight-recorder events
-(``serve.admit`` / ``serve.evict`` / ``serve.stream_end`` +
-sampled ``serve.decode``) so ``tools/postmortem.py`` can autopsy a
+and gauges in the StatRegistry (always-on ``serve_prefix_hits`` /
+``serve_cow_forks`` / ``serve_spec_proposed`` / ``serve_spec_accepted``
+counters; ``serve_prefix_hit_rate`` / ``serve_spec_accept_rate``
+gauges on the /metrics endpoint), and flight-recorder events
+(``serve.admit`` / ``serve.evict`` / ``serve.stream_end`` /
+``serve.prefix_hit`` / ``serve.cow_fork`` + sampled ``serve.decode``
+and ``serve.spec_verify``) so ``tools/postmortem.py`` can autopsy a
 pool-exhaustion shed.
 """
 from __future__ import annotations
@@ -67,6 +105,7 @@ import numpy as np
 
 from ..framework import monitor as _monitor
 from ..observability import flight_recorder as _flight
+from .prefix_cache import PrefixCache
 from .serving import (RequestTimeout, ServeError, ServerClosed,
                       ServerOverloaded)
 
@@ -75,7 +114,8 @@ __all__ = ["GenerationServer", "GenerationStream", "ServeError",
 
 # one serve.decode ring event per this many decode steps: the ring is
 # postmortem context, not a per-token log (progress() still ticks the
-# stall watchdog every step)
+# stall watchdog every step).  serve.spec_verify samples on the same
+# cadence, offset so the FIRST verify step is always recorded.
 _FLIGHT_DECODE_EVERY = 32
 
 _END = object()
@@ -151,7 +191,7 @@ class _GenSeq:
         "rid", "prompt", "L", "max_new", "eos", "do_sample", "temp",
         "top_k", "top_p", "key_data", "priority", "arrival", "deadline",
         "stream", "generated", "decoded", "blocks", "slot", "evictions",
-        "t_submit", "t_first_tok")
+        "t_submit", "t_first_tok", "cached", "draft_decoded")
 
     def __init__(self, rid, prompt, max_new, eos, do_sample, temp,
                  top_k, top_p, key_data, priority, arrival, deadline):
@@ -176,6 +216,8 @@ class _GenSeq:
         self.evictions = 0
         self.t_submit = time.monotonic()
         self.t_first_tok: Optional[float] = None
+        self.cached = 0           # prefix tokens aliased at admission
+        self.draft_decoded = 0    # generated tokens the draft consumed
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -188,13 +230,15 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
 
 
 class GenerationServer:
-    """Continuous-batching generative server over a KV-cache-capable
+    """Continuous-batching generative gateway over a KV-cache-capable
     causal LM (``supports_kv_cache()`` / ``forward_paged``).
 
     Usage::
 
         server = GenerationServer(model, num_slots=8, block_size=16,
-                                  num_blocks=256, max_model_len=512)
+                                  num_blocks=256, max_model_len=512,
+                                  prefix_cache=True,
+                                  draft_model=small_lm, spec_k=4)
         server.start()                    # prewarms every program
         stream = server.submit(prompt_ids, max_new_tokens=64)
         for tok in stream:                # tokens stream per step
@@ -204,7 +248,8 @@ class GenerationServer:
     Knobs:
 
     - ``num_slots``: decode batch width — the ONE fixed-shape decode
-      program runs over this many slots every step, live or idle.
+      (or spec-verify) program runs over this many slots every step,
+      live or idle.
     - ``block_size`` / ``num_blocks``: KV pool geometry.  Block 0 is
       the trash block, so ``num_blocks - 1`` blocks are allocatable;
       default ``num_blocks`` sizes the pool for ``num_slots``
@@ -212,8 +257,22 @@ class GenerationServer:
       deliberately to exercise eviction).
     - ``max_model_len``: prompt + generation cap per sequence; fixes
       the block-table width ``ceil(max_model_len / block_size)``.
-    - ``prompt_buckets``: prefill compiles one program per bucket
-      (default: powers of two up to ``max_model_len``).
+    - ``prompt_buckets``: prefill compiles one program per (bucket,
+      batch) pair (default buckets: powers of two up to
+      ``max_model_len``).
+    - ``max_prefill_batch``: widest batched-prefill program (powers of
+      two up to this; 1 restores the ISSUE 8 one-prompt-per-dispatch
+      behavior).
+    - ``prefix_cache``: enable copy-on-write prefix sharing.  Changes
+      pool accounting semantics: finished conversations' full blocks
+      stay cached (recyclable under pressure) instead of returning to
+      the free list, and ALL prefill runs the chunked cache-gather
+      program so cold and warm runs are bit-identical.
+    - ``draft_model`` / ``spec_k``: speculative decoding — the draft
+      model (same vocab, typically far smaller) proposes up to
+      ``spec_k`` tokens per iteration, verified in one target forward.
+      Greedy and seeded-sampling outputs are bit-identical to plain
+      decode by construction (deterministic positional-stream accept).
     - ``max_waiting``: waiting-queue depth cap; past it ``submit``
       sheds with :class:`ServerOverloaded`.
     - ``request_timeout_s``: deadline enforced while a request WAITS
@@ -230,7 +289,10 @@ class GenerationServer:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  max_waiting: int = 256,
                  request_timeout_s: float = 300.0,
-                 seed: int = 0, check_replay: bool = False):
+                 seed: int = 0, check_replay: bool = False,
+                 max_prefill_batch: int = 4,
+                 prefix_cache: bool = False,
+                 draft_model=None, spec_k: int = 4):
         if not bool(getattr(model, "supports_kv_cache",
                             lambda: False)()):
             # surface the model's own typed error (names the
@@ -246,6 +308,21 @@ class GenerationServer:
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self._model = model
+        self._draft = draft_model
+        self._spec = draft_model is not None
+        self._k = int(spec_k)
+        if self._spec:
+            if self._k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if not bool(getattr(draft_model, "supports_kv_cache",
+                                lambda: False)()):
+                raise ServeError(
+                    "draft_model must be KV-cache-capable "
+                    "(supports_kv_cache() is False)")
+            if (getattr(draft_model.config, "vocab_size", None)
+                    != getattr(model.config, "vocab_size", None)):
+                raise ValueError(
+                    "draft_model vocab_size must match the target's")
         self._num_slots = int(num_slots)
         self._bs = int(block_size)
         if max_model_len is None:
@@ -267,18 +344,27 @@ class GenerationServer:
         if bks[-1] < self._max_len:
             bks.append(self._max_len)
         self._buckets = bks
+        if max_prefill_batch < 1:
+            raise ValueError("max_prefill_batch must be >= 1")
+        self._pbatches = _pow2_buckets(
+            1, min(int(max_prefill_batch), self._num_slots))
         self._max_waiting = int(max_waiting)
         self._timeout_s = float(request_timeout_s)
         self._seed = int(seed)
         self._check_replay = bool(check_replay)
+        self._prefix_on = bool(prefix_cache)
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._waiting: List[_GenSeq] = []
         self._active: Dict[int, _GenSeq] = {}
         self._free_slots = list(range(self._num_slots))
-        # block 0 is trash; LIFO free list for locality
-        self._free_blocks = list(range(self._num_blocks - 1, 0, -1))
+        # block 1..num_blocks-1 are allocatable (0 is trash); the
+        # PrefixCache is the one accounting path for both modes —
+        # with the index disabled it IS the ISSUE 8 free list
+        self._cache = PrefixCache(self._num_blocks - 1, self._bs,
+                                  index_enabled=self._prefix_on,
+                                  first_block=1)
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._rid = 0
@@ -291,6 +377,10 @@ class GenerationServer:
             "shed_timeout": 0, "tokens_generated": 0,
             "decode_steps": 0, "replay_steps": 0,
             "decode_ms": 0.0, "prefill_ms": 0.0,
+            "prefill_batches": 0, "prefill_tokens": 0,
+            "prefill_tokens_skipped": 0,
+            "spec_verify_steps": 0, "draft_steps": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
             "prefill_bucket_hits": {b: 0 for b in self._buckets},
         }
 
@@ -298,8 +388,14 @@ class GenerationServer:
         # constructor stays cheap; start() builds everything)
         self._pvals = None
         self._pools = None
+        self._dvals = None
+        self._dpools = None
         self._decode_fn = None
         self._prefill_fn = None
+        self._draft_prefill_fn = None
+        self._draft_decode_fn = None
+        self._verify_fn = None
+        self._fork_fn = None
 
     # -- program construction ----------------------------------------
     def _build_programs(self):
@@ -308,41 +404,57 @@ class GenerationServer:
 
         from ..framework.core import Tensor, no_grad
 
-        model = self._model
-        self._pvals = {k: t._value for k, t in model.state_dict().items()}
-        self._pools = model.init_paged_cache(self._num_blocks, self._bs)
         server = self
+        prefix_on = self._prefix_on
 
-        def call_model(pvals, ids, pos, pools, tables, wm,
-                       gather_at=None):
-            st = model.state_dict()
-            old = {k: t._value for k, t in st.items()}
-            try:
-                for k, t in st.items():
-                    if k in pvals:
-                        t._value = pvals[k]
-                with no_grad():
-                    logits, pools = model.forward_paged(
-                        Tensor(ids), Tensor(pos), pools, tables, wm,
-                        gather_at=gather_at)
-            finally:
-                for k, t in st.items():
-                    t._value = old[k]
-            lv = logits._value if isinstance(logits, Tensor) else logits
+        def make_call(model):
+            def call_model(pvals, ids, pos, pools, tables, wm,
+                           gather_at=None, verify_mode=False):
+                st = model.state_dict()
+                old = {k: t._value for k, t in st.items()}
+                try:
+                    for k, t in st.items():
+                        if k in pvals:
+                            t._value = pvals[k]
+                    with no_grad():
+                        logits, pools = model.forward_paged(
+                            Tensor(ids), Tensor(pos), pools, tables, wm,
+                            gather_at=gather_at, verify_mode=verify_mode)
+                finally:
+                    for k, t in st.items():
+                        t._value = old[k]
+                lv = logits._value if isinstance(logits, Tensor) \
+                    else logits
 
-            def raw(v):
-                return v._value if isinstance(v, Tensor) else v
-            pools = [{kk: raw(vv) for kk, vv in d.items()}
-                     for d in pools]
-            return lv, pools
+                def raw(v):
+                    return v._value if isinstance(v, Tensor) else v
+                pools = [{kk: raw(vv) for kk, vv in d.items()}
+                         for d in pools]
+                return lv, pools
+            return call_model
+
+        call_model = make_call(self._model)
+        self._pvals = {k: t._value
+                       for k, t in self._model.state_dict().items()}
+        self._pools = self._model.init_paged_cache(self._num_blocks,
+                                                   self._bs)
+        if self._spec:
+            call_draft = make_call(self._draft)
+            self._dvals = {k: t._value
+                           for k, t in self._draft.state_dict().items()}
+            self._dpools = self._draft.init_paged_cache(
+                self._num_blocks, self._bs)
+        else:
+            self._dpools = []
 
         def sample(lg, kd, rng_steps, temp, top_k, top_p, do_sample):
-            """Per-slot next-token selection: exact argmax for greedy
-            slots, temperature/top-k/top-p categorical for sampling
-            slots — one program covers any mix.  The key for token j of
+            """Per-row next-token selection: exact argmax for greedy
+            rows, temperature/top-k/top-p categorical for sampling
+            rows — one program covers any mix.  The key for token j of
             a request is fold_in(request_key, j-1): a pure function of
-            the stream position, so replay after eviction reproduces
-            the draw exactly."""
+            the stream position, so replay after eviction — and
+            spec-decode verification, which samples the same stream at
+            many positions in one call — reproduce the draw exactly."""
             V = lg.shape[-1]
             greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
             x = lg / jnp.maximum(temp, 1e-6)[:, None]
@@ -372,7 +484,7 @@ class GenerationServer:
             # python side effect runs at TRACE time only: the counter
             # proves steady-state decode never retraces
             server._compiles += 1
-            server._note_compile("decode", tokens.shape[0])
+            server._note_compile("decode", 1, tokens.shape[0])
             logits, pools = call_model(pvals, tokens, positions, pools,
                                        tables, wm)
             lg = logits[:, -1, :].astype(jnp.float32)
@@ -380,37 +492,101 @@ class GenerationServer:
                          do_sample)
             return nxt, pools
 
-        def prefill_fn(pvals, pools, prompt, length, table, kd, temp,
-                       top_k, top_p, do_sample):
+        def make_prefill(call, name):
+            def prefill_fn(pvals, pools, prompt, start, length, table,
+                           kd, temp, top_k, top_p, do_sample):
+                server._compiles += 1
+                server._note_compile(name, prompt.shape[1],
+                                     prompt.shape[0])
+                B, Lb = prompt.shape
+                pos = start[:, None] + jnp.broadcast_to(
+                    jnp.arange(Lb, dtype=jnp.int32)[None, :], (B, Lb))
+                wm = (jnp.arange(Lb, dtype=jnp.int32)[None, :]
+                      < length[:, None])
+                gather_at = jnp.clip(length - 1, 0, Lb - 1)
+                # prefix-sharing servers run ALL prefill through the
+                # cache-gather path (verify_mode) so a cold full
+                # prefill and a warm suffix prefill are the same
+                # floating-point program per position — the bit-
+                # identity the shared-prefix contract rests on
+                logits, pools = call(pvals, prompt, pos, pools, table,
+                                     wm, gather_at=gather_at,
+                                     verify_mode=prefix_on)
+                lg = logits[:, -1, :].astype(jnp.float32)
+                first = sample(lg, kd, jnp.zeros_like(length), temp,
+                               top_k, top_p, do_sample)
+                return first, pools
+            return prefill_fn
+
+        def verify_fn(pvals, pools, tokens, positions, tables, wm, kd,
+                      rng_steps, temp, top_k, top_p, do_sample):
+            """Score S=spec_k+1 fed tokens in one forward and sample
+            the target's OWN token at every position with its
+            positional key — the deterministic accept reference."""
             server._compiles += 1
-            server._note_compile("prefill", prompt.shape[1])
-            B, Lb = prompt.shape
-            pos = jnp.broadcast_to(
-                jnp.arange(Lb, dtype=jnp.int32)[None, :], (B, Lb))
-            wm = pos < length[:, None]
-            gather_at = jnp.clip(length - 1, 0, Lb - 1)
-            logits, pools = call_model(pvals, prompt, pos, pools, table,
-                                       wm, gather_at=gather_at)
-            lg = logits[:, -1, :].astype(jnp.float32)
-            first = sample(lg, kd, jnp.zeros_like(length), temp, top_k,
-                           top_p, do_sample)
-            return first, pools
+            server._note_compile("verify", tokens.shape[1],
+                                 tokens.shape[0])
+            B, S = tokens.shape
+            logits, pools = call_model(pvals, tokens, positions, pools,
+                                       tables, wm, verify_mode=True)
+            lg = logits.astype(jnp.float32).reshape(B * S, -1)
+            rep = lambda a: jnp.repeat(a, S, axis=0)
+            sampled = sample(lg, rep(kd), rng_steps.reshape(B * S),
+                             rep(temp), rep(top_k), rep(top_p),
+                             rep(do_sample))
+            return sampled.reshape(B, S), pools
+
+        def fork_fn(pools, dpools, src, dst):
+            """Copy-on-write fork: duplicate one physical block across
+            every pool tensor (target + draft, K/V + int8 scales).
+            Physical ids never enter the attention math, so remapping
+            the table to the copy is invisible to the stream."""
+            server._compiles += 1
+            server._note_compile("fork", 1, 1)
+
+            def cp(d):
+                return {k: v.at[dst].set(v[src]) for k, v in d.items()}
+            return [cp(d) for d in pools], [cp(d) for d in dpools]
 
         # donate the pools: each step consumes the previous pool
         # buffers in place (the CPU backend can't donate — skip the
         # unusable-donation warning there)
         donate = () if jax.default_backend() == "cpu" else (1,)
         self._decode_fn = jax.jit(decode_fn, donate_argnums=donate)
-        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=donate)
+        self._prefill_fn = jax.jit(make_prefill(call_model, "prefill"),
+                                   donate_argnums=donate)
+        if self._prefix_on:
+            dfork = () if jax.default_backend() == "cpu" else (0, 1)
+            self._fork_fn = jax.jit(fork_fn, donate_argnums=dfork)
+        if self._spec:
+            self._draft_prefill_fn = jax.jit(
+                make_prefill(call_draft, "draft_prefill"),
+                donate_argnums=donate)
 
-    def _note_compile(self, program: str, width: int):
+            def draft_decode_fn(dvals, dpools, tokens, positions,
+                                tables, wm, kd, rng_steps, temp, top_k,
+                                top_p, do_sample):
+                server._compiles += 1
+                server._note_compile("draft_decode", 1, tokens.shape[0])
+                logits, dpools = call_draft(dvals, tokens, positions,
+                                            dpools, tables, wm)
+                lg = logits[:, -1, :].astype(jnp.float32)
+                nxt = sample(lg, kd, rng_steps, temp, top_k, top_p,
+                             do_sample)
+                return nxt, dpools
+            self._draft_decode_fn = jax.jit(draft_decode_fn,
+                                            donate_argnums=donate)
+            self._verify_fn = jax.jit(verify_fn, donate_argnums=donate)
+
+    def _note_compile(self, program: str, width: int, batch: int = 1):
         """Runs inside a trace: log the compile to the server's shared
         bucket-compile table and the flight recorder's observatory."""
         cause = "prewarm" if not self._running else "new_shape_bucket"
         self._compile_records.append(
-            {"program": program, "bucket": int(width), "cause": cause})
+            {"program": program, "bucket": int(width),
+             "batch": int(batch), "cause": cause})
         _flight.note_compile(f"GenerationServer[{program}]", cause, 0.0,
-                             key=(program, int(width)),
+                             key=(program, int(width), int(batch)),
                              n_buckets=self._compiles)
 
     # -- lifecycle ---------------------------------------------------
@@ -429,28 +605,58 @@ class GenerationServer:
         return self
 
     def _prewarm(self):
-        """Compile every program before traffic: each prompt bucket's
-        prefill + the single decode program.  Dummy calls write only to
-        the trash block (write masks all False), so the pools' live
-        contents are untouched by construction."""
+        """Compile every program before traffic: each (prompt bucket,
+        prefill batch) pair's prefill (target + draft), the decode /
+        draft-decode / verify programs, and the COW fork.  Dummy calls
+        write only to the trash block (write masks all False), so the
+        pools' live contents are untouched by construction."""
         W = int(np.asarray(self._seq_key_data(0)).shape[-1])
         for b in self._buckets:
-            first, self._pools = self._prefill_fn(
-                self._pvals, self._pools,
-                np.zeros((1, b), np.int32), np.zeros((1,), np.int32),
-                np.zeros((1, self._M), np.int32),
-                np.zeros((1, W), np.uint32),
-                np.ones((1,), np.float32), np.zeros((1,), np.int32),
-                np.ones((1,), np.float32), np.zeros((1,), bool))
+            for pb in self._pbatches:
+                args = (np.zeros((pb, b), np.int32),
+                        np.zeros((pb,), np.int32),
+                        np.zeros((pb,), np.int32),
+                        np.zeros((pb, self._M), np.int32),
+                        np.zeros((pb, W), np.uint32),
+                        np.ones((pb,), np.float32),
+                        np.zeros((pb,), np.int32),
+                        np.ones((pb,), np.float32),
+                        np.zeros((pb,), bool))
+                _, self._pools = self._prefill_fn(
+                    self._pvals, self._pools, *args)
+                if self._spec:
+                    _, self._dpools = self._draft_prefill_fn(
+                        self._dvals, self._dpools, *args)
         B = self._num_slots
-        nxt, self._pools = self._decode_fn(
-            self._pvals, self._pools,
-            np.zeros((B, 1), np.int32), np.zeros((B, 1), np.int32),
-            np.zeros((B, self._M), np.int32), np.zeros((B, 1), bool),
-            np.zeros((B, W), np.uint32), np.zeros((B,), np.int32),
-            np.ones((B,), np.float32), np.zeros((B,), np.int32),
-            np.ones((B,), np.float32), np.zeros((B,), bool))
-        np.asarray(nxt)   # block until the warmup step really ran
+        dec_args = (np.zeros((B, 1), np.int32),
+                    np.zeros((B, 1), np.int32),
+                    np.zeros((B, self._M), np.int32),
+                    np.zeros((B, 1), bool),
+                    np.zeros((B, W), np.uint32),
+                    np.zeros((B,), np.int32),
+                    np.ones((B,), np.float32),
+                    np.zeros((B,), np.int32),
+                    np.ones((B,), np.float32),
+                    np.zeros((B,), bool))
+        nxt, self._pools = self._decode_fn(self._pvals, self._pools,
+                                           *dec_args)
+        if self._spec:
+            dn, self._dpools = self._draft_decode_fn(
+                self._dvals, self._dpools, *dec_args)
+            S = self._k + 1
+            sv, self._pools = self._verify_fn(
+                self._pvals, self._pools,
+                np.zeros((B, S), np.int32), np.zeros((B, S), np.int32),
+                np.zeros((B, self._M), np.int32),
+                np.zeros((B, S), bool), np.zeros((B, W), np.uint32),
+                np.zeros((B, S), np.int32), np.ones((B,), np.float32),
+                np.zeros((B,), np.int32), np.ones((B,), np.float32),
+                np.zeros((B,), bool))
+            np.asarray(sv)
+        if self._fork_fn is not None:
+            self._pools, self._dpools = self._fork_fn(
+                self._pools, self._dpools, np.int32(0), np.int32(0))
+        np.asarray(nxt)   # block until the warmup steps really ran
 
     def stop(self, drain: bool = False, timeout: float = 30.0):
         if not self._running:
@@ -549,9 +755,15 @@ class GenerationServer:
         return self.submit(prompt, **kw).result(timeout=timeout)
 
     def num_compiles(self) -> int:
-        """Distinct program traces (prefill buckets + the decode
-        program).  Steady state after warmup: delta == 0."""
+        """Distinct program traces (prefill grid + decode + spec/fork
+        programs).  Steady state after warmup: delta == 0."""
         return self._compiles
+
+    def flush_prefix_cache(self):
+        """Drop every prefix-index entry (active sequences keep their
+        references; cached-only blocks return to the free list)."""
+        with self._lock:
+            self._cache.flush()
 
     def stats(self) -> Dict:
         with self._lock:
@@ -559,20 +771,40 @@ class GenerationServer:
                  for k, v in self._stats.items()}
             s["waiting"] = len(self._waiting)
             s["active"] = len(self._active)
-            s["free_blocks"] = len(self._free_blocks)
-            s["allocated_blocks"] = (self._num_blocks - 1
-                                     - len(self._free_blocks))
+            cache = self._cache.snapshot()
             records = list(self._compile_records)
+        # "free" keeps its ISSUE 8 meaning — allocatable right now —
+        # which with prefix sharing includes cached blocks (they
+        # recycle on demand); "cached_blocks" is the subset holding
+        # reusable prefix content
+        s["free_blocks"] = cache["free"] + cache["cached"]
+        s["allocated_blocks"] = cache["in_use"]
+        s["cached_blocks"] = cache["cached"]
+        s["prefix_entries"] = cache["entries"]
+        s["prefix_hits"] = cache["hits"]
+        s["prefix_hit_tokens"] = cache["hit_tokens"]
+        s["prefix_queries"] = cache["queries"]
+        s["prefix_hit_rate"] = (cache["hit_tokens"]
+                                / max(cache["query_tokens"], 1))
+        s["prefix_recycled"] = cache["recycled"]
+        s["cow_forks"] = cache["cow_forks"]
         s["total_blocks"] = self._num_blocks - 1   # trash excluded
         s["block_size"] = self._bs
         s["num_slots"] = self._num_slots
         s["num_compiles"] = self._compiles
+        s["spec_enabled"] = self._spec
+        s["spec_k"] = self._k if self._spec else 0
+        s["spec_accept_rate"] = (s["spec_accepted"]
+                                 / max(s["spec_proposed"], 1))
+        s["prefix_cache_enabled"] = self._prefix_on
+        s["server"] = "generation"   # provenance, see PredictorServer
         # shared bucket-compile accounting shape with
-        # PredictorServer.stats() (ISSUE 8 satellite): per program
-        # bucket -> {count, cause}
+        # PredictorServer.stats() (ISSUE 8 satellite; ISSUE 11 adds
+        # the batch axis): per program "name:bucketxbatch" ->
+        # {count, cause}
         bc: Dict = {}
         for r in records:
-            key = f"{r['program']}:{r['bucket']}"
+            key = f"{r['program']}:{r['bucket']}x{r.get('batch', 1)}"
             ent = bc.setdefault(key, {"count": 0, "cause": r["cause"]})
             ent["count"] += 1
         s["bucket_compiles"] = bc
@@ -595,7 +827,10 @@ class GenerationServer:
                 self._expire_waiting()
                 self._admit()
                 if self._active:
-                    self._decode_once()
+                    if self._spec:
+                        self._spec_once()
+                    else:
+                        self._decode_once()
         except BaseException as e:   # noqa: BLE001 — fail streams loudly
             with self._lock:
                 victims = (list(self._waiting)
@@ -631,26 +866,79 @@ class GenerationServer:
                    if s.evictions else "queued")
                 + " — pool/slots overloaded"))
 
+    # -- admission + prefill -----------------------------------------
     def _admit(self):
-        while True:
-            with self._lock:
-                if not self._waiting or not self._free_slots:
-                    return
+        """Admit as many waiting sequences as slots + blocks allow, in
+        strict (priority, arrival) order, then prefill them in batches
+        grouped by prompt/suffix bucket (ONE dispatch per group chunk
+        — the batched-prefill win)."""
+        taken: List[_GenSeq] = []
+        forks: List[tuple] = []
+        with self._lock:
+            while self._waiting and self._free_slots:
                 self._waiting.sort(key=lambda s: (s.priority, s.arrival))
                 seq = self._waiting[0]
-                # ceil(L/bs) blocks for the prompt, +1 headroom when L
-                # lands exactly on a block boundary (the first decode
-                # write would otherwise evict immediately)
-                need = seq.L // self._bs + 1
-                if len(self._free_blocks) < need:
-                    return   # strict priority order: no queue jumping
-                self._waiting.pop(0)
+                hit_blocks, matched = self._cache.match(seq.prompt)
+                cached = min(matched, seq.L - 1)
+                a = len(hit_blocks)
                 nb = -(-seq.L // self._bs)
-                seq.blocks = [self._free_blocks.pop()
-                              for _ in range(nb)]
+                fresh = nb - a
+                # +1 headroom when the first decode write lands on a
+                # block boundary; +1 more when the tail alias must COW-
+                # fork before the suffix prefill writes into it
+                w = cached // self._bs
+                fork = bool(a and w < a)
+                need = fresh + (1 if seq.L % self._bs == 0 else 0) \
+                    + (1 if fork else 0)
+                if self._cache.available() < max(need, 0):
+                    break   # strict priority order: no queue jumping
+                self._waiting.pop(0)
+                for b in hit_blocks:
+                    self._cache.ref(b)
+                seq.blocks = list(hit_blocks)
+                for _ in range(fresh):
+                    blk = self._cache.alloc()
+                    assert blk is not None, "admission check broke"
+                    seq.blocks.append(blk)
+                seq.cached = cached
+                self._cache.note_query(seq.L, cached)
+                if fork:
+                    # reserve the COW destination UNDER the admission
+                    # check's lock — a same-round sibling's fresh
+                    # allocations must not eat the block the check
+                    # just promised this fork
+                    dst = self._cache.alloc()
+                    assert dst is not None, "admission check broke"
+                    self._cache.stats["cow_forks"] += 1
+                    forks.append((seq, w, seq.blocks[w], dst))
                 seq.slot = self._free_slots.pop()
                 self._active[seq.slot] = seq
-            self._prefill(seq)
+                taken.append(seq)
+        if not taken:
+            return
+        # COW-fork each aliased tail block the suffix prefill will
+        # write into (refcount > 1 counts the index entry, so an
+        # indexed original is never clobbered): device-copy into the
+        # reserved block, remap the table, drop the alias reference
+        for seq, w, src, dst in forks:
+            self._pools, self._dpools = self._fork_fn(
+                self._pools, self._dpools, np.int32(src),
+                np.int32(dst))
+            with self._lock:
+                seq.blocks[w] = dst
+                self._cache.unref(src)
+            _monitor.stat_add("serve_cow_forks")
+            _flight.record("serve.cow_fork", rid=seq.rid, src=src,
+                           dst=dst, logical=w)
+        # group by suffix bucket and dispatch in chunks
+        groups: Dict[int, List[_GenSeq]] = {}
+        for seq in taken:
+            groups.setdefault(self._bucket_for(seq.L - seq.cached),
+                              []).append(seq)
+        for bucket, seqs in sorted(groups.items()):
+            for i in range(0, len(seqs), self._pbatches[-1]):
+                self._prefill_batch(seqs[i:i + self._pbatches[-1]],
+                                    bucket)
 
     def _bucket_for(self, L: int) -> int:
         for b in self._buckets:
@@ -658,40 +946,91 @@ class GenerationServer:
                 return b
         return self._buckets[-1]
 
-    def _prefill(self, seq: _GenSeq):
-        bucket = self._bucket_for(seq.L)
-        prompt = np.zeros((1, bucket), np.int32)
-        prompt[0, :seq.L] = seq.prompt
-        table = np.zeros((1, self._M), np.int32)
-        table[0, :len(seq.blocks)] = seq.blocks
+    def _pbatch_for(self, n: int) -> int:
+        for b in self._pbatches:
+            if n <= b:
+                return b
+        return self._pbatches[-1]
+
+    def _prefill_batch(self, seqs: List[_GenSeq], bucket: int):
+        """One prefill dispatch for up to max_prefill_batch sequences
+        sharing a bucket; padding rows (length 0) write only trash."""
+        B = self._pbatch_for(len(seqs))
+        W = int(seqs[0].key_data.shape[-1])
+        prompt = np.zeros((B, bucket), np.int32)
+        start = np.zeros((B,), np.int32)
+        length = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self._M), np.int32)
+        kd = np.zeros((B, W), np.uint32)
+        temp = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        do_sample = np.zeros((B,), bool)
+        for i, seq in enumerate(seqs):
+            sfx = seq.prompt[seq.cached:]
+            prompt[i, :sfx.shape[0]] = sfx
+            start[i] = seq.cached
+            length[i] = sfx.shape[0]
+            tables[i, :len(seq.blocks)] = seq.blocks
+            kd[i] = seq.key_data
+            temp[i] = seq.temp
+            top_k[i] = seq.top_k
+            top_p[i] = seq.top_p
+            do_sample[i] = seq.do_sample
         t0 = time.perf_counter()
         first, self._pools = self._prefill_fn(
-            self._pvals, self._pools, prompt,
-            np.asarray([seq.L], np.int32), table,
-            seq.key_data[None, :], np.asarray([seq.temp], np.float32),
-            np.asarray([seq.top_k], np.int32),
-            np.asarray([seq.top_p], np.float32),
-            np.asarray([seq.do_sample], bool))
-        first = int(np.asarray(first)[0])
+            self._pvals, self._pools, prompt, start, length, tables,
+            kd, temp, top_k, top_p, do_sample)
+        if self._spec:
+            _, self._dpools = self._draft_prefill_fn(
+                self._dvals, self._dpools, prompt, start, length,
+                tables, kd, temp, top_k, top_p, do_sample)
+        first = np.asarray(first)
         dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._stats["prefill_ms"] += dt_ms
+            self._stats["prefill_batches"] += 1
+            self._stats["prefill_bucket_hits"][bucket] = \
+                self._stats["prefill_bucket_hits"].get(bucket, 0) \
+                + len(seqs)
+            self._stats["prefill_tokens"] += int(
+                sum(s.L - s.cached for s in seqs))
+            self._stats["prefill_tokens_skipped"] += int(
+                sum(s.cached for s in seqs))
+        if _monitor.metrics_enabled():
+            _monitor.hist_observe("prefill_ms", dt_ms)
+        for i, seq in enumerate(seqs):
+            self._post_prefill(seq, int(first[i]), bucket)
+
+    def _post_prefill(self, seq: _GenSeq, first: int, bucket: int):
         readmit = seq.evictions > 0
         with self._lock:
             self._stats["admitted"] += 1
             self._stats["readmitted"] += int(readmit)
-            self._stats["prefill_ms"] += dt_ms
-            self._stats["prefill_bucket_hits"][bucket] = \
-                self._stats["prefill_bucket_hits"].get(bucket, 0) + 1
+            # index the prompt's full blocks for future sharing; the
+            # aliased ones are already indexed (insert is idempotent)
+            self._cache.insert(seq.prompt.tolist(), seq.blocks)
         _monitor.stat_add("serve_gen_admitted")
         _flight.record("serve.admit", rid=seq.rid, prompt_len=seq.L,
                        bucket=bucket, blocks=len(seq.blocks),
                        slot=seq.slot, readmit=readmit,
-                       priority=seq.priority)
+                       priority=seq.priority, cached=seq.cached)
+        if seq.cached:
+            _monitor.stat_add("serve_prefix_hits")
+            _monitor.stat_add("serve_prefix_hit_tokens", seq.cached)
+            _flight.record("serve.prefix_hit", rid=seq.rid,
+                           cached_tokens=seq.cached,
+                           prompt_len=seq.L)
         if _monitor.metrics_enabled():
-            _monitor.hist_observe("prefill_ms", dt_ms)
             _monitor.gauge_set("serve_gen_active", len(self._active))
             _monitor.gauge_set("serve_gen_free_blocks",
-                               len(self._free_blocks))
+                               self._cache.available())
+            st = self._cache.stats
+            _monitor.gauge_set("serve_prefix_hit_rate",
+                               st["hit_tokens"]
+                               / max(st["query_tokens"], 1))
         seq.decoded = 0
+        seq.draft_decoded = 0
         if readmit:
             # replay: prefill re-derives t1 from the identical program
             # + inputs; the stored token is authoritative either way
@@ -704,6 +1043,7 @@ class GenerationServer:
         else:
             self._emit(seq, first)
 
+    # -- emission / release ------------------------------------------
     def _emit(self, seq: _GenSeq, tok: int):
         seq.generated.append(tok)
         if seq.t_first_tok is None:
@@ -722,6 +1062,12 @@ class GenerationServer:
             self._finish(seq, reason)
 
     def _finish(self, seq: _GenSeq, reason: str):
+        with self._lock:
+            # index completed full blocks (prompt + generated): the
+            # next turn of this conversation aliases them — multi-turn
+            # chat is the prefix cache's defining traffic
+            self._cache.insert(
+                seq.prompt.tolist() + seq.generated, seq.blocks)
         self._release(seq)
         with self._lock:
             self._stats["finished"] += 1
@@ -732,10 +1078,13 @@ class GenerationServer:
         seq.stream._end(reason)
 
     def _release(self, seq: _GenSeq):
-        """Return a sequence's blocks + slot to the pools immediately."""
+        """Drop the sequence's block references + slot immediately
+        (shared blocks survive through their other references; indexed
+        blocks stay cached until recycled)."""
         with self._lock:
             if seq.blocks:
-                self._free_blocks.extend(seq.blocks)
+                for b in seq.blocks:
+                    self._cache.unref(b)
                 seq.blocks = []
             if seq.slot is not None:
                 self._active.pop(seq.slot, None)
@@ -749,6 +1098,8 @@ class GenerationServer:
         freed = len(seq.blocks)
         self._release(seq)
         seq.decoded = 0
+        seq.draft_decoded = 0
+        seq.cached = 0
         seq.evictions += 1
         with self._lock:
             self._stats["evicted"] += 1
@@ -761,18 +1112,20 @@ class GenerationServer:
         _flight.maybe_dump("BlockPoolExhausted")
 
     def _grow_or_evict(self):
-        """Before a decode step every live sequence must own the block
-        its next K/V write lands in; a dry pool evicts the lowest-
-        priority sequence (highest priority number, then youngest)."""
+        """Before a decode/verify step every live sequence must own the
+        blocks its next K/V writes land in (one position for plain
+        decode, up to spec_k+1 for a spec iteration); a dry pool evicts
+        the lowest-priority sequence (highest priority number, then
+        youngest)."""
+        ahead = self._k if self._spec else 0
         for seq in sorted(self._active.values(), key=lambda s: s.slot):
             if seq.slot is None:
                 continue      # evicted below us this round
-            p = seq.L + seq.decoded          # position written next
+            p = min(seq.L + seq.decoded + ahead, self._max_len - 1)
             need = p // self._bs + 1
             while len(seq.blocks) < need and seq.slot is not None:
                 with self._lock:
-                    blk = (self._free_blocks.pop()
-                           if self._free_blocks else None)
+                    blk = self._cache.alloc()
                     if blk is not None:
                         seq.blocks.append(blk)
                         continue
@@ -782,6 +1135,7 @@ class GenerationServer:
                 # the growing sequence itself can be the lowest
                 # priority: it re-queues and this slot sits out
 
+    # -- plain decode -------------------------------------------------
     def _decode_once(self):
         self._grow_or_evict()
         with self._lock:
@@ -834,19 +1188,232 @@ class GenerationServer:
                         "decode is not bit-stable")
             else:
                 self._emit(seq, int(nxt[s]))
+        self._after_step(len(live), replays, dt_ms)
+
+    def _after_step(self, n_live: int, replays: int, dt_ms: float):
         with self._lock:
             self._stats["decode_steps"] += 1
             self._stats["replay_steps"] += replays
             self._stats["decode_ms"] += dt_ms
             n_steps = self._stats["decode_steps"]
+            free_now = self._cache.available()
         _flight.progress("serve.decode")
         if n_steps % _FLIGHT_DECODE_EVERY == 0:
-            _flight.record("serve.decode", steps=n_steps,
-                           live=len(live),
-                           free_blocks=len(self._free_blocks),
-                           ms=round(dt_ms, 3))
+            _flight.record("serve.decode", steps=n_steps, live=n_live,
+                           free_blocks=free_now, ms=round(dt_ms, 3))
         if _monitor.metrics_enabled():
             _monitor.hist_observe("decode_step_ms", dt_ms)
             _monitor.gauge_set("serve_gen_active", len(self._active))
-            _monitor.gauge_set("serve_gen_free_blocks",
-                               len(self._free_blocks))
+            _monitor.gauge_set("serve_gen_free_blocks", free_now)
+
+    # -- speculative decode -------------------------------------------
+    def _spec_once(self):
+        """One spec iteration: k batched draft steps propose, one
+        target verify forward scores k+1 positions, the accepted
+        prefix advances.  Bit-identical to plain decode: every
+        candidate is the target's own positional-stream token, and a
+        proposal is accepted only when it EQUALS that token."""
+        self._grow_or_evict()
+        with self._lock:
+            live = sorted(self._active.values(), key=lambda s: s.slot)
+        if not live:
+            return
+        B, M, k = self._num_slots, self._M, self._k
+        W = live[0].key_data.shape[-1]
+        t0 = time.perf_counter()
+
+        # ---- draft phase: k batched draft-decode steps.  Per slot the
+        # feed is the next unconsumed token: stored tokens first
+        # (catch-up after eviction or a rejected round), then its own
+        # proposal chain.  Chain outputs past the end of the stored
+        # stream are this round's proposals.
+        chains: Dict[int, List[int]] = {s.slot: [] for s in live}
+        draft_feeds: Dict[int, List[int]] = {s.slot: [] for s in live}
+        for _ in range(k):
+            tokens = np.zeros((B, 1), np.int32)
+            positions = np.zeros((B, 1), np.int32)
+            tables = np.zeros((B, M), np.int32)
+            wm = np.zeros((B, 1), bool)
+            kd = np.zeros((B, W), np.uint32)
+            rng_steps = np.zeros((B,), np.int32)
+            temp = np.ones((B,), np.float32)
+            top_k = np.zeros((B,), np.int32)
+            top_p = np.ones((B,), np.float32)
+            do_sample = np.zeros((B,), bool)
+            fed_any = False
+            fed_this: Dict[int, int] = {}
+            for seq in live:
+                s = seq.slot
+                f = seq.draft_decoded + len(draft_feeds[s])
+                pos = seq.L + f
+                gen, chain = seq.generated, chains[s]
+                # accepting m proposals emits m+1 tokens, so proposals
+                # beyond max_new - len(gen) - 1 can never be consumed —
+                # don't draft them (they'd be fed to verify, counted
+                # rejected, and waste a draft dispatch)
+                cap = max(seq.max_new - len(gen) - 1, 0)
+                if f < len(gen):
+                    if f >= len(gen) - 1 and len(chain) >= cap:
+                        continue      # proposal budget spent
+                    tok = gen[f]
+                elif f - len(gen) < len(chain):
+                    if len(chain) >= cap:
+                        continue      # proposal budget spent
+                    tok = chain[f - len(gen)]
+                else:
+                    continue          # chain exhausted (position cap)
+                if pos >= self._max_len:
+                    continue          # context full: draft idles
+                tokens[s, 0] = tok
+                positions[s, 0] = pos
+                tables[s, :len(seq.blocks)] = seq.blocks
+                wm[s, 0] = True
+                kd[s] = seq.key_data
+                rng_steps[s] = f + 1
+                temp[s] = seq.temp
+                top_k[s] = seq.top_k
+                top_p[s] = seq.top_p
+                do_sample[s] = seq.do_sample
+                fed_any = True
+                fed_this[s] = (f, int(tok))
+            if not fed_any:
+                break
+            nxt, self._dpools = self._draft_decode_fn(
+                self._dvals, self._dpools, tokens, positions, tables,
+                wm, kd, rng_steps, temp, top_k, top_p, do_sample)
+            nxt = np.asarray(nxt)
+            with self._lock:
+                self._stats["draft_steps"] += 1
+            for seq in live:
+                s = seq.slot
+                if s not in fed_this:
+                    continue
+                f, ftok = fed_this[s]
+                draft_feeds[s].append(ftok)
+                # outputs from the last stored token onward extend the
+                # proposal chain
+                if f >= len(seq.generated) - 1:
+                    chains[s].append(int(nxt[s]))
+
+        # ---- verify phase: one S=k+1 target forward over [last
+        # stored suffix ++ proposals] per slot
+        S = k + 1
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.zeros((B, S), np.int32)
+        tables = np.zeros((B, M), np.int32)
+        wm = np.zeros((B, S), bool)
+        kd = np.zeros((B, W), np.uint32)
+        rng_steps = np.zeros((B, S), np.int32)
+        temp = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        do_sample = np.zeros((B,), bool)
+        feeds: Dict[int, List[int]] = {}
+        n_props: Dict[int, int] = {}
+        for seq in live:
+            s = seq.slot
+            f0 = seq.decoded
+            known = seq.generated[f0:]       # >= 1 (last emitted)
+            fed = (known + chains[s])[:S]
+            cap = self._max_len - (seq.L + f0)   # positions available
+            # candidates beyond the replay region + remaining token
+            # budget can never be consumed — don't feed them
+            useful = (len(known) - 1) + max(
+                seq.max_new - len(seq.generated), 0)
+            fed = fed[:max(min(len(fed), cap, useful), 0)]
+            if not fed:
+                continue      # context full: nothing to verify
+            feeds[s] = fed
+            n_props[s] = max(len(fed) - len(known), 0)
+            for o, tok in enumerate(fed):
+                tokens[s, o] = tok
+                positions[s, o] = seq.L + f0 + o
+                wm[s, o] = True
+                rng_steps[s, o] = f0 + o + 1
+            tables[s, :len(seq.blocks)] = seq.blocks
+            kd[s] = seq.key_data
+            temp[s] = seq.temp
+            top_k[s] = seq.top_k
+            top_p[s] = seq.top_p
+            do_sample[s] = seq.do_sample
+        if not feeds:
+            return
+        cand, self._pools = self._verify_fn(
+            self._pvals, self._pools, tokens, positions, tables, wm,
+            kd, rng_steps, temp, top_k, top_p, do_sample)
+        cand = np.asarray(cand)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+
+        # ---- host accept: candidate o realizes generated index
+        # f0+o+1.  Stored region => replay check; beyond => emit the
+        # target's token, continue only while the NEXT fed proposal
+        # equals it (the deterministic accept).
+        replays = 0
+        accepted_total = 0
+        proposed_total = 0
+        for seq in live:
+            s = seq.slot
+            if s not in feeds or seq.slot is None:
+                continue
+            fed = feeds[s]
+            f0 = seq.decoded
+            proposed_total += n_props[s]
+            valid_fed = 0
+            for o in range(len(fed)):
+                if seq.slot is None:
+                    break           # finished mid-verify
+                tok = int(cand[s, o])
+                idx = f0 + o + 1    # 0-based generated index realized
+                if idx < len(seq.generated):
+                    replays += 1
+                    valid_fed += 1
+                    if self._check_replay \
+                            and tok != seq.generated[idx]:
+                        raise AssertionError(
+                            f"replayed verify step for request "
+                            f"{seq.rid} produced {tok}, stream "
+                            f"already emitted {seq.generated[idx]} — "
+                            "paged verify is not bit-stable")
+                    continue
+                valid_fed += 1      # fed token o was gen[f0+o]
+                self._emit(seq, tok)
+                if o + 1 < len(fed) and fed[o + 1] == tok:
+                    accepted_total += 1
+                    continue        # proposal matched: keep going
+                break               # mismatch or out of proposals
+            if seq.slot is not None:
+                seq.decoded = min(f0 + valid_fed,
+                                  len(seq.generated) - 1)
+                # draft validity: a fed token counts while it matches
+                # the FINAL stream at its index (stored feeds match by
+                # construction; proposal feeds match iff accepted) —
+                # the draft's KV at those positions is then correct
+                df0 = seq.draft_decoded
+                nvalid = 0
+                for t, ftok in enumerate(draft_feeds[s]):
+                    i2 = df0 + t
+                    if i2 < len(seq.generated) \
+                            and seq.generated[i2] == ftok:
+                        nvalid += 1
+                    else:
+                        break
+                seq.draft_decoded = min(df0 + nvalid,
+                                        len(seq.generated) - 1)
+        with self._lock:
+            self._stats["spec_verify_steps"] += 1
+            self._stats["spec_proposed"] += proposed_total
+            self._stats["spec_accepted"] += accepted_total
+            n_verify = self._stats["spec_verify_steps"]
+            p_tot = self._stats["spec_proposed"]
+            a_tot = self._stats["spec_accepted"]
+        _monitor.stat_add("serve_spec_proposed", proposed_total)
+        _monitor.stat_add("serve_spec_accepted", accepted_total)
+        if _monitor.metrics_enabled():
+            _monitor.gauge_set("serve_spec_accept_rate",
+                               a_tot / max(p_tot, 1))
+        if n_verify % _FLIGHT_DECODE_EVERY == 1:
+            _flight.record("serve.spec_verify", steps=n_verify,
+                           proposed=proposed_total,
+                           accepted=accepted_total,
+                           accept_rate=round(a_tot / max(p_tot, 1), 3))
+        self._after_step(len(live), replays, dt_ms)

@@ -1,0 +1,243 @@
+"""paddle_tpu.inference.prefix_cache — content-addressed block index +
+refcounted allocator for the paged KV cache (ISSUE 11 tentpole).
+
+The paged design (ISSUE 8) was built for this: physical block ids never
+enter the attention math — a sequence's cache is a gather over its
+block table — so two conversations whose token streams share a prefix
+can alias the SAME physical blocks and stay bit-identical by
+construction.  This module is the pure host-side bookkeeping that makes
+the aliasing safe:
+
+- **content-hash chain index** — a full block (``block_size`` tokens)
+  is addressed by ``(chain_hash_of_prefix, its_own_tokens)``.  KV at
+  position ``q`` depends on the WHOLE token prefix ``0..q`` (attention
+  mixes it into every layer's hidden states), so the chain hash —
+  ``h_b = blake2b(h_{b-1} || tokens_b)`` — is the correctness key:
+  equal chain hash + equal block tokens  ⇒  bit-equal pool contents.
+- **per-block refcounts, with the index itself holding a reference** —
+  a block's refcount counts its sequence users PLUS one for its index
+  entry.  A block whose ONLY reference is the index sits in an LRU of
+  reusable cached blocks: allocation prefers never-cached free blocks,
+  then recycles the LRU tail (dropping its index entry).  This is what
+  lets cached prefixes outlive the conversations that built them
+  without ever leaking: ``free + lru + in_use == capacity`` always.
+- **copy-on-write discipline** — the scheduler may WRITE into an
+  aliased block only after :meth:`writable` says so; a refcount > 1
+  (another sequence, or the index entry, still needs the old contents)
+  means the write must fork: allocate, device-copy, remap the block
+  table, unref the original.  Because the index counts as a reference,
+  a partial-tail alias (a block whose leading tokens match but whose
+  tail the new sequence overwrites) forks automatically — the indexed
+  original stays valid for future full matches.
+
+Thread-safety: NONE here by design — every method must be called under
+the owning scheduler's lock (GenerationServer holds ``self._lock``).
+Keeping the cache lock-free avoids a second lock order to verify and
+keeps GraftLint's lock graph for the serving tier unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PrefixCache", "chain_hashes"]
+
+
+def _block_hash(prev_hex: str, tokens) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev_hex.encode("ascii"))
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode("ascii"))
+    return h.hexdigest()
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[str]:
+    """Chain hash per FULL block of ``tokens``: entry ``i`` commits to
+    every token in blocks ``0..i`` (the whole prefix, which is what KV
+    contents depend on)."""
+    out, prev = [], ""
+    n_full = len(tokens) // block_size
+    for i in range(n_full):
+        blk = tokens[i * block_size:(i + 1) * block_size]
+        prev = _block_hash(prev, blk)
+        out.append(prev)
+    return out
+
+
+class PrefixCache:
+    """Block allocator + content index over ``capacity`` physical
+    blocks (ids ``first_block .. first_block+capacity-1``; the paged
+    pools' trash block 0 is outside the managed range).
+
+    With ``index_enabled=False`` this degrades to the plain free-list
+    allocator ISSUE 8 shipped (no entries are ever created, ``lru``
+    stays empty), so one accounting path serves both server modes.
+    """
+
+    def __init__(self, capacity: int, block_size: int,
+                 index_enabled: bool = True, first_block: int = 1):
+        self.bs = int(block_size)
+        self.capacity = int(capacity)
+        self.index_enabled = bool(index_enabled)
+        # LIFO free list for locality, same order as the ISSUE 8 list
+        self.free: List[int] = list(
+            range(first_block + self.capacity - 1, first_block - 1, -1))
+        self.refcnt: Dict[int, int] = {}
+        # key -> block;  key = (prefix_chain_hash, tokens_tuple)
+        self.index: Dict[Tuple[str, tuple], int] = {}
+        self.entry_of: Dict[int, Tuple[str, tuple]] = {}
+        # prefix_chain_hash -> {tokens_tuple -> block}: the partial-
+        # tail lookup only scans entries sharing the prefix hash
+        self.by_prefix: Dict[str, Dict[tuple, int]] = {}
+        # blocks whose only reference is their index entry (recyclable)
+        self.lru: "OrderedDict[int, None]" = OrderedDict()
+        self.stats = {"hits": 0, "hit_tokens": 0, "queries": 0,
+                      "query_tokens": 0, "inserted": 0, "recycled": 0,
+                      "cow_forks": 0}
+
+    # -- allocation ---------------------------------------------------
+    def available(self) -> int:
+        """Blocks allocatable right now (free + recyclable cached)."""
+        return len(self.free) + len(self.lru)
+
+    def in_use(self) -> int:
+        return self.capacity - self.available()
+
+    def alloc(self) -> Optional[int]:
+        """One block with refcount 1, or None when truly exhausted.
+        Prefers never-cached blocks; recycles the LRU-oldest cached
+        block (dropping its index entry) under pressure."""
+        if self.free:
+            b = self.free.pop()
+        elif self.lru:
+            b, _ = self.lru.popitem(last=False)
+            self._drop_entry(b)
+            self.stats["recycled"] += 1
+        else:
+            return None
+        self.refcnt[b] = 1
+        return b
+
+    def _drop_entry(self, block: int):
+        key = self.entry_of.pop(block)
+        del self.index[key]
+        bp = self.by_prefix[key[0]]
+        del bp[key[1]]
+        if not bp:
+            del self.by_prefix[key[0]]
+
+    def ref(self, block: int):
+        """A sequence starts using an indexed block (a prefix hit)."""
+        self.refcnt[block] = self.refcnt.get(block, 0) + 1
+        self.lru.pop(block, None)      # actively used: not recyclable
+
+    def unref(self, block: int):
+        n = self.refcnt.get(block, 0) - 1
+        if n < 0:
+            raise AssertionError(f"block {block} unref'd below zero")
+        self.refcnt[block] = n
+        if n == 0:
+            del self.refcnt[block]
+            self.free.append(block)
+        elif n == 1 and block in self.entry_of:
+            # only the index still needs it: recyclable, keep contents
+            self.lru[block] = None
+            self.lru.move_to_end(block)
+
+    def writable(self, block: int) -> bool:
+        """May the (single) sequence holding one reference write into
+        ``block`` in place?  False means copy-on-write: someone else —
+        another sequence or the index entry — still needs the old
+        bytes."""
+        return self.refcnt.get(block, 0) <= 1
+
+    # -- content index ------------------------------------------------
+    def match(self, tokens: Sequence[int]):
+        """Longest cached prefix of ``tokens``: full blocks via the
+        chain index, then one partial-tail block whose leading tokens
+        extend the match.  Returns ``(blocks, cached_tokens)`` WITHOUT
+        taking references or touching hit statistics — the scheduler
+        may probe the same queue head many times before admission;
+        call :meth:`note_query` once per ADMITTED sequence."""
+        if not self.index_enabled:
+            return [], 0
+        blocks: List[int] = []
+        prev = ""
+        n_full = len(tokens) // self.bs
+        matched = 0
+        for i in range(n_full):
+            blk = tuple(int(t) for t in
+                        tokens[i * self.bs:(i + 1) * self.bs])
+            prev_next = _block_hash(prev, blk)
+            b = self.index.get((prev, blk))
+            if b is None:
+                break
+            blocks.append(b)
+            matched += self.bs
+            prev = prev_next
+        # partial tail: an indexed block under the same prefix hash
+        # whose leading tokens extend the match by >= 1 token
+        tail = tuple(int(t) for t in tokens[matched:])
+        if tail:
+            best, best_n = None, 0
+            for toks, b in self.by_prefix.get(prev, {}).items():
+                n = 0
+                for a, c in zip(tail, toks):
+                    if a != c:
+                        break
+                    n += 1
+                if n > best_n:
+                    best, best_n = b, n
+            if best is not None:
+                blocks.append(best)
+                matched += best_n
+        return blocks, matched
+
+    def note_query(self, prompt_tokens: int, hit_tokens: int):
+        """Record one admitted sequence's prefix-cache outcome."""
+        self.stats["queries"] += 1
+        self.stats["query_tokens"] += int(prompt_tokens)
+        if hit_tokens:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += int(hit_tokens)
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]):
+        """Index every FULL block of ``tokens`` that isn't indexed yet
+        (``blocks[i]`` holds block ``i``'s KV).  Each new entry adds
+        the index's reference.  Returns how many entries were added."""
+        if not self.index_enabled:
+            return 0
+        added = 0
+        prev = ""
+        for i, h in enumerate(chain_hashes(tokens, self.bs)):
+            blk = tuple(int(t) for t in
+                        tokens[i * self.bs:(i + 1) * self.bs])
+            key = (prev, blk)
+            prev = h
+            if i >= len(blocks):
+                break
+            b = blocks[i]
+            if key in self.index or b in self.entry_of:
+                continue       # first content wins; one entry per block
+            self.index[key] = b
+            self.entry_of[b] = key
+            self.by_prefix.setdefault(key[0], {})[key[1]] = b
+            self.refcnt[b] = self.refcnt.get(b, 0) + 1
+            added += 1
+        self.stats["inserted"] += added
+        return added
+
+    def flush(self):
+        """Drop every index entry (blocks in active use keep their
+        sequence references; cached-only blocks return to free)."""
+        for b in list(self.entry_of):
+            self._drop_entry(b)
+            self.lru.pop(b, None)
+            self.unref(b)
+
+    # -- introspection -------------------------------------------------
+    def snapshot(self) -> Dict:
+        return {"free": len(self.free), "cached": len(self.lru),
+                "in_use": self.in_use(), "entries": len(self.index),
+                "capacity": self.capacity, **self.stats}

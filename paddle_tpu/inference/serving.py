@@ -319,11 +319,18 @@ class PredictorServer:
                              if hasattr(self._pred, "num_compiles")
                              else None)
         s["queue_depth"] = self._q.qsize()
+        # provenance tag (ISSUE 11 gateway wiring): a process running
+        # both servers — PS-backed PredictorServer for fixed-shape
+        # models AND a GenerationServer for LLM streams — merges their
+        # stats into one report; the tag says which engine produced
+        # which numbers
+        s["server"] = "predictor"
         # per-bucket compile provenance (ISSUE 8 satellite, shared
-        # shape with GenerationServer.stats()["bucket_compiles"]):
-        # which buckets were prewarmed vs compiled under traffic —
-        # "traffic_compiles > 0" is the prewarm-gap smoking gun that
-        # hit counts alone cannot show
+        # shape with GenerationServer.stats()["bucket_compiles"],
+        # whose keys gained a batch axis — "prefill:16x4" — in
+        # ISSUE 11): which buckets were prewarmed vs compiled under
+        # traffic — "traffic_compiles > 0" is the prewarm-gap smoking
+        # gun that hit counts alone cannot show
         if hasattr(self._pred, "compile_records"):
             records = self._pred.compile_records()
             bc: Dict = {}

@@ -113,8 +113,12 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # data stream while the serving tier reorganises — these events ARE
 # the recovery making progress; ps.replica_error and the client's
 # read_stale_exhausted stay bad kinds (tools/postmortem.py).
+# serve.spec_verify (ISSUE 11): a speculative-decode verify step IS
+# decode progress — the gateway ticks serve.decode every iteration and
+# additionally samples verify events into the ring
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
                              "serve.decode", "serve.admit",
+                             "serve.spec_verify",
                              "elastic.join", "elastic.reshard",
                              "elastic.resume", "elastic.promote",
                              "ps.replica.attach", "ps.promote",

@@ -85,6 +85,12 @@ class LlamaConfig:
     context_parallel: Optional[str] = None
     scan_layers: bool = False     # stack layer params, lax.scan the depth
     pp_num_microbatches: int = 1  # GPipe microbatches when mesh has pp>1
+    # paged-KV pool dtype (ISSUE 11 satellite / ROADMAP item 2 hook):
+    # None -> compute_dtype; "int8" -> quantized pools with a per-block
+    # [num_blocks, block_size] f32 scale tensor per pool (symmetric
+    # per-token scales, quantize on write / dequantize on read); any
+    # other value is taken as a plain storage dtype for the pools
+    kv_cache_dtype: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -286,7 +292,7 @@ class LlamaAttention(Layer):
         return self.o_proj(ctx), {"k": kbuf, "v": vbuf}
 
     def forward_paged(self, hidden, positions, cache, block_tables,
-                      write_mask):
+                      write_mask, verify_mode: bool = False):
         """Block-paged variant of :meth:`_forward_cached` (continuous-
         batching serving, ISSUE 8).  K/V live in fixed-shape pools
         ``[num_blocks, block_size, KH, D]`` shared by every sequence; a
@@ -303,13 +309,31 @@ class LlamaAttention(Layer):
         which is reserved as a trash block and never allocated; the
         validity mask (slot <= query position) guarantees trash is
         never read.
+
+        ``verify_mode`` (ISSUE 11): a multi-token call whose positions
+        do NOT start at 0 — speculative-decode verification and
+        prefix-cache suffix prefill both feed an S>1 block that must
+        attend against the EXISTING cache plus itself.  The fresh-block
+        flash path assumes an empty cache, so verify mode takes the
+        gather path instead: writes land first, then every query
+        attends the gathered table with the slot <= position mask
+        (causal within the block AND against the prefix by the same
+        inequality).
+
+        Quantized pools (``kv_cache_dtype="int8"``): the cache dict
+        additionally carries ``k_scale`` / ``v_scale``
+        ``[num_blocks, block_size]`` f32 tensors; writes store a
+        symmetric per-token scale next to the int8 rows and the gather
+        path dequantizes with the gathered scales.
         """
         c = self.config
         q = self.q_proj(hidden)
         k = self.k_proj(hidden)
         v = self.v_proj(hidden)
+        quant = "k_scale" in cache
 
-        def attn_paged(qv, kv, vv, pos, wm, kpool, vpool, tbl):
+        def attn_paged(qv, kv, vv, pos, wm, kpool, vpool, tbl,
+                       kscale=None, vscale=None):
             B, S = qv.shape[0], qv.shape[1]
             bs = kpool.shape[1]
             qh = qv.reshape(B, S, c.num_attention_heads, c.head_dim)
@@ -328,13 +352,35 @@ class LlamaAttention(Layer):
             off = jnp.where(wm, off, 0)
             fb = blk_phys.reshape(-1)
             fo = off.reshape(-1)
-            kpool = kpool.at[fb, fo].set(
-                kh.reshape(B * S, c.kv_heads, c.head_dim)
-                .astype(kpool.dtype))
-            vpool = vpool.at[fb, fo].set(
-                vh.reshape(B * S, c.kv_heads, c.head_dim)
-                .astype(vpool.dtype))
-            if S > 1:
+            kfl = kh.reshape(B * S, c.kv_heads, c.head_dim)
+            vfl = vh.reshape(B * S, c.kv_heads, c.head_dim)
+            if quant:
+                # symmetric per-token int8: one f32 scale per written
+                # (block, slot), stored beside the rows so dequant is a
+                # gather of exactly what the write saw (replay-stable)
+                ksc = jnp.maximum(jnp.max(jnp.abs(
+                    kfl.astype(jnp.float32)), axis=(1, 2)) / 127.0, 1e-8)
+                vsc = jnp.maximum(jnp.max(jnp.abs(
+                    vfl.astype(jnp.float32)), axis=(1, 2)) / 127.0, 1e-8)
+                kpool = kpool.at[fb, fo].set(jnp.clip(jnp.round(
+                    kfl.astype(jnp.float32) / ksc[:, None, None]),
+                    -127, 127).astype(jnp.int8))
+                vpool = vpool.at[fb, fo].set(jnp.clip(jnp.round(
+                    vfl.astype(jnp.float32) / vsc[:, None, None]),
+                    -127, 127).astype(jnp.int8))
+                kscale = kscale.at[fb, fo].set(ksc)
+                vscale = vscale.at[fb, fo].set(vsc)
+            else:
+                kpool = kpool.at[fb, fo].set(kfl.astype(kpool.dtype))
+                vpool = vpool.at[fb, fo].set(vfl.astype(vpool.dtype))
+
+            def ret(o):
+                out = (o.reshape(B, S,
+                                 c.num_attention_heads * c.head_dim),
+                       kpool, vpool)
+                return out + (kscale, vscale) if quant else out
+
+            if S > 1 and not verify_mode:
                 # PREFILL: causal attention over the fresh block equals
                 # attention against the just-written cache (contiguous
                 # positions from 0) — use the flash/sdpa path; the
@@ -352,33 +398,46 @@ class LlamaAttention(Layer):
                     o = _fa_t(qh, kh2, vh2, causal=True)
                 else:
                     o = _sdpa_ref(qh, kh2, vh2, None, 0.0, True, None)
-                return (o.reshape(B, S,
-                                  c.num_attention_heads * c.head_dim),
-                        kpool, vpool)
-            # DECODE: gather the sequence's cache through its block
-            # table — [B, M, bs, KH, D] -> [B, M*bs, KH, D] in logical
-            # position order — then the same grouped-query masked
-            # attention as :meth:`_forward_cached` (slot index ==
-            # absolute position, valid iff slot <= query position)
+                return ret(o)
+            # DECODE / VERIFY: gather the sequence's cache through its
+            # block table — [B, M, bs, KH, D] -> [B, M*bs, KH, D] in
+            # logical position order — then the same grouped-query
+            # masked attention as :meth:`_forward_cached` (slot index
+            # == absolute position, valid iff slot <= query position).
+            # In verify mode the queries' own K/V were written above,
+            # so slot <= pos is simultaneously the causal mask within
+            # the block and the prefix mask against the cache.
             T = tbl.shape[1] * bs
             kg = kpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
             vg = vpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
+            kgf = kg.astype(jnp.float32)
+            vgf = vg.astype(jnp.float32)
+            if quant:
+                kgf = kgf * kscale[tbl].reshape(B, T)[:, :, None, None]
+                vgf = vgf * vscale[tbl].reshape(B, T)[:, :, None, None]
             G = c.kv_heads
             R = c.num_attention_heads // G
             qg = qh.reshape(B, S, G, R, c.head_dim)
             scale = 1.0 / (c.head_dim ** 0.5)
             logits = jnp.einsum(
                 "bsgrd,btgd->bgrst", qg.astype(jnp.float32),
-                kg.astype(jnp.float32)) * scale        # [B,G,R,S,T]
+                kgf) * scale                           # [B,G,R,S,T]
             valid = (jnp.arange(T)[None, None, None, None, :]
                      <= pos[:, None, None, :, None])
             logits = jnp.where(valid, logits, -jnp.inf)
             w = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bgrst,btgd->bsgrd", w,
-                           vg.astype(jnp.float32)).astype(qv.dtype)
-            return (o.reshape(B, S, c.num_attention_heads * c.head_dim),
-                    kpool, vpool)
+                           vgf).astype(qv.dtype)
+            return ret(o)
 
+        if quant:
+            ctx, kpool, vpool, ksc, vsc = _apply(
+                attn_paged, q, k, v, positions, write_mask,
+                cache["k"], cache["v"], block_tables,
+                cache["k_scale"], cache["v_scale"],
+                op_name="llama_attention_paged")
+            return self.o_proj(ctx), {"k": kpool, "v": vpool,
+                                      "k_scale": ksc, "v_scale": vsc}
         ctx, kpool, vpool = _apply(attn_paged, q, k, v, positions,
                                    write_mask, cache["k"], cache["v"],
                                    block_tables,
@@ -426,10 +485,10 @@ class LlamaDecoderLayer(Layer):
         return h + self.mlp(self.post_attention_layernorm(h)), cache
 
     def forward_paged(self, hidden, positions, cache, block_tables,
-                      write_mask):
+                      write_mask, verify_mode: bool = False):
         attn_out, cache = self.self_attn.forward_paged(
             self.input_layernorm(hidden), positions, cache,
-            block_tables, write_mask)
+            block_tables, write_mask, verify_mode=verify_mode)
         h = hidden + attn_out
         return h + self.mlp(self.post_attention_layernorm(h)), cache
 
@@ -575,10 +634,14 @@ class LlamaModel(Layer):
         return self.norm(hidden)
 
     def forward_paged(self, input_ids, positions, pools, block_tables,
-                      write_mask):
+                      write_mask, verify_mode: bool = False):
         """Paged-KV forward: ``pools`` is one {"k","v"} pool dict per
-        layer, ``block_tables`` [B, max_blocks] int32, ``write_mask``
-        [B, S] bool.  Returns (hidden, new_pools)."""
+        layer (plus ``k_scale``/``v_scale`` for int8 pools),
+        ``block_tables`` [B, max_blocks] int32, ``write_mask`` [B, S]
+        bool.  ``verify_mode``: multi-token blocks whose positions
+        start mid-sequence (spec-decode verify, suffix prefill) attend
+        through the cache gather instead of the fresh-block prefill
+        path.  Returns (hidden, new_pools)."""
         c = self.config
         if self.decoder is not None:
             raise KVCacheUnsupportedError(_SCAN_LAYERS_KV_MSG)
@@ -588,7 +651,8 @@ class LlamaModel(Layer):
         new_pools = []
         for layer, pool in zip(self.layers, pools):
             hidden, pool = layer.forward_paged(hidden, positions, pool,
-                                               block_tables, write_mask)
+                                               block_tables, write_mask,
+                                               verify_mode=verify_mode)
             new_pools.append(pool)
         return self.norm(hidden), new_pools
 
@@ -737,28 +801,52 @@ class LlamaForCausalLM(Layer):
         shared across every concurrent sequence (physical block 0 is
         the conventional trash block — the scheduler must never hand it
         out).  Under a tp mesh the kv-head dim is sharded like
-        :meth:`init_cache`."""
+        :meth:`init_cache`.  ``config.kv_cache_dtype="int8"`` mints
+        int8 pools plus per-(block, slot) f32 scale tensors
+        ``k_scale``/``v_scale`` [num_blocks, block_size] — the ROADMAP
+        item 2 hook: this method and :meth:`LlamaAttention.
+        forward_paged` are the only two quantization sites."""
         if not self.supports_kv_cache():
             raise KVCacheUnsupportedError(_SCAN_LAYERS_KV_MSG)
         c = self.config
-        dt = jnp.dtype(c.compute_dtype) if c.compute_dtype else jnp.float32
+        kvdt = c.kv_cache_dtype
+        quant = kvdt == "int8"
+        if quant:
+            dt = jnp.int8
+        elif kvdt:
+            dt = jnp.dtype(kvdt)
+        else:
+            dt = (jnp.dtype(c.compute_dtype) if c.compute_dtype
+                  else jnp.float32)
         shape = (int(num_blocks), int(block_size), c.kv_heads, c.head_dim)
 
         def make():
             buf = jnp.zeros(shape, dt)
             return mesh_mod.constrain_dim(buf, 2, "tp")
 
+        def make_scale():
+            return jnp.zeros(shape[:2], jnp.float32)
+
+        if quant:
+            return [{"k": make(), "v": make(),
+                     "k_scale": make_scale(), "v_scale": make_scale()}
+                    for _ in range(c.num_hidden_layers)]
         return [{"k": make(), "v": make()}
                 for _ in range(c.num_hidden_layers)]
 
     def forward_paged(self, input_ids, positions, pools, block_tables,
-                      write_mask, gather_at=None):
+                      write_mask, gather_at=None,
+                      verify_mode: bool = False):
         """(logits, pools) through the block-paged cache.  With
         ``gather_at`` [B] the hidden states are gathered at those
         positions BEFORE the vocab projection (prefill only pays the
-        [B, 1, V] projection of its last real token, not [B, S, V])."""
+        [B, 1, V] projection of its last real token, not [B, S, V]).
+        ``verify_mode`` routes S>1 blocks with mid-sequence positions
+        through the cache-gather attention (spec-decode verification,
+        prefix-cache suffix prefill)."""
         hidden, pools = self.model.forward_paged(
-            input_ids, positions, pools, block_tables, write_mask)
+            input_ids, positions, pools, block_tables, write_mask,
+            verify_mode=verify_mode)
         if gather_at is not None:
             hv = hidden._value if isinstance(hidden, Tensor) else hidden
             ga = gather_at._value if isinstance(gather_at, Tensor) \

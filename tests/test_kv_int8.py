@@ -1,0 +1,192 @@
+"""int8 KV-cache pools (ISSUE 11 satellite; ROADMAP item 2 hook).
+
+``LlamaConfig.kv_cache_dtype="int8"`` mints int8 pools + per-(block,
+slot) f32 scale tensors in ``init_paged_cache`` and quantizes on
+write / dequantizes on read in ``forward_paged`` — exactly the two
+sites the ROADMAP promised.  Contracts:
+
+- fp-reference parity: int8 decode logits track the fp pools within a
+  small tolerance (symmetric per-token scales);
+- KV capacity: int8 pools + scales cost well under the bf16 pools'
+  bytes (the bench reports the exact factor);
+- quantization is DETERMINISTIC: eviction + re-admission replay stays
+  bit-identical on an int8 server, and prefix-sharing warm runs equal
+  cold runs (quantize(dequantize) of the same write is the same
+  bytes).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor, no_grad
+from paddle_tpu.inference import GenerationServer
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+
+def _cfg(**kw):
+    d = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+             num_hidden_layers=2, num_attention_heads=4,
+             num_key_value_heads=2, max_position_embeddings=64)
+    d.update(kw)
+    return llama_tiny(**d)
+
+
+@pytest.fixture(scope="module")
+def models():
+    """fp and int8-KV variants of the SAME weights."""
+    paddle.seed(0)
+    fp = LlamaForCausalLM(_cfg())
+    fp.eval()
+    q8 = LlamaForCausalLM(dataclasses.replace(
+        fp.config, kv_cache_dtype="int8"))
+    q8.eval()
+    sd, sd8 = fp.state_dict(), q8.state_dict()
+    for k in sd8:
+        sd8[k]._value = sd[k]._value
+    return fp, q8
+
+
+def _forward(m, ids, pos, pools, tables, wm, gather_at=None,
+             verify=False):
+    with no_grad():
+        lg, pools = m.forward_paged(Tensor(ids), Tensor(pos), pools,
+                                    tables, wm, gather_at=gather_at,
+                                    verify_mode=verify)
+
+    def raw(v):
+        return v._value if isinstance(v, Tensor) else v
+    return (np.asarray(raw(lg)),
+            [{k: raw(v) for k, v in d.items()} for d in pools])
+
+
+def _pool_bytes(pools):
+    return sum(np.asarray(v).nbytes for d in pools for v in d.values())
+
+
+def test_int8_pools_shapes_dtypes_and_capacity(models):
+    fp, q8 = models
+    pf = fp.init_paged_cache(16, 4)
+    pq = q8.init_paged_cache(16, 4)
+    assert set(pq[0]) == {"k", "v", "k_scale", "v_scale"}
+    assert str(np.asarray(pq[0]["k"]).dtype) == "int8"
+    assert np.asarray(pq[0]["k_scale"]).shape == (16, 4)
+    assert str(np.asarray(pq[0]["k_scale"]).dtype) == "float32"
+    factor = _pool_bytes(pf) / _pool_bytes(pq)
+    # bf16 -> int8 halves the rows; the per-token scale costs
+    # 4/(KH*D) per element on top
+    assert factor > 1.5, factor
+
+
+def test_int8_decode_logits_parity_with_fp(models):
+    fp, q8 = models
+    rng = np.random.RandomState(0)
+    p = rng.randint(1, 64, (7,)).astype(np.int32)
+    L = p.shape[0]
+    tbl = np.arange(1, 9, dtype=np.int32)[None, :]
+
+    def run_one(m):
+        pools = m.init_paged_cache(16, 4)
+        ids = np.zeros((1, 8), np.int32)
+        ids[0, :L] = p
+        pos = np.arange(8, dtype=np.int32)[None, :]
+        wm = np.zeros((1, 8), bool)
+        wm[0, :L] = True
+        lg, pools = _forward(m, ids, pos, pools, tbl, wm,
+                             gather_at=np.asarray([L - 1], np.int32))
+        outs = [lg[0, 0]]
+        tok = int(np.argmax(lg[0, 0]))
+        for j in range(4):
+            lg, pools = _forward(m, np.asarray([[tok]], np.int32),
+                                 np.asarray([[L + j]], np.int32),
+                                 pools, tbl, np.ones((1, 1), bool))
+            outs.append(lg[0, 0])
+            tok = int(np.argmax(lg[0, 0]))
+        return outs
+
+    ref = run_one(fp)
+    got = run_one(q8)
+    for r, g in zip(ref, got):
+        assert np.isfinite(g).all()
+        # decode logits read dequantized KV; prefill writes quantize.
+        # tiny-model logits are O(1), so atol is the honest metric
+        np.testing.assert_allclose(g, r, atol=0.15)
+
+
+def test_int8_server_decodes_and_accounts(models):
+    _, q8 = models
+    srv = GenerationServer(q8, num_slots=4, block_size=4,
+                           max_model_len=32, prompt_buckets=[8, 16],
+                           max_prefill_batch=1,
+                           request_timeout_s=120.0)
+    srv.start()
+    try:
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, 64, (l,)).astype(np.int32)
+                   for l in (5, 9, 3, 12)]
+        outs = [srv.submit(p, max_new_tokens=6).result(timeout=120)
+                for p in prompts]
+        assert all(len(o) == 6 for o in outs)
+        st = srv.stats()
+        assert st["free_blocks"] == st["total_blocks"]
+        assert st["traffic_compiles"] == 0
+    finally:
+        srv.stop()
+
+
+def test_int8_eviction_replay_bit_identical(models):
+    """Quantization is a pure function of the write: replay after
+    eviction re-quantizes the same values to the same bytes, so the
+    resumed stream is bit-identical (check_replay asserts live)."""
+    _, q8 = models
+    srv = GenerationServer(q8, num_slots=4, block_size=4,
+                           max_model_len=24, num_blocks=14,
+                           prompt_buckets=[8, 16], max_prefill_batch=1,
+                           check_replay=True, request_timeout_s=120.0)
+    srv.start()
+    try:
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(1, 64, (l,)).astype(np.int32)
+                   for l in (6, 10, 4, 8)]
+        kw = dict(max_new_tokens=12, do_sample=True, temperature=0.9,
+                  top_k=8)
+        base = [srv.submit(p, seed=100 + i, **kw).result(timeout=120)
+                for i, p in enumerate(prompts)]
+        ev0 = srv.stats()["evicted"]
+        streams = [srv.submit(p, seed=100 + i, **kw) for i, p in
+                   enumerate(prompts)]
+        conc = [s.result(timeout=120) for s in streams]
+        st = srv.stats()
+        assert st["evicted"] > ev0
+        assert conc == base
+    finally:
+        srv.stop()
+
+
+def test_int8_composes_with_prefix_sharing(models):
+    _, q8 = models
+    srv = GenerationServer(q8, num_slots=4, block_size=4,
+                           max_model_len=40, prompt_buckets=[8, 16],
+                           max_prefill_batch=1, prefix_cache=True,
+                           check_replay=True, request_timeout_s=120.0)
+    srv.start()
+    try:
+        rng = np.random.RandomState(3)
+        sys_p = rng.randint(1, 64, (12,)).astype(np.int32)
+        prompts = [np.concatenate([sys_p, rng.randint(1, 64, (l,))
+                                   .astype(np.int32)])
+                   for l in (3, 5, 2)]
+        cold = [srv.submit(p, max_new_tokens=6,
+                           do_sample=(i % 2 == 1), temperature=0.9,
+                           top_k=8, seed=100 + i).result(timeout=120)
+                for i, p in enumerate(prompts)]
+        warm = [srv.submit(p, max_new_tokens=6,
+                           do_sample=(i % 2 == 1), temperature=0.9,
+                           top_k=8, seed=100 + i).result(timeout=120)
+                for i, p in enumerate(prompts)]
+        st = srv.stats()
+        assert warm == cold
+        assert st["prefix_hits"] > 0 and st["cow_forks"] >= 1
+    finally:
+        srv.stop()

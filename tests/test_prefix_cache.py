@@ -1,0 +1,320 @@
+"""Inference-gateway prefix sharing + batched prefill (ISSUE 11
+tentpole).
+
+Acceptance contracts, tested directly:
+
+- copy-on-write prefix sharing never changes tokens: a warm
+  (cache-hit) run of a stream is BIT-IDENTICAL to its cold run,
+  greedy AND seeded sampling, and a COW fork never perturbs the
+  sibling stream that still owns the original block;
+- prefill-compute savings are real and visible:
+  ``prefill_tokens_skipped`` grows with every hit and warm admissions
+  prefill only the uncached suffix;
+- block refcount/COW accounting is exact: after mixed shared-prefix
+  traffic — including pool-exhaustion eviction + re-admission — every
+  block is either free or cached-with-only-the-index-reference, and
+  refcounts return to the index baseline (zero leaks);
+- batched prefill (B>1 per bucket) is BIT-IDENTICAL to B=1 prefill
+  row-for-row, and bursts actually coalesce into fewer dispatches;
+- the prefix-sharing server performs ZERO steady-state retraces
+  (``num_compiles`` delta 0 across warm traffic, every compile cause
+  is prewarm);
+- flight-recorder events ``serve.prefix_hit`` / ``serve.cow_fork``
+  are emitted (ISSUE 11 observability satellite).
+
+The module-scoped server is shared; tests that need a cold cache call
+``flush_prefix_cache()`` first (every stream is deterministic per
+seed, so sharing the server never changes tokens — that's the point).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import GenerationServer
+from paddle_tpu.inference.prefix_cache import PrefixCache, chain_hashes
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def lm():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def srv(lm):
+    """Shared prefix-sharing server, ample pool (no eviction)."""
+    s = GenerationServer(lm, num_slots=4, block_size=4,
+                         max_model_len=40, prompt_buckets=[8, 16],
+                         max_prefill_batch=4, prefix_cache=True,
+                         check_replay=True, request_timeout_s=120.0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _chat_prompts(seed=0):
+    """Shared 12-token system prompt + per-conversation tails."""
+    rng = np.random.RandomState(seed)
+    sys_p = rng.randint(1, 64, (12,)).astype(np.int32)
+    return [np.concatenate([sys_p, rng.randint(1, 64, (l,))
+                            .astype(np.int32)])
+            for l in (1, 3, 2, 4)]
+
+
+def _run(srv, prompts, sample=True, max_new=6, concurrent=False):
+    kw = lambda i: dict(max_new_tokens=max_new,
+                        do_sample=sample and (i % 2 == 1),
+                        temperature=0.9, top_k=8, seed=100 + i)
+    if concurrent:
+        streams = [srv.submit(p, **kw(i)) for i, p in enumerate(prompts)]
+        return [s.result(timeout=120) for s in streams]
+    return [srv.submit(p, **kw(i)).result(timeout=120)
+            for i, p in enumerate(prompts)]
+
+
+# -- PrefixCache unit contracts ---------------------------------------
+
+def test_chain_hash_commits_to_whole_prefix():
+    toks = list(range(16))
+    h = chain_hashes(toks, 4)
+    assert len(h) == 4                       # full blocks only
+    assert len(chain_hashes(toks[:15], 4)) == 3
+    # changing an EARLY token changes every later hash (KV depends on
+    # the whole prefix, so the key must too)
+    toks2 = [99] + toks[1:]
+    h2 = chain_hashes(toks2, 4)
+    assert all(a != b for a, b in zip(h, h2))
+    # same prefix -> same chain
+    assert chain_hashes(toks, 4) == h
+
+
+def test_alloc_free_accounting_and_recycle():
+    pc = PrefixCache(4, 4, index_enabled=True, first_block=1)
+    assert pc.available() == 4
+    blocks = [pc.alloc() for _ in range(4)]
+    assert pc.alloc() is None                # exhausted
+    assert pc.in_use() == 4 and 0 not in blocks
+    pc.insert(list(range(8)), blocks)        # index blocks 0,1
+    for b in blocks:
+        pc.unref(b)
+    # 2 indexed blocks stay cached, 2 return free
+    assert pc.available() == 4
+    snap = pc.snapshot()
+    assert snap["cached"] == 2 and snap["free"] == 2
+    assert snap["entries"] == 2
+    # pressure recycles LRU cached blocks and drops their entries
+    got = [pc.alloc() for _ in range(4)]
+    assert None not in got
+    assert pc.snapshot()["entries"] == 0
+    assert pc.snapshot()["recycled"] == 2
+    with pytest.raises(AssertionError):
+        pc.unref(99)                         # unref below zero
+
+
+def test_match_full_and_partial_tail():
+    pc = PrefixCache(8, 4, index_enabled=True, first_block=1)
+    toks = list(range(10, 26))               # 16 tokens = 4 full blocks
+    blocks = [pc.alloc() for _ in range(4)]
+    pc.insert(toks, blocks)
+    # full-prefix match
+    got, n = pc.match(toks[:8])
+    assert got == blocks[:2] and n == 8
+    # full blocks + partial tail inside block 2 (2 of its 4 tokens)
+    got, n = pc.match(toks[:10])
+    assert got == blocks[:3] and n == 10
+    # diverging first token: no match at all
+    got, n = pc.match([99] + toks[1:8])
+    assert got == [] and n == 0
+    # a matched-but-referenced block must trigger COW before writes
+    pc.ref(blocks[2])
+    assert not pc.writable(blocks[2])        # index ref + user ref
+    pc.unref(blocks[2])
+
+
+def test_insert_is_idempotent_and_first_content_wins():
+    pc = PrefixCache(8, 4, index_enabled=True, first_block=1)
+    toks = list(range(8))
+    b1 = [pc.alloc(), pc.alloc()]
+    assert pc.insert(toks, b1) == 2
+    b2 = [pc.alloc(), pc.alloc()]
+    assert pc.insert(toks, b2) == 0          # same content: keep first
+    assert pc.match(toks)[0] == b1
+
+
+# -- server-level sharing contracts -----------------------------------
+
+def test_warm_run_bit_identical_to_cold(srv):
+    srv.flush_prefix_cache()
+    prompts = _chat_prompts()
+    cold = _run(srv, prompts)
+    st1 = srv.stats()
+    warm = _run(srv, prompts)
+    st2 = srv.stats()
+    assert warm == cold
+    assert st2["prefix_hits"] > st1["prefix_hits"]
+    assert st2["prefill_tokens_skipped"] > st1["prefill_tokens_skipped"]
+    # warm admissions aliased at least the shared full blocks
+    assert st2["prefix_hit_tokens"] - st1["prefix_hit_tokens"] >= 4 * 8
+
+
+def test_concurrent_shared_prefix_matches_cold(srv):
+    srv.flush_prefix_cache()
+    prompts = _chat_prompts(seed=1)
+    cold = _run(srv, prompts)
+    conc = _run(srv, prompts, concurrent=True)
+    assert conc == cold
+
+
+def test_cow_fork_never_perturbs_the_sibling(srv):
+    """A long-running stream A shares its prompt blocks; a late
+    arrival B aliases them (including a partial tail inside one of
+    A's full blocks, which COW-forks before B's suffix prefill).  A's
+    stream must equal its solo run exactly; B must equal ITS solo
+    run."""
+    rng = np.random.RandomState(7)
+    pa = rng.randint(1, 64, (16,)).astype(np.int32)   # 4 full blocks
+    pb = pa[:10].copy()          # partial tail inside A's block 2
+    srv.flush_prefix_cache()
+    a_ref = srv.submit(pa, max_new_tokens=16).result(timeout=120)
+    srv.flush_prefix_cache()
+    b_ref = srv.submit(pb, max_new_tokens=6).result(timeout=120)
+    srv.flush_prefix_cache()
+    forks0 = srv.stats()["cow_forks"]
+    a = srv.submit(pa, max_new_tokens=16)
+    next(iter(a))                # A prefilled: its prompt is indexed
+    b = srv.submit(pb, max_new_tokens=6)
+    assert b.result(timeout=120) == b_ref
+    assert a.result(timeout=120) == a_ref
+    st = srv.stats()
+    assert st["cow_forks"] > forks0, \
+        "partial-tail alias did not fork — COW untested"
+
+
+def test_refcounts_return_to_index_baseline_zero_leaks(lm):
+    """Mixed shared-prefix traffic including pool-exhaustion eviction
+    + re-admission: afterwards every allocatable block is free or
+    cached, and every remaining refcount is exactly the index's own
+    reference."""
+    srv = GenerationServer(lm, num_slots=4, block_size=4,
+                           max_model_len=24, num_blocks=14,
+                           prompt_buckets=[8, 16], prefix_cache=True,
+                           max_prefill_batch=1, check_replay=True,
+                           request_timeout_s=120.0)
+    srv.start()
+    try:
+        prompts = [p[:10] for p in _chat_prompts(seed=2)]
+        base = _run(srv, prompts, max_new=12)
+        ev0 = srv.stats()["evicted"]
+        conc = _run(srv, prompts, max_new=12, concurrent=True)
+        st = srv.stats()
+        assert st["evicted"] > ev0, \
+            "pool was never exhausted — eviction + sharing untested"
+        assert conc == base
+        assert st["free_blocks"] == st["total_blocks"]
+        assert st["allocated_blocks"] == 0
+        # refcount baseline: only index references remain, one per
+        # entry, and every cached block IS an indexed block
+        pc = srv._cache
+        assert sum(pc.refcnt.values()) == len(pc.index)
+        assert set(pc.refcnt) == set(pc.entry_of)
+        assert set(pc.lru) == set(pc.entry_of)
+    finally:
+        srv.stop()
+
+
+def test_flush_prefix_cache_returns_blocks(srv):
+    srv.flush_prefix_cache()
+    prompts = _chat_prompts(seed=3)
+    cold = _run(srv, prompts)
+    assert srv.stats()["cached_blocks"] > 0
+    srv.flush_prefix_cache()
+    st = srv.stats()
+    assert st["cached_blocks"] == 0 and st["prefix_entries"] == 0
+    assert st["free_blocks"] == st["total_blocks"]
+    # a re-run is cold again but still bit-identical
+    again = _run(srv, prompts)
+    assert again == cold
+
+
+# -- batched prefill ---------------------------------------------------
+
+def test_batched_prefill_bit_identical_to_b1(lm):
+    prompts = _chat_prompts(seed=4)
+    outs = {}
+    for mb in (1, 4):
+        s = GenerationServer(lm, num_slots=4, block_size=4,
+                             max_model_len=32, prompt_buckets=[16],
+                             max_prefill_batch=mb,
+                             request_timeout_s=120.0)
+        s.start()
+        try:
+            outs[mb] = _run(s, prompts, max_new=5,
+                            concurrent=(mb == 4))
+            if mb == 4:
+                st = s.stats()
+                # batched programs exist per (bucket, batch) pair
+                assert any(k.startswith("prefill:")
+                           and k.endswith("x4")
+                           for k in st["bucket_compiles"])
+        finally:
+            s.stop()
+    assert outs[4] == outs[1]
+
+
+def test_burst_coalesces_into_fewer_prefill_dispatches(lm):
+    """12 same-bucket requests through 4 slots: rounds 2 and 3 are
+    admitted when all four slots free simultaneously, so they MUST
+    batch — far fewer dispatches than admissions."""
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 64, (7,)).astype(np.int32)
+               for _ in range(12)]
+    srv = GenerationServer(lm, num_slots=4, block_size=4,
+                           max_model_len=16, prompt_buckets=[8],
+                           max_prefill_batch=4, request_timeout_s=120.0)
+    srv.start()
+    try:
+        streams = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        outs = [s.result(timeout=120) for s in streams]
+        assert all(len(o) == 4 for o in outs)
+        st = srv.stats()
+        assert st["admitted"] == 12
+        assert st["prefill_batches"] <= 8, st["prefill_batches"]
+        assert st["traffic_compiles"] == 0
+    finally:
+        srv.stop()
+
+
+def test_prefix_server_zero_steady_state_retraces(srv):
+    srv.flush_prefix_cache()
+    prompts = _chat_prompts(seed=5)
+    _run(srv, prompts)
+    n = srv.num_compiles()
+    _run(srv, prompts, concurrent=True)       # warm + batched
+    assert srv.num_compiles() == n
+    st = srv.stats()
+    assert st["traffic_compiles"] == 0
+    assert all(v["cause"] == "prewarm"
+               for v in st["bucket_compiles"].values())
+
+
+def test_prefix_flight_events_and_counters(srv):
+    from paddle_tpu.framework import monitor as _monitor
+    from paddle_tpu.observability import flight_recorder as flight
+    srv.flush_prefix_cache()
+    c0 = _monitor.stat_get("serve_prefix_hits")
+    prompts = _chat_prompts(seed=6)
+    _run(srv, prompts, sample=False)
+    _run(srv, prompts, sample=False)          # warm: hits fire
+    kinds = {e.get("kind") for e in flight.events()}
+    assert "serve.prefix_hit" in kinds
+    assert _monitor.stat_get("serve_prefix_hits") > c0
+    # resubmitting an identical prompt re-writes its clamped last
+    # token into a fully-shared block -> COW fork event
+    assert "serve.cow_fork" in kinds
+    assert _monitor.stat_get("serve_cow_forks") >= 1

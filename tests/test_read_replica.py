@@ -600,3 +600,117 @@ def test_sigkill_primary_mid_read_traffic_acceptance():
         for r in reps:
             r.stop()
         stby.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica-side read coalescing (ISSUE 11 satellite; PR 10 follow-up)
+# ---------------------------------------------------------------------------
+
+def test_coalesced_single_pull_bit_equal_direct():
+    """Even a batch of ONE goes through the union-gather + scatter
+    path: unsorted ids with duplicates must come back exactly like a
+    direct pull."""
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read",
+                          read_coalesce_ms=5.0)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(32, dtype=np.int64)
+        _push_n(w, 3, ids)
+        rd = PSClient([pep], mode="read", max_lag=8,
+                      read_replicas=[rep_ep], **_FAST)
+        odd = np.asarray([7, 3, 3, 31, 0, 7], np.int64)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            got = rd.pull("emb", odd)
+            if np.all(got == 3.0):
+                break
+            time.sleep(0.05)
+        ref = w.pull("emb", odd)          # primary = uncoalesced path
+        assert np.array_equal(got, ref)
+        assert got.shape == (6, 4)
+        rd.close()
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_concurrent_pulls_coalesce_bit_equal():
+    """N concurrent bounded pulls inside the window merge into one
+    gather over the union of ids; every reader's rows are bit-equal
+    to its uncoalesced pull of the quiesced table."""
+    from paddle_tpu.framework import monitor as _monitor
+    prim, pep = _server()
+    rep, rep_ep = _server(replica_of=pep, mode="read",
+                          read_coalesce_ms=40.0)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([pep], **_FAST)
+        ids = np.arange(64, dtype=np.int64)
+        _push_n(w, 4, ids)
+        # wait for the replica to fully catch up (quiesced afterwards)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and rep._watermark < 4:
+            time.sleep(0.02)
+        assert rep._watermark == 4
+        rng = np.random.RandomState(0)
+        id_sets = [np.sort(rng.choice(64, size=24, replace=True))
+                   .astype(np.int64) for _ in range(8)]
+        refs = [w.pull("emb", s).copy() for s in id_sets]
+        b0 = _monitor.stat_get("ps_read_coalesce_batches")
+        p0 = _monitor.stat_get("ps_read_coalesced_pulls")
+        results = [None] * 8
+        errors = []
+        start = threading.Barrier(8)
+
+        def reader(i):
+            try:
+                cli = PSClient([pep], mode="read", max_lag=8,
+                               read_replicas=[rep_ep], **_FAST)
+                start.wait(10.0)
+                results[i] = cli.pull("emb", id_sets[i]).copy()
+                cli.close()
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errors, errors
+        for got, ref in zip(results, refs):
+            assert np.array_equal(got, ref)
+        pulls = _monitor.stat_get("ps_read_coalesced_pulls") - p0
+        batches = _monitor.stat_get("ps_read_coalesce_batches") - b0
+        assert pulls == 8
+        # released together behind a barrier into a 40ms window: at
+        # least one merge actually happened
+        assert batches < pulls, (batches, pulls)
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_coalescer_error_propagates_to_every_rider():
+    from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
+
+    def bad_table(name):
+        raise KeyError(f"unknown table {name!r}")
+    co = _ReadCoalescer(bad_table, 0.02)
+    errs = []
+
+    def puller():
+        try:
+            co.pull("nope", np.arange(4, dtype=np.int64))
+        except KeyError as e:
+            errs.append(e)
+    ts = [threading.Thread(target=puller) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10.0)
+    assert len(errs) == 3     # nobody hangs, everyone gets the error

@@ -1,0 +1,228 @@
+"""Speculative decoding on the inference gateway (ISSUE 11 tentpole).
+
+Acceptance contracts, tested directly:
+
+- GREEDY spec-decode output is TOKEN-IDENTICAL to plain decode (the
+  verify program's per-position logits are bit-equal to S=1 decode's,
+  and a proposal is accepted only when it equals the target's own
+  token);
+- SEEDED-SAMPLING spec decode consumes the same
+  ``fold_in(request_key, position)`` stream as plain decode for every
+  accepted token — streams are token-identical there too;
+- a same-weights draft accepts ~100% and cuts target iterations well
+  below one-per-token; a disagreeing draft still produces the exact
+  plain-decode stream (acceptance only changes SPEED);
+- eviction + re-admission under speculation stays bit-identical
+  (``check_replay`` asserts every replayed verify candidate live);
+- zero steady-state retraces across draft, verify, and prefill
+  programs; spec + prefix sharing compose (warm == cold);
+- the accept-rate gauge / counters and the ``serve.spec_verify``
+  flight event are emitted (ISSUE 11 observability satellite).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import GenerationServer, ServeError
+from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+
+def _cfg(**kw):
+    d = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+             num_hidden_layers=2, num_attention_heads=4,
+             num_key_value_heads=2, max_position_embeddings=64)
+    d.update(kw)
+    return llama_tiny(**d)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    paddle.seed(0)
+    m = LlamaForCausalLM(_cfg())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def other_draft():
+    """Different weights (different seed): a draft that genuinely
+    disagrees with the target."""
+    paddle.seed(123)
+    m = LlamaForCausalLM(_cfg(num_hidden_layers=1))
+    m.eval()
+    return m
+
+
+def _prompts(seed=0, lens=(5, 9, 3, 12)):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 64, (l,)).astype("int32") for l in lens]
+
+
+def _run(srv, prompts, sample, max_new=8):
+    streams = [srv.submit(p, max_new_tokens=max_new, do_sample=sample,
+                          temperature=0.9, top_k=8, seed=50 + i)
+               for i, p in enumerate(prompts)]
+    return [s.result(timeout=120) for s in streams]
+
+
+def _mk(lm, draft=None, **kw):
+    d = dict(num_slots=4, block_size=4, max_model_len=48,
+             prompt_buckets=[8, 16], check_replay=True,
+             max_prefill_batch=1, request_timeout_s=120.0)
+    d.update(kw)
+    return GenerationServer(lm, draft_model=draft, **d).start()
+
+
+@pytest.fixture(scope="module")
+def plain_runs(lm):
+    srv = _mk(lm)
+    try:
+        prompts = _prompts()
+        return {"prompts": prompts,
+                "greedy": _run(srv, prompts, sample=False),
+                "sampled": _run(srv, prompts, sample=True)}
+    finally:
+        srv.stop()
+
+
+@pytest.fixture(scope="module")
+def spec_srv(lm):
+    """Shared spec server: same-weights draft (accepts everywhere)."""
+    srv = _mk(lm, draft=lm, spec_k=3)
+    yield srv
+    srv.stop()
+
+
+def test_greedy_spec_token_identical_to_plain(spec_srv, plain_runs):
+    st0 = spec_srv.stats()
+    got = _run(spec_srv, plain_runs["prompts"], sample=False)
+    assert got == plain_runs["greedy"]
+    st = spec_srv.stats()
+    # a same-weights draft agrees everywhere: every proposal
+    # accepted, and far fewer target iterations than tokens
+    assert st["spec_accept_rate"] == 1.0
+    assert (st["spec_verify_steps"] - st0["spec_verify_steps"]
+            < st["tokens_generated"] - st0["tokens_generated"])
+
+
+def test_seeded_sampling_spec_token_identical_to_plain(spec_srv,
+                                                       plain_runs):
+    got = _run(spec_srv, plain_runs["prompts"], sample=True)
+    assert got == plain_runs["sampled"]
+    assert spec_srv.stats()["spec_accept_rate"] == 1.0
+
+
+def test_disagreeing_draft_still_exact(lm, other_draft, plain_runs):
+    """Acceptance rate only changes speed, NEVER tokens: a draft with
+    different weights produces the exact plain-decode stream."""
+    srv = _mk(lm, draft=other_draft, spec_k=3)
+    try:
+        got_g = _run(srv, plain_runs["prompts"], sample=False)
+        got_s = _run(srv, plain_runs["prompts"], sample=True)
+        st = srv.stats()
+        assert got_g == plain_runs["greedy"]
+        assert got_s == plain_runs["sampled"]
+        assert st["spec_proposed"] > 0
+        assert st["spec_accept_rate"] <= 1.0
+    finally:
+        srv.stop()
+
+
+def test_concurrent_spec_matches_sequential(spec_srv, plain_runs):
+    prompts = plain_runs["prompts"]
+    streams = [spec_srv.submit(p, max_new_tokens=8, seed=50 + i)
+               for i, p in enumerate(prompts)]
+    conc = [s.result(timeout=120) for s in streams]
+    assert conc == plain_runs["greedy"]
+
+
+def test_spec_eviction_readmission_bit_identical(lm):
+    """Pool exhaustion mid-speculation: evicted sequences re-prefill
+    and REPLAY through the verify program (check_replay asserts every
+    replayed candidate); streams equal the uncontended run."""
+    def mk():
+        return GenerationServer(
+            lm, draft_model=lm, spec_k=3, num_slots=4, block_size=4,
+            max_model_len=24, num_blocks=14, prompt_buckets=[8, 16],
+            max_prefill_batch=1, check_replay=True,
+            request_timeout_s=120.0).start()
+    prompts = _prompts(seed=1, lens=(6, 10, 4, 8))
+    kw = dict(max_new_tokens=12, do_sample=True, temperature=0.9,
+              top_k=8)
+    srv = mk()
+    try:
+        base = [srv.submit(p, seed=100 + i, **kw).result(timeout=120)
+                for i, p in enumerate(prompts)]
+        ev0 = srv.stats()["evicted"]
+        streams = [srv.submit(p, seed=100 + i, priority=i, **kw)
+                   for i, p in enumerate(prompts)]
+        conc = [s.result(timeout=120) for s in streams]
+        st = srv.stats()
+        assert st["evicted"] > ev0, \
+            "pool was never exhausted — spec eviction untested"
+        assert conc == base
+        assert st["free_blocks"] == st["total_blocks"]
+        assert st["allocated_blocks"] == 0
+    finally:
+        srv.stop()
+
+
+def test_spec_zero_steady_state_retraces(spec_srv):
+    prompts = _prompts(seed=2)
+    _run(spec_srv, prompts, sample=False)
+    n = spec_srv.num_compiles()
+    _run(spec_srv, prompts, sample=True)
+    assert spec_srv.num_compiles() == n
+    st = spec_srv.stats()
+    assert st["traffic_compiles"] == 0
+    progs = {k.split(":")[0] for k in st["bucket_compiles"]}
+    assert {"prefill", "draft_prefill", "draft_decode",
+            "verify"} <= progs
+
+
+def test_spec_composes_with_prefix_sharing(lm):
+    srv = _mk(lm, draft=lm, spec_k=3, prefix_cache=True)
+    try:
+        rng = np.random.RandomState(11)
+        sys_p = rng.randint(1, 64, (12,)).astype(np.int32)
+        prompts = [np.concatenate([sys_p,
+                                   rng.randint(1, 64, (l,))
+                                   .astype(np.int32)])
+                   for l in (3, 5, 2)]
+        cold = _run(srv, prompts, sample=True)
+        warm = _run(srv, prompts, sample=True)
+        st = srv.stats()
+        assert warm == cold
+        assert st["prefix_hits"] > 0
+        assert st["spec_accept_rate"] == 1.0
+    finally:
+        srv.stop()
+
+
+def test_spec_observability(spec_srv):
+    from paddle_tpu.framework import monitor as _monitor
+    from paddle_tpu.observability import flight_recorder as flight
+    c0 = _monitor.stat_get("serve_spec_proposed")
+    _run(spec_srv, _prompts(seed=3), sample=False)
+    assert _monitor.stat_get("serve_spec_proposed") > c0
+    assert _monitor.stat_get("serve_spec_accepted") > 0
+    evs = [e for e in flight.events()
+           if e.get("kind") == "serve.spec_verify"]
+    assert evs and all("accept_rate" in e for e in evs)
+    from paddle_tpu.observability.flight_recorder import _PROGRESS_KINDS
+    assert "serve.spec_verify" in _PROGRESS_KINDS
+
+
+def test_spec_validation_typed_errors(lm):
+    class NoKV:
+        def supports_kv_cache(self):
+            return False
+    with pytest.raises(ServeError, match="draft_model"):
+        GenerationServer(lm, draft_model=NoKV())
+    paddle.seed(5)
+    other_vocab = LlamaForCausalLM(_cfg(vocab_size=32))
+    other_vocab.eval()
+    with pytest.raises(ValueError, match="vocab_size"):
+        GenerationServer(lm, draft_model=other_vocab)
+    with pytest.raises(ValueError, match="spec_k"):
+        GenerationServer(lm, draft_model=lm, spec_k=0)

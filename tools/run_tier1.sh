@@ -77,13 +77,18 @@ if [ "$CHAOS" -eq 1 ]; then
     # test_serving_ps.py are the ONLINE SERVING TIER suite (ISSUE 10):
     # primary SIGKILL under live read traffic, lossy/delayed replica
     # and geo links, coordinator failover — all seeded + deterministic.
+    # test_prefix_cache.py / test_spec_decode.py / test_kv_int8.py are
+    # the INFERENCE GATEWAY suite (ISSUE 11): pool-exhaustion eviction
+    # + re-admission under prefix sharing, speculation, and int8 KV —
+    # all replay paths bit-checked live (check_replay).
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
         tests/test_crash_mid_save.py tests/test_train_guard.py \
         tests/test_elastic.py tests/test_read_replica.py \
         tests/test_geo.py tests/test_coordinator_ha.py \
-        tests/test_serving_ps.py \
+        tests/test_serving_ps.py tests/test_prefix_cache.py \
+        tests/test_spec_decode.py tests/test_kv_int8.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
