@@ -489,7 +489,7 @@ class _ReadCoalescer:
     uncoalesced pull of the same snapshot (a gather of a gather is the
     same gather).
 
-    The first arriving reader becomes the LEADER: it sleeps the
+    The first arriving reader becomes the LEADER: it waits out the
     window, drains the pending set, executes one ``pull(unique_ids)``
     per table, and scatters rows to every rider via
     ``searchsorted(unique_ids, ids)`` (np.unique returns sorted ids,
@@ -497,17 +497,29 @@ class _ReadCoalescer:
     event.  A failed gather propagates the SAME exception to every
     rider — nobody hangs.
 
-    ``_lock`` only guards the pending list (append/drain); the gather
-    itself runs outside it, and no other ps_service lock is taken
-    while holding it — the coalescer lock is a leaf.
+    The window is a CEILING, not a floor: the leader's wait is an
+    Event it abandons early once ``flush_at`` pulls are pending
+    (amortization achieved — waiting longer only adds latency), and a
+    leader elected on a QUIET replica (no flush within the last
+    window, so there is no evidence of concurrency to wait for)
+    skips the wait entirely — a solitary low-rate pull pays ~zero
+    added latency instead of the whole window.
+
+    ``_lock`` only guards the pending list (append/drain) and the
+    leader-election state; the gather itself runs outside it, and no
+    other ps_service lock is taken while holding it — the coalescer
+    lock is a leaf.
     """
 
-    def __init__(self, table_fn, window_s: float):
+    def __init__(self, table_fn, window_s: float, flush_at: int = 64):
         self._table_fn = table_fn
         self._window = float(window_s)
+        self._flush_at = max(int(flush_at), 1)
         self._lock = threading.Lock()
         self._pending: List[dict] = []
         self._leading = False
+        self._wake = threading.Event()
+        self._last_flush = -float("inf")
 
     def pull(self, table: str, ids):
         req = {"table": table, "ids": ids,
@@ -517,15 +529,22 @@ class _ReadCoalescer:
             lead = not self._leading
             if lead:
                 self._leading = True
+                self._wake = threading.Event()
+                quiet = (time.monotonic() - self._last_flush
+                         > self._window)
+            elif len(self._pending) >= self._flush_at:
+                self._wake.set()
         if not lead:
             req["ev"].wait()
             if req["err"] is not None:
                 raise req["err"]
             return req["vals"]
-        time.sleep(self._window)
+        if not quiet and len(self._pending) < self._flush_at:
+            self._wake.wait(self._window)
         with self._lock:
             batch, self._pending = self._pending, []
             self._leading = False
+            self._last_flush = time.monotonic()
         self._execute(batch)
         if req["err"] is not None:
             raise req["err"]
@@ -588,7 +607,8 @@ class PSServer:
                  stale_after_s: float = 2.0,
                  wm_interval_s: float = 0.25,
                  sink_queue: int = 8192,
-                 read_coalesce_ms: float = 0.0):
+                 read_coalesce_ms: float = 0.0,
+                 read_coalesce_batch: int = 64):
         if on_dead not in ("evict", "fail"):
             raise ValueError(f"on_dead must be 'evict' or 'fail', "
                              f"got {on_dead!r}")
@@ -656,9 +676,11 @@ class PSServer:
         # follow-up): concurrent pulls landing within the window merge
         # into ONE gather over the union of their ids; off by default
         # (it trades up to window_ms latency for gather amortization —
-        # a read replica under fan-out load opts in)
+        # a read replica under fan-out load opts in; quiet replicas
+        # and full batches skip the wait, see _ReadCoalescer)
         self._coalescer = (_ReadCoalescer(self._table,
-                                          read_coalesce_ms / 1e3)
+                                          read_coalesce_ms / 1e3,
+                                          flush_at=read_coalesce_batch)
                            if read_coalesce_ms > 0 else None)
         if replica_of is None:
             self.replica_ready.set()

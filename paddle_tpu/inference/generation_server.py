@@ -381,6 +381,7 @@ class GenerationServer:
             "prefill_tokens_skipped": 0,
             "spec_verify_steps": 0, "draft_steps": 0,
             "spec_proposed": 0, "spec_accepted": 0,
+            "admit_rollbacks": 0, "spec_index_withheld_tokens": 0,
             "prefill_bucket_hits": {b: 0 for b in self._buckets},
         }
 
@@ -890,25 +891,58 @@ class GenerationServer:
                 fork = bool(a and w < a)
                 need = fresh + (1 if seq.L % self._bs == 0 else 0) \
                     + (1 if fork else 0)
-                if self._cache.available() < max(need, 0):
-                    break   # strict priority order: no queue jumping
+                # available() counts LRU-cached blocks, but ref()ing
+                # the hits below pins exactly the LRU ones out of the
+                # recyclable pool — count only what alloc() can still
+                # hand out afterwards (a warm cache under
+                # oversubscription routinely has hits as the BULK of
+                # the recyclable pool)
+                pinned = sum(1 for b in hit_blocks
+                             if b in self._cache.lru)
+                if self._cache.available() - pinned < max(need, 0):
+                    # pinning the hits + the fork destination can make
+                    # the warm path need MORE allocatable blocks than
+                    # a cold admission (which recycles the hit blocks
+                    # as fresh ones) — fall back rather than starve
+                    cold = nb + (1 if seq.L % self._bs == 0 else 0)
+                    if self._cache.available() < cold:
+                        break   # strict priority: no queue jumping
+                    hit_blocks, cached = [], 0
+                    fresh, fork = nb, False
+                    need = cold
                 self._waiting.pop(0)
                 for b in hit_blocks:
                     self._cache.ref(b)
                 seq.blocks = list(hit_blocks)
+                # fresh blocks, plus the COW destination reserved
+                # UNDER the admission check's lock — a same-round
+                # sibling's fresh allocations must not eat the block
+                # the check just promised this fork
+                grabbed: List[int] = []
+                dst = None
                 for _ in range(fresh):
                     blk = self._cache.alloc()
-                    assert blk is not None, "admission check broke"
-                    seq.blocks.append(blk)
+                    if blk is None:
+                        break
+                    grabbed.append(blk)
+                if fork and len(grabbed) == fresh:
+                    dst = self._cache.alloc()
+                if len(grabbed) < fresh or (fork and dst is None):
+                    # the capacity check miscounted: roll back (free
+                    # the grabs, unpin the hits, requeue) so one shed
+                    # admission never kills the scheduler thread
+                    for b in grabbed:
+                        self._cache.unref(b)
+                    for b in hit_blocks:
+                        self._cache.unref(b)
+                    seq.blocks = []
+                    self._waiting.insert(0, seq)
+                    self._stats["admit_rollbacks"] += 1
+                    break
+                seq.blocks.extend(grabbed)
                 seq.cached = cached
                 self._cache.note_query(seq.L, cached)
                 if fork:
-                    # reserve the COW destination UNDER the admission
-                    # check's lock — a same-round sibling's fresh
-                    # allocations must not eat the block the check
-                    # just promised this fork
-                    dst = self._cache.alloc()
-                    assert dst is not None, "admission check broke"
                     self._cache.stats["cow_forks"] += 1
                     forks.append((seq, w, seq.blocks[w], dst))
                 seq.slot = self._free_slots.pop()
@@ -1066,8 +1100,21 @@ class GenerationServer:
             # index completed full blocks (prompt + generated): the
             # next turn of this conversation aliases them — multi-turn
             # chat is the prefix cache's defining traffic
-            self._cache.insert(
-                seq.prompt.tolist() + seq.generated, seq.blocks)
+            toks = seq.prompt.tolist() + seq.generated
+            if self._spec and self._prefix_on:
+                # the draft pools hold valid KV only through position
+                # L + draft_decoded - 1 (capped/rejected proposals
+                # leave the draft behind the emitted stream); indexing
+                # past that would hand a future alias stale draft-KV —
+                # output stays bit-correct via the deterministic
+                # accept, but the accept rate silently sinks for
+                # exactly the warm multi-turn traffic the cache
+                # targets.  Withhold the tail and count it.
+                valid = seq.L + seq.draft_decoded
+                self._stats["spec_index_withheld_tokens"] += max(
+                    len(toks) - valid, 0)
+                toks = toks[:valid]
+            self._cache.insert(toks, seq.blocks)
         self._release(seq)
         with self._lock:
             self._stats["finished"] += 1

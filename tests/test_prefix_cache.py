@@ -228,6 +228,38 @@ def test_refcounts_return_to_index_baseline_zero_leaks(lm):
         srv.stop()
 
 
+def test_warm_admission_survives_lru_only_pool(lm):
+    """Regression: free list EMPTY, LRU holding exactly the blocks a
+    resubmitted cached prompt hits.  available() counts LRU blocks,
+    but admission ref()s the hits — pinning them out of the recyclable
+    pool — so the old check over-admitted, the COW-fork alloc came
+    back None, and the assert killed the scheduler (bricking the
+    server).  The fixed check excludes about-to-be-pinned hits and
+    falls back to a cold admission (recycling the LRU blocks), which
+    must complete bit-identically and leave the scheduler alive."""
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 64, (7,)).astype(np.int32)
+    srv = GenerationServer(lm, num_slots=1, block_size=4,
+                           max_model_len=8, num_blocks=3,
+                           prompt_buckets=[8], prefix_cache=True,
+                           max_prefill_batch=1, check_replay=True,
+                           request_timeout_s=30.0)
+    srv.start()
+    try:
+        first = srv.submit(prompt, max_new_tokens=1).result(timeout=60)
+        # engineered regime: every allocatable block is LRU-cached and
+        # will be a prefix hit of the resubmission
+        assert len(srv._cache.free) == 0
+        assert len(srv._cache.lru) == 2
+        again = srv.submit(prompt, max_new_tokens=1).result(timeout=60)
+        assert again == first
+        # the scheduler survived: a further request still completes
+        third = srv.submit(prompt, max_new_tokens=1).result(timeout=60)
+        assert third == first
+    finally:
+        srv.stop()
+
+
 def test_flush_prefix_cache_returns_blocks(srv):
     srv.flush_prefix_cache()
     prompts = _chat_prompts(seed=3)

@@ -695,6 +695,56 @@ def test_concurrent_pulls_coalesce_bit_equal():
         prim.stop()
 
 
+def test_solitary_pull_skips_the_window():
+    """A leader elected on a QUIET coalescer (no flush within the
+    last window) executes immediately — a low-rate reader must not
+    pay the whole window as a fixed latency floor."""
+    from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
+
+    class _T:
+        def pull(self, ids):
+            return np.asarray(ids, dtype=np.float32)[:, None]
+
+    co = _ReadCoalescer(lambda name: _T(), 0.5)
+    t0 = time.monotonic()
+    out = co.pull("emb", np.arange(4, dtype=np.int64))
+    assert time.monotonic() - t0 < 0.25, "quiet pull paid the window"
+    assert np.array_equal(out.reshape(-1),
+                          np.arange(4, dtype=np.float32))
+
+
+def test_full_batch_flushes_before_window():
+    """Once ``flush_at`` pulls are pending the leader abandons the
+    window wait — amortization is achieved; waiting longer would only
+    add latency."""
+    from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
+
+    class _T:
+        def pull(self, ids):
+            return np.asarray(ids, dtype=np.float32)[:, None]
+
+    co = _ReadCoalescer(lambda name: _T(), 5.0, flush_at=3)
+    co.pull("emb", np.arange(2, dtype=np.int64))   # warm-up: not quiet
+    ok = []
+    start = threading.Barrier(3)
+
+    def reader(i):
+        start.wait(10.0)
+        ids = np.arange(i, i + 4, dtype=np.int64)
+        vals = co.pull("emb", ids)
+        ok.append(np.array_equal(vals.reshape(-1),
+                                 ids.astype(np.float32)))
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30.0)
+    assert len(ok) == 3 and all(ok)
+    assert time.monotonic() - t0 < 2.5, \
+        "full batch still waited out the 5s window"
+
+
 def test_coalescer_error_propagates_to_every_rider():
     from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
 

@@ -195,6 +195,11 @@ def test_spec_composes_with_prefix_sharing(lm):
         assert warm == cold
         assert st["prefix_hits"] > 0
         assert st["spec_accept_rate"] == 1.0
+        # generated-region blocks the draft pools don't cover are
+        # withheld from the index (a future alias would otherwise run
+        # its draft over stale KV and silently sink the accept rate);
+        # the withheld tail is counted so the trade-off is observable
+        assert st["spec_index_withheld_tokens"] > 0
     finally:
         srv.stop()
 
