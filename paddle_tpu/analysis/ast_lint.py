@@ -78,6 +78,9 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/inference/__init__.py",
     "paddle_tpu/observability/trace.py",
     "paddle_tpu/observability/timeline.py",
+    "paddle_tpu/observability/request_trace.py",
+    "paddle_tpu/observability/aggregator.py",
+    "paddle_tpu/observability/slo.py",
     "paddle_tpu/framework/monitor.py",
     "paddle_tpu/distributed/fleet/dist_step.py",
     "paddle_tpu/io/dataloader.py",

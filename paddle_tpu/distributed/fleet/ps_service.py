@@ -796,6 +796,11 @@ class PSServer:
                         else:
                             t = self._table(msg["table"])
                             _send_msg(conn, {"vals": t.pull(msg["ids"])})
+                        if stale is None and _monitor.metrics_enabled():
+                            # per-pull progress counter: the fleet
+                            # aggregator's straggler detection rates
+                            # this across primary + replicas (ISSUE 12)
+                            _monitor.stat_add("ps_server_pulls")
                     elif op in ("push", "push_delta"):
                         applied = self._apply_mutation(msg)
                         if msg.get("sync"):

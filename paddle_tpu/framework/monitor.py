@@ -19,6 +19,17 @@ observation sites (per-RPC, per-request, per-step) gate themselves on
 :func:`enable_metrics`) so the clean path stays untouched by default;
 rare-event counters/gauges (retries, failovers, guard skips) always
 record.
+
+Label extension (ISSUE 12): every family accepts an optional
+``labels={...}`` dict — one series per distinct label set, stored
+under a canonical sorted ``k="v"`` key (exactly the Prometheus label
+syntax, so exposition is a string concat).  The tenant dimension of
+the serving tier (``serve_tenant_tokens_out{tenant="a"}``) and the
+SLO engine's per-objective burn gauges ride this.  Labeled series
+live in SEPARATE maps: the unlabeled snapshot/exposition stays
+byte-identical when no labeled series exist (the ``"labeled"``
+snapshot key only appears once one does), which is what keeps the
+existing golden tests and flusher streams stable.
 """
 from __future__ import annotations
 
@@ -31,8 +42,9 @@ __all__ = ["StatRegistry", "Histogram", "stat_add", "stat_get",
            "stat_reset", "get_all_stats", "stats_with_prefix",
            "gauge_set", "gauge_add", "gauge_get", "hist_observe",
            "get_histogram", "metrics_snapshot", "metrics_reset",
-           "metrics_enabled", "enable_metrics", "device_memory_stats",
-           "max_memory_allocated", "memory_allocated"]
+           "metrics_enabled", "enable_metrics", "label_key",
+           "device_memory_stats", "max_memory_allocated",
+           "memory_allocated"]
 
 _lock = threading.Lock()
 
@@ -47,6 +59,14 @@ def metrics_enabled() -> bool:
 def enable_metrics(on: bool = True):
     global _metrics_on
     _metrics_on = bool(on)
+
+
+def label_key(labels: Dict[str, object]) -> str:
+    """Canonical label-set key: sorted ``k="v"`` pairs joined by commas
+    — exactly the inside of a Prometheus sample's ``{...}``, so the
+    exposition side concatenates it verbatim and two processes agree on
+    series identity (what the fleet aggregator merges on)."""
+    return ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
 
 
 class Histogram:
@@ -105,6 +125,21 @@ class Histogram:
         return {"buckets": buckets, "sum": self.sum,
                 "count": self.count}
 
+    @classmethod
+    def from_snapshot(cls, snap: Dict) -> "Histogram":
+        """Reconstruct a histogram from a ``snapshot()`` dict (the
+        fleet aggregator's merged snapshots become queryable again —
+        ``percentile()`` on the pooled fleet distribution)."""
+        h = cls(buckets=[b for b, _ in snap["buckets"]] or None)
+        prev = 0
+        for i, (_, cum) in enumerate(snap["buckets"]):
+            h.counts[i] = int(cum) - prev
+            prev = int(cum)
+        h.counts[-1] = int(snap["count"]) - prev
+        h.sum = float(snap["sum"])
+        h.count = int(snap["count"])
+        return h
+
 
 class StatRegistry:
     """Named monotonic/settable int64 counters (parity:
@@ -116,14 +151,30 @@ class StatRegistry:
         self._stats: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
+        # labeled series: family name -> {label_key -> value/Histogram}
+        self._lstats: Dict[str, Dict[str, int]] = {}
+        self._lgauges: Dict[str, Dict[str, float]] = {}
+        self._lhists: Dict[str, Dict[str, Histogram]] = {}
 
-    def add(self, name: str, delta: int = 1) -> int:
+    def add(self, name: str, delta: int = 1,
+            labels: Optional[Dict] = None) -> int:
+        if labels:
+            lk = label_key(labels)
+            with _lock:
+                fam = self._lstats.setdefault(name, {})
+                v = fam.get(lk, 0) + int(delta)
+                fam[lk] = v
+                return v
         with _lock:
             v = self._stats.get(name, 0) + int(delta)
             self._stats[name] = v
             return v
 
-    def get(self, name: str) -> int:
+    def get(self, name: str, labels: Optional[Dict] = None) -> int:
+        if labels:
+            with _lock:
+                return self._lstats.get(name, {}).get(
+                    label_key(labels), 0)
         with _lock:
             return self._stats.get(name, 0)
 
@@ -143,63 +194,111 @@ class StatRegistry:
             return dict(self._stats)
 
     # -- gauges ---------------------------------------------------------
-    def gauge_set(self, name: str, value: float) -> float:
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict] = None) -> float:
+        v = float(value)
+        if labels:
+            with _lock:
+                self._lgauges.setdefault(name, {})[label_key(labels)] = v
+                return v
         with _lock:
-            v = float(value)
             self._gauges[name] = v
             return v
 
-    def gauge_add(self, name: str, delta: float = 1.0) -> float:
+    def gauge_add(self, name: str, delta: float = 1.0,
+                  labels: Optional[Dict] = None) -> float:
+        if labels:
+            lk = label_key(labels)
+            with _lock:
+                fam = self._lgauges.setdefault(name, {})
+                v = fam.get(lk, 0.0) + float(delta)
+                fam[lk] = v
+                return v
         with _lock:
             v = self._gauges.get(name, 0.0) + float(delta)
             self._gauges[name] = v
             return v
 
-    def gauge_get(self, name: str, default: float = 0.0) -> float:
+    def gauge_get(self, name: str, default: float = 0.0,
+                  labels: Optional[Dict] = None) -> float:
+        if labels:
+            with _lock:
+                return self._lgauges.get(name, {}).get(
+                    label_key(labels), default)
         with _lock:
             return self._gauges.get(name, default)
 
     # -- histograms -----------------------------------------------------
     def observe(self, name: str, value: float,
-                buckets: Optional[Sequence[float]] = None):
+                buckets: Optional[Sequence[float]] = None,
+                labels: Optional[Dict] = None):
         with _lock:
-            h = self._hists.get(name)
-            if h is None:
-                h = self._hists[name] = Histogram(buckets)
+            if labels:
+                fam = self._lhists.setdefault(name, {})
+                lk = label_key(labels)
+                h = fam.get(lk)
+                if h is None:
+                    h = fam[lk] = Histogram(buckets)
+            else:
+                h = self._hists.get(name)
+                if h is None:
+                    h = self._hists[name] = Histogram(buckets)
             h.observe(value)
 
-    def histogram(self, name: str) -> Optional[Histogram]:
+    def histogram(self, name: str,
+                  labels: Optional[Dict] = None) -> Optional[Histogram]:
         with _lock:
+            if labels:
+                return self._lhists.get(name, {}).get(label_key(labels))
             return self._hists.get(name)
 
     def metrics_snapshot(self) -> Dict:
         """Point-in-time view of all three metric families — what the
-        Prometheus exposition and the JSONL flusher render."""
+        Prometheus exposition and the JSONL flusher render.  The
+        ``"labeled"`` key appears ONLY once a labeled series exists, so
+        label-free processes keep their exact pre-label snapshot shape
+        (golden/flusher stability)."""
         with _lock:
-            return {
+            snap = {
                 "counters": dict(self._stats),
                 "gauges": dict(self._gauges),
                 "histograms": {n: h.snapshot()
                                for n, h in self._hists.items()},
             }
+            if self._lstats or self._lgauges or self._lhists:
+                snap["labeled"] = {
+                    "counters": {n: dict(f)
+                                 for n, f in self._lstats.items()},
+                    "gauges": {n: dict(f)
+                               for n, f in self._lgauges.items()},
+                    "histograms": {
+                        n: {lk: h.snapshot() for lk, h in f.items()}
+                        for n, f in self._lhists.items()},
+                }
+            return snap
 
     def metrics_reset(self):
         with _lock:
             self._stats.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._lstats.clear()
+            self._lgauges.clear()
+            self._lhists.clear()
 
 
 _registry = StatRegistry()
 
 
-def stat_add(name: str, delta: int = 1) -> int:
-    """STAT_ADD analog."""
-    return _registry.add(name, delta)
+def stat_add(name: str, delta: int = 1,
+             labels: Optional[Dict] = None) -> int:
+    """STAT_ADD analog.  ``labels`` selects one series of a labeled
+    family (e.g. ``labels={"tenant": "a"}``)."""
+    return _registry.add(name, delta, labels=labels)
 
 
-def stat_get(name: str) -> int:
-    return _registry.get(name)
+def stat_get(name: str, labels: Optional[Dict] = None) -> int:
+    return _registry.get(name, labels=labels)
 
 
 def stat_reset(name: Optional[str] = None):
@@ -218,27 +317,32 @@ def stats_with_prefix(prefix: str) -> Dict[str, int]:
             if k.startswith(prefix)}
 
 
-def gauge_set(name: str, value: float) -> float:
-    return _registry.gauge_set(name, value)
+def gauge_set(name: str, value: float,
+              labels: Optional[Dict] = None) -> float:
+    return _registry.gauge_set(name, value, labels=labels)
 
 
-def gauge_add(name: str, delta: float = 1.0) -> float:
-    return _registry.gauge_add(name, delta)
+def gauge_add(name: str, delta: float = 1.0,
+              labels: Optional[Dict] = None) -> float:
+    return _registry.gauge_add(name, delta, labels=labels)
 
 
-def gauge_get(name: str, default: float = 0.0) -> float:
-    return _registry.gauge_get(name, default)
+def gauge_get(name: str, default: float = 0.0,
+              labels: Optional[Dict] = None) -> float:
+    return _registry.gauge_get(name, default, labels=labels)
 
 
 def hist_observe(name: str, value: float,
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 labels: Optional[Dict] = None):
     """Record one sample into the named fixed-bucket histogram (created
     on first observe; ``buckets`` only applies then)."""
-    _registry.observe(name, value, buckets)
+    _registry.observe(name, value, buckets, labels=labels)
 
 
-def get_histogram(name: str) -> Optional[Histogram]:
-    return _registry.histogram(name)
+def get_histogram(name: str,
+                  labels: Optional[Dict] = None) -> Optional[Histogram]:
+    return _registry.histogram(name, labels=labels)
 
 
 def metrics_snapshot() -> Dict:

@@ -93,6 +93,20 @@ gauges on the /metrics endpoint), and flight-recorder events
 ``serve.prefix_hit`` / ``serve.cow_fork`` + sampled ``serve.decode``
 and ``serve.spec_verify``) so ``tools/postmortem.py`` can autopsy a
 pool-exhaustion shed.
+
+ISSUE 12 (fleet observatory) adds the REQUEST dimension:
+``submit(tenant=...)`` tags a request for usage accounting (always-on
+labeled counters ``serve_tenant_tokens_in/out`` /
+``serve_tenant_sheds`` / ``serve_tenant_prefix_hit_tokens`` plus the
+untagged ``serve_tokens_in/out`` totals they sum to), and with tracing
+on every request gets its own span lane
+(:mod:`~paddle_tpu.observability.request_trace`): submit -> queue ->
+admit[cold/prefix-hit/readmit] -> prefill -> sampled decode steps ->
+first_token/evict/finish, one Perfetto lane per request, with the
+span-carried ``ttft_ms`` equal BY CONSTRUCTION to the value
+``serve_ttft_ms`` observed.  ``serve_admit_rollbacks`` and
+``serve_spec_index_withheld_tokens`` (PR 11 review fixes) are
+always-on counters too, the rollback also a flight event.
 """
 from __future__ import annotations
 
@@ -105,6 +119,8 @@ import numpy as np
 
 from ..framework import monitor as _monitor
 from ..observability import flight_recorder as _flight
+from ..observability import trace as _trace
+from ..observability.request_trace import RequestTrace
 from .prefix_cache import PrefixCache
 from .serving import (RequestTimeout, ServeError, ServerClosed,
                       ServerOverloaded)
@@ -191,10 +207,12 @@ class _GenSeq:
         "rid", "prompt", "L", "max_new", "eos", "do_sample", "temp",
         "top_k", "top_p", "key_data", "priority", "arrival", "deadline",
         "stream", "generated", "decoded", "blocks", "slot", "evictions",
-        "t_submit", "t_first_tok", "cached", "draft_decoded")
+        "t_submit", "t_first_tok", "cached", "draft_decoded", "tenant",
+        "rt")
 
     def __init__(self, rid, prompt, max_new, eos, do_sample, temp,
-                 top_k, top_p, key_data, priority, arrival, deadline):
+                 top_k, top_p, key_data, priority, arrival, deadline,
+                 tenant=None):
         self.rid = rid
         self.prompt = prompt                  # np.int32 [L]
         self.L = int(prompt.shape[0])
@@ -218,6 +236,10 @@ class _GenSeq:
         self.t_first_tok: Optional[float] = None
         self.cached = 0           # prefix tokens aliased at admission
         self.draft_decoded = 0    # generated tokens the draft consumed
+        self.tenant = tenant      # usage-accounting tag (ISSUE 12)
+        # per-request span lane; None keeps the traced-off path at one
+        # attribute check per site
+        self.rt: Optional[RequestTrace] = None
 
 
 def _pow2_buckets(lo: int, hi: int) -> List[int]:
@@ -680,6 +702,8 @@ class GenerationServer:
             self._waiting.clear()
         for seq in leftovers:
             self._release(seq)
+            if seq.rt is not None:
+                seq.rt.finish("server_stopped")
             seq.stream._fail(ServerClosed("server stopped"))
 
     def __enter__(self) -> "GenerationServer":
@@ -698,13 +722,21 @@ class GenerationServer:
                top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None,
                seed: Optional[int] = None, priority: int = 0,
-               timeout_s: Optional[float] = None) -> GenerationStream:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> GenerationStream:
         """Enqueue one generation request; returns a
         :class:`GenerationStream` that yields tokens as decode steps
         complete.  ``priority``: lower = more important (evicted last).
         ``seed`` fixes the request's sampling RNG stream (default:
-        derived from the server seed + request id).  Raises
-        :class:`ServerOverloaded` at the waiting-queue cap."""
+        derived from the server seed + request id).  ``tenant`` tags
+        the request for usage accounting: always-on labeled counters
+        (``serve_tenant_tokens_in/out``, ``serve_tenant_sheds``,
+        ``serve_tenant_prefix_hit_tokens`` + a ``serve_tenant_queue_ms``
+        gauge) accumulate per tenant, and — tagged or not — the request
+        also counts into the untagged ``serve_tokens_in/out`` totals,
+        so all-tagged traffic's tenant series sum EXACTLY to the
+        totals.  Raises :class:`ServerOverloaded` at the waiting-queue
+        cap."""
         if not self._running:
             raise ServerClosed("server not started")
         p = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
@@ -733,13 +765,23 @@ class GenerationServer:
                 seq = _GenSeq(self._rid, p, max_new_tokens,
                               eos_token_id, do_sample, temperature,
                               top_k, top_p, key_data, priority,
-                              self._arrival, time.monotonic() + to)
+                              self._arrival, time.monotonic() + to,
+                              tenant=tenant)
+                if _trace.enabled():
+                    seq.rt = RequestTrace("gen", seq.rid, tenant)
+                    seq.rt.instant("submit", prompt_len=seq.L,
+                                   max_new=seq.max_new)
+                    seq.rt.begin("queue")
                 self._waiting.append(seq)
                 self._stats["submitted"] += 1
                 self._cond.notify_all()
                 shed_depth = None
         if shed_depth is not None:
             _monitor.stat_add("serve_shed_overload")
+            if tenant is not None:
+                _monitor.stat_add("serve_tenant_sheds",
+                                  labels={"tenant": tenant,
+                                          "reason": "overload"})
             _flight.record("serve.shed", reason="overload",
                            depth=shed_depth, server="generation")
             _flight.maybe_dump("ServerOverloaded")
@@ -840,6 +882,8 @@ class GenerationServer:
                 self._active.clear()
                 self._running = False
             for seq in victims:
+                if seq.rt is not None:
+                    seq.rt.finish("scheduler_error")
                 seq.stream._fail(ServeError(
                     f"generation scheduler died: {e!r}"))
             raise
@@ -856,11 +900,17 @@ class GenerationServer:
                 self._stats["shed_timeout"] += 1
         for s in expired:
             _monitor.stat_add("serve_shed_timeout")
+            if s.tenant is not None:
+                _monitor.stat_add("serve_tenant_sheds",
+                                  labels={"tenant": s.tenant,
+                                          "reason": "timeout"})
             _flight.record("serve.shed", reason="timeout", rid=s.rid,
                            waited_ms=round((now - s.t_submit) * 1e3, 1),
                            evictions=s.evictions, server="generation")
             _flight.record("serve.stream_end", rid=s.rid,
                            reason="timeout", tokens=len(s.generated))
+            if s.rt is not None:
+                s.rt.finish("shed_timeout", tokens=len(s.generated))
             s.stream._fail(RequestTimeout(
                 f"request {s.rid} spent its whole deadline "
                 + ("evicted and waiting for re-admission"
@@ -875,6 +925,7 @@ class GenerationServer:
         — the batched-prefill win)."""
         taken: List[_GenSeq] = []
         forks: List[tuple] = []
+        rollback: Optional[_GenSeq] = None
         with self._lock:
             while self._waiting and self._free_slots:
                 self._waiting.sort(key=lambda s: (s.priority, s.arrival))
@@ -938,6 +989,7 @@ class GenerationServer:
                     seq.blocks = []
                     self._waiting.insert(0, seq)
                     self._stats["admit_rollbacks"] += 1
+                    rollback = seq
                     break
                 seq.blocks.extend(grabbed)
                 seq.cached = cached
@@ -948,6 +1000,41 @@ class GenerationServer:
                 seq.slot = self._free_slots.pop()
                 self._active[seq.slot] = seq
                 taken.append(seq)
+        if rollback is not None:
+            # shed-class anomaly (ISSUE 12 satellite): the capacity
+            # check miscounted and one admission was rolled back —
+            # always-on counter + flight event (postmortem _BAD_KINDS)
+            _monitor.stat_add("serve_admit_rollbacks")
+            _flight.record("serve.admit_rollback", rid=rollback.rid,
+                           prompt_len=rollback.L,
+                           available=self._cache.available())
+            if rollback.rt is not None:
+                rollback.rt.instant("admit_rollback")
+        for seq in taken:
+            # usage accounting at admission: queue age per wait,
+            # prompt tokens once per REQUEST (re-admissions re-alias,
+            # they don't re-ingest)
+            queue_ms = (time.monotonic() - seq.t_submit) * 1e3
+            if seq.evictions == 0:
+                _monitor.stat_add("serve_tokens_in", seq.L)
+            if seq.tenant is not None:
+                lab = {"tenant": seq.tenant}
+                if seq.evictions == 0:
+                    # first admission: queue age == submit -> now; a
+                    # re-admission's wait shows on its req.queue span
+                    _monitor.stat_add("serve_tenant_tokens_in", seq.L,
+                                      labels=lab)
+                    _monitor.gauge_add("serve_tenant_queue_ms",
+                                       queue_ms, labels=lab)
+                if seq.cached:
+                    _monitor.stat_add("serve_tenant_prefix_hit_tokens",
+                                      seq.cached, labels=lab)
+            if seq.rt is not None:
+                seq.rt.end("queue", evictions=seq.evictions)
+                kind = ("readmit" if seq.evictions
+                        else "prefix-hit" if seq.cached else "cold")
+                seq.rt.instant("admit", kind=kind, cached=seq.cached,
+                               blocks=len(seq.blocks), slot=seq.slot)
         if not taken:
             return
         # COW-fork each aliased tail block the suffix prefill will
@@ -1011,6 +1098,8 @@ class GenerationServer:
             top_k[i] = seq.top_k
             top_p[i] = seq.top_p
             do_sample[i] = seq.do_sample
+            if seq.rt is not None:
+                seq.rt.begin("prefill")
         t0 = time.perf_counter()
         first, self._pools = self._prefill_fn(
             self._pvals, self._pools, prompt, start, length, tables,
@@ -1033,6 +1122,10 @@ class GenerationServer:
                 sum(s.cached for s in seqs))
         if _monitor.metrics_enabled():
             _monitor.hist_observe("prefill_ms", dt_ms)
+        for seq in seqs:
+            if seq.rt is not None:
+                seq.rt.end("prefill", bucket=bucket, batch=len(seqs),
+                           suffix=seq.L - seq.cached)
         for i, seq in enumerate(seqs):
             self._post_prefill(seq, int(first[i]), bucket)
 
@@ -1082,10 +1175,14 @@ class GenerationServer:
         seq.generated.append(tok)
         if seq.t_first_tok is None:
             seq.t_first_tok = time.monotonic()
+            # ONE ttft value feeds both the histogram and the span
+            # lane: the span view and serve_ttft_ms must agree exactly
+            # (the ISSUE 12 consistency contract)
+            ttft_ms = (seq.t_first_tok - seq.t_submit) * 1e3
             if _monitor.metrics_enabled():
-                _monitor.hist_observe(
-                    "serve_ttft_ms",
-                    (seq.t_first_tok - seq.t_submit) * 1e3)
+                _monitor.hist_observe("serve_ttft_ms", ttft_ms)
+            if seq.rt is not None:
+                seq.rt.instant("first_token", ttft_ms=ttft_ms)
         seq.stream._emit(tok)
         with self._lock:
             self._stats["tokens_generated"] += 1
@@ -1096,6 +1193,7 @@ class GenerationServer:
             self._finish(seq, reason)
 
     def _finish(self, seq: _GenSeq, reason: str):
+        withheld = 0
         with self._lock:
             # index completed full blocks (prompt + generated): the
             # next turn of this conversation aliases them — multi-turn
@@ -1111,17 +1209,30 @@ class GenerationServer:
                 # exactly the warm multi-turn traffic the cache
                 # targets.  Withhold the tail and count it.
                 valid = seq.L + seq.draft_decoded
-                self._stats["spec_index_withheld_tokens"] += max(
-                    len(toks) - valid, 0)
+                withheld = max(len(toks) - valid, 0)
+                self._stats["spec_index_withheld_tokens"] += withheld
                 toks = toks[:valid]
             self._cache.insert(toks, seq.blocks)
         self._release(seq)
         with self._lock:
             self._stats["finished"] += 1
+        if withheld:
+            # stats()-only until ISSUE 12: the accept-rate sink is a
+            # fleet-visible signal, so it counts on /metrics too
+            _monitor.stat_add("serve_spec_index_withheld_tokens",
+                              withheld)
         _monitor.stat_add("serve_gen_finished")
+        _monitor.stat_add("serve_tokens_out", len(seq.generated))
+        if seq.tenant is not None:
+            _monitor.stat_add("serve_tenant_tokens_out",
+                              len(seq.generated),
+                              labels={"tenant": seq.tenant})
         _flight.record("serve.stream_end", rid=seq.rid, reason=reason,
                        tokens=len(seq.generated),
                        evictions=seq.evictions)
+        if seq.rt is not None:
+            seq.rt.finish(reason, tokens=len(seq.generated),
+                          evictions=seq.evictions)
         seq.stream._end(reason)
 
     def _release(self, seq: _GenSeq):
@@ -1156,6 +1267,9 @@ class GenerationServer:
                        reason="pool_exhausted", freed_blocks=freed,
                        tokens_so_far=len(seq.generated),
                        priority=seq.priority, evictions=seq.evictions)
+        if seq.rt is not None:
+            seq.rt.instant("evict", tokens=len(seq.generated))
+            seq.rt.begin("queue")   # waiting for re-admission
         _flight.maybe_dump("BlockPoolExhausted")
 
     def _grow_or_evict(self):
@@ -1220,9 +1334,13 @@ class GenerationServer:
         nxt = np.asarray(nxt)
         dt_ms = (time.perf_counter() - t0) * 1e3
         replays = 0
+        every = _trace.trace_every()
         for seq in live:
             s = seq.slot
             seq.decoded += 1
+            if seq.rt is not None and seq.decoded % every == 0:
+                # sampled per-request decode span (PADDLE_TRACE_EVERY)
+                seq.rt.span_at("decode", dt_ms, step=seq.decoded)
             j = seq.decoded + 1          # 1-based index produced
             if j <= len(seq.generated):
                 replays += 1             # catching up after eviction
@@ -1431,6 +1549,10 @@ class GenerationServer:
             if seq.slot is not None:
                 seq.decoded = min(f0 + valid_fed,
                                   len(seq.generated) - 1)
+                if seq.rt is not None \
+                        and seq.decoded % _trace.trace_every() == 0:
+                    seq.rt.span_at("decode", dt_ms, step=seq.decoded,
+                                   spec=True)
                 # draft validity: a fed token counts while it matches
                 # the FINAL stream at its index (stored feeds match by
                 # construction; proposal feeds match iff accepted) —

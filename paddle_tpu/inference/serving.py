@@ -55,6 +55,7 @@ import numpy as np
 from ..framework import monitor as _monitor
 from ..observability import flight_recorder as _flight
 from ..observability import trace as _trace
+from ..observability.request_trace import RequestTrace
 
 __all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
            "ServerClosed", "RequestTimeout", "UpstreamUnavailable"]
@@ -116,15 +117,18 @@ class _Future:
 
 
 class _Request:
-    __slots__ = ("arrays", "n", "future", "t_submit", "deadline")
+    __slots__ = ("arrays", "n", "future", "t_submit", "deadline",
+                 "tenant", "rt")
 
     def __init__(self, arrays: List[np.ndarray], n: int,
-                 deadline: float):
+                 deadline: float, tenant: Optional[str] = None):
         self.arrays = arrays
         self.n = n
         self.future = _Future()
         self.t_submit = time.monotonic()
         self.deadline = deadline
+        self.tenant = tenant
+        self.rt: Optional[RequestTrace] = None
 
 
 def _default_buckets(max_batch: int) -> List[int]:
@@ -208,6 +212,7 @@ class PredictorServer:
         self._running = False
         self._carry: Optional[_Request] = None
         self._lock = threading.Lock()
+        self._rid = 0                 # request-lane ids (ISSUE 12)
         self._stats = {
             "requests": 0, "examples": 0, "batches": 0,
             "padded_examples": 0, "shed_overload": 0, "shed_timeout": 0,
@@ -250,8 +255,12 @@ class PredictorServer:
                 req = self._q.get_nowait()
             except _queue.Empty:
                 break
+            if req.rt is not None:
+                req.rt.finish("server_stopped")
             req.future.set_exception(ServerClosed("server stopped"))
         if self._carry is not None:
+            if self._carry.rt is not None:
+                self._carry.rt.finish("server_stopped")
             self._carry.future.set_exception(
                 ServerClosed("server stopped"))
             self._carry = None
@@ -264,10 +273,15 @@ class PredictorServer:
 
     # -- client surface ----------------------------------------------
     def submit(self, inputs: Sequence[np.ndarray],
-               timeout_s: Optional[float] = None) -> _Future:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> _Future:
         """Enqueue one request (list of arrays, shared leading batch
         dim).  Returns a future; raises :class:`ServerOverloaded` when
         the queue is at its cap and :class:`ServerClosed` when stopped.
+        ``tenant`` tags the request for usage accounting (always-on
+        ``serve_tenant_examples`` / ``serve_tenant_sheds`` labeled
+        counters + a ``serve_tenant_queue_ms`` gauge) and for the
+        per-request trace lane when tracing is on (ISSUE 12).
         """
         if not self._running:
             raise ServerClosed("server not started")
@@ -285,13 +299,26 @@ class PredictorServer:
                 f"request batch {n} exceeds max_batch="
                 f"{self._max_batch}; split it client-side")
         to = self._timeout_s if timeout_s is None else float(timeout_s)
-        req = _Request(arrays, n, time.monotonic() + to)
+        req = _Request(arrays, n, time.monotonic() + to, tenant=tenant)
+        if _trace.enabled():
+            with self._lock:
+                self._rid += 1
+                rid = self._rid
+            req.rt = RequestTrace("pred", rid, tenant)
+            req.rt.instant("submit", rows=n)
+            req.rt.begin("queue")
         try:
             self._q.put_nowait(req)
         except _queue.Full:
             with self._lock:
                 self._stats["shed_overload"] += 1
             _monitor.stat_add("serve_shed_overload")
+            if tenant is not None:
+                _monitor.stat_add("serve_tenant_sheds",
+                                  labels={"tenant": tenant,
+                                          "reason": "overload"})
+            if req.rt is not None:
+                req.rt.finish("shed_overload")
             _flight.record("serve.shed", reason="overload",
                            depth=self._q.qsize(), rows=n)
             # typed-failure trigger (rate limited: a load spike sheds
@@ -305,11 +332,13 @@ class PredictorServer:
         return req.future
 
     def infer(self, inputs: Sequence[np.ndarray],
-              timeout_s: Optional[float] = None) -> List[np.ndarray]:
+              timeout_s: Optional[float] = None,
+              tenant: Optional[str] = None) -> List[np.ndarray]:
         """Blocking submit + wait.  Thread-safe; this is the per-client
         call the bench's concurrent workers use."""
         to = self._timeout_s if timeout_s is None else float(timeout_s)
-        return self.submit(inputs, timeout_s=to).result(timeout=to)
+        return self.submit(inputs, timeout_s=to,
+                           tenant=tenant).result(timeout=to)
 
     def stats(self) -> Dict:
         with self._lock:
@@ -395,6 +424,8 @@ class PredictorServer:
             except BaseException as e:    # noqa: BLE001 - fail futures
                 for r in batch:
                     if not r.future.done():
+                        if r.rt is not None:
+                            r.rt.finish("batch_error")
                         r.future.set_exception(
                             ServeError(f"batch execution failed: {e!r}"))
 
@@ -406,9 +437,15 @@ class PredictorServer:
                 with self._lock:
                     self._stats["shed_timeout"] += 1
                 _monitor.stat_add("serve_shed_timeout")
+                if r.tenant is not None:
+                    _monitor.stat_add("serve_tenant_sheds",
+                                      labels={"tenant": r.tenant,
+                                              "reason": "timeout"})
                 _flight.record("serve.shed", reason="timeout",
                                queued_ms=round(
                                    (t0 - r.t_submit) * 1e3, 3))
+                if r.rt is not None:
+                    r.rt.finish("shed_timeout")
                 r.future.set_exception(RequestTimeout(
                     "request spent its whole deadline queued — server "
                     "overloaded"))
@@ -416,6 +453,18 @@ class PredictorServer:
                 live.append(r)
         if not live:
             return
+        for r in live:
+            # usage accounting at batch entry: the queue phase ends
+            # here whether the batch later succeeds or sheds
+            if r.tenant is not None:
+                lab = {"tenant": r.tenant}
+                _monitor.stat_add("serve_tenant_examples", r.n,
+                                  labels=lab)
+                _monitor.gauge_add("serve_tenant_queue_ms",
+                                   (t0 - r.t_submit) * 1e3, labels=lab)
+            if r.rt is not None:
+                r.rt.end("queue")
+                r.rt.begin("run")
         queue_s = sum(t0 - r.t_submit for r in live)
         rows = sum(r.n for r in live)
         bucket = self._bucket_for(rows)
@@ -474,6 +523,13 @@ class PredictorServer:
                         f"fan-out and primary failover: {e}")
                     err.__cause__ = e
                     for r in live:
+                        if r.tenant is not None:
+                            _monitor.stat_add(
+                                "serve_tenant_sheds",
+                                labels={"tenant": r.tenant,
+                                        "reason": "ps_read"})
+                        if r.rt is not None:
+                            r.rt.finish("shed_ps")
                         r.future.set_exception(err)
                     return
                 ps_s = time.monotonic() - t1
@@ -504,6 +560,8 @@ class PredictorServer:
                 s["run_ms"] += (t2 - t1 - ps_s) * 1e3
                 s["unpad_ms"] += (t3 - t2) * 1e3
             for r, sl in zip(live, slices):
+                if r.rt is not None:
+                    r.rt.finish("ok", rows=r.n, bucket=bucket)
                 r.future.set_result(sl)
         finally:
             # a failed run must still close the span, or the batcher

@@ -19,11 +19,27 @@ off by default, opt-in by env, ~zero cost when off):
    (data wait / h2d / dispatch / health fetch / host) with
    ``trace_every=N`` sampling.
 
+ISSUE 12 grows the subsystem into a FLEET observatory:
+
+4. **Per-request tracing + tenants** (:mod:`.request_trace`): one
+   span lane per serving request (submit -> queue -> admit -> prefill
+   -> sampled decode -> finish) and ``tenant=`` usage accounting via
+   the registry's new labeled series.
+5. **Fleet aggregator** (:mod:`.aggregator`): scrapes N
+   ``/metrics.json`` endpoints or flusher JSONL files, merges
+   counters/le-buckets EXACTLY, computes per-process rates, flags
+   stragglers (k x MAD below fleet median) and stale scrapees;
+   ``/fleet`` + ``tools/fleet_top.py``.
+6. **SLO engine** (:mod:`.slo`): declarative objectives (latency
+   percentile, error rate, gauge bound) with multi-window burn
+   rates; a breach is a flight-recorder event + postmortem bundle.
+
 Env quick reference::
 
     PADDLE_TRACE=1  PADDLE_TRACE_DIR=... PADDLE_TRACE_ROLE=...
     PADDLE_TRACE_EVERY=16
     PADDLE_METRICS=1  PADDLE_METRICS_PORT=9464  PADDLE_METRICS_FILE=...
+    PADDLE_METRICS_HOST=127.0.0.1   (loopback default; opt into wider)
 
 Importable without jax (PS server subprocesses stay lightweight).
 """
@@ -33,22 +49,28 @@ from ..framework.monitor import (  # noqa: F401
     Histogram, enable_metrics, gauge_add, gauge_get, gauge_set,
     get_histogram, hist_observe, metrics_enabled, metrics_reset,
     metrics_snapshot, stat_add, stat_get)
-from . import flight_recorder, metrics, timeline, trace  # noqa: F401
+from . import (aggregator, flight_recorder, metrics,  # noqa: F401
+               request_trace, slo, timeline, trace)
+from .aggregator import FleetAggregator  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, Watchdog, compile_log, flight_dump, flight_enabled,
     flight_record)
 from .metrics import (  # noqa: F401
     MetricsFlusher, MetricsServer, prometheus_text, start_metrics_server)
+from .request_trace import RequestTrace  # noqa: F401
+from .slo import SLO, SloEngine  # noqa: F401
 from .timeline import StepTimeline  # noqa: F401
 from .trace import (  # noqa: F401
     Span, disable as disable_tracing, enable as enable_tracing, enabled
     as tracing_enabled, propagation_ctx, record_clock, server_span, span)
 
 __all__ = [
-    "trace", "metrics", "timeline", "flight_recorder",
+    "trace", "metrics", "timeline", "flight_recorder", "aggregator",
+    "slo", "request_trace",
     "Span", "span", "server_span", "propagation_ctx", "record_clock",
     "enable_tracing", "disable_tracing", "tracing_enabled",
-    "StepTimeline", "Histogram",
+    "StepTimeline", "Histogram", "RequestTrace",
+    "FleetAggregator", "SLO", "SloEngine",
     "FlightRecorder", "Watchdog", "flight_record", "flight_dump",
     "flight_enabled", "compile_log",
     "MetricsServer", "MetricsFlusher", "prometheus_text",

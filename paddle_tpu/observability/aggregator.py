@@ -1,0 +1,426 @@
+"""Fleet metrics aggregator — the cross-process half of /metrics
+(ISSUE 12 tentpole).
+
+PRs 5/7 gave every process its own registry, ``/metrics`` endpoint and
+flight recorder; the system now runs as a FLEET (trainer, PS primary,
+read replicas, geo follower, predictor + generation servers) and
+nothing sees it whole.  :class:`FleetAggregator` is that view:
+
+- **Scrape.**  Each target is either an HTTP endpoint (``host:port``
+  or a full URL — the new ``GET /metrics.json`` returns the RAW
+  registry snapshot, so no text re-parsing) or a
+  :class:`~paddle_tpu.observability.metrics.MetricsFlusher` JSONL file
+  (the last complete record is the sample — the zero-infrastructure
+  path).  Scrapes run on an ``interval_s`` cadence
+  (:meth:`start`/:meth:`stop`) or on demand (:meth:`scrape_once` —
+  what the deterministic tests drive).
+
+- **Exact merge.**  Fleet rollup = counters summed exactly (ints),
+  labeled series summed per (family, label set), le-bucket histograms
+  merged bucket-by-bucket when bounds agree (cumulative counts, sum
+  and count all add — the merged percentile is the percentile of the
+  POOLED samples to within bucket resolution; mismatched bounds are
+  left un-merged and listed in ``unmerged_histograms``), gauges
+  reduced by MAX (a lag/queue-depth fleet rollup asks "how bad is the
+  worst process").  The rollup is itself snapshot-shaped: it renders
+  through :func:`~paddle_tpu.observability.metrics.prometheus_text`
+  and feeds :class:`~paddle_tpu.observability.slo.SloEngine`
+  unchanged.
+
+- **Rates + stragglers.**  Per process, every counter's delta/dt
+  between its last two samples (file targets use the records' own
+  ``ts_us``; endpoints use the scrape's).  For ``straggler_key`` (a
+  counter name), a process whose rate sits below the fleet median by
+  more than ``straggler_k`` x MAD is flagged — the robust-statistics
+  version of "one replica is mysteriously slow" (SURVEY §2.6's fleet
+  monitoring).  With fewer than 3 rate-bearing processes MAD is
+  degenerate and nothing is flagged (two processes cannot outvote
+  each other).
+
+- **Staleness.**  A target whose scrape fails — or whose newest
+  sample is older than ``stale_after_s`` — is flagged stale and
+  EXCLUDED from the rollup (a dead process's last counters must not
+  freeze into the fleet sums forever); its identity stays listed so
+  the dashboard shows the hole.
+
+- **Expose.**  :meth:`serve` publishes the aggregator's own
+  ``/metrics`` (+ ``/metrics.json``) rendering the MERGED rollup, and
+  ``/fleet`` with the full JSON fleet view (per-process rates,
+  stragglers, staleness) — ``tools/fleet_top.py`` renders it as a
+  live table.  Straggler/stale transitions are flight-recorder events
+  so a postmortem shows when the fleet view first degraded, and
+  ``state_file=`` appends each fleet snapshot as JSONL
+  (``fleet-*.jsonl``, covered by the tier-1 leak check).
+
+Must stay importable without jax (the aggregator is a sidecar
+process in real deployments).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..framework import monitor as _monitor
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+
+__all__ = ["FleetAggregator", "merge_snapshots", "merge_histograms"]
+
+
+def merge_histograms(a: Dict, b: Dict) -> Optional[Dict]:
+    """Exact merge of two histogram snapshots sharing bucket bounds:
+    cumulative counts, sum and count all add.  Returns None when the
+    bounds differ (caller records the family as un-merged)."""
+    ab = [le for le, _ in a["buckets"]]
+    bb = [le for le, _ in b["buckets"]]
+    if ab != bb:
+        return None
+    return {"buckets": [[le, ca + cb] for (le, ca), (_, cb)
+                        in zip(a["buckets"], b["buckets"])],
+            "sum": a["sum"] + b["sum"],
+            "count": a["count"] + b["count"]}
+
+
+def merge_snapshots(snaps: List[Dict]) -> Dict:
+    """Fleet rollup over metrics snapshots: counters sum exactly,
+    gauges take the fleet MAX, histograms merge exactly per
+    :func:`merge_histograms`; labeled families merge per label set the
+    same way.  Returns a snapshot-shaped dict plus
+    ``unmerged_histograms`` (families whose bounds disagreed — first
+    seen wins, the rest dropped from the rollup)."""
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict] = {}
+    lab = {"counters": {}, "gauges": {}, "histograms": {}}
+    unmerged: List[str] = []
+    for snap in snaps:
+        for k, v in snap.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for k, v in snap.get("gauges", {}).items():
+            gauges[k] = max(gauges.get(k, float("-inf")), float(v))
+        for k, h in snap.get("histograms", {}).items():
+            if k in unmerged:
+                continue
+            if k not in hists:
+                hists[k] = {"buckets": [list(b) for b in h["buckets"]],
+                            "sum": h["sum"], "count": h["count"]}
+            else:
+                m = merge_histograms(hists[k], h)
+                if m is None:
+                    unmerged.append(k)
+                else:
+                    hists[k] = m
+        sl = snap.get("labeled", {})
+        for k, fam in sl.get("counters", {}).items():
+            out = lab["counters"].setdefault(k, {})
+            for lk, v in fam.items():
+                out[lk] = out.get(lk, 0) + int(v)
+        for k, fam in sl.get("gauges", {}).items():
+            out = lab["gauges"].setdefault(k, {})
+            for lk, v in fam.items():
+                out[lk] = max(out.get(lk, float("-inf")), float(v))
+        for k, fam in sl.get("histograms", {}).items():
+            out = lab["histograms"].setdefault(k, {})
+            for lk, h in fam.items():
+                key = f"{k}{{{lk}}}"
+                if key in unmerged:
+                    continue
+                if lk not in out:
+                    out[lk] = {"buckets": [list(b)
+                                           for b in h["buckets"]],
+                               "sum": h["sum"], "count": h["count"]}
+                else:
+                    m = merge_histograms(out[lk], h)
+                    if m is None:
+                        unmerged.append(key)
+                    else:
+                        out[lk] = m
+    rollup = {"counters": counters, "gauges": gauges,
+              "histograms": hists}
+    if any(lab.values()):
+        rollup["labeled"] = lab
+    rollup["unmerged_histograms"] = sorted(unmerged)
+    return rollup
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class _Target:
+    """One scrapee: endpoint URL or flusher JSONL path + its sample
+    history (the last two samples give the rate window)."""
+
+    __slots__ = ("spec", "url", "path", "tid", "role", "pid",
+                 "last_snap", "last_ts_s", "prev_counters",
+                 "prev_ts_s", "last_ok_mono", "errors", "ok")
+
+    def __init__(self, spec: str):
+        self.spec = str(spec)
+        if "://" in self.spec or (":" in self.spec
+                                  and os.path.sep not in self.spec
+                                  and not self.spec.endswith(".jsonl")):
+            base = (self.spec if "://" in self.spec
+                    else f"http://{self.spec}")
+            self.url = base.rstrip("/") + "/metrics.json"
+            self.path = None
+        else:
+            self.url = None
+            self.path = self.spec
+        self.tid = self.spec       # refined to role-pid on first scrape
+        self.role = "proc"
+        self.pid = 0
+        self.last_snap: Optional[Dict] = None
+        self.last_ts_s: Optional[float] = None
+        self.prev_counters: Optional[Dict[str, int]] = None
+        self.prev_ts_s: Optional[float] = None
+        self.last_ok_mono: Optional[float] = None
+        self.errors = 0
+        self.ok = False
+
+    def fetch(self, timeout_s: float):
+        """-> (sample, previous_sample_or_None).  Endpoints have no
+        baked-in history; flusher files carry their own — the last TWO
+        complete records prime the rate window even from a static file
+        (``fleet_top --once`` over a finished run still shows rates).
+        A torn tail line (process died mid-write) falls back one
+        line."""
+        if self.url is not None:
+            with urllib.request.urlopen(self.url,
+                                        timeout=timeout_s) as r:
+                return json.loads(r.read().decode()), None
+        last = prev = None
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                last, prev = rec, last
+        if last is None:
+            raise ValueError(f"no complete record in {self.path}")
+        return last, prev
+
+
+class FleetAggregator:
+    """Scrape-merge-flag loop over N processes (module docstring)."""
+
+    def __init__(self, targets: List[str], interval_s: float = 5.0,
+                 stale_after_s: Optional[float] = None,
+                 straggler_key: Optional[str] = None,
+                 straggler_k: float = 3.0,
+                 scrape_timeout_s: float = 5.0,
+                 state_file: Optional[str] = None):
+        self._targets = [_Target(t) for t in targets]
+        self.interval_s = float(interval_s)
+        self.stale_after_s = (float(stale_after_s)
+                              if stale_after_s is not None
+                              else 3.0 * self.interval_s)
+        self.straggler_key = straggler_key
+        self.straggler_k = float(straggler_k)
+        self._scrape_timeout = float(scrape_timeout_s)
+        self._state_file = state_file
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._srv: Optional[_metrics.MetricsServer] = None
+        self._fleet: Dict = {"targets": {}, "rollup": {},
+                             "stragglers": [], "stale": [],
+                             "n_scrapes": 0}
+        self._was_straggler: set = set()
+        self._was_stale: set = set()
+        self.n_scrapes = 0
+
+    # -- scraping -----------------------------------------------------
+    def scrape_once(self) -> Dict:
+        """One synchronous scrape round over every target; recomputes
+        the fleet view and returns it (also kept for :meth:`fleet`)."""
+        now_mono = time.monotonic()
+        for t in self._targets:
+            try:
+                rec, filed_prev = t.fetch(self._scrape_timeout)
+            except Exception:
+                t.ok = False
+                t.errors += 1
+                continue
+            ts_s = float(rec.get("ts_us", time.time_ns() // 1000)) / 1e6
+            if t.last_ts_s is not None and ts_s > t.last_ts_s:
+                # a NEW sample advances the rate window; a re-read of
+                # the same flusher record must not zero the rates
+                t.prev_counters = dict(
+                    t.last_snap.get("counters", {}))
+                t.prev_ts_s = t.last_ts_s
+            elif t.prev_counters is None and filed_prev is not None:
+                # static file: its own second-to-last record opens the
+                # rate window
+                pts = filed_prev.get("ts_us")
+                if pts is not None and float(pts) / 1e6 < ts_s:
+                    t.prev_counters = dict(
+                        filed_prev.get("counters", {}))
+                    t.prev_ts_s = float(pts) / 1e6
+            t.last_snap = {k: rec.get(k, {}) for k in
+                           ("counters", "gauges", "histograms")}
+            if "labeled" in rec:
+                t.last_snap["labeled"] = rec["labeled"]
+            t.last_ts_s = ts_s
+            t.role = rec.get("role", t.role)
+            t.pid = int(rec.get("pid", t.pid) or 0)
+            t.tid = (f"{t.role}-{t.pid}" if t.pid else t.spec)
+            t.last_ok_mono = now_mono
+            t.ok = True
+        self.n_scrapes += 1
+        fleet = self._recompute(now_mono)
+        if self._state_file:
+            try:
+                d = os.path.dirname(self._state_file)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                with open(self._state_file, "a") as f:
+                    f.write(json.dumps(fleet, separators=(",", ":"),
+                                       default=str) + "\n")
+            except OSError:
+                pass          # state persistence must never kill scrapes
+        return fleet
+
+    def _recompute(self, now_mono: float) -> Dict:
+        per: Dict[str, Dict] = {}
+        fresh_snaps: List[Dict] = []
+        rates_for_key: Dict[str, float] = {}
+        stale: List[str] = []
+        for t in self._targets:
+            age = (None if t.last_ok_mono is None
+                   else now_mono - t.last_ok_mono)
+            is_stale = (t.last_snap is None
+                        or (not t.ok and (age is None
+                                          or age > self.stale_after_s))
+                        or (t.last_ts_s is not None
+                            and time.time() - t.last_ts_s
+                            > self.stale_after_s))
+            rates: Dict[str, float] = {}
+            if (t.last_snap is not None and t.prev_counters is not None
+                    and t.last_ts_s is not None
+                    and t.prev_ts_s is not None
+                    and t.last_ts_s > t.prev_ts_s):
+                dt = t.last_ts_s - t.prev_ts_s
+                cur = t.last_snap.get("counters", {})
+                for k in set(cur) | set(t.prev_counters):
+                    rates[k] = (int(cur.get(k, 0))
+                                - int(t.prev_counters.get(k, 0))) / dt
+            per[t.tid] = {"target": t.spec, "role": t.role,
+                          "pid": t.pid, "ok": t.ok,
+                          "stale": bool(is_stale), "errors": t.errors,
+                          "age_s": (round(age, 3)
+                                    if age is not None else None),
+                          "rates": {k: round(v, 3)
+                                    for k, v in rates.items()}}
+            if is_stale:
+                stale.append(t.tid)
+            else:
+                fresh_snaps.append(t.last_snap)
+                if self.straggler_key is not None \
+                        and self.straggler_key in rates:
+                    rates_for_key[t.tid] = rates[self.straggler_key]
+        stragglers = self._find_stragglers(rates_for_key)
+        rollup = merge_snapshots(fresh_snaps)
+        fleet = {"ts_us": time.time_ns() // 1000,
+                 "n_scrapes": self.n_scrapes,
+                 "straggler_key": self.straggler_key,
+                 "targets": per, "rollup": rollup,
+                 "stragglers": stragglers, "stale": stale}
+        # transition events: the postmortem wants WHEN the fleet view
+        # first degraded, not a heartbeat spam
+        for tid in stragglers:
+            if tid not in self._was_straggler:
+                _flight.record("fleet.straggler", proc=tid,
+                               key=self.straggler_key,
+                               rate=rates_for_key.get(tid))
+        for tid in stale:
+            if tid not in self._was_stale:
+                _flight.record("fleet.stale", proc=tid)
+        self._was_straggler = set(stragglers)
+        self._was_stale = set(stale)
+        _monitor.gauge_set("fleet_targets", len(self._targets))
+        _monitor.gauge_set("fleet_stale", len(stale))
+        _monitor.gauge_set("fleet_stragglers", len(stragglers))
+        with self._lock:
+            self._fleet = fleet
+        return fleet
+
+    def _find_stragglers(self, rates: Dict[str, float]) -> List[str]:
+        """Robust low-rate outliers: rate below the fleet median by
+        more than k x MAD (median absolute deviation).  Needs >= 3
+        rate-bearing processes — with 2 the MAD equals every
+        deviation, so nothing can sit k>1 MADs out."""
+        if len(rates) < 3:
+            return []
+        vals = list(rates.values())
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        if mad <= 0.0:
+            return []
+        return sorted(t for t, v in rates.items()
+                      if med - v > self.straggler_k * mad)
+
+    # -- views --------------------------------------------------------
+    def fleet(self) -> Dict:
+        """The last computed fleet view (``/fleet`` payload)."""
+        with self._lock:
+            return dict(self._fleet)
+
+    def rollup(self) -> Dict:
+        """The last merged snapshot — feed it to
+        :func:`~paddle_tpu.observability.metrics.prometheus_text` or
+        an :class:`~paddle_tpu.observability.slo.SloEngine`."""
+        with self._lock:
+            return dict(self._fleet.get("rollup", {}))
+
+    # -- lifecycle ----------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:
+                # an aggregator crash must never take the fleet's
+                # dashboard down with it
+                _monitor.stat_add("fleet_scrape_errors")
+
+    def start(self) -> "FleetAggregator":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-fleet-aggregator",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._srv is not None:
+            self._srv.stop()
+            self._srv = None
+
+    def serve(self, port: int = 0,
+              host: Optional[str] = None) -> _metrics.MetricsServer:
+        """Publish the fleet view: ``/metrics``(+``.json``) render the
+        MERGED rollup, ``/fleet`` the full JSON fleet state."""
+        if self._srv is None:
+            def _fleet_route():
+                return (json.dumps(self.fleet(), default=str),
+                        "application/json")
+            self._srv = _metrics.MetricsServer(
+                port=port, host=host,
+                snapshot_fn=self.rollup,
+                routes={"/fleet": _fleet_route}).start()
+        return self._srv

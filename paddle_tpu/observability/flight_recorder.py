@@ -571,10 +571,13 @@ class Watchdog(threading.Thread):
             if age > self.deadline_s:
                 if not self._fired:
                     self._fired = True
-                    self.stalls += 1
                     record("stall", age_s=round(age, 3),
                            deadline_s=self.deadline_s)
                     dump("stall")
+                    # publish LAST: a caller polling `stalls` must
+                    # find the bundle already on disk (a dump takes
+                    # ~ms once many threads' stacks need formatting)
+                    self.stalls += 1
             else:
                 self._fired = False
 

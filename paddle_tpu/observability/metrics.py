@@ -19,8 +19,19 @@ Opt-in (everything off by default)::
 
     PADDLE_METRICS=1           enable high-frequency observation sites
     PADDLE_METRICS_PORT=9464   also serve /metrics on this port
+    PADDLE_METRICS_HOST=addr   bind address (default 127.0.0.1 —
+                               loopback; set 0.0.0.0 explicitly for a
+                               real deployment scrape)
     PADDLE_METRICS_FILE=path   also flush snapshots to this JSONL file
     PADDLE_METRICS_FLUSH_S=10  flusher cadence (seconds)
+
+ISSUE 12 growth: labeled series (the ``"labeled"`` snapshot key from
+:mod:`~paddle_tpu.framework.monitor`) render inside their family —
+``paddle_serve_tenant_tokens_out{tenant="a"} 5`` — while a label-free
+snapshot's exposition stays byte-identical (golden contract).  A
+``GET /metrics.json`` endpoint serves the RAW snapshot (+ role/pid/
+ts_us) so :mod:`.aggregator` can merge counters and le-buckets
+EXACTLY instead of re-parsing rendered text.
 
 Must stay importable without jax (PS server subprocesses).
 """
@@ -35,8 +46,9 @@ from typing import Dict, Optional
 
 from ..framework import monitor as _monitor
 
-__all__ = ["prometheus_text", "build_info", "MetricsServer",
-           "MetricsFlusher", "start_metrics_server", "enable_from_env"]
+__all__ = ["prometheus_text", "snapshot_json", "build_info",
+           "MetricsServer", "MetricsFlusher", "start_metrics_server",
+           "enable_from_env", "default_host"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -84,52 +96,108 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
+def _hist_lines(lines, pn, h, lk: str = ""):
+    """Append one histogram series' exposition lines; ``lk`` is the
+    canonical inner label string ("" for the unlabeled series)."""
+    pre = f"{lk}," if lk else ""
+    cum = 0
+    for le, cum in h["buckets"]:
+        lines.append(f'{pn}_bucket{{{pre}le="{_fmt(le)}"}} {cum}')
+    lines.append(f'{pn}_bucket{{{pre}le="+Inf"}} {h["count"]}')
+    suffix = f"{{{lk}}}" if lk else ""
+    lines.append(f"{pn}_sum{suffix} {repr(float(h['sum']))}")
+    lines.append(f"{pn}_count{suffix} {h['count']}")
+
+
 def prometheus_text(snapshot: Optional[Dict] = None) -> str:
     """Render a registry snapshot (default: the live registry) as
     Prometheus text exposition format.  A constant
     ``paddle_build_info`` gauge (version + jax/jaxlib dist versions as
     labels, value 1 — the standard ``*_build_info`` idiom) leads the
-    exposition so every scrape identifies WHAT produced the numbers."""
+    exposition so every scrape identifies WHAT produced the numbers.
+    Labeled series render under their family's one ``# TYPE`` line; a
+    snapshot with no labeled series renders byte-identically to the
+    pre-label format (the golden contract)."""
     snap = snapshot if snapshot is not None \
         else _monitor.metrics_snapshot()
+    lab = snap.get("labeled", {})
     bi = build_info()
     lines = ["# TYPE paddle_build_info gauge",
              "paddle_build_info{"
              + ",".join(f'{k}="{bi[k]}"' for k in sorted(bi)) + "} 1"]
-    for name in sorted(snap.get("counters", {})):
+    plain_c = snap.get("counters", {})
+    lab_c = lab.get("counters", {})
+    for name in sorted(set(plain_c) | set(lab_c)):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_fmt(snap['counters'][name])}")
-    for name in sorted(snap.get("gauges", {})):
+        if name in plain_c:
+            lines.append(f"{pn} {_fmt(plain_c[name])}")
+        for lk in sorted(lab_c.get(name, {})):
+            lines.append(f"{pn}{{{lk}}} {_fmt(lab_c[name][lk])}")
+    plain_g = snap.get("gauges", {})
+    lab_g = lab.get("gauges", {})
+    for name in sorted(set(plain_g) | set(lab_g)):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_fmt(snap['gauges'][name])}")
-    for name in sorted(snap.get("histograms", {})):
-        h = snap["histograms"][name]
+        if name in plain_g:
+            lines.append(f"{pn} {_fmt(plain_g[name])}")
+        for lk in sorted(lab_g.get(name, {})):
+            lines.append(f"{pn}{{{lk}}} {_fmt(lab_g[name][lk])}")
+    plain_h = snap.get("histograms", {})
+    lab_h = lab.get("histograms", {})
+    for name in sorted(set(plain_h) | set(lab_h)):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} histogram")
-        cum = 0
-        for le, cum in h["buckets"]:
-            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{pn}_sum {repr(float(h['sum']))}")
-        lines.append(f"{pn}_count {h['count']}")
+        if name in plain_h:
+            _hist_lines(lines, pn, plain_h[name])
+        for lk in sorted(lab_h.get(name, {})):
+            _hist_lines(lines, pn, lab_h[name][lk], lk)
     return "\n".join(lines) + "\n"
 
 
+def snapshot_json(snapshot: Optional[Dict] = None) -> Dict:
+    """The ``/metrics.json`` payload: the raw snapshot plus scrape
+    identity — what the fleet aggregator consumes (exact merge needs
+    the numbers, not the rendered text)."""
+    snap = snapshot if snapshot is not None \
+        else _monitor.metrics_snapshot()
+    return {"ts_us": time.time_ns() // 1000,
+            "role": os.environ.get("PADDLE_TRACE_ROLE", "proc"),
+            "pid": os.getpid(), **snap}
+
+
+def default_host() -> str:
+    """Metrics bind address: loopback unless ``PADDLE_METRICS_HOST``
+    says otherwise.  (ISSUE 12 satellite: the previous ``0.0.0.0``
+    default exposed every process's registry to the whole network the
+    moment a port was set — real deployments opt in explicitly.)"""
+    return os.environ.get("PADDLE_METRICS_HOST", "127.0.0.1")
+
+
 class MetricsServer:
-    """``GET /metrics`` endpoint over the live registry.
+    """``GET /metrics`` (+ ``/metrics.json`` + ``/healthz``) endpoint
+    over the live registry.
 
     ::
 
         srv = MetricsServer(port=0).start()   # 0 = ephemeral
         requests.get(f"http://127.0.0.1:{srv.port}/metrics")
         srv.stop()
-    """
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+    ``host`` defaults to loopback (``PADDLE_METRICS_HOST`` or an
+    explicit ctor value overrides — pass ``"0.0.0.0"`` to expose a
+    real deployment to its scraper).  ``snapshot_fn`` substitutes the
+    snapshot both text and JSON endpoints render (the fleet aggregator
+    serves its MERGED rollup this way); ``routes`` maps extra paths to
+    ``() -> (body_bytes, content_type)`` callables (the aggregator's
+    ``/fleet``)."""
+
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 snapshot_fn=None, routes: Optional[Dict] = None):
         self._want_port = int(port)
-        self._host = host
+        self._host = host if host is not None else default_host()
+        self._snapshot_fn = snapshot_fn
+        self._routes = dict(routes or {})
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -139,6 +207,8 @@ class MetricsServer:
             return self
         from http.server import (BaseHTTPRequestHandler,
                                  ThreadingHTTPServer)
+        snapshot_fn = self._snapshot_fn
+        routes = self._routes
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):            # noqa: N802 (stdlib API name)
@@ -156,10 +226,21 @@ class MetricsServer:
                         **build_info(),
                     }).encode()
                     ctype = "application/json"
+                elif path == "/metrics.json":
+                    snap = snapshot_fn() if snapshot_fn else None
+                    body = json.dumps(
+                        snapshot_json(snap),
+                        separators=(",", ":")).encode()
+                    ctype = "application/json"
                 elif path in ("/metrics", "/"):
-                    body = prometheus_text().encode()
+                    snap = snapshot_fn() if snapshot_fn else None
+                    body = prometheus_text(snap).encode()
                     ctype = ("text/plain; version=0.0.4; "
                              "charset=utf-8")
+                elif path in routes:
+                    body, ctype = routes[path]()
+                    if isinstance(body, str):
+                        body = body.encode()
                 else:
                     self.send_error(404)
                     return
@@ -236,7 +317,7 @@ _env_flusher: Optional[MetricsFlusher] = None
 
 
 def start_metrics_server(port: int = 0,
-                         host: str = "0.0.0.0") -> MetricsServer:
+                         host: Optional[str] = None) -> MetricsServer:
     return MetricsServer(port=port, host=host).start()
 
 

@@ -51,7 +51,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Span", "span", "server_span", "enable", "disable", "enabled",
            "current_ctx", "propagation_ctx", "record_clock", "sink_id",
-           "sink_path", "trace_every", "flush"]
+           "sink_path", "trace_every", "flush", "new_id", "emit_span"]
 
 _lock = threading.Lock()
 _tls = threading.local()
@@ -287,6 +287,44 @@ def server_span(name: str, ctx, cat: str = "rpc", **args):
     if ctx is not None:
         return Span(name, cat=cat, ctx=(ctx[0], ctx[1]), **args)
     return Span(name, cat=cat, **args)
+
+
+def new_id() -> str:
+    """Mint a fresh trace/span id (public for :mod:`.request_trace`,
+    which manages its own id chains instead of the thread-local
+    stack)."""
+    return _new_id()
+
+
+def emit_span(name: str, ts_us: int, dur_us: int, trace_id: str,
+              span_id: str, parent: Optional[str] = None,
+              cat: str = "req", tid: Optional[int] = None,
+              args: Optional[Dict] = None):
+    """Write one span record with EXPLICIT ids, timestamps and lane.
+
+    The :class:`Span` context manager parents through the thread-local
+    stack — correct for code that nests on one thread, wrong for a
+    scheduler thread interleaving many requests per iteration (ISSUE
+    12): a request's queue phase opens on the submitting thread and
+    closes on the scheduler thread, and two requests' phases overlap
+    arbitrarily.  This function bypasses the stack entirely; the
+    caller supplies the chain.  ``tid`` overrides the thread ident in
+    the record — :mod:`.request_trace` assigns one virtual lane id per
+    request so ``tools/trace_merge.py`` renders one lane per request.
+    """
+    if not _enabled:
+        return
+    rec = {"t": "span", "name": name, "cat": cat,
+           "ts_us": int(ts_us), "dur_us": int(dur_us),
+           "pid": os.getpid(),
+           "tid": int(tid) if tid is not None
+           else threading.get_ident(),
+           "trace": trace_id, "span": span_id}
+    if parent is not None:
+        rec["parent"] = parent
+    if args:
+        rec["args"] = args
+    _write(rec)
 
 
 def record_clock(peer_sink: str, offset_us: float, rtt_us: float):

@@ -1,0 +1,848 @@
+"""ISSUE 12: fleet observatory — labeled metrics, per-request trace
+lanes + tenant accounting, the cross-process aggregator (exact merge,
+rates, straggler/stale flagging), and the SLO burn-rate engine.
+
+Coverage map:
+- labeled counters/gauges/histograms in the registry + Prometheus
+  rendering (unlabeled exposition stays byte-stable — the ISSUE 5
+  golden test next door pins that independently);
+- Histogram.from_snapshot round trip + exact cross-process histogram
+  merge (merged percentiles vs numpy on the pooled samples);
+- FleetAggregator over flusher JSONL files and live endpoints:
+  rollup sums EXACTLY, rates from sample timestamps, straggler =
+  below-median by k x MAD (>= 3 procs, degenerate fleets never flag),
+  stale scrapees flagged AND excluded from the rollup;
+- SloEngine: multi-window error-rate burn, latency-histogram
+  objectives, gauge bounds; breach emits ONE latched flight event;
+- request lanes on GenerationServer (span chain per request, TTFT
+  agreement with serve_ttft_ms) and tenant tags on both servers;
+- the acceptance e2e: 8 concurrent streams across 2 tenants on a
+  prefix-sharing GenerationServer + subprocess PS primary + read
+  replicas, one artificially delayed, all scraped by ONE aggregator —
+  rollup exactness, straggler flag, TTFT SLO breach -> flight bundle
+  -> tools/postmortem.py renders the request lane + breach marker.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import monitor
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import trace
+from paddle_tpu.observability.aggregator import (FleetAggregator,
+                                                 merge_histograms,
+                                                 merge_snapshots)
+from paddle_tpu.observability.slo import SLO, SloEngine
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_POSTMORTEM = os.path.join(_REPO, "tools", "postmortem.py")
+_FLEET_TOP = os.path.join(_REPO, "tools", "fleet_top.py")
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Same discipline as test_observability.py: per-test tracing
+    state must never leak into the next test (the --trace pass runs
+    the whole suite with PADDLE_TRACE=1)."""
+    yield
+    trace.disable()
+    monitor.enable_metrics(os.environ.get("PADDLE_METRICS", "0") == "1")
+
+
+def _read_sink(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _hist_snap(samples, bounds):
+    h = monitor.Histogram(buckets=bounds)
+    for s in samples:
+        h.observe(s)
+    return h.snapshot()
+
+
+def _empty_hist_baseline(name):
+    """A zero-count series for priming an SloEngine's first burn
+    sample when the process had no observations before the window of
+    interest."""
+    return {"counters": {}, "gauges": {},
+            "histograms": {name: {"buckets": [], "sum": 0.0,
+                                  "count": 0}}}
+
+
+# ---------------------------------------------------------------------------
+# labeled metrics
+# ---------------------------------------------------------------------------
+
+def test_labeled_series_in_registry_and_exposition():
+    monitor.stat_add("obs12_tok", 5, labels={"tenant": "a"})
+    monitor.stat_add("obs12_tok", 7, labels={"tenant": "b"})
+    monitor.stat_add("obs12_tok", 2)
+    monitor.gauge_set("obs12_burn", 1.5,
+                      labels={"slo": "ttft", "window": "60"})
+    monitor.hist_observe("obs12_ms", 3.0, buckets=(1.0, 5.0),
+                         labels={"tenant": "a"})
+    assert monitor.stat_get("obs12_tok", labels={"tenant": "a"}) == 5
+    assert monitor.stat_get("obs12_tok") == 2
+    snap = monitor.metrics_snapshot()
+    assert snap["labeled"]["counters"]["obs12_tok"] == {
+        'tenant="a"': 5, 'tenant="b"': 7}
+    txt = obs_metrics.prometheus_text(snap)
+    # one TYPE line per family, unlabeled sample first, labels sorted
+    assert 'paddle_obs12_tok 2\npaddle_obs12_tok{tenant="a"} 5\n' \
+           'paddle_obs12_tok{tenant="b"} 7' in txt
+    assert txt.count("# TYPE paddle_obs12_tok counter") == 1
+    assert 'paddle_obs12_burn{slo="ttft",window="60"} 1.5' in txt
+    assert 'paddle_obs12_ms_bucket{tenant="a",le="5"} 1' in txt
+    assert 'paddle_obs12_ms_sum{tenant="a"} 3.0' in txt
+
+
+def test_unlabeled_snapshot_has_no_labeled_key():
+    """Label-free processes keep the exact pre-label snapshot shape
+    (flusher byte-stability)."""
+    monitor.metrics_reset()
+    monitor.stat_add("obs12_plain", 1)
+    snap = monitor.metrics_snapshot()
+    assert set(snap) == {"counters", "gauges", "histograms"}
+
+
+def test_histogram_from_snapshot_round_trip():
+    h = monitor.Histogram(buckets=(1.0, 5.0, 25.0))
+    for v in (0.2, 3.0, 3.5, 20.0, 99.0):
+        h.observe(v)
+    h2 = monitor.Histogram.from_snapshot(h.snapshot())
+    assert h2.counts == h.counts
+    assert h2.sum == h.sum and h2.count == h.count
+    for q in (10, 50, 99):
+        assert h2.percentile(q) == h.percentile(q)
+
+
+# ---------------------------------------------------------------------------
+# exact merge
+# ---------------------------------------------------------------------------
+
+def test_merge_histograms_exact_and_percentiles_match_numpy():
+    rng = np.random.RandomState(7)
+    s1 = rng.uniform(0.0, 100.0, 5000)
+    s2 = rng.uniform(20.0, 120.0, 3000)
+    bounds = [float(b) for b in range(1, 131)]
+    m = merge_histograms(_hist_snap(s1, bounds), _hist_snap(s2, bounds))
+    assert m["count"] == 8000
+    assert m["sum"] == pytest.approx(s1.sum() + s2.sum())
+    pooled = np.concatenate([s1, s2])
+    h = monitor.Histogram.from_snapshot(m)
+    for q in (10, 50, 90, 99):
+        est, ref = h.percentile(q), float(np.percentile(pooled, q))
+        assert abs(est - ref) < 1.5, (q, est, ref)   # bucket width 1
+    # mismatched bounds refuse instead of merging garbage
+    assert merge_histograms(_hist_snap(s1, bounds),
+                            _hist_snap(s2, [1.0, 2.0])) is None
+
+
+def test_merge_snapshots_counters_gauges_labels():
+    a = {"counters": {"x": 3, "y": 1}, "gauges": {"lag": 1.0},
+         "histograms": {},
+         "labeled": {"counters": {"tok": {'tenant="a"': 5}},
+                     "gauges": {}, "histograms": {}}}
+    b = {"counters": {"x": 4}, "gauges": {"lag": 4.0},
+         "histograms": {},
+         "labeled": {"counters": {"tok": {'tenant="a"': 2,
+                                          'tenant="b"': 9}},
+                     "gauges": {}, "histograms": {}}}
+    m = merge_snapshots([a, b])
+    assert m["counters"] == {"x": 7, "y": 1}
+    assert m["gauges"]["lag"] == 4.0           # fleet MAX
+    assert m["labeled"]["counters"]["tok"] == {'tenant="a"': 7,
+                                               'tenant="b"': 9}
+
+
+# ---------------------------------------------------------------------------
+# aggregator over flusher files: rates, stragglers, staleness
+# ---------------------------------------------------------------------------
+
+def _write_flusher(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _proc_file(tmp_path, role, pid, pulls_then, pulls_now, now_us,
+               extra_now=None):
+    p = tmp_path / f"metrics-{role}.jsonl"
+    rec0 = {"ts_us": now_us - 2_000_000, "role": role, "pid": pid,
+            "counters": {"pulls": pulls_then}, "gauges": {},
+            "histograms": {}}
+    rec1 = {"ts_us": now_us, "role": role, "pid": pid,
+            "counters": {"pulls": pulls_now}, "gauges": {},
+            "histograms": {}}
+    if extra_now:
+        rec1.update(extra_now)
+    _write_flusher(p, [rec0, rec1])
+    return str(p)
+
+
+def test_aggregator_rates_and_straggler_flagging(tmp_path):
+    """A static pair of flusher records per process gives exact,
+    deterministic rates (delta / record-timestamp dt); the process
+    whose rate sits below the median by > k x MAD is flagged."""
+    now_us = time.time_ns() // 1000
+    files = [
+        _proc_file(tmp_path, "ps0", 11, 0, 2000, now_us),    # 1000/s
+        _proc_file(tmp_path, "rep1", 12, 0, 1900, now_us),   # 950/s
+        _proc_file(tmp_path, "rep2", 13, 0, 50, now_us),     # 25/s
+    ]
+    agg = FleetAggregator(files, interval_s=1.0, stale_after_s=3600.0,
+                          straggler_key="pulls")
+    fleet = agg.scrape_once()
+    rates = {t: v["rates"]["pulls"]
+             for t, v in fleet["targets"].items()}
+    assert rates == {"ps0-11": 1000.0, "rep1-12": 950.0,
+                     "rep2-13": 25.0}
+    assert fleet["stragglers"] == ["rep2-13"]
+    assert fleet["rollup"]["counters"]["pulls"] == 3950
+    # the straggler transition is a flight event (postmortem marker)
+    evs = [e for e in flight_recorder.recorder().events()
+           if e["kind"] == "fleet.straggler"]
+    assert any(e.get("proc") == "rep2-13" for e in evs)
+
+
+def test_aggregator_two_proc_fleet_never_flags(tmp_path):
+    """MAD over 2 processes is degenerate (each deviation == MAD) —
+    two processes cannot outvote each other, so nothing is flagged no
+    matter how far apart they sit."""
+    now_us = time.time_ns() // 1000
+    files = [
+        _proc_file(tmp_path, "a", 1, 0, 2000, now_us),
+        _proc_file(tmp_path, "b", 2, 0, 2, now_us),
+    ]
+    agg = FleetAggregator(files, interval_s=1.0, stale_after_s=3600.0,
+                          straggler_key="pulls")
+    assert agg.scrape_once()["stragglers"] == []
+
+
+def test_aggregator_stale_target_flagged_and_excluded(tmp_path):
+    """A scrapee whose newest sample is too old (or whose endpoint is
+    dead) is flagged stale and its counters LEAVE the rollup — a dead
+    process must not freeze into the fleet sums forever."""
+    now_us = time.time_ns() // 1000
+    live = _proc_file(tmp_path, "live", 1, 0, 100, now_us)
+    dead = tmp_path / "metrics-dead.jsonl"
+    _write_flusher(dead, [
+        {"ts_us": now_us - 3600_000_000, "role": "dead", "pid": 9,
+         "counters": {"pulls": 7777}, "gauges": {}, "histograms": {}}])
+    gone = str(tmp_path / "metrics-gone.jsonl")     # never existed
+    agg = FleetAggregator([live, str(dead), gone], interval_s=1.0,
+                          stale_after_s=60.0)
+    fleet = agg.scrape_once()
+    assert set(fleet["stale"]) == {"dead-9", gone}
+    assert fleet["rollup"]["counters"]["pulls"] == 100
+    assert fleet["targets"]["dead-9"]["stale"]
+    assert fleet["targets"][gone]["errors"] == 1
+
+
+def test_aggregator_serves_fleet_and_merged_metrics(tmp_path):
+    """serve(): /fleet returns the fleet JSON; /metrics renders the
+    MERGED rollup (not the aggregator process's own registry)."""
+    now_us = time.time_ns() // 1000
+    files = [_proc_file(tmp_path, "a", 1, 0, 30, now_us,
+                        extra_now={"counters": {"pulls": 30,
+                                                "obs12_only_a": 4}}),
+             _proc_file(tmp_path, "b", 2, 0, 12, now_us)]
+    agg = FleetAggregator(files, interval_s=1.0, stale_after_s=3600.0)
+    agg.scrape_once()
+    srv = agg.serve(port=0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/fleet", timeout=5) as r:
+            fleet = json.loads(r.read().decode())
+        assert set(fleet["targets"]) == {"a-1", "b-2"}
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert "paddle_pulls 42" in body
+        assert "paddle_obs12_only_a 4" in body
+    finally:
+        agg.stop()
+
+
+def test_fleet_top_once_renders_table(tmp_path):
+    now_us = time.time_ns() // 1000
+    files = [
+        _proc_file(tmp_path, "ps0", 11, 0, 2000, now_us),
+        _proc_file(tmp_path, "rep1", 12, 0, 1900, now_us),
+        _proc_file(tmp_path, "rep2", 13, 0, 50, now_us),
+    ]
+    r = subprocess.run(
+        [sys.executable, _FLEET_TOP, "--once", "--key", "pulls",
+         "--stale-after", "3600", "--targets", ",".join(files)],
+        capture_output=True, text=True, cwd=_REPO)
+    assert r.returncode == 0, r.stderr
+    assert "STRAGGLER" in r.stdout
+    assert "fleet pulls total: 3950" in r.stdout
+    assert "rep2-13" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+def _snap_counts(bad, tot):
+    return {"counters": {"bad": bad, "tot": tot}, "gauges": {},
+            "histograms": {}}
+
+
+def test_slo_error_rate_multi_window_burn_and_latch():
+    eng = SloEngine([SLO("obs12_shed", "error_rate", "bad",
+                         total="tot", budget=0.01,
+                         windows=((10.0, 10.0), (60.0, 5.0)),
+                         min_events=10)])
+    t0 = 1000.0
+    assert eng.evaluate(_snap_counts(0, 0), now=t0)[0]["ok"]
+    # 2% errors: burn 2.0 — below both thresholds
+    assert eng.evaluate(_snap_counts(2, 100), now=t0 + 5)[0]["ok"]
+    # short window spikes to 50% (burn 50) but the long window still
+    # averages under its threshold -> NOT a breach (multi-window AND)
+    st = eng.evaluate(_snap_counts(4, 104), now=t0 + 8)[0]
+    assert st["ok"], st
+    # sustained: both windows over threshold -> breach, one latched
+    # flight event
+    n0 = len([e for e in flight_recorder.recorder().events()
+              if e.get("kind") == "slo.breach"])
+    st = eng.evaluate(_snap_counts(80, 204), now=t0 + 50)[0]
+    assert not st["ok"], st
+    assert st["burn"]["60"] > 5.0
+    eng.evaluate(_snap_counts(90, 220), now=t0 + 55)   # still bad
+    evs = [e for e in flight_recorder.recorder().events()
+           if e.get("kind") == "slo.breach"]
+    assert len(evs) == n0 + 1
+    assert evs[-1]["slo"] == "obs12_shed"
+    # burn gauges published as labeled series
+    assert monitor.gauge_get("slo_burn_rate",
+                             labels={"slo": "obs12_shed",
+                                     "window": "60"}) > 5.0
+    assert monitor.gauge_get("slo_breached",
+                             labels={"slo": "obs12_shed"}) == 1.0
+    # recovery un-latches
+    eng.evaluate(_snap_counts(90, 5000), now=t0 + 58)
+    assert [e for e in flight_recorder.recorder().events()
+            if e.get("kind") == "slo.recover"]
+
+
+def test_slo_min_events_suppresses_noise():
+    eng = SloEngine([SLO("obs12_noise", "error_rate", "bad",
+                         total="tot", budget=0.01,
+                         windows=((60.0, 1.0),), min_events=50)])
+    t0 = 0.0
+    eng.evaluate(_snap_counts(0, 0), now=t0)
+    # 3 of 3 bad = burn 100, but 3 events < min_events
+    assert eng.evaluate(_snap_counts(3, 3), now=t0 + 10)[0]["ok"]
+
+
+def test_slo_latency_histogram_objective():
+    bounds = [float(b) for b in range(1, 101)]
+    eng = SloEngine([SLO("obs12_lat", "latency", "lat_ms", bound=50.0,
+                         budget=0.10, windows=((60.0, 2.0),),
+                         min_events=10)])
+    t0 = 0.0
+    fast = _hist_snap(np.full(100, 10.0), bounds)
+    eng.evaluate({"counters": {}, "gauges": {},
+                  "histograms": {"lat_ms": fast}}, now=t0)
+    # next 100 samples all above the bound: window bad-rate ~50% vs
+    # 10% budget -> burn ~5 -> breach
+    slow = merge_histograms(fast, _hist_snap(np.full(100, 90.0),
+                                             bounds))
+    st = eng.evaluate({"counters": {}, "gauges": {},
+                       "histograms": {"lat_ms": slow}}, now=t0 + 10)[0]
+    assert not st["ok"], st
+
+
+def test_slo_gauge_bound_immediate():
+    eng = SloEngine([SLO("obs12_lag", "gauge_bound",
+                         "ps_replica_lag_seq", bound=8.0)])
+    ok = eng.evaluate({"counters": {}, "histograms": {},
+                       "gauges": {"ps_replica_lag_seq": 3.0}})[0]
+    assert ok["ok"]
+    bad = eng.evaluate({"counters": {}, "histograms": {},
+                        "gauges": {"ps_replica_lag_seq": 40.0}})[0]
+    assert not bad["ok"] and bad["value"] == 40.0
+
+
+# ---------------------------------------------------------------------------
+# request lanes + tenants on the serving tier
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_predictor_server_tenant_accounting():
+    """Tenant counters on the fixed-shape server, no predictor build
+    needed — the micro-batcher only requires .run()."""
+    from paddle_tpu.inference.serving import PredictorServer
+
+    class _Stub:
+        def run(self, inputs):
+            return [inputs[0] * 2.0]
+
+    a0 = monitor.stat_get("serve_tenant_examples",
+                          labels={"tenant": "obs12_a"})
+    b0 = monitor.stat_get("serve_tenant_examples",
+                          labels={"tenant": "obs12_b"})
+    srv = PredictorServer(_Stub(), max_batch=8, max_wait_ms=1.0,
+                          prewarm=False)
+    srv.start()
+    try:
+        x = np.ones((2, 3), np.float32)
+        out = srv.infer([x], tenant="obs12_a")
+        assert np.array_equal(out[0], x * 2.0)
+        srv.infer([x], tenant="obs12_a")
+        srv.infer([np.ones((3, 3), np.float32)], tenant="obs12_b")
+        srv.infer([x])                        # untagged rides along
+    finally:
+        srv.stop()
+    assert monitor.stat_get("serve_tenant_examples",
+                            labels={"tenant": "obs12_a"}) - a0 == 4
+    assert monitor.stat_get("serve_tenant_examples",
+                            labels={"tenant": "obs12_b"}) - b0 == 3
+    assert monitor.gauge_get("serve_tenant_queue_ms",
+                             labels={"tenant": "obs12_a"}) >= 0.0
+
+
+def test_generation_request_lanes_and_tenant_sums(tmp_path, lm):
+    """The per-request span chain exists and is self-consistent, the
+    span-carried TTFT matches serve_ttft_ms EXACTLY, and per-tenant
+    token counters sum to the untagged totals when every request is
+    tagged."""
+    from paddle_tpu.inference import GenerationServer
+    monitor.enable_metrics(True)
+    trace.enable(dir=str(tmp_path), role="gwunit")
+    h0 = monitor.get_histogram("serve_ttft_ms")
+    hc0, hs0 = (h0.count, h0.sum) if h0 is not None else (0, 0.0)
+    d0 = {k: monitor.stat_get(k) for k in ("serve_tokens_in",
+                                           "serve_tokens_out")}
+    ta0 = {k: monitor.stat_get(f"serve_tenant_{k}",
+                               labels={"tenant": "u_a"})
+           for k in ("tokens_in", "tokens_out")}
+    tb0 = {k: monitor.stat_get(f"serve_tenant_{k}",
+                               labels={"tenant": "u_b"})
+           for k in ("tokens_in", "tokens_out")}
+    srv = GenerationServer(lm, num_slots=4, block_size=4,
+                           max_model_len=32, prefix_cache=True,
+                           max_prefill_batch=1)
+    srv.start()
+    rng = np.random.RandomState(0)
+    pre = rng.randint(1, 64, (8,)).astype("int32")
+    streams, lens = [], []
+    try:
+        # first request runs ALONE so its blocks land in the prefix
+        # index before the burst — same-round siblings cannot alias a
+        # prefix that is only indexed at post-prefill
+        p0 = np.concatenate([pre,
+                             rng.randint(1, 64, (3,)).astype("int32")])
+        lens.append(p0.size)
+        outs = [srv.submit(p0, max_new_tokens=5,
+                           tenant="u_a").result(timeout=120)]
+        for i in range(1, 4):
+            p = np.concatenate(
+                [pre, rng.randint(1, 64, (3 + i,)).astype("int32")])
+            lens.append(p.size)
+            streams.append(srv.submit(
+                p, max_new_tokens=5,
+                tenant="u_a" if i % 2 == 0 else "u_b"))
+        outs += [s.result(timeout=120) for s in streams]
+    finally:
+        srv.stop()
+        trace.disable()
+    assert all(len(o) == 5 for o in outs)
+
+    recs = _read_sink(tmp_path / f"trace-gwunit-{os.getpid()}.jsonl")
+    spans = [r for r in recs if r.get("t") == "span"]
+    roots = [s for s in spans if s["name"] == "req"]
+    assert len(roots) == 4
+    by_rid = {}
+    for s in spans:
+        rid = (s.get("args") or {}).get("rid")
+        if rid is not None:
+            by_rid.setdefault(rid, []).append(s)
+    assert len(by_rid) == 4
+    for rid, chain in by_rid.items():
+        names = {s["name"] for s in chain}
+        # the full lifecycle chain, one lane, one trace id
+        assert {"req", "req.submit", "req.queue", "req.admit",
+                "req.prefill", "req.first_token"} <= names
+        assert len({s["tid"] for s in chain}) == 1
+        assert len({s["trace"] for s in chain}) == 1
+        root = next(s for s in chain if s["name"] == "req")
+        assert root["args"]["lane"] == f"gen-req-{rid}"
+        assert root["args"]["tenant"] in ("u_a", "u_b")
+        # phases nest inside the root window
+        t0, t1 = root["ts_us"], root["ts_us"] + root["dur_us"]
+        for s in chain:
+            assert s["ts_us"] >= t0 - 1
+            assert s["ts_us"] + s["dur_us"] <= t1 + 1
+        # at least one prefix admission in this warm-prefix traffic
+    kinds = {(s["args"]["rid"], s["args"]["kind"])
+             for s in spans if s["name"] == "req.admit"}
+    assert any(k == "prefix-hit" for _, k in kinds)
+
+    # TTFT agreement: histogram delta == the 4 span-carried values
+    ft = [s for s in spans if s["name"] == "req.first_token"]
+    assert len(ft) == 4
+    h = monitor.get_histogram("serve_ttft_ms")
+    assert h.count - hc0 == 4
+    assert h.sum - hs0 == pytest.approx(
+        sum(s["args"]["ttft_ms"] for s in ft), rel=1e-9)
+    # and the span-derived TTFT (timestamps) agrees with the carried
+    # value to clock-mapping precision
+    subs = {s["args"]["rid"]: s for s in spans
+            if s["name"] == "req.submit"}
+    for s in ft:
+        d_ms = (s["ts_us"] - subs[s["args"]["rid"]]["ts_us"]) / 1e3
+        assert abs(d_ms - s["args"]["ttft_ms"]) < 5.0
+
+    # tenant sums == untagged totals (all requests tagged)
+    din = monitor.stat_get("serve_tokens_in") - d0["serve_tokens_in"]
+    dout = monitor.stat_get("serve_tokens_out") \
+        - d0["serve_tokens_out"]
+    da_in = monitor.stat_get("serve_tenant_tokens_in",
+                             labels={"tenant": "u_a"}) \
+        - ta0["tokens_in"]
+    db_in = monitor.stat_get("serve_tenant_tokens_in",
+                             labels={"tenant": "u_b"}) \
+        - tb0["tokens_in"]
+    da_out = monitor.stat_get("serve_tenant_tokens_out",
+                              labels={"tenant": "u_a"}) \
+        - ta0["tokens_out"]
+    db_out = monitor.stat_get("serve_tenant_tokens_out",
+                              labels={"tenant": "u_b"}) \
+        - tb0["tokens_out"]
+    assert din == sum(lens) == da_in + db_in
+    assert dout == 20 == da_out + db_out
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: gateway + PS fleet under one aggregator
+# ---------------------------------------------------------------------------
+
+_PS_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.framework import monitor
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+from paddle_tpu.observability.metrics import MetricsServer
+tables = {n: SparseTable(**kw) for n, kw in cfg["tables"].items()}
+srv = PSServer(tables, host="127.0.0.1",
+               replica_of=cfg.get("replica_of"),
+               replica_mode=cfg.get("replica_mode", "standby"),
+               read_coalesce_ms=cfg.get("coalesce_ms", 0.0),
+               read_coalesce_batch=cfg.get("coalesce_batch", 64))
+srv.start()
+# deterministic shared-histogram samples for the exact-merge check
+for v in cfg.get("demo_samples", []):
+    monitor.hist_observe("fleet_demo_ms", float(v),
+                         buckets=[float(b) for b in range(1, 101)])
+msrv = MetricsServer(port=0, host="127.0.0.1").start()
+print(json.dumps({"port": srv.port, "mport": msrv.port,
+                  "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+_SPEC = {"emb": dict(dim=4, optimizer="sgd", lr=0.1, seed=5)}
+
+
+def _spawn_ps(role, repo, replica_of=None, mode="standby",
+              coalesce_ms=0.0, demo=()):
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    env.update(PADDLE_METRICS="1", PADDLE_TRACE_ROLE=role)
+    env.pop("PADDLE_TRACE", None)       # fleet procs: metrics only
+    cfg = {"tables": _SPEC, "replica_of": replica_of,
+           "replica_mode": mode, "coalesce_ms": coalesce_ms,
+           "coalesce_batch": 100000, "demo_samples": list(demo)}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PS_SRC, repo, json.dumps(cfg)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    info = json.loads(proc.stdout.readline())
+    return proc, info
+
+
+def test_fleet_observatory_end_to_end(tmp_path, monkeypatch):
+    """The ISSUE 12 acceptance run (docstring at the top of this
+    file): (a) exact rollup + pooled-percentile merge, (b) span TTFT
+    vs serve_ttft_ms, (c) tenant sums, (d) straggler flag + TTFT SLO
+    breach -> flight bundle -> postmortem renders lane + marker."""
+    from paddle_tpu.distributed.fleet.ps_service import PSClient
+    from paddle_tpu.inference import GenerationServer
+
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRACE_ROLE", "gateway")
+    monkeypatch.setattr(flight_recorder, "_dumps_on", True)
+    monitor.enable_metrics(True)
+    trace.enable(dir=str(tmp_path), role="gateway")
+
+    rng = np.random.RandomState(42)
+    demo = {r: rng.uniform(0.0, 100.0, 400).round(3)
+            for r in ("ps0", "repA", "repB", "repSlow")}
+
+    prim, pinfo = _spawn_ps("ps0", _REPO, demo=demo["ps0"])
+    pep = f"127.0.0.1:{pinfo['port']}"
+    reps = {}
+    try:
+        for role, cms in (("repA", 0.0), ("repB", 0.0),
+                          ("repSlow", 60.0)):
+            # repSlow is ARTIFICIALLY DELAYED: a 60 ms read-coalesce
+            # window with an unreachable early-flush ceiling makes
+            # every sustained pull pay the window — an honest in-repo
+            # way to slow one replica's serve rate
+            reps[role] = _spawn_ps(role, _REPO, replica_of=pep,
+                                   mode="read", coalesce_ms=cms,
+                                   demo=demo[role])
+
+        # seed rows + wait for replicas to catch up
+        w = PSClient([pep], mode="sync", worker_id="w0",
+                     connect_timeout=5.0, rpc_timeout=5.0,
+                     max_retries=4, backoff_base=0.02,
+                     rpc_deadline=30.0)
+        ids = np.arange(32, dtype=np.int64)
+        w.pull("emb", ids)
+        w.push("emb", ids, np.ones((32, 4), np.float32))
+
+        # ---- serving traffic: 8 streams x 2 tenants, shared prefix
+        from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+        paddle.seed(0)
+        cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=64)
+        lm = LlamaForCausalLM(cfg)
+        lm.eval()
+        h0 = monitor.get_histogram("serve_ttft_ms")
+        hc0, hs0 = (h0.count, h0.sum) if h0 is not None else (0, 0.0)
+        tin0 = monitor.stat_get("serve_tokens_in")
+        tout0 = monitor.stat_get("serve_tokens_out")
+        ten0 = {t: {k: monitor.stat_get(f"serve_tenant_{k}",
+                                        labels={"tenant": t})
+                    for k in ("tokens_in", "tokens_out")}
+                for t in ("acme", "zeta")}
+        gsrv = GenerationServer(lm, num_slots=8, block_size=4,
+                                max_model_len=32, prefix_cache=True,
+                                max_prefill_batch=2)
+        gsrv.start()
+        prng = np.random.RandomState(1)
+        pre = prng.randint(1, 64, (8,)).astype("int32")
+        streams, lens = [], []
+        for i in range(8):
+            p = np.concatenate(
+                [pre,
+                 prng.randint(1, 64, (2 + i % 4,)).astype("int32")])
+            lens.append(p.size)
+            streams.append(gsrv.submit(
+                p, max_new_tokens=4,
+                tenant="acme" if i % 2 == 0 else "zeta"))
+        outs = [s.result(timeout=180) for s in streams]
+        assert all(len(o) == 4 for o in outs)
+
+        # gateway's own demo samples + metrics endpoint
+        gw_demo = rng.uniform(0.0, 100.0, 400).round(3)
+        for v in gw_demo:
+            monitor.hist_observe(
+                "fleet_demo_ms", float(v),
+                buckets=[float(b) for b in range(1, 101)])
+        gw_msrv = obs_metrics.MetricsServer(port=0,
+                                            host="127.0.0.1").start()
+
+        # ---- read traffic: one hammering reader per replica AND one
+        # on the primary, so every rate-bearing process sees the same
+        # symmetric load (GIL-shared reader threads) and the delayed
+        # replica is the only outlier
+        stop = threading.Event()
+        read_errs = []
+
+        def reader(ep):
+            try:
+                if ep == pep:
+                    cli = PSClient([pep], mode="sync", worker_id="wr",
+                                   connect_timeout=5.0,
+                                   rpc_timeout=5.0, max_retries=4,
+                                   backoff_base=0.02,
+                                   rpc_deadline=30.0)
+                else:
+                    cli = PSClient([pep], mode="read", max_lag=1000,
+                                   read_replicas=[ep],
+                                   connect_timeout=5.0,
+                                   rpc_timeout=5.0, max_retries=4,
+                                   backoff_base=0.02,
+                                   rpc_deadline=30.0)
+                sub = np.arange(16, dtype=np.int64)
+                while not stop.is_set():
+                    cli.pull("emb", sub)
+                cli.close()
+            except Exception as e:      # noqa: BLE001
+                read_errs.append(e)
+
+        threads = [threading.Thread(target=reader, args=(pep,),
+                                    daemon=True)]
+        threads[0].start()
+        for role, (proc, info) in reps.items():
+            # wait until the replica serves bounded reads
+            rep_ep = f"127.0.0.1:{info['port']}"
+            deadline = time.monotonic() + 20.0
+            while True:
+                try:
+                    cli = PSClient([pep], mode="read", max_lag=1000,
+                                   read_replicas=[rep_ep],
+                                   connect_timeout=5.0,
+                                   rpc_timeout=5.0, max_retries=6,
+                                   backoff_base=0.05,
+                                   rpc_deadline=20.0)
+                    cli.pull("emb", ids[:4])
+                    cli.close()
+                    break
+                except Exception:
+                    assert time.monotonic() < deadline, \
+                        f"{role} never served reads"
+                    time.sleep(0.2)
+            t = threading.Thread(target=reader, args=(rep_ep,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+
+        # ---- ONE aggregator scrapes the whole fleet
+        targets = [f"127.0.0.1:{gw_msrv.port}",
+                   f"127.0.0.1:{pinfo['mport']}"] + \
+                  [f"127.0.0.1:{info['mport']}"
+                   for _, info in reps.values()]
+        agg = FleetAggregator(targets, interval_s=1.0,
+                              stale_after_s=3600.0,
+                              straggler_key="ps_server_pulls",
+                              straggler_k=2.0)
+        agg.scrape_once()              # opens the rate window
+        time.sleep(1.2)                # readers hammer meanwhile
+        fleet = agg.scrape_once()
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        assert not read_errs, read_errs
+
+        # (a) rollup counters == exact per-process sums
+        per_snaps = [t.last_snap for t in agg._targets]
+        for key in ("ps_server_pulls", "serve_tokens_out",
+                    "serve_gen_finished"):
+            total = sum(int(s.get("counters", {}).get(key, 0))
+                        for s in per_snaps)
+            assert fleet["rollup"]["counters"].get(key, 0) == total, key
+        # merged histogram percentiles == numpy on the pooled samples
+        pooled = np.concatenate(list(demo.values()) + [gw_demo])
+        mh = monitor.Histogram.from_snapshot(
+            fleet["rollup"]["histograms"]["fleet_demo_ms"])
+        assert mh.count == pooled.size
+        for q in (50, 90, 99):
+            assert abs(mh.percentile(q)
+                       - float(np.percentile(pooled, q))) < 1.5
+        # per-tenant labeled counters survived the merge
+        lab = fleet["rollup"]["labeled"]["counters"]
+        assert "serve_tenant_tokens_out" in lab
+
+        # (d-1) the delayed replica is the straggler
+        rates = {t: v["rates"].get("ps_server_pulls")
+                 for t, v in fleet["targets"].items()
+                 if "ps_server_pulls" in v["rates"]}
+        slow_tid = f"repSlow-{reps['repSlow'][1]['pid']}"
+        assert slow_tid in fleet["stragglers"], (rates,
+                                                 fleet["stragglers"])
+
+        # (b) span TTFT == serve_ttft_ms observations
+        trace.flush()
+        sink = tmp_path / f"trace-gateway-{os.getpid()}.jsonl"
+        spans = [r for r in _read_sink(sink) if r.get("t") == "span"]
+        ft = [s for s in spans if s["name"] == "req.first_token"]
+        assert len(ft) == 8
+        h = monitor.get_histogram("serve_ttft_ms")
+        assert h.count - hc0 == 8
+        assert h.sum - hs0 == pytest.approx(
+            sum(s["args"]["ttft_ms"] for s in ft), rel=1e-9)
+
+        # (c) tenant sums == untagged totals
+        din = monitor.stat_get("serve_tokens_in") - tin0
+        dout = monitor.stat_get("serve_tokens_out") - tout0
+        tin = sum(monitor.stat_get("serve_tenant_tokens_in",
+                                   labels={"tenant": t})
+                  - ten0[t]["tokens_in"] for t in ("acme", "zeta"))
+        tout = sum(monitor.stat_get("serve_tenant_tokens_out",
+                                    labels={"tenant": t})
+                   - ten0[t]["tokens_out"] for t in ("acme", "zeta"))
+        assert din == sum(lens) == tin
+        assert dout == 32 == tout
+
+        # (d-2) inject a TTFT SLO breach -> flight bundle
+        n_bundles0 = len(flight_recorder.bundle_paths())
+        eng = SloEngine([SLO("ttft_e2e", "latency", "serve_ttft_ms",
+                             bound=1e-4, budget=0.01,
+                             windows=((60.0, 1.0),), min_events=4)])
+        t0 = time.time()
+        eng.evaluate(_empty_hist_baseline("serve_ttft_ms"), now=t0)
+        st = eng.evaluate(now=t0 + 10)[0]    # live local registry
+        assert not st["ok"], st
+        bundles = flight_recorder.bundle_paths()
+        assert len(bundles) > n_bundles0, "breach produced no bundle"
+
+        gsrv.stop()
+        gw_msrv.stop()
+        agg.stop()
+        w.stop_server()
+        w.close()
+        trace.disable()
+
+        # (d-3) postmortem renders the request lane + breach marker
+        out = tmp_path / "postmortem.json"
+        rep_txt = tmp_path / "postmortem.txt"
+        r = subprocess.run(
+            [sys.executable, _POSTMORTEM, "--dir", str(tmp_path),
+             "-o", str(out), "--report", str(rep_txt)],
+            capture_output=True, text=True, cwd=_REPO)
+        assert r.returncode == 0, r.stderr
+        txt = rep_txt.read_text()
+        assert "slo.breach" in txt
+        bad_lines = [ln for ln in txt.splitlines()
+                     if "slo.breach" in ln and "<-- BAD" in ln]
+        assert bad_lines, "breach not marked BAD in the report"
+        merged = json.load(open(out))
+        evs = merged["traceEvents"]
+        lanes = [e for e in evs if e.get("ph") == "M"
+                 and e.get("name") == "thread_name"
+                 and str(e["args"].get("name", "")
+                         ).startswith("gen-req-")]
+        assert lanes, "no request lanes in the postmortem timeline"
+        lane_tids = {(e["pid"], e["tid"]) for e in lanes}
+        req_spans = [e for e in evs if e.get("ph") == "X"
+                     and e.get("name", "").startswith("req")
+                     and (e["pid"], e["tid"]) in lane_tids]
+        assert req_spans, "request lane holds no spans"
+        breach_marks = [e for e in evs if e.get("ph") == "i"
+                        and e.get("name") == "slo.breach"]
+        assert breach_marks, "no slo.breach instant on the timeline"
+    finally:
+        prim.kill()
+        prim.wait(timeout=10)
+        for proc, _ in reps.values():
+            proc.kill()
+            proc.wait(timeout=10)

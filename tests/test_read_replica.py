@@ -745,6 +745,62 @@ def test_full_batch_flushes_before_window():
         "full batch still waited out the 5s window"
 
 
+def test_early_flush_observes_coalescer_telemetry():
+    """ISSUE 12 satellite: when the ``read_coalesce_batch`` CEILING
+    (not the timer) flushes the batch, the coalescer telemetry must
+    still observe — ``ps_read_coalesce_batches`` /
+    ``ps_read_coalesced_pulls`` count and the size histogram records
+    the early-flushed batch size (the PR 11 early-flush path skipped
+    no accounting, now pinned by test under the telemetry pass)."""
+    from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
+    from paddle_tpu.framework import monitor as _monitor
+
+    class _T:
+        def pull(self, ids):
+            return np.asarray(ids, dtype=np.float32)[:, None]
+
+    was_on = _monitor.metrics_enabled()
+    _monitor.enable_metrics(True)
+    try:
+        co = _ReadCoalescer(lambda name: _T(), 5.0, flush_at=3)
+        b0 = _monitor.stat_get("ps_read_coalesce_batches")
+        p0 = _monitor.stat_get("ps_read_coalesced_pulls")
+        h = _monitor.get_histogram("ps_read_coalesce_size")
+        hc0 = h.count if h is not None else 0
+        hs0 = h.sum if h is not None else 0.0
+        co.pull("emb", np.arange(2, dtype=np.int64))  # warm: not quiet
+        start = threading.Barrier(3)
+        ok = []
+
+        def reader(i):
+            start.wait(10.0)
+            ids = np.arange(i, i + 4, dtype=np.int64)
+            vals = co.pull("emb", ids)
+            ok.append(np.array_equal(vals.reshape(-1),
+                                     ids.astype(np.float32)))
+        t0 = time.monotonic()
+        ts = [threading.Thread(target=reader, args=(i,))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30.0)
+        assert len(ok) == 3 and all(ok)
+        # the CEILING flushed (well under the 5s window)...
+        assert time.monotonic() - t0 < 2.5
+        # ...and the telemetry observed it: 2 gathers (warm-up of 1 +
+        # early-flushed batch of 3), 4 coalesced pulls, histogram
+        # samples [1, 3]
+        assert _monitor.stat_get("ps_read_coalesce_batches") - b0 == 2
+        assert _monitor.stat_get("ps_read_coalesced_pulls") - p0 == 4
+        h = _monitor.get_histogram("ps_read_coalesce_size")
+        assert h is not None
+        assert h.count - hc0 == 2
+        assert h.sum - hs0 == pytest.approx(4.0)
+    finally:
+        _monitor.enable_metrics(was_on)
+
+
 def test_coalescer_error_propagates_to_every_rider():
     from paddle_tpu.distributed.fleet.ps_service import _ReadCoalescer
 

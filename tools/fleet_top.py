@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Live fleet table over the observability aggregator (ISSUE 12).
+
+Three ways to point it at a fleet:
+
+1. ``--fleet http://host:port`` — an already-running
+   :class:`~paddle_tpu.observability.aggregator.FleetAggregator`'s
+   ``serve()`` endpoint (reads its ``/fleet`` JSON);
+2. ``--targets a:1234,b:1235,run/metrics-ps0.jsonl`` — spin up a
+   private aggregator over endpoints and/or MetricsFlusher JSONL
+   files and scrape them directly;
+3. positional JSONL paths — shorthand for ``--targets`` on files.
+
+Renders one row per process (role, freshness, straggler flag, the
+rates that matter) plus the fleet rollup line, refreshed every
+``--interval`` seconds; ``--once`` prints a single table and exits
+(what the tests drive).
+
+Usage::
+
+    python tools/fleet_top.py --fleet http://127.0.0.1:9464
+    python tools/fleet_top.py --targets 127.0.0.1:9464,127.0.0.1:9465 \
+        --key ps_server_pulls --interval 2
+    python tools/fleet_top.py run/metrics-*.jsonl --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RATE_COLS = 4      # busiest counters shown per process
+
+
+def render(fleet: dict, key=None) -> str:
+    """One fleet table (pure function of the /fleet JSON — testable)."""
+    rows = []
+    hdr = f"{'PROC':<20} {'ROLE':<10} {'OK':<3} {'AGE':>6} " \
+          f"{'FLAG':<10} RATES(/s)"
+    rows.append(hdr)
+    rows.append("-" * len(hdr))
+    stragglers = set(fleet.get("stragglers", []))
+    stale = set(fleet.get("stale", []))
+    for tid, t in sorted(fleet.get("targets", {}).items()):
+        flag = ("STRAGGLER" if tid in stragglers
+                else "STALE" if tid in stale else "")
+        rates = t.get("rates", {})
+        # the straggler key first, then the busiest counters
+        keys = sorted(rates, key=lambda k: -abs(rates[k]))
+        if key and key in rates:
+            keys = [key] + [k for k in keys if k != key]
+        shown = " ".join(f"{k}={rates[k]:.1f}"
+                         for k in keys[:RATE_COLS])
+        age = t.get("age_s")
+        rows.append(f"{tid:<20.20} {t.get('role', '?'):<10.10} "
+                    f"{'y' if t.get('ok') else 'n':<3} "
+                    f"{(f'{age:.1f}' if age is not None else '?'):>6} "
+                    f"{flag:<10} {shown}")
+    roll = fleet.get("rollup", {})
+    nc = len(roll.get("counters", {}))
+    nh = len(roll.get("histograms", {}))
+    un = roll.get("unmerged_histograms", [])
+    rows.append("-" * len(hdr))
+    rows.append(f"fleet: {len(fleet.get('targets', {}))} procs, "
+                f"{len(stale)} stale, {len(stragglers)} stragglers | "
+                f"rollup: {nc} counters, {nh} histograms merged"
+                + (f", UNMERGED: {','.join(un)}" if un else ""))
+    if key:
+        tot = roll.get("counters", {}).get(key)
+        if tot is not None:
+            rows.append(f"fleet {key} total: {tot}")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="MetricsFlusher JSONL files to scrape")
+    ap.add_argument("--fleet", help="URL of a running aggregator "
+                                    "(reads <url>/fleet)")
+    ap.add_argument("--targets", help="comma-separated endpoints "
+                                      "(host:port) and/or JSONL paths")
+    ap.add_argument("--key", help="straggler-detection counter name")
+    ap.add_argument("--k", type=float, default=3.0,
+                    help="straggler threshold in MADs (default 3)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--stale-after", type=float, default=None)
+    ap.add_argument("--once", action="store_true",
+                    help="print one table and exit")
+    args = ap.parse_args(argv)
+
+    agg = None
+    if args.fleet:
+        url = args.fleet.rstrip("/") + "/fleet"
+
+        def snap():
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return json.loads(r.read().decode())
+    else:
+        targets = list(args.files)
+        if args.targets:
+            targets += [t for t in args.targets.split(",") if t]
+        if not targets:
+            ap.error("no targets (positional files, --targets or "
+                     "--fleet)")
+        from paddle_tpu.observability.aggregator import FleetAggregator
+        agg = FleetAggregator(targets, interval_s=args.interval,
+                              stale_after_s=args.stale_after,
+                              straggler_key=args.key,
+                              straggler_k=args.k)
+
+        def snap():
+            return agg.scrape_once()
+
+    try:
+        while True:
+            fleet = snap()
+            table = render(fleet, key=args.key)
+            if args.once:
+                print(table)
+                return 0
+            # full-screen refresh (plain dumb-terminal safe)
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "")
+            print(table, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if agg is not None:
+            agg.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,9 +54,17 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 # ps.read_stale_exhausted (ISSUE 10): a bounded-staleness read found
 # NOTHING within the bound — every replica stale/down AND the primary
 # unreachable — the serving tier's defining incident
+# slo.breach (ISSUE 12): an error-budget burn crossing its multi-window
+# thresholds IS the incident a serving postmortem starts from.
+# serve.admit_rollback (ISSUE 12 satellite): the admission capacity
+# check miscounted and shed one admission — shed-class anomaly.
+# fleet.straggler / fleet.stale: the aggregator's view of a process
+# falling behind or going dark.
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
               "ps.replica_error", "serve.shed", "serve.evict",
-              "elastic.leave", "ps.read_stale_exhausted"}
+              "elastic.leave", "ps.read_stale_exhausted",
+              "slo.breach", "serve.admit_rollback",
+              "fleet.straggler", "fleet.stale"}
 
 
 def _is_bad(ev: dict) -> bool:
@@ -242,14 +250,24 @@ def merge(procs: List[_Proc], root: Optional[str] = None) -> dict:
                            "s": "p",
                            "ts": float(ev.get("ts_us", 0)) - off,
                            "args": args})
+        named = set()
         for sp in p.trace_spans:
             args = dict(sp.get("args") or {})
             args["span"] = sp.get("span")
             if sp.get("parent") is not None:
                 args["parent"] = sp["parent"]
+            tid = int(sp.get("tid", 0)) % (1 << 31)
+            lane = args.get("lane")
+            if lane and (pid, tid) not in named:
+                # request lanes (ISSUE 12): name the virtual tid so
+                # the postmortem timeline shows one lane per request
+                named.add((pid, tid))
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": str(lane)}})
             events.append({"ph": "X", "name": sp["name"],
                            "cat": sp.get("cat", "host"), "pid": pid,
-                           "tid": int(sp.get("tid", 0)) % (1 << 31),
+                           "tid": tid,
                            "ts": float(sp["ts_us"]) - off,
                            "dur": float(sp.get("dur_us", 0)),
                            "args": args})

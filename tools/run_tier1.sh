@@ -81,6 +81,11 @@ if [ "$CHAOS" -eq 1 ]; then
     # the INFERENCE GATEWAY suite (ISSUE 11): pool-exhaustion eviction
     # + re-admission under prefix sharing, speculation, and int8 KV —
     # all replay paths bit-checked live (check_replay).
+    # test_fleet_observatory.py is the FLEET OBSERVATORY suite (ISSUE
+    # 12): multi-process aggregator scrape/merge, straggler + stale
+    # flagging, SLO burn-rate breaches dumping flight bundles, and the
+    # per-request trace lanes — the whole e2e runs subprocess PS
+    # servers and an artificially delayed replica.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
@@ -89,6 +94,7 @@ if [ "$CHAOS" -eq 1 ]; then
         tests/test_geo.py tests/test_coordinator_ha.py \
         tests/test_serving_ps.py tests/test_prefix_cache.py \
         tests/test_spec_decode.py tests/test_kv_int8.py \
+        tests/test_fleet_observatory.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
@@ -107,10 +113,12 @@ if [ "$TRACE" -eq 1 ]; then
         python -m pytest tests/ "${PYARGS[@]}" -p no:randomly
     rc4=$?
     # a green run must leak NEITHER trace sinks NOR flight bundles /
-    # faulthandler sidecars into the repo (tests that trigger dumps
-    # point PADDLE_TRACE_DIR at their own tmp dirs)
+    # faulthandler sidecars NOR aggregator state files into the repo
+    # (tests that trigger dumps / fleet snapshots point
+    # PADDLE_TRACE_DIR / state_file at their own tmp dirs)
     LEAKED=$(find . -maxdepth 2 \( -name 'trace-*.jsonl' -o -name \
-        'flight-*.jsonl' -o -name 'faulthandler-*.txt' \) -not -path \
+        'flight-*.jsonl' -o -name 'faulthandler-*.txt' -o -name \
+        'fleet-*.jsonl' \) -not -path \
         './paddle_trace/*' 2>/dev/null; [ -d paddle_trace ] && echo \
         paddle_trace)
     if [ -n "$LEAKED" ]; then

@@ -130,6 +130,18 @@ def merge_sinks(sinks: List[dict]) -> dict:
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {
                            "name": f"{s['role']} ({s['sink']})"}})
+        # request lanes (ISSUE 12): a root "req" span carries a "lane"
+        # arg naming its virtual tid — surface it as the Perfetto
+        # thread name so the UI shows one named lane per request
+        named = set()
+        for sp in s["spans"]:
+            lane = (sp.get("args") or {}).get("lane")
+            tid = int(sp.get("tid", 0)) % (1 << 31)
+            if lane and (pid, tid) not in named:
+                named.add((pid, tid))
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": str(lane)}})
         for sp in s["spans"]:
             ts = float(sp["ts_us"]) - off
             tid = int(sp.get("tid", 0)) % (1 << 31)
