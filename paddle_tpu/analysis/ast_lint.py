@@ -85,6 +85,14 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/distributed/fleet/dist_step.py",
     "paddle_tpu/io/dataloader.py",
     "paddle_tpu/train_guard.py",
+    # ISSUE 13: the Pallas kernel tier (registry locking + kernels)
+    "paddle_tpu/ops/pallas/__init__.py",
+    "paddle_tpu/ops/pallas/registry.py",
+    "paddle_tpu/ops/pallas/flash_attention.py",
+    "paddle_tpu/ops/pallas/opt_apply.py",
+    "paddle_tpu/ops/pallas/int8_matmul.py",
+    "paddle_tpu/ops/pallas/kv_attention.py",
+    "paddle_tpu/ops/pallas/segment_sum.py",
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

@@ -29,7 +29,10 @@ properties a human reviewer otherwise has to eyeball per PR:
 
 Beyond findings, the report carries a **collective inventory** (count +
 bytes of psum / all_gather / ppermute / ... at the jaxpr level, plus the
-post-SPMD HLO instruction counts when a compiled text is available) and
+post-SPMD HLO instruction counts when a compiled text is available), a
+**kernel inventory** (ISSUE 13: pallas/Mosaic custom calls classified
+as device kernels — never host callbacks — by name and count, with the
+compiled ``tpu_custom_call`` targets mirrored from HLO) and
 a per-input **donation table** — the observable surface
 ``DistributedTrainStep.audit()`` / ``Predictor.audit()`` expose and the
 auto-sharding planner (ROADMAP item 4) will reuse for memory and
@@ -48,7 +51,8 @@ from .findings import SEV_ERROR, SEV_WARNING, Finding
 
 __all__ = ["AuditReport", "audit_fn", "audit_traced", "audit_jaxpr",
            "collective_inventory", "hlo_collective_inventory",
-           "COLLECTIVE_PRIMS", "CALLBACK_PRIMS"]
+           "kernel_inventory", "hlo_kernel_inventory",
+           "COLLECTIVE_PRIMS", "CALLBACK_PRIMS", "KERNEL_PRIMS"]
 
 # jaxpr-level collective primitives (psum lowers as psum2 on jax 0.4.x)
 COLLECTIVE_PRIMS = {
@@ -63,6 +67,15 @@ COLLECTIVE_PRIMS = {
 CALLBACK_PRIMS = {"pure_callback", "io_callback", "callback",
                   "outside_call", "host_callback_call"}
 DEBUG_PRIMS = {"debug_callback", "debug_print"}
+
+# device-kernel primitives (ISSUE 13): pallas custom calls are KERNELS
+# — device code behind a custom-call boundary, NOT host callbacks.
+# They land in the report's kernel inventory (name + count) so a step
+# program's custom-call surface is auditable; they must never trip the
+# jaxpr.host-callback rule.
+KERNEL_PRIMS = {"pallas_call", "tpu_custom_call", "mosaic"}
+# post-SPMD HLO: what a compiled pallas call looks like on TPU
+_HLO_KERNEL_TARGETS = ("tpu_custom_call", "mosaic", "__gpu$xla.gpu")
 
 # post-SPMD HLO collective instructions (what XLA actually emits once
 # shardings partition the program — jaxpr psums may be absent entirely
@@ -136,6 +149,11 @@ class AuditReport:
     hlo_collectives: Optional[Dict[str, Dict[str, int]]] = None
     donation: List[Dict] = field(default_factory=list)
     widening_casts: int = 0
+    # ISSUE 13: pallas/Mosaic custom calls classified as device
+    # KERNELS — {kernel_name: count}; hlo_kernels mirrors the compiled
+    # custom-call targets when HLO text was audited
+    kernels: Dict[str, int] = field(default_factory=dict)
+    hlo_kernels: Optional[Dict[str, int]] = None
 
     def errors(self) -> List[Finding]:
         return [f for f in self.findings if f.severity == SEV_ERROR]
@@ -181,6 +199,13 @@ class AuditReport:
             lines.append("  collectives: " + ", ".join(
                 f"{k} x{v['count']} ({v['bytes']}B)"
                 for k, v in sorted(inv.items())))
+        kinv = dict(self.kernels)
+        if self.hlo_kernels:
+            kinv.update({f"hlo:{k}": v
+                         for k, v in self.hlo_kernels.items()})
+        if kinv:
+            lines.append("  kernels: " + ", ".join(
+                f"{k} x{v}" for k, v in sorted(kinv.items())))
         for f in self.findings:
             lines.append("  " + f.format())
         return "\n".join(lines)
@@ -191,7 +216,9 @@ class AuditReport:
                 "collectives": self.collectives,
                 "hlo_collectives": self.hlo_collectives,
                 "donation": self.donation,
-                "widening_casts": self.widening_casts}
+                "widening_casts": self.widening_casts,
+                "kernels": self.kernels,
+                "hlo_kernels": self.hlo_kernels}
 
 
 def collective_inventory(closed_jaxpr) -> Dict[str, Dict[str, int]]:
@@ -205,6 +232,45 @@ def collective_inventory(closed_jaxpr) -> Dict[str, Dict[str, int]]:
         d = inv.setdefault(fam, {"count": 0, "bytes": 0})
         d["count"] += 1
         d["bytes"] += sum(_aval_nbytes(v.aval) for v in eqn.outvars)
+    return inv
+
+
+def _kernel_name(eqn) -> str:
+    """Best-effort kernel name for a pallas/Mosaic custom call: the
+    pallas_call's NameAndSrcInfo carries the kernel function name."""
+    nsi = eqn.params.get("name_and_src_info")
+    nm = getattr(nsi, "name", None)
+    if nm:
+        return str(nm)
+    nm = eqn.params.get("name")
+    return str(nm) if nm else eqn.primitive.name
+
+
+def kernel_inventory(closed_jaxpr) -> Dict[str, int]:
+    """Count device-kernel custom calls (pallas_call etc.) per kernel
+    name — the ISSUE 13 classification: kernels, not host callbacks."""
+    inv: Dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in KERNEL_PRIMS:
+            nm = _kernel_name(eqn)
+            inv[nm] = inv.get(nm, 0) + 1
+    return inv
+
+
+def hlo_kernel_inventory(hlo_text: str) -> Dict[str, int]:
+    """Count compiled custom-call instructions whose target is a known
+    device-kernel entry point (``tpu_custom_call`` is what a pallas
+    kernel lowers to on TPU)."""
+    inv: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        if "custom-call" not in line:
+            continue
+        m = re.search(r'custom_call_target="([^"]+)"', line)
+        if not m:
+            continue
+        tgt = m.group(1)
+        if any(t in tgt for t in _HLO_KERNEL_TARGETS):
+            inv[tgt] = inv.get(tgt, 0) + 1
     return inv
 
 
@@ -313,6 +379,13 @@ def audit_jaxpr(closed_jaxpr, *, program: str = "program",
     # rules over equations ---------------------------------------------
     for eqn in iter_eqns(closed_jaxpr.jaxpr):
         prim = eqn.primitive.name
+        if prim in KERNEL_PRIMS:
+            # a pallas custom call is a DEVICE kernel: inventoried,
+            # never flagged as a host callback (its inner jaxpr is
+            # still recursed for the other rules)
+            nm = _kernel_name(eqn)
+            rep.kernels[nm] = rep.kernels.get(nm, 0) + 1
+            continue
         if prim in CALLBACK_PRIMS or prim in DEBUG_PRIMS:
             sev = SEV_ERROR if prim in CALLBACK_PRIMS else SEV_WARNING
             cb = eqn.params.get("callback")
@@ -411,6 +484,7 @@ def audit_traced(traced, *, program: str = "program",
                       **thresholds)
     if hlo_text is not None:
         rep.hlo_collectives = hlo_collective_inventory(hlo_text)
+        rep.hlo_kernels = hlo_kernel_inventory(hlo_text)
     return rep
 
 

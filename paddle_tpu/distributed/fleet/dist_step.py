@@ -42,7 +42,8 @@ from .. import mesh as mesh_mod
 __all__ = ["DistributedTrainStep", "param_partition_spec",
            "zero_shard_ranges", "flatten_zero_state",
            "unflatten_zero_state", "zero_shard", "zero_unshard",
-           "zero_reshard", "LRSchedule", "make_lr_schedule"]
+           "zero_reshard", "LRSchedule", "make_lr_schedule",
+           "fused_optimizer_apply"]
 
 # storage suffix for 8-bit optimizer-state scales ("m" -> "m@scale");
 # "@" cannot collide with real slot names
@@ -319,6 +320,54 @@ def zero_reshard(shards, new_world: int):
     ``new_world`` run would shard from the same global vector."""
     flat = zero_unshard(shards)
     return [zero_shard(flat, r, new_world) for r in range(new_world)]
+
+
+_FUSED_APPLY_CACHE: Dict[tuple, Any] = {}
+
+
+def fused_optimizer_apply(kind: str, p: np.ndarray, g: np.ndarray,
+                          slots: Dict[str, np.ndarray], *, t: int,
+                          lr, betas=(0.9, 0.999), eps=1e-8,
+                          momentum=0.9):
+    """Fused one-pass optimizer apply over a flat ZeRO shard (ISSUE 13).
+
+    Device analog of the flat elastic sgd/momentum/adam: reads
+    grad+param+moments and writes param+moments in ONE pass through the
+    ``opt_apply`` kernel of the Pallas tier (``ops/pallas/opt_apply``;
+    mode — pallas on TPU, XLA reference elsewhere, interpret for
+    parity — resolved by the kernel registry).  Strictly elementwise
+    with every constant pinned to f32, so the PR 9 world-invariance
+    contract holds bit-for-bit WITHIN the fused engine: the update of
+    a shard equals the same slice of the full-vector update, for any
+    world size.  Adam's bias corrections are computed on host from the
+    global step exactly like the numpy engine, so ``t`` never enters
+    the device program and steady-state steps never retrace (the jit
+    cache below is keyed by (kind, mode, shard length) only).
+
+    Returns ``(new_param, new_slots_dict)`` as numpy f32 arrays.
+    """
+    from ...ops.pallas import registry as _kreg
+    from ...ops.pallas.opt_apply import SLOTS, pack_hyper
+    slot_names = SLOTS[kind]          # raises KeyError on unknown kind
+    hyper = pack_hyper(kind, lr=lr, betas=betas, eps=eps,
+                       momentum=momentum, t=t)
+    mode = _kreg.resolve("opt_apply")
+    key = (kind, mode, int(p.size))
+    fn = _FUSED_APPLY_CACHE.get(key)
+    if fn is None:
+
+        def _run(pv, gv, sv, hy):
+            return _kreg.dispatch("opt_apply", kind, pv, gv, sv, hy)
+
+        fn = _FUSED_APPLY_CACHE[key] = jax.jit(_run)
+        if len(_FUSED_APPLY_CACHE) > 256:   # bound shape-bucket growth
+            _FUSED_APPLY_CACHE.pop(next(iter(_FUSED_APPLY_CACHE)))
+    out = fn(np.asarray(p, np.float32), np.asarray(g, np.float32),
+             tuple(np.asarray(slots[n], np.float32)
+                   for n in slot_names), hyper)
+    p_new = np.asarray(out[0], np.float32)
+    return p_new, {n: np.asarray(o, np.float32)
+                   for n, o in zip(slot_names, out[1:])}
 
 
 def _tree_to_tensors(obj):

@@ -836,8 +836,9 @@ class ElasticClient:
 
 class _FlatSGD:
     SLOTS: Tuple[str, ...] = ()
+    KIND = "sgd"
 
-    def __init__(self, lr, lr_schedule=None, **_):
+    def __init__(self, lr, lr_schedule=None, fused=None, **_):
         self.lr = np.float32(lr)
         # t-indexed schedule (ISSUE 10 satellite): a pure function of
         # the 1-based global step — see dist_step.LRSchedule.  Because
@@ -846,10 +847,33 @@ class _FlatSGD:
         # any N->M reshard mid-schedule.
         self.sched = lr_schedule
         self.t = 0
+        # ISSUE 13: route the update through the fused Pallas-tier
+        # optimizer-apply kernel (dist_step.fused_optimizer_apply) —
+        # ONE device pass over grad+param+moments instead of the numpy
+        # expression chain.  Bit-contracts (slot-ordered reduction,
+        # N->M->N reshard) hold exactly WITHIN either engine; the two
+        # engines differ ~1 ulp on XLA-CPU FMA-contracted elements
+        # (documented in ops/pallas/opt_apply.py), so an engine is a
+        # run-scoped choice, not a per-step one.
+        self.fused = (os.environ.get("PADDLE_ELASTIC_FUSED") == "1"
+                      if fused is None else bool(fused))
 
     def lr_at(self, t: int) -> np.float32:
         return self.lr if self.sched is None else np.float32(
             self.sched(t))
+
+    def _hyper(self) -> dict:
+        return {"lr": self.lr_at(self.t)}
+
+    def _fused_update(self, p, g):
+        from .dist_step import fused_optimizer_apply
+        p_new, slots = fused_optimizer_apply(
+            self.KIND, p, g,
+            {k: getattr(self, k) for k in self.SLOTS},
+            t=self.t, **self._hyper())
+        for k in self.SLOTS:
+            setattr(self, k, slots[k])
+        return p_new
 
     def load(self, slots: Dict[str, np.ndarray], t: int):
         if set(slots) != set(self.SLOTS):
@@ -866,25 +890,34 @@ class _FlatSGD:
 
     def update(self, p: np.ndarray, g: np.ndarray) -> np.ndarray:
         self.t += 1
+        if self.fused:
+            return self._fused_update(p, g)
         return (p - self.lr_at(self.t) * g).astype(np.float32)
 
 
 class _FlatMomentum(_FlatSGD):
     SLOTS = ("u",)
+    KIND = "momentum"
 
     def __init__(self, lr, momentum=0.9, **kw):
         super().__init__(lr, **kw)
         self.mu = np.float32(momentum)
         self.u = None
 
+    def _hyper(self):
+        return {"lr": self.lr_at(self.t), "momentum": self.mu}
+
     def update(self, p, g):
         self.t += 1
+        if self.fused:
+            return self._fused_update(p, g)
         self.u = (self.mu * self.u + g).astype(np.float32)
         return (p - self.lr_at(self.t) * self.u).astype(np.float32)
 
 
 class _FlatAdam(_FlatSGD):
     SLOTS = ("m", "v")
+    KIND = "adam"
 
     def __init__(self, lr, betas=(0.9, 0.999), eps=1e-8, **kw):
         super().__init__(lr, **kw)
@@ -894,8 +927,14 @@ class _FlatAdam(_FlatSGD):
         self.m = None
         self.v = None
 
+    def _hyper(self):
+        return {"lr": self.lr_at(self.t), "betas": (self.b1, self.b2),
+                "eps": self.eps}
+
     def update(self, p, g):
         self.t += 1
+        if self.fused:
+            return self._fused_update(p, g)
         b1, b2 = np.float32(self.b1), np.float32(self.b2)
         self.m = (b1 * self.m + (np.float32(1) - b1) * g) \
             .astype(np.float32)
@@ -939,7 +978,8 @@ class ElasticTrainer:
                  coordinator: Optional[str] = None,
                  expected_world: Optional[int] = None,
                  client_timeout: float = 120.0,
-                 role_maker: Optional[ElasticRoleMaker] = None):
+                 role_maker: Optional[ElasticRoleMaker] = None,
+                 fused_optimizer: Optional[bool] = None):
         flat0, meta = flatten_zero_state(
             {k: np.asarray(v, np.float32) for k, v in params.items()})
         self._init_flat = flat0.astype(np.float32)
@@ -958,7 +998,8 @@ class ElasticTrainer:
             lr_schedule = make_lr_schedule(**lr_schedule)
         self._opt = _FLAT_OPTS[optimizer](lr, betas=betas, eps=eps,
                                           momentum=momentum,
-                                          lr_schedule=lr_schedule)
+                                          lr_schedule=lr_schedule,
+                                          fused=fused_optimizer)
         self._mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep)
         self._ckpt_every = int(ckpt_every)
         self._endpoint = coordinator

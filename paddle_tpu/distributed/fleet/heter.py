@@ -503,9 +503,16 @@ class DeviceCachedTable:
                 sums.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
             g = jnp.asarray(sums)
         else:
-            g = jax.ops.segment_sum(jnp.asarray(grads, jnp.float32),
-                                    jnp.asarray(inverse),
-                                    num_segments=nseg)
+            # ISSUE 13: device-resident grads merge through the Pallas
+            # tier's ``segment_sum`` kernel (registry-dispatched:
+            # xla_ref == the old jax.ops.segment_sum on CPU, the fused
+            # one-pass kernel on TPU — the device mirror of
+            # ps_core.cc's fused push)
+            from ...ops.pallas import registry as _kreg
+            g = _kreg.dispatch("segment_sum",
+                               jnp.asarray(grads, jnp.float32),
+                               jnp.asarray(inverse),
+                               num_segments=nseg)
         sl = jnp.asarray(self._pad_slots(np.asarray(slots, np.int64)))
         if self._opt == "adagrad":
             self._acc = self._acc.at[sl].add(g * g)

@@ -4,5 +4,12 @@ The reference's analog is the hand-written CUDA fused op library
 (reference: paddle/fluid/operators/fused/, operators/jit/ runtime x86
 codegen). Here the compiler (XLA) covers most fusion; these kernels cover
 what it can't: blockwise attention and other manually-tiled patterns.
+
+ISSUE 13 grew this into a real kernel tier: ``ops/pallas/`` holds the
+registry (per-kernel ``pallas | xla_ref | interpret`` selection with
+an always-on XLA-reference parity oracle) and the fused
+optimizer-apply / int8 dequant-matmul / int8-KV dequant-attention /
+segment-sum kernels next to flash attention.
 """
 from .flash_attention import flash_attention, flash_attention_bhsd  # noqa: F401
+from . import pallas  # noqa: F401

@@ -1,0 +1,130 @@
+"""Fused int8 dequant-matmul for serving (ISSUE 13 kernel 2).
+
+BENCH_r04 measured ``int8_speedup`` at only 1.22-1.33x because the
+XLA graph runs dequantization and the matmul as separate passes: the
+weight-only path materializes a full f32/bf16 copy of the int8 weight
+tensor before the matmul ever sees it.  This kernel dequantizes INSIDE
+the matmul tile: int8 weight tiles stream HBM->VMEM (1 byte/element —
+the whole point of int8 storage), are scaled in-register, and feed the
+MXU directly.  No dequantized weight tensor ever exists in HBM.
+
+Two modes, matching :class:`paddle_tpu.quantization.Int8InferenceLinear`:
+
+- **dynamic** (``x_scale`` given): activations arrive already
+  quantized (int8) with their per-call scale; the kernel runs a native
+  int8 x int8 -> int32 MXU matmul and applies the combined
+  ``x_scale * w_scale`` rescale to the int32 accumulator.  Integer
+  accumulation is associativity-free, so this path is BIT-EXACT vs the
+  XLA reference — the parity test asserts ``np.array_equal``.
+- **weight-only** (``x_scale=None``): float activations; the int8
+  weight tile is dequantized to the compute dtype in VMEM and the dot
+  accumulates in f32.  Reduction blocking differs from XLA's matmul,
+  so parity carries a documented tolerance (rtol 2e-2 for bf16
+  compute, 1e-5 for f32).
+
+The conv path (``Int8InferenceConv2D``) feeds this same kernel with
+``conv_general_dilated_patches`` rows — patch extraction is an exact
+int-preserving data movement, so the fused conv inherits the dynamic
+path's bit-exactness vs the reference int8 conv.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import registry
+
+__all__ = ["int8_matmul_ref", "int8_matmul_pallas"]
+
+_TM, _TN = 128, 128
+_K_ALIGN = 128
+# whole-K tiles live in VMEM: (TM + TN) * K bytes at int8 — keep the
+# compiled kernel's working set under ~8 MiB of the 16 MiB VMEM
+_MAX_K = 16384
+
+
+def int8_matmul_ref(x, qw, w_scale, x_scale=None, compute_dtype=None):
+    """XLA reference — the exact expressions the quantization layers
+    ran before this kernel existed (fallback + parity oracle)."""
+    cdt = compute_dtype or jnp.bfloat16
+    if x_scale is None:
+        w = qw.astype(cdt) * w_scale.astype(cdt)[None, :]
+        return x.astype(cdt) @ w
+    acc = jax.lax.dot_general(
+        x, qw, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (x_scale * w_scale)).astype(cdt)
+
+
+def _int8_matmul_kernel(dyn, cdt, x_ref, w_ref, s_ref, o_ref):
+    if dyn:
+        acc = jnp.dot(x_ref[...], w_ref[...],
+                      preferred_element_type=jnp.int32)
+        o_ref[...] = (acc.astype(jnp.float32)
+                      * s_ref[0, :][None, :]).astype(cdt)
+    else:
+        w = w_ref[...].astype(cdt) * s_ref[0, :].astype(cdt)[None, :]
+        o_ref[...] = jnp.dot(x_ref[...].astype(cdt), w,
+                             preferred_element_type=jnp.float32
+                             ).astype(cdt)
+
+
+def int8_matmul_pallas(x, qw, w_scale, x_scale=None, compute_dtype=None,
+                       *, interpret=False):
+    """Tiled fused dequant-matmul.  ``x`` may carry leading batch dims
+    (collapsed to rows); M/N/K are zero-padded to tile multiples —
+    zero rows/columns contribute exact zeros and are sliced off."""
+    cdt = compute_dtype or jnp.bfloat16
+    dyn = x_scale is not None
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    n_out = qw.shape[1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+
+    mp = -(-m // _TM) * _TM
+    np_ = -(-n_out // _TN) * _TN
+    kp = -(-k // _K_ALIGN) * _K_ALIGN
+    x2 = jnp.pad(x2, ((0, mp - m), (0, kp - k)))
+    qwp = jnp.pad(qw, ((0, kp - k), (0, np_ - n_out)))
+    if dyn:
+        # fold the activation scale in once: [1, N] combined rescale
+        scale = (x_scale * w_scale).reshape(1, -1)
+    else:
+        scale = w_scale.reshape(1, -1)
+    scale = jnp.pad(scale.astype(jnp.float32), ((0, 0), (0, np_ - n_out)))
+
+    out = pl.pallas_call(
+        functools.partial(_int8_matmul_kernel, dyn, cdt),
+        grid=(mp // _TM, np_ // _TN),
+        in_specs=[
+            pl.BlockSpec((_TM, kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((kp, _TN), lambda i, j: (0, j)),
+            pl.BlockSpec((1, _TN), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((_TM, _TN), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), cdt),
+        interpret=interpret,
+    )(x2, qwp, scale)
+    return out[:m, :n_out].reshape(*lead, n_out)
+
+
+def _eligible(x, qw, w_scale, x_scale=None, compute_dtype=None):
+    # compiled-mode gate only (interpret mode has no tile constraints):
+    # whole-K tiles must fit VMEM alongside the x/out tiles
+    return qw.shape[0] <= _MAX_K
+
+
+registry.register(
+    "int8_matmul", int8_matmul_pallas, int8_matmul_ref,
+    tolerance="dynamic (int8 activations): bit-exact vs xla_ref "
+              "(int32 accumulation is order-free); weight-only: "
+              "rtol 2e-2 @ bf16 compute / 1e-5 @ f32 (reduction "
+              "blocking differs from XLA's matmul)",
+    eligible=_eligible,
+    doc="int8-weight matmul with in-tile dequant: int8 tiles stream "
+        "from HBM once, no f32 weight tensor is ever materialized",
+)

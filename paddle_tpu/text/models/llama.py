@@ -331,6 +331,13 @@ class LlamaAttention(Layer):
         k = self.k_proj(hidden)
         v = self.v_proj(hidden)
         quant = "k_scale" in cache
+        # ISSUE 13: kernel mode resolved OUTSIDE the traced closure and
+        # bound into it, so any dispatch cache keys on the mode (a mode
+        # switch must never replay the other path's program)
+        kv_mode = None
+        if quant:
+            from ...ops.pallas import registry as _kreg
+            kv_mode = _kreg.resolve("int8_kv_attention")
 
         def attn_paged(qv, kv, vv, pos, wm, kpool, vpool, tbl,
                        kscale=None, vscale=None):
@@ -407,27 +414,23 @@ class LlamaAttention(Layer):
             # In verify mode the queries' own K/V were written above,
             # so slot <= pos is simultaneously the causal mask within
             # the block and the prefix mask against the cache.
-            T = tbl.shape[1] * bs
-            kg = kpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
-            vg = vpool[tbl].reshape(B, T, c.kv_heads, c.head_dim)
-            kgf = kg.astype(jnp.float32)
-            vgf = vg.astype(jnp.float32)
+            #
+            # ISSUE 13: the gather/dequant/attend math lives in
+            # ops/pallas/kv_attention.paged_attention_ref (lifted
+            # verbatim, so the non-pallas serving contracts — replay,
+            # prefix sharing, eviction — are pinned by the SAME ops);
+            # int8 pools additionally dispatch through the registry so
+            # the fused dequant-attention kernel can read the pools
+            # once on TPU (``int8_kv_attention``; xla_ref elsewhere).
+            from ...ops.pallas.kv_attention import paged_attention_ref
             if quant:
-                kgf = kgf * kscale[tbl].reshape(B, T)[:, :, None, None]
-                vgf = vgf * vscale[tbl].reshape(B, T)[:, :, None, None]
-            G = c.kv_heads
-            R = c.num_attention_heads // G
-            qg = qh.reshape(B, S, G, R, c.head_dim)
-            scale = 1.0 / (c.head_dim ** 0.5)
-            logits = jnp.einsum(
-                "bsgrd,btgd->bgrst", qg.astype(jnp.float32),
-                kgf) * scale                           # [B,G,R,S,T]
-            valid = (jnp.arange(T)[None, None, None, None, :]
-                     <= pos[:, None, None, :, None])
-            logits = jnp.where(valid, logits, -jnp.inf)
-            w = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum("bgrst,btgd->bsgrd", w,
-                           vgf).astype(qv.dtype)
+                from ...ops.pallas import registry as _kreg
+                o = _kreg.dispatch(
+                    "int8_kv_attention", qh, kpool, vpool, kscale,
+                    vscale, tbl, pos, c.kv_heads, mode=kv_mode)
+            else:
+                o = paged_attention_ref(qh, kpool, vpool, None, None,
+                                        tbl, pos, c.kv_heads)
             return ret(o)
 
         if quant:

@@ -17,6 +17,16 @@
 #        linter over the repo module set AND the jaxpr self-audit of
 #        the step programs, gated on tools/lint_baseline.json — any
 #        finding not in the baseline exits nonzero
+#
+# ISSUE 13 (Pallas kernel tier): tests/test_pallas_kernels.py is the
+# interpret-mode kernel parity suite — every ops/pallas/ kernel vs its
+# XLA reference at the documented tolerance (optimizer-apply
+# bit-exact) — and rides BOTH tier-1 passes (file order and shuffled;
+# its registry fixture clears mode overrides so order cannot leak).
+# The trace pass below additionally proves the kernel-dispatch
+# counters surface on /metrics (the suite's
+# test_dispatch_counters_on_metrics_endpoint runs with telemetry live)
+# without leaking any sink files into the repo.
 # Env:   TIER1_SHUFFLE_SEED  fix the shuffle (default: date-derived,
 #                            printed so a red run is reproducible)
 set -u -o pipefail
