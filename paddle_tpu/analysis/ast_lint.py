@@ -85,6 +85,12 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/distributed/fleet/dist_step.py",
     "paddle_tpu/io/dataloader.py",
     "paddle_tpu/train_guard.py",
+    # ISSUE 14: the online learning loop (threaded trainer/sweeper/
+    # freshness watch)
+    "paddle_tpu/online/__init__.py",
+    "paddle_tpu/online/streaming.py",
+    "paddle_tpu/online/lifecycle.py",
+    "paddle_tpu/online/freshness.py",
     # ISSUE 13: the Pallas kernel tier (registry locking + kernels)
     "paddle_tpu/ops/pallas/__init__.py",
     "paddle_tpu/ops/pallas/registry.py",

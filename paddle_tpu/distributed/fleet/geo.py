@@ -31,6 +31,31 @@ both sides materialise the identical deterministic init for that id.
 The native table core guarantees per-id deterministic init; the pure
 Python fallback only does for ``init_std=0`` (the constructor checks).
 
+Bidirectional mode (ISSUE 14): run one :class:`GeoPusher` on EACH
+cluster and the pair converges under concurrent writes — no flag
+needed, the machinery is symmetric.  Two things make it sound:
+
+* **echo suppression** — commits whose ``src`` carries the geo prefix
+  (a peer pusher's replicated write) are never marked dirty, so a
+  delta can't bounce between clusters forever;
+* **conflict policy, per table** (``SparseTable(geo_policy=...)``):
+
+  - ``"add"`` (default) — op-based additive merge: each side ships
+    exactly its LOCAL writes; a peer delta applied locally also
+    advances the mirror (buffered by the commit listener, drained
+    atomically with the row read under the primary's apply lock, so
+    the ``cur - mirror`` delta is always exactly the unshipped local
+    writes — neither echoing a peer delta back nor missing one).
+    Fixed point: both sides hold base + all local writes + all peer
+    writes, each applied exactly once (bit-equal across sites for
+    order-insensitive payloads, e.g. integer-valued f32);
+  - ``"lww"`` — last-writer-wins per ``(lamport seq, site)`` stamp:
+    local writes mint stamps on the server (the stamp directory
+    replicates to standbys), the pusher ships ABSOLUTE rows via
+    ``geo_set``, and the receiver replaces a row iff the incoming
+    stamp strictly beats its stored one.  Fixed point: every site
+    holds, per id, the row of the globally maximal stamp, bit-exactly.
+
 Staleness / convergence bound: with a dirty backlog of ``B`` ids and a
 per-table rate of ``R = max_ids_per_flush`` per ``interval_s``, the
 follower trails the primary by at most ``ceil(B / R)`` flush intervals
@@ -99,6 +124,13 @@ class GeoPusher:
         self._lock = threading.Lock()
         self._dirty: Dict[str, set] = {}
         self._mirrors: Dict[str, SparseTable] = {}
+        # bidirectional mode: a peer pusher's writes arrive with this
+        # src prefix — they are never dirty (echo suppression), and on
+        # additive tables their deltas buffer here so the mirror
+        # advances in step with the local table (drained by flush()
+        # atomically with the row read)
+        self._peer_prefix = "geo-"
+        self._inbound: Dict[str, List] = {}
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._flush_lock = threading.Lock()   # flush() is not reentrant
@@ -138,12 +170,29 @@ class GeoPusher:
         return self._client
 
     # -- commit feed (runs under PSServer._apply_lock) ------------------
-    def _on_commit(self, op, table, ids):
-        if self._tables is not None and table not in self._tables:
+    def _on_commit(self, rec):
+        table = rec.get("table")
+        if table is None or (self._tables is not None
+                             and table not in self._tables):
+            return
+        op = rec.get("op")
+        if op not in ("push", "push_delta", "geo_set"):
+            return
+        src = str(rec.get("src") or "")
+        if src.startswith(self._peer_prefix):
+            # a geo peer's replicated write: NEVER dirty (echo
+            # suppression).  Additive tables buffer the delta so the
+            # mirror advances in step with the table; LWW geo_sets need
+            # nothing (the stamp directory already decided).
+            if op == "push_delta":
+                with self._lock:
+                    self._inbound.setdefault(table, []).append(
+                        (np.array(rec["ids"], np.int64).reshape(-1),
+                         np.array(rec["deltas"], np.float32)))
             return
         with self._lock:
             self._dirty.setdefault(table, set()).update(
-                np.asarray(ids).reshape(-1).tolist())
+                np.asarray(rec["ids"]).reshape(-1).tolist())
 
     def backlog(self) -> int:
         with self._lock:
@@ -166,28 +215,73 @@ class GeoPusher:
 
     def flush(self) -> int:
         """One flush pass: per table, ship up to the rate limit of
-        dirty ids' deltas.  Returns how many ids were pushed.  A
-        remote failure (typed, after the client's own retry budget)
-        re-queues the ids and advances nothing — the delta stays
-        derivable from the unmoved mirror."""
+        dirty ids — deltas for additive tables, stamped absolute rows
+        (``geo_set``) for LWW tables.  Returns how many ids were
+        pushed.  A remote failure (typed, after the client's own retry
+        budget) re-queues the ids and advances nothing — the delta
+        stays derivable from the unmoved mirror."""
         with self._flush_lock:
             total = 0
-            for table in sorted(self._dirty_tables()):
+            with self._lock:
+                tables = sorted(set(t for t, s in self._dirty.items()
+                                    if s)
+                                | set(t for t, b in self._inbound.items()
+                                      if b))
+            for table in tables:
+                src_t = self._server._tables[table]
+                policy = getattr(src_t, "geo_policy", "add")
                 with self._lock:
-                    d = self._dirty.get(table)
-                    if not d:
-                        continue
+                    d = self._dirty.get(table) or set()
                     take = [d.pop() for _ in range(min(len(d),
                                                        self._rate))]
                 ids = np.asarray(sorted(take), np.int64)
+                # resolve the mirror BEFORE draining inbound: a config
+                # error (non-deterministic python init) must surface
+                # with the peer-delta buffer untouched and the dirty
+                # ids re-queued
                 try:
-                    # pop-BEFORE-read: a commit landing between the pop
-                    # and the row read re-dirties the id (listener runs
-                    # after apply), so the next flush re-ships it —
-                    # values can lag one flush, never be lost
-                    cur = self._server._tables[table].pull(ids)
-                    mirror = self._mirror(table)
-                    n_pushed = self._ship(table, mirror, ids, cur)
+                    mirror = (self._mirror(table) if policy == "add"
+                              else None)
+                except PSError:
+                    self.push_failures += 1
+                    _monitor.stat_add("ps_geo_push_failures")
+                    with self._lock:
+                        self._dirty.setdefault(table, set()).update(
+                            ids.tolist())
+                    raise
+                # pop-BEFORE-read: a commit landing between the pop and
+                # the row read re-dirties the id (listener runs after
+                # apply), so the next flush re-ships it — values can
+                # lag one flush, never be lost.
+                # The row read, the LWW stamp read, and the inbound
+                # drain happen UNDER THE APPLY LOCK: no commit can
+                # interleave, so every buffered peer delta's effect is
+                # in ``cur`` and ``cur`` holds no unbuffered one —
+                # without this a racing peer delta would be echoed back
+                # (double-apply) or subtracted out (loss).
+                stamps = None
+                with self._server._apply_lock:
+                    cur = (src_t.pull(ids) if ids.size else
+                           np.zeros((0, src_t.dim), np.float32))
+                    if policy == "lww":
+                        st = self._server._geo_stamps.get(table, {})
+                        stamps = [st.get(int(k),
+                                         (0, self._server.geo_site))
+                                  for k in ids.tolist()]
+                    with self._lock:
+                        inbound = self._inbound.pop(table, [])
+                try:
+                    if policy == "lww":
+                        n_pushed = self._ship_lww(table, ids, cur,
+                                                  stamps)
+                    else:
+                        # peer deltas already committed locally advance
+                        # the mirror in commit order, preserving the
+                        # invariant cur - mirror == unshipped LOCAL
+                        # writes
+                        for i_ids, i_deltas in inbound:
+                            mirror.push_delta(i_ids, i_deltas)
+                        n_pushed = self._ship(table, mirror, ids, cur)
                 except (PSError, PSUnavailable):
                     # remote outage / config error: re-queue, never
                     # drop — the mirror did not advance past anything
@@ -205,11 +299,24 @@ class GeoPusher:
                     _monitor.stat_add("ps_geo_flushes")
                     _monitor.stat_add("ps_geo_pushed_ids", n_pushed)
                     _flight.record("ps.geo.push", table=table,
-                                   n=int(n_pushed),
+                                   n=int(n_pushed), policy=policy,
                                    backlog=self.backlog())
             if _monitor.metrics_enabled():
                 _monitor.gauge_set("ps_geo_backlog_ids", self.backlog())
             return total
+
+    def _ship_lww(self, table: str, ids: np.ndarray, cur: np.ndarray,
+                  stamps) -> int:
+        """Ship ABSOLUTE rows with their conflict stamps: the receiver
+        replaces a row iff the stamp strictly beats its stored one, so
+        concurrent writers converge to the globally maximal stamp's
+        bits — no mirror, no residual pass."""
+        if ids.size == 0:
+            return 0
+        seqs = np.asarray([s[0] for s in stamps], np.int64)
+        sites = [s[1] for s in stamps]
+        self._ensure_client().geo_set(table, ids, cur, seqs, sites)
+        return int(ids.size)
 
     def _ship(self, table: str, mirror: SparseTable, ids: np.ndarray,
               cur: np.ndarray) -> int:

@@ -62,10 +62,20 @@ class SparseTable:
                  backend: str = "auto", n_shards: int = 32,
                  beta1: float = 0.9, beta2: float = 0.999,
                  epsilon: float = 1e-10, entry=None,
-                 use_native: Optional[bool] = None):
+                 use_native: Optional[bool] = None,
+                 geo_policy: str = "add"):
         self.dim = dim
         self._seed = int(seed)
         self._init_std = float(init_std)
+        # geo conflict policy (ISSUE 14): how concurrent writes from two
+        # geo-bridged clusters resolve on THIS table — "add" merges
+        # deltas additively per slot, "lww" resolves whole rows to the
+        # last writer per (lamport seq, site) stamp (PSServer keeps the
+        # stamp directory; the table only declares the policy)
+        if geo_policy not in ("add", "lww"):
+            raise ValueError(f"geo_policy must be 'add' or 'lww', "
+                             f"got {geo_policy!r}")
+        self.geo_policy = geo_policy
         # feature admission (reference entry_attr.py): ids the entry has
         # not admitted pull zeros and drop their grads — no row memory
         self._entry = entry
@@ -108,6 +118,12 @@ class SparseTable:
         self._moments: Dict[int, np.ndarray] = {}
         self._moments2: Dict[int, np.ndarray] = {}
         self._steps: Dict[int, int] = {}
+        # feature lifecycle (python mirror of the native clock/touched/
+        # churn state — ISSUE 14)
+        self._clock = 0
+        self._touched: Dict[int, int] = {}
+        self._py_admitted_total = 0
+        self._py_evicted_total = 0
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._rng = np.random.default_rng(seed)
         self._init = initializer or (
@@ -162,6 +178,7 @@ class SparseTable:
                     continue
                 if counting:
                     self._seen[k] = self._seen.get(k, 0) + 1
+                    self._touched[k] = self._clock
                 if self._entry.admit(k, self._seen.get(k, 0)):
                     self._admitted.add(k)
                     self._seen.pop(k, None)
@@ -207,6 +224,8 @@ class SparseTable:
                 row = self._rows.get(k)
                 if row is None:
                     row = self._rows[k] = self._init()
+                    self._py_admitted_total += 1
+                self._touched[k] = self._clock
                 out[i] = row
         return out
 
@@ -246,6 +265,8 @@ class SparseTable:
                 row = self._rows.get(k)
                 if row is None:
                     row = self._rows[k] = self._init()
+                    self._py_admitted_total += 1
+                self._touched[k] = self._clock
                 if self._opt == "adagrad":
                     m = self._moments.get(k)
                     if m is None:
@@ -299,6 +320,8 @@ class SparseTable:
                 row = self._rows.get(k)
                 if row is None:
                     row = self._rows[k] = self._init()
+                    self._py_admitted_total += 1
+                self._touched[k] = self._clock
                 row += d
 
     def _entry_state(self):
@@ -380,6 +403,137 @@ class SparseTable:
             return int(self._lib.pts_version(self._native))
         return self._version
 
+    # -- feature lifecycle (ISSUE 14) ----------------------------------
+    def set_clock(self, now: int):
+        """Advance the table's lifecycle clock (the TTL sweeper stamps
+        wall seconds once per tick).  Every pull/push/push_delta touch
+        of an id copies the current clock into its last-sighting stamp;
+        sightings are therefore timestamped at tick granularity."""
+        if self._native is not None:
+            self._lib.pts_set_clock(self._native, int(now))
+        else:
+            self._clock = int(now)
+
+    def touch_all(self, now: int):
+        """Grandfather pass: stamp every known id (and the clock) to
+        ``now`` — rows of unknown age (created before any lifecycle
+        sweeper ran, or restored from a checkpoint) age from here
+        instead of being evicted as tick-0 ancients."""
+        if self._native is not None:
+            self._lib.pts_touch_all(self._native, int(now))
+            return
+        with self._lock:
+            self._clock = int(now)
+            keys = (set(self._rows) | set(self._seen)
+                    | set(self._admitted))
+            self._touched = {k: int(now) for k in keys}
+
+    def ttl_sweep(self, cutoff: int) -> np.ndarray:
+        """Evict every id whose last sighting predates ``cutoff``
+        (materialised rows AND pre-admission counters — a stale feature
+        fully expires and must re-earn admission).  Surviving rows keep
+        their exact bits (values, optimizer moments, step counters).
+        Returns the evicted ids (sorted); counts as one applied
+        mutating batch iff anything was evicted."""
+        import ctypes
+        if self._native is not None:
+            cap = int(self._lib.pts_slots(self._native))
+            out = np.empty(max(cap, 1), np.int64)
+            n = int(self._lib.pts_ttl_sweep(
+                self._native, int(cutoff),
+                self._c(out, ctypes.c_int64), cap))
+            return np.sort(out[:n])
+        with self._lock:
+            keys = (set(self._rows) | set(self._seen)
+                    | set(self._admitted) | set(self._touched))
+            evict = sorted(k for k in keys
+                           if self._touched.get(k, 0) < cutoff)
+            if evict:
+                self._drop_ids_locked(evict)
+                self._version += 1
+                self._py_evicted_total += len(evict)
+        return np.asarray(evict, np.int64)
+
+    def evict_ids(self, ids) -> int:
+        """Exact-id eviction — the replica-side replay of a primary's
+        TTL sweep (the streamed ``evict`` record names the swept ids).
+        ALWAYS counts as one applied mutating batch: the primary sweep
+        that produced the record did, and version parity is the audited
+        catch-up invariant.  Returns how many ids were present."""
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        if self._native is not None:
+            return int(self._lib.pts_evict(
+                self._native, self._c(ids, ctypes.c_int64), ids.size))
+        with self._lock:
+            present = [k for k in ids.tolist()
+                       if k in self._rows or k in self._seen
+                       or k in self._admitted or k in self._touched]
+            self._drop_ids_locked(present)
+            self._version += 1
+            if present:
+                self._py_evicted_total += len(present)
+        return len(present)
+
+    def _drop_ids_locked(self, keys):
+        for k in keys:
+            self._rows.pop(k, None)
+            self._moments.pop(k, None)
+            self._moments2.pop(k, None)
+            self._steps.pop(k, None)
+            self._seen.pop(k, None)
+            self._touched.pop(k, None)
+            self._admitted.discard(k)
+        self._admitted_arr = None
+
+    def set_vals(self, ids, vals):
+        """LWW geo row replacement: overwrite the VALUE part of each
+        id's row wholesale — existing rows keep their optimizer
+        moments, fresh rows materialise with zeroed state (the incoming
+        value IS the row, no deterministic init).  Bypasses admission
+        but marks the id admitted (the origin cluster admitted it).
+        One applied mutating batch per call, empty calls included (the
+        replica replay of a geo_set record must tick version exactly
+        like the primary's apply of its winning subset)."""
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        vals = np.ascontiguousarray(
+            np.asarray(vals, np.float32).reshape(ids.size, self.dim))
+        if self._native is not None:
+            self._lib.pts_set_vals(self._native,
+                                   self._c(ids, ctypes.c_int64), ids.size,
+                                   self._c(vals, ctypes.c_float))
+            return
+        with self._lock:
+            self._version += 1
+            # geo-replicated rows do NOT count toward admitted_total
+            # (matching the native import-style materialisation): they
+            # were admitted at the origin cluster, not sighted here
+            for k, v in zip(ids.tolist(), vals):
+                self._rows[k] = v.copy()
+                self._touched[k] = self._clock
+                if self._entry is not None:
+                    self._admitted.add(k)
+            if ids.size and self._entry is not None:
+                self._admitted_arr = None
+
+    @property
+    def admitted_total(self) -> int:
+        """Features newly materialised via admission since construction
+        (imports/restores excluded) — the ``ps_feature_admitted``
+        churn-metric source."""
+        if self._native is not None:
+            return int(self._lib.pts_admitted_total(self._native))
+        return self._py_admitted_total
+
+    @property
+    def evicted_total(self) -> int:
+        """Ids removed by TTL sweeps / evict replays — the
+        ``ps_feature_evicted`` churn-metric source."""
+        if self._native is not None:
+            return int(self._lib.pts_evicted_total(self._native))
+        return self._py_evicted_total
+
     def config_arrays(self) -> dict:
         """The table's construction config as npz-storable scalars —
         rides in every snapshot so a replica (or warm start) can
@@ -392,7 +546,8 @@ class SparseTable:
                     beta2=np.float64(self._beta2),
                     eps=np.float64(self._eps),
                     init_std=np.float64(self._init_std),
-                    seed=np.int64(self._seed))
+                    seed=np.int64(self._seed),
+                    policy=np.str_(self.geo_policy))
 
     def clone_config(self) -> "SparseTable":
         """A NEW empty table with this table's exact construction
@@ -407,7 +562,8 @@ class SparseTable:
                            seed=self._seed, init_std=self._init_std,
                            beta1=self._beta1, beta2=self._beta2,
                            epsilon=self._eps,
-                           use_native=self._native is not None)
+                           use_native=self._native is not None,
+                           geo_policy=self.geo_policy)
 
     @staticmethod
     def from_config(d) -> "SparseTable":
@@ -424,6 +580,8 @@ class SparseTable:
                       epsilon=float(d["eps"]),
                       init_std=float(d["init_std"]),
                       seed=int(d["seed"]))
+            if "policy" in d:
+                kw["geo_policy"] = str(d["policy"])
         return SparseTable(dim, **kw)
 
     def _opt_state_width(self) -> int:
@@ -595,6 +753,9 @@ class SparseTable:
             self._moments.clear()
             self._moments2.clear()
             self._steps.clear()
+            # restored rows start a fresh TTL epoch (the native path
+            # stamps touched=clock at import-insert time identically)
+            self._touched = {int(i): self._clock for i in ids}
             if opt_state is not None:
                 for i, k in enumerate(ids.tolist()):
                     if self._opt in ("adagrad", "adam"):

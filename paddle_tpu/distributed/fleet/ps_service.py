@@ -181,22 +181,23 @@ class _ReplicaDown(PSError):
 
 
 # RPCs with server-side effects: they carry (src, seq) so a retry can be
-# acked without re-applying (additive pushes would double-apply)
-_MUTATING_OPS = ("push", "push_delta", "register", "barrier")
+# acked without re-applying (additive pushes would double-apply;
+# geo_set must not re-run its stamp comparisons against its own result)
+_MUTATING_OPS = ("push", "push_delta", "geo_set", "register", "barrier")
 
 # RPCs an un-promoted standby must refuse: serving pulls would return
 # rows the snapshot/stream has not caught up to, and applying writes
 # would diverge from the primary (split brain).  stats/stop/heartbeat/
 # replicate stay allowed.
-_GATED_OPS = ("pull", "push", "push_delta", "barrier", "register",
-              "unregister", "worker_barrier")
+_GATED_OPS = ("pull", "push", "push_delta", "geo_set", "barrier",
+              "register", "unregister", "worker_barrier")
 
 
 def _expects_reply(msg) -> bool:
     """Whether the protocol answers this request frame.  An error reply
     to a one-way frame would desynchronise the request/reply stream."""
     op = msg.get("op")
-    if op in ("push", "push_delta"):
+    if op in ("push", "push_delta", "geo_set"):
         return bool(msg.get("sync"))
     return op in ("pull", "barrier", "register", "unregister",
                   "worker_barrier", "stats", "stop")
@@ -608,7 +609,8 @@ class PSServer:
                  wm_interval_s: float = 0.25,
                  sink_queue: int = 8192,
                  read_coalesce_ms: float = 0.0,
-                 read_coalesce_batch: int = 64):
+                 read_coalesce_batch: int = 64,
+                 geo_site: Optional[str] = None):
         if on_dead not in ("evict", "fail"):
             raise ValueError(f"on_dead must be 'evict' or 'fail', "
                              f"got {on_dead!r}")
@@ -669,6 +671,30 @@ class PSServer:
         self._head = 0
         self._stream_live = False
         self._last_stream = 0.0
+        # TIME-based lag (ISSUE 14 satellite): every stream frame (wm
+        # heartbeats included) carries the primary's wall clock ``ts``;
+        # _head_time = newest primary clock heard, _wm_time = primary
+        # clock of the last APPLIED record (or of a heartbeat heard
+        # while fully caught up) — their difference is
+        # ``ps_replica_lag_seconds``, the freshness SLO's gauge.
+        self._head_time = 0.0
+        self._wm_time = 0.0
+        # ingest watermark (ISSUE 14): highest event-ingest timestamp
+        # applied here — pushes stamped with ``iwm`` feed the
+        # event-ingested -> servable freshness histogram on replicas
+        self._ingest_wm = 0.0
+        # geo conflict-policy state (ISSUE 14): per-(table, id) LWW
+        # stamps ``(lamport seq, site)`` for tables declaring
+        # geo_policy="lww"; local writes mint fresh stamps, incoming
+        # geo_set records compare against them.  Replicated: forwarded
+        # records carry their stamp (``gst``) and the attach snapshot
+        # header carries the whole directory, so a promoted standby
+        # keeps deciding conflicts exactly like the dead primary.
+        self.geo_site = geo_site or f"site-{os.getpid()}-{self.port}"
+        self._geo_clock = 0
+        self._geo_stamps: Dict[str, Dict[int, Tuple[int, str]]] = {}
+        # admitted-churn publication cursor (PSServer.ttl_sweep)
+        self._admitted_published: Dict[str, int] = {}
         # commit listeners (geo tier): fn(op, table, ids) called under
         # the apply lock after each committed mutation — keep them FAST
         self._commit_listeners: List = []
@@ -801,7 +827,7 @@ class PSServer:
                             # aggregator's straggler detection rates
                             # this across primary + replicas (ISSUE 12)
                             _monitor.stat_add("ps_server_pulls")
-                    elif op in ("push", "push_delta"):
+                    elif op in ("push", "push_delta", "geo_set"):
                         applied = self._apply_mutation(msg)
                         if msg.get("sync"):
                             _send_msg(conn, {"ok": True,
@@ -917,11 +943,53 @@ class PSServer:
                     _monitor.stat_add("ps_server_dup_acks")
                     return False
             t = self._table(msg["table"])
-            if msg["op"] == "push":
+            op = msg["op"]
+            if op == "push":
                 t.push(msg["ids"], msg["grads"])
-            else:
+            elif op == "push_delta":
                 t.push_delta(msg["ids"], msg["deltas"])
+            elif op == "evict":
+                # replica-side replay of a primary TTL sweep (only ever
+                # arrives on the replication stream)
+                t.evict_ids(msg["ids"])
+            else:  # geo_set: LWW conflict resolution, winning subset
+                msg = self._apply_geo_set(t, msg)
+            # LWW stamp minting: every LOCAL write to an lww table
+            # stamps its ids (lamport clock, this site); a replica
+            # applying the forwarded record reuses the primary's stamp
+            # (``gst``) so both sides' stamp directories stay identical
+            if op in ("push", "push_delta") \
+                    and getattr(t, "geo_policy", "add") == "lww":
+                g = msg.get("gst")
+                if g is not None:
+                    gst = (int(g[0]), str(g[1]))
+                else:
+                    self._geo_clock += 1
+                    gst = (self._geo_clock, self.geo_site)
+                    msg["gst"] = [gst[0], gst[1]]
+                if gst[0] > self._geo_clock:
+                    self._geo_clock = gst[0]
+                st = self._geo_stamps.setdefault(msg["table"], {})
+                for k in np.asarray(msg["ids"]).reshape(-1).tolist():
+                    st[int(k)] = gst
             self.applied += 1
+            # ingest watermark (ISSUE 14): a push stamped with the
+            # event's ingest time makes end-to-end freshness measurable
+            # — a replica applying it observes event-ingested ->
+            # servable-at-THIS-replica latency off the real data path
+            iwm = msg.get("iwm")
+            if iwm is not None:
+                iwm = float(iwm)
+                if iwm > self._ingest_wm:
+                    self._ingest_wm = iwm
+                if _monitor.metrics_enabled():
+                    lat_ms = max((time.time() - iwm) * 1e3, 0.0)
+                    if self.role == "replica" and not self.promoted:
+                        _monitor.hist_observe("ps_freshness_ms", lat_ms)
+                    else:
+                        _monitor.hist_observe("ps_ingest_apply_ms",
+                                              lat_ms)
+                    _monitor.gauge_set("ps_ingest_wm", self._ingest_wm)
             if _monitor.metrics_enabled():
                 # per-mutation gauge: a scrape of primary + replica
                 # reads replica lag as the difference of the two
@@ -929,25 +997,65 @@ class PSServer:
             # ring event doubles as server-side progress: a primary
             # that stops applying trips ITS watchdog too, not only the
             # wedged client's
-            _flight.record("ps.apply", op=msg["op"],
+            _flight.record("ps.apply", op=op,
                            table=msg.get("table"), src=src, seq=seq,
                            applied=self.applied)
             for fn in self._commit_listeners:
                 # geo tier hook: runs under the apply lock — listeners
                 # must only buffer (a failing listener must not fail or
-                # slow the commit)
+                # slow the commit).  Listeners receive the WHOLE record
+                # (op/table/ids/payload/src) so a bidirectional geo
+                # pusher can tell a peer's delta from a local write.
                 try:
-                    fn(msg["op"], msg.get("table"), msg["ids"])
+                    fn(msg)
                 except Exception:
                     pass
             if self._replicas:
                 self._forward(msg)
         return True
 
+    def _apply_geo_set(self, t, msg) -> dict:
+        """Resolve one LWW geo_set record: ids whose incoming stamp
+        ``(seq, site)`` is strictly greater than the stored stamp WIN —
+        their rows are replaced wholesale and their stamps advance; the
+        rest are skipped (the local write is newer).  Returns the
+        record filtered to the winning subset — that is what gets
+        forwarded to replicas (they apply it blindly, so a replica
+        never needs to re-decide a conflict it did not see the loser
+        of) and what commit listeners observe."""
+        ids = np.asarray(msg["ids"]).reshape(-1).astype(np.int64)
+        # explicit dims: reshape(0, -1) cannot infer on empty payloads
+        vals = np.asarray(msg["vals"], np.float32).reshape(
+            ids.size, int(t.dim))
+        seqs = np.asarray(msg["seqs"]).reshape(-1).astype(np.int64)
+        sites = [str(s) for s in (msg.get("sites") or [])]
+        st = self._geo_stamps.setdefault(msg["table"], {})
+        win = []
+        for i, k in enumerate(ids.tolist()):
+            stamp = (int(seqs[i]), sites[i])
+            if stamp[0] > self._geo_clock:
+                self._geo_clock = stamp[0]
+            if stamp > st.get(k, (-1, "")):
+                st[k] = stamp
+                win.append(i)
+        wi = np.asarray(win, np.int64)
+        out = dict(msg)
+        out["ids"] = np.ascontiguousarray(ids[wi])
+        out["vals"] = np.ascontiguousarray(vals[wi]) if wi.size \
+            else np.zeros((0, vals.shape[1]), np.float32)
+        out["seqs"] = np.ascontiguousarray(seqs[wi])
+        out["sites"] = [sites[i] for i in win]
+        # applied even when empty: version must tick identically on the
+        # replica replaying this record
+        t.set_vals(out["ids"], out["vals"])
+        return out
+
     def add_commit_listener(self, fn):
-        """Subscribe ``fn(op, table, ids)`` to every committed mutation
-        (called under the apply lock — buffer, don't block; the geo
-        delta pusher's dirty-id feed)."""
+        """Subscribe ``fn(record)`` to every committed mutation (called
+        under the apply lock — buffer, don't block; the geo delta
+        pusher's dirty-id feed).  ``record`` is the full mutation dict
+        (op/table/ids/payload/src/seq) so a bidirectional geo pusher
+        can distinguish a peer's replicated write from a local one."""
         with self._apply_lock:
             self._commit_listeners.append(fn)
 
@@ -967,8 +1075,18 @@ class PSServer:
         (this server's applied count) the replicas' staleness bound is
         measured in."""
         rec = {k: msg[k] for k in ("op", "table", "ids", "grads",
-                                   "deltas", "src", "seq") if k in msg}
+                                   "deltas", "vals", "seqs", "sites",
+                                   "gst", "iwm", "src", "seq")
+               if k in msg}
         rec["cs"] = self.applied
+        # primary commit wall clock ``ts`` + head clock ``hts``: the
+        # replica's TIME-based lag gauge differences the newest head
+        # clock HEARD against the commit clock of the last record
+        # APPLIED.  They coincide here; the read-sink sender refreshes
+        # ``hts`` at send time (mirroring ``head``) so a replica
+        # draining a backlog of old records still learns how far the
+        # primary's clock has moved.
+        rec["ts"] = rec["hts"] = time.time()
         # the forward span is a child of the server's apply span (tls),
         # and its context rides the record so the REPLICA's apply span
         # parents here — client -> primary -> replica is one chain in
@@ -1022,12 +1140,18 @@ class PSServer:
             blobs = [(n, self._tables[n].state_bytes()) for n in names]
             seqs = {s: w.export() for s, w in self._seqs.items()}
             head = self.applied
+            geo = None
+            if self._geo_stamps or self._geo_clock:
+                geo = {"clock": self._geo_clock,
+                       "stamps": {n: [[k, s[0], s[1]]
+                                      for k, s in d.items()]
+                                  for n, d in self._geo_stamps.items()}}
             rep["lock"].acquire()
             self._replicas.append(rep)
         try:
             conn.settimeout(30.0)
             _send_msg_raw(conn, {"op": "snapshot", "tables": names,
-                                 "seqs": seqs, "head": head,
+                                 "seqs": seqs, "head": head, "geo": geo,
                                  "srv_us": time.time_ns() // 1000,
                                  "srv_sink": _trace.sink_id()})
             for n, b in blobs:
@@ -1072,13 +1196,25 @@ class PSServer:
         it is.  Frames go through the chaos-aware ``_send_msg`` so a
         delayed/lossy replica link is injectable."""
         conn, q = rep["conn"], rep["q"]
+        last_wm = 0.0
         try:
             while not self._stop.is_set():
+                # wm heartbeats flow on cadence even while the record
+                # queue is BUSY: they are how a backlogged replica
+                # learns the primary's current head/clock (its lag)
+                # without waiting to drain — the pump consumes them
+                # out of band of the apply queue
+                now = time.monotonic()
+                if now - last_wm >= self._wm_interval:
+                    _send_msg(conn, {"op": "wm", "head": self.applied,
+                                     "hts": time.time()})
+                    last_wm = now
                 try:
                     rec = q.get(timeout=self._wm_interval)
                 except queue.Empty:
-                    rec = {"op": "wm"}
+                    continue
                 rec["head"] = self.applied
+                rec["hts"] = time.time()
                 _send_msg(conn, rec)
         except (OSError, ConnectionError):
             pass
@@ -1110,11 +1246,30 @@ class PSServer:
                 with rep["lock"]:
                     try:
                         _send_msg_raw(rep["conn"],
-                                      {"op": "wm", "head": self.applied})
+                                      {"op": "wm", "head": self.applied,
+                                       "hts": time.time()})
                     except (OSError, ConnectionError):
                         dead.append(rep)
             for rep in dead:
                 self._detach_sink(rep)
+
+    def _lag_gauges(self, mx: bool):
+        """Publish both replica-lag gauges (seq- and time-based) —
+        called on every stream frame.  ``ps_replica_lag_seconds`` is
+        the freshness SLO's input: how far behind the primary's wall
+        clock this replica's applied state is."""
+        if not mx:
+            return
+        _monitor.gauge_set("ps_replica_lag_seq",
+                           max(0, self._head - self._watermark))
+        _monitor.gauge_set("ps_replica_lag_seconds",
+                           max(0.0, self._head_time - self._wm_time))
+
+    def lag_seconds(self) -> float:
+        """Current time-based replica lag (0.0 on a primary)."""
+        if self.role != "replica" or self.promoted:
+            return 0.0
+        return max(0.0, self._head_time - self._wm_time)
 
     def _read_lag(self) -> Tuple[int, bool]:
         """(seq lag, fresh?) for the bounded-read gate.  A primary (or
@@ -1204,8 +1359,21 @@ class PSServer:
             with self._apply_lock:
                 self._seqs = {s: _SeqWindow.from_export(x)
                               for s, x in head.get("seqs", {}).items()}
+                g = head.get("geo")
+                if g:
+                    # LWW stamp directory: a standby that later promotes
+                    # must decide conflicts exactly like the primary did
+                    self._geo_clock = max(self._geo_clock,
+                                          int(g.get("clock", 0)))
+                    self._geo_stamps = {
+                        n: {int(k): (int(a), str(b)) for k, a, b in rows}
+                        for n, rows in g.get("stamps", {}).items()}
             self._watermark = self._head = int(head.get("head", 0))
             self._last_stream = time.monotonic()
+            # snapshot == caught up as of the primary's clock in the
+            # handshake; the time-lag gauge starts at zero from here
+            self._head_time = self._wm_time = \
+                head.get("srv_us", time.time_ns() // 1000) / 1e6
             self._stream_live = True
             _send_msg_raw(sock, {"ok": True})
             caught_up = True
@@ -1214,22 +1382,35 @@ class PSServer:
                            mode=self.replica_mode, head=self._head)
             sock.settimeout(None)
             mx = _monitor.metrics_enabled()
+            if read_mode:
+                # transport PUMP (ISSUE 14): a read replica receives
+                # stream frames EAGERLY on a dedicated thread while
+                # this thread applies them in order.  Without the
+                # split, head/freshness information is stuck in the
+                # TCP stream BEHIND the unapplied records, so a
+                # replica slow at APPLYING could never see (or refuse
+                # on) more than one frame of its own lag — the lag
+                # gauges and the bounded-read gate would both
+                # under-report the true backlog.
+                inq: "queue.Queue" = queue.Queue()
+                pump = threading.Thread(target=self._stream_pump,
+                                        args=(sock, inq, mx),
+                                        daemon=True)
+                pump.start()
+                self._threads.append(pump)
             while not self._stop.is_set():
-                rec = _recv_msg(sock)
-                if rec is None:
-                    break   # primary is gone
-                self._last_stream = time.monotonic()
-                if "head" in rec:
-                    h = int(rec["head"])
-                    if h > self._head:
-                        self._head = h
-                if rec.get("op") == "wm":
-                    # heartbeat: freshness + head only, never acked
-                    if mx:
-                        _monitor.gauge_set(
-                            "ps_replica_lag_seq",
-                            max(0, self._head - self._watermark))
-                    continue
+                if read_mode:
+                    rec = inq.get()
+                    if rec is None:
+                        break   # pump hit EOF: primary is gone
+                else:
+                    rec = _recv_msg(sock)
+                    if rec is None:
+                        break   # primary is gone
+                    self._note_stream_frame(rec, mx)
+                    if rec.get("op") == "wm":
+                        continue
+                ts = rec.get("ts")
                 tctx = rec.pop(_TRACE_KEY, None)
                 rep_sp = (_trace.server_span("ps.replica.apply", tctx,
                                              table=rec.get("table"))
@@ -1255,16 +1436,21 @@ class PSServer:
                 finally:
                     if rep_sp is not None:
                         rep_sp.__exit__(None, None, None)
+                if read_mode:
+                    # the record's head/clock stamps land together
+                    # with its apply (see _stream_pump)
+                    self._note_stream_frame(rec, False)
                 if "cs" in rec:
                     cs = int(rec["cs"])
                     if cs > self._watermark:
                         self._watermark = cs
                     if cs > self._head:
                         self._head = cs
-                if mx:
-                    _monitor.gauge_set(
-                        "ps_replica_lag_seq",
-                        max(0, self._head - self._watermark))
+                if ts is not None and float(ts) > self._wm_time:
+                    # the record is applied: this replica is now as
+                    # fresh as the primary's clock at ITS commit
+                    self._wm_time = float(ts)
+                self._lag_gauges(mx)
                 if not read_mode:
                     _send_msg_raw(sock, {"ok": True})
         except (OSError, ConnectionError):
@@ -1275,6 +1461,54 @@ class PSServer:
             # writes this replica cannot see yet
             self._stream_live = False
         return caught_up
+
+    def _note_stream_frame(self, rec, mx: bool):
+        """Per-frame bookkeeping at RECEIVE time: freshness clock,
+        head (seq + time), caught-up watermark-time advance on
+        heartbeats, and the lag gauges.  Called by the replica loop
+        (sync sinks) or the transport pump (read sinks)."""
+        self._last_stream = time.monotonic()
+        if "head" in rec:
+            h = int(rec["head"])
+            if h > self._head:
+                self._head = h
+        ts = rec.get("ts")
+        hts = rec.get("hts", ts)
+        if hts is not None and float(hts) > self._head_time:
+            self._head_time = float(hts)
+        if rec.get("op") == "wm" and self._watermark >= self._head:
+            # heartbeat while fully caught up: write silence is not
+            # lag — the time-lag clock advances with the heartbeat
+            self._wm_time = self._head_time
+        self._lag_gauges(mx)
+
+    def _stream_pump(self, sock, inq, mx: bool):
+        """READ-replica transport pump (see _attach_and_stream): recv
+        frames eagerly, note head/freshness per frame, queue records
+        for the applier (wm heartbeats are consumed here).  EOF or
+        transport death makes bounded reads refuse INSTANTLY and wakes
+        the applier with the None sentinel."""
+        try:
+            while not self._stop.is_set():
+                rec = _recv_msg(sock)
+                if rec is None:
+                    break
+                if rec.get("op") == "wm":
+                    self._note_stream_frame(rec, mx)
+                    continue
+                # records advance ONLY the freshness clock here: their
+                # head/clock stamps take effect atomically WITH their
+                # apply (the applier), so the bounded-read gate never
+                # counts a record this replica has heard but not yet
+                # served — eager head knowledge comes from the wm
+                # heartbeats the sender interleaves even mid-backlog
+                self._last_stream = time.monotonic()
+                inq.put(rec)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            self._stream_live = False
+            inq.put(None)
 
     def _load_snapshot_table(self, name: str, blob: bytes):
         t = self._tables.get(name)
@@ -1308,6 +1542,49 @@ class PSServer:
             return [int(s) for s in seqs
                     if s > floor and s not in w.seen]
 
+    # -- feature lifecycle (ISSUE 14) -----------------------------------
+    def ttl_sweep(self, cutoff: int, now: Optional[int] = None,
+                  tables=None) -> Dict[str, int]:
+        """One TTL pass: advance every table's lifecycle clock to
+        ``now`` (wall seconds by default), evict ids whose last
+        sighting predates ``cutoff`` ATOMICALLY with the mutation
+        stream (under the apply lock), and forward each table's evicted
+        ids as an ``evict`` record so replicas drop the exact same
+        rows.  Publishes the ``ps_feature_admitted`` /
+        ``ps_feature_evicted`` churn counters.  Returns
+        ``{table: evicted_count}``.  ``cutoff``/``now`` are wall
+        SECONDS (table ticks are milliseconds internally).  The sweep
+        driver is :class:`paddle_tpu.online.FeatureLifecycle`."""
+        now = time.time() if now is None else float(now)
+        out: Dict[str, int] = {}
+        names = sorted(tables) if tables is not None \
+            else sorted(self._tables)
+        for name in names:
+            t = self._tables.get(name)
+            if t is None or not hasattr(t, "ttl_sweep"):
+                continue
+            t.set_clock(int(now * 1000.0))
+            with self._apply_lock:
+                ev = t.ttl_sweep(int(float(cutoff) * 1000.0))
+                n = int(ev.size)
+                if n:
+                    self.applied += 1
+                    if self._replicas:
+                        self._forward({"op": "evict", "table": name,
+                                       "ids": np.ascontiguousarray(
+                                           ev, np.int64)})
+            if n:
+                _monitor.stat_add("ps_feature_evicted", n)
+            adm = int(getattr(t, "admitted_total", 0))
+            delta = adm - self._admitted_published.get(name, 0)
+            if delta > 0:
+                _monitor.stat_add("ps_feature_admitted", delta)
+            self._admitted_published[name] = adm
+            _flight.record("ps.ttl_sweep", table=name, evicted=n,
+                           cutoff=float(cutoff), rows=len(t))
+            out[name] = n
+        return out
+
     def promote(self):
         """Become the primary (the standby's stream ended)."""
         _flight.record("ps.promote", was_replica_of=self.replica_of,
@@ -1329,6 +1606,8 @@ class PSServer:
                     "head": int(self._head),
                     "read_lag": int(lag),
                     "read_fresh": bool(fresh),
+                    "lag_seconds": self.lag_seconds(),
+                    "ingest_wm": float(self._ingest_wm),
                     "versions": {n: t.version
                                  for n, t in self._tables.items()
                                  if hasattr(t, "version")}}
@@ -1908,9 +2187,12 @@ class PSClient:
             return
         self._push_now(table, ids, grads, sync=True)
 
-    def push_delta(self, table: str, ids, deltas, sync: bool = True):
+    def push_delta(self, table: str, ids, deltas, sync: bool = True,
+                   wm: Optional[float] = None):
         """Raw additive push (server-side push_delta), sharded like
-        pull — the primitive UtilBase's collectives build on."""
+        pull — the primitive UtilBase's collectives build on.  ``wm``
+        stamps the payload with its event-ingest time (``iwm``) so
+        replicas can measure end-to-end freshness."""
         if self._mode == "read":
             raise PSError("read-mode PSClient is pull-only")
         ids = np.asarray(ids).reshape(-1)
@@ -1920,19 +2202,96 @@ class PSClient:
             # table's true trailing dim
             return
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+
+        def _msg(i, d):
+            m = {"op": "push_delta", "table": table, "ids": i,
+                 "deltas": d, "sync": sync}
+            if wm is not None:
+                m["iwm"] = float(wm)
+            return m
+
         if len(self._socks) == 1:
-            self._rpc(0, {"op": "push_delta", "table": table,
-                          "ids": ids, "deltas": deltas, "sync": sync},
-                      reply=sync)
+            self._rpc(0, _msg(ids, deltas), reply=sync)
             return
         shard = self._shard(ids)
         for r in range(len(self._socks)):
             m = shard == r
             if not m.any():
                 continue
-            self._rpc(r, {"op": "push_delta", "table": table,
-                          "ids": ids[m], "deltas": deltas[m],
-                          "sync": sync}, reply=sync)
+            self._rpc(r, _msg(ids[m], deltas[m]), reply=sync)
+
+    def push_stamped(self, table: str, ids, grads, seq: int,
+                     src: Optional[str] = None,
+                     wm: Optional[float] = None) -> bool:
+        """Sync push carrying an EXPLICIT ``(src, seq)`` idempotency
+        stamp instead of the client's internal counter.  A caller whose
+        seq is a pure function of its input cursor (the streaming
+        trainer: seq == event-batch index) gets exactly-once semantics
+        ACROSS PROCESS RESTARTS: a replayed batch re-sends the same
+        stamp and the server acks it as a duplicate without
+        re-applying.  ``wm`` stamps the event-ingest watermark
+        (``iwm``) through to the mutation stream.  Returns True when
+        at least one shard actually applied (False == full replay)."""
+        if self._mode == "read":
+            raise PSError("read-mode PSClient is pull-only")
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        src = src or self._src
+
+        def _msg(i, g):
+            m = {"op": "push", "table": table, "ids": i, "grads": g,
+                 "sync": True, "src": src, "seq": int(seq)}
+            if wm is not None:
+                m["iwm"] = float(wm)
+            return m
+
+        applied = False
+        if len(self._socks) == 1:
+            rep = self._rpc(0, _msg(ids, grads), reply=True)
+            return not (rep or {}).get("dup", False)
+        shard = self._shard(ids)
+        for r in range(len(self._socks)):
+            m = shard == r
+            if not m.any():
+                continue
+            rep = self._rpc(r, _msg(ids[m], grads[m]), reply=True)
+            applied = applied or not (rep or {}).get("dup", False)
+        return applied
+
+    def geo_set(self, table: str, ids, vals, seqs, sites):
+        """LWW geo row shipment: each id carries its origin stamp
+        ``(lamport seq, site)``; the receiving server replaces the row
+        iff the stamp beats its stored one (see
+        ``PSServer._apply_geo_set``).  Rides the normal idempotent
+        ``(src, seq)`` retry layer — a lossy geo link cannot replay a
+        conflict decision."""
+        if self._mode == "read":
+            raise PSError("read-mode PSClient is pull-only")
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        if ids.size == 0:
+            return
+        vals = np.ascontiguousarray(
+            np.asarray(vals, np.float32).reshape(ids.size, -1))
+        seqs = np.ascontiguousarray(np.asarray(seqs).reshape(-1),
+                                    np.int64)
+        sites = [str(s) for s in sites]
+        if len(self._socks) == 1:
+            self._rpc(0, {"op": "geo_set", "table": table, "ids": ids,
+                          "vals": vals, "seqs": seqs, "sites": sites,
+                          "sync": True}, reply=True)
+            return
+        shard = self._shard(ids)
+        for r in range(len(self._socks)):
+            m = shard == r
+            if not m.any():
+                continue
+            sel = np.flatnonzero(m)
+            self._rpc(r, {"op": "geo_set", "table": table,
+                          "ids": np.ascontiguousarray(ids[m]),
+                          "vals": np.ascontiguousarray(vals[m]),
+                          "seqs": np.ascontiguousarray(seqs[m]),
+                          "sites": [sites[int(i)] for i in sel],
+                          "sync": True}, reply=True)
 
     def flush_deltas(self):
         """Send accumulated geo deltas to the servers (push_delta adds

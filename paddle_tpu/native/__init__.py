@@ -117,6 +117,20 @@ def ps_core() -> Optional[ctypes.CDLL]:
     lib.pts_export_full.argtypes = [c.c_void_p, i64p, f32p, c.c_int64]
     lib.pts_import_full.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.pts_clear.argtypes = [c.c_void_p]
+    # feature lifecycle (ISSUE 14)
+    lib.pts_set_clock.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pts_touch_all.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pts_admitted_total.restype = c.c_uint64
+    lib.pts_admitted_total.argtypes = [c.c_void_p]
+    lib.pts_evicted_total.restype = c.c_uint64
+    lib.pts_evicted_total.argtypes = [c.c_void_p]
+    lib.pts_slots.restype = c.c_int64
+    lib.pts_slots.argtypes = [c.c_void_p]
+    lib.pts_ttl_sweep.restype = c.c_int64
+    lib.pts_ttl_sweep.argtypes = [c.c_void_p, c.c_uint64, i64p, c.c_int64]
+    lib.pts_evict.restype = c.c_int64
+    lib.pts_evict.argtypes = [c.c_void_p, i64p, c.c_int64]
+    lib.pts_set_vals.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.ps_segsum_inv.argtypes = [i64p, c.c_int64, c.c_int, f32p, f32p]
     lib._pts_ready = True
     return lib

@@ -39,6 +39,7 @@
 //    lock is taken once per call, not once per id.
 //
 // C ABI only (loaded via ctypes; pybind11 is not in this image).
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -79,6 +80,10 @@ struct Slot {
   int64_t row;    // arena row index; -1 = admission counter only, no row
   uint32_t seen;  // sighting count (count-filter entries, pre-admission)
   uint32_t flags;
+  // feature-lifecycle last-sighting tick (ISSUE 14): stamped from the
+  // table clock on every pull/push/push_delta that touches the id; a
+  // TTL sweep evicts slots whose tick is older than the cutoff
+  uint64_t touched;
 };
 
 struct Shard {
@@ -106,6 +111,15 @@ struct Table {
   // exposed alongside the id directory so a replica's catch-up can be
   // audited (primary and caught-up standby report the same version)
   std::atomic<uint64_t> version{0};
+  // feature-lifecycle clock (ISSUE 14): a caller-advanced logical tick
+  // (the sweeper stamps wall seconds); touches copy it into the slot.
+  // Sightings are therefore timestamped at sweep-interval granularity.
+  std::atomic<uint64_t> clock{0};
+  // churn counters: rows newly materialised via admission (imports
+  // excluded) / slots removed by sweeps — the ps_feature_admitted /
+  // ps_feature_evicted metric sources
+  std::atomic<uint64_t> admitted_total{0};
+  std::atomic<uint64_t> evicted_total{0};
   std::vector<Shard> shards;
 
   Table(int dim_, int opt_, float lr_, float b1, float b2, float eps_,
@@ -144,7 +158,7 @@ struct Table {
     size_t ncap = s.slots.empty() ? 1024 : s.slots.size() * 2;
     std::vector<Slot> old;
     old.swap(s.slots);
-    s.slots.assign(ncap, Slot{0, -1, 0, 0});
+    s.slots.assign(ncap, Slot{0, -1, 0, 0, 0});
     uint64_t mask = ncap - 1;
     for (Slot& sl : old) {
       if (!(sl.flags & kOccupied)) continue;
@@ -164,7 +178,8 @@ struct Table {
       if (s.slots[i].id == id) return &s.slots[i];
       i = (i + 1) & mask;
     }
-    s.slots[i] = Slot{id, -1, 0, kOccupied};
+    s.slots[i] = Slot{id, -1, 0, kOccupied,
+                      clock.load(std::memory_order_relaxed)};
     ++s.used;
     return &s.slots[i];
   }
@@ -183,7 +198,12 @@ struct Table {
         s.chunks.push_back(new float[(size_t)kRowsPerChunk * stride]);
       sl->row = (int64_t)idx;
       float* r = row_ptr(s, sl->row);
-      if (init) init_row(r, sl->id);
+      if (init) {
+        init_row(r, sl->id);
+        // a freshly materialised (admitted) feature — imports restore,
+        // they don't admit, and pass init=false
+        admitted_total.fetch_add(1, std::memory_order_relaxed);
+      }
       return r;
     }
     return row_ptr(s, sl->row);
@@ -243,11 +263,16 @@ struct Table {
   // admitted (creating the row), nullptr when the id pulls zeros /
   // drops its grad. Mirrors SparseTable._filter_admitted exactly.
   float* admit_row(Shard& s, int64_t id, bool counting) {
+    uint64_t now = clock.load(std::memory_order_relaxed);
     switch (entry_mode) {
-      case kNoEntry:
-        return row_of(s, insert(s, id), true);
+      case kNoEntry: {
+        Slot* sl = insert(s, id);
+        sl->touched = now;  // every sighting refreshes the TTL clock
+        return row_of(s, sl, true);
+      }
       case kCountEntry: {
         Slot* sl = insert(s, id);
+        sl->touched = now;  // pre-admission counters age out too
         if (sl->flags & kAdmitted) return row_of(s, sl, true);
         if (counting) ++sl->seen;
         if ((double)sl->seen >= entry_param) {
@@ -259,15 +284,80 @@ struct Table {
       }
       default: {  // kProbEntry
         Slot* sl = find(s, id);
-        if (sl != nullptr && (sl->flags & kAdmitted))
+        if (sl != nullptr && (sl->flags & kAdmitted)) {
+          sl->touched = now;
           return row_of(s, sl, true);
+        }
         if (!prob_admit(id, entry_param)) return nullptr;
         // rejected ids leave NO slot behind (ProbabilityEntry is
         // count-independent — the memory the entry exists to save)
         sl = insert(s, id);
         sl->flags |= kAdmitted;
+        sl->touched = now;
         return row_of(s, sl, true);
       }
+    }
+  }
+
+  // Drop every occupied slot whose last sighting predates ``cutoff``
+  // (counter-only slots included), rebuilding the shard's directory
+  // and compacting its arena.  Surviving rows are memcpy'd whole
+  // stride — value, optimizer moments and step counter keep their
+  // exact bits, which is what makes post-sweep checkpoints/replica
+  // snapshots round-trip exact.  Evicted ids are appended to ``out``
+  // up to ``cap``; a slot whose eviction would overflow the caller's
+  // buffer is LEFT IN PLACE for the next sweep (everything reported
+  // is everything evicted — the replica replay depends on that).
+  int64_t sweep_shard(Shard& s, uint64_t cutoff, int64_t* out,
+                      int64_t cap, int64_t n_out) {
+    int64_t wrote = 0;
+    bool any = false;
+    for (auto& sl : s.slots)
+      if ((sl.flags & kOccupied) && sl.touched < cutoff) { any = true; break; }
+    if (!any) return 0;
+    std::vector<Slot> surv;
+    surv.reserve(s.used);
+    for (auto& sl : s.slots) {
+      if (!(sl.flags & kOccupied)) continue;
+      if (sl.touched < cutoff && (out == nullptr || n_out + wrote < cap)) {
+        if (out != nullptr) out[n_out + wrote] = sl.id;
+        ++wrote;
+        continue;
+      }
+      surv.push_back(sl);
+    }
+    rebuild_shard(s, surv);
+    return wrote;
+  }
+
+  // Re-seat ``surv`` (slot copies holding OLD arena row indices) as the
+  // shard's whole population: compact the arena (bit-exact row copies)
+  // and rebuild the open-addressing directory.
+  void rebuild_shard(Shard& s, std::vector<Slot>& surv) {
+    std::vector<float*> nchunks;
+    uint64_t nrows = 0;
+    for (auto& sl : surv) {
+      if (sl.row < 0) continue;
+      if (nrows / kRowsPerChunk >= nchunks.size())
+        nchunks.push_back(new float[(size_t)kRowsPerChunk * stride]);
+      float* dst = nchunks[nrows / kRowsPerChunk] +
+                   (size_t)(nrows % kRowsPerChunk) * stride;
+      std::memcpy(dst, row_ptr(s, sl.row), sizeof(float) * stride);
+      sl.row = (int64_t)nrows++;
+    }
+    for (float* c : s.chunks) delete[] c;
+    s.chunks = std::move(nchunks);
+    s.rows_used = nrows;
+    size_t ncap = 1024;
+    while ((surv.size() + 1) * 10 >= ncap * 7) ncap <<= 1;
+    s.slots.assign(ncap, Slot{0, -1, 0, 0, 0});
+    s.used = 0;
+    uint64_t mask = ncap - 1;
+    for (auto& sl : surv) {
+      uint64_t i = slot_hash(sl.id) & mask;
+      while (s.slots[i].flags & kOccupied) i = (i + 1) & mask;
+      s.slots[i] = sl;
+      ++s.used;
     }
   }
 };
@@ -359,6 +449,136 @@ void pts_set_entry(void* h, int mode, double param) {
   Table* t = (Table*)h;
   t->entry_mode = mode;
   t->entry_param = param;
+}
+
+// -- feature lifecycle (ISSUE 14) ---------------------------------------
+
+// advance the table's logical clock (the TTL sweeper stamps wall
+// seconds once per tick; touches copy the current value)
+void pts_set_clock(void* h, uint64_t now) {
+  ((Table*)h)->clock.store(now, std::memory_order_relaxed);
+}
+
+// grandfather pass: stamp EVERY occupied slot (and the clock) to
+// ``now`` — rows of unknown age (created before any lifecycle ran,
+// e.g. pre-sweeper history or a restored checkpoint) age from the
+// sweeper's start instead of being evicted as tick-0 ancients
+void pts_touch_all(void* h, uint64_t now) {
+  Table* t = (Table*)h;
+  t->clock.store(now, std::memory_order_relaxed);
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& sl : s.slots)
+      if (sl.flags & kOccupied) sl.touched = now;
+  }
+}
+
+uint64_t pts_admitted_total(void* h) {
+  return ((Table*)h)->admitted_total.load(std::memory_order_relaxed);
+}
+
+uint64_t pts_evicted_total(void* h) {
+  return ((Table*)h)->evicted_total.load(std::memory_order_relaxed);
+}
+
+// occupied directory slots (materialised rows + admission counters) —
+// the TTL sweep output-buffer bound
+int64_t pts_slots(void* h) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += (int64_t)s.used;
+  }
+  return n;
+}
+
+// TTL sweep: evict every slot whose last sighting predates ``cutoff``.
+// Evicted ids are written to ``out`` (up to ``cap``); slots that would
+// overflow the buffer survive until the next sweep, so the return value
+// counts EXACTLY the ids written — the caller forwards that list to
+// replicas verbatim.  Counts as one applied mutating batch (version)
+// iff anything was evicted.
+int64_t pts_ttl_sweep(void* h, uint64_t cutoff, int64_t* out,
+                      int64_t cap) {
+  Table* t = (Table*)h;
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += t->sweep_shard(s, cutoff, out, cap, n);
+  }
+  if (n) {
+    t->version.fetch_add(1, std::memory_order_relaxed);
+    t->evicted_total.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  }
+  return n;
+}
+
+// exact-id eviction — the replica-side replay of a primary's TTL sweep
+// (the streamed ``evict`` record names the swept ids).  ALWAYS counts
+// as one applied mutating batch: the primary's sweep that produced the
+// record did, and version parity between primary and replica is the
+// audited catch-up invariant.
+int64_t pts_evict(void* h, const int64_t* ids, int64_t n) {
+  Table* t = (Table*)h;
+  int64_t removed = 0;
+  std::vector<std::vector<int64_t>> by_shard(t->n_shards);
+  for (int64_t i = 0; i < n; ++i)
+    by_shard[t->shard_of(ids[i])].push_back(ids[i]);
+  for (int sh = 0; sh < t->n_shards; ++sh) {
+    if (by_shard[sh].empty()) continue;
+    std::sort(by_shard[sh].begin(), by_shard[sh].end());
+    Shard& s = t->shards[sh];
+    std::lock_guard<std::mutex> lk(s.mu);
+    std::vector<Slot> surv;
+    surv.reserve(s.used);
+    bool any = false;
+    for (auto& sl : s.slots) {
+      if (!(sl.flags & kOccupied)) continue;
+      if (std::binary_search(by_shard[sh].begin(), by_shard[sh].end(),
+                             sl.id)) {
+        ++removed;
+        any = true;
+        continue;
+      }
+      surv.push_back(sl);
+    }
+    if (any) t->rebuild_shard(s, surv);
+  }
+  t->version.fetch_add(1, std::memory_order_relaxed);
+  if (removed)
+    t->evicted_total.fetch_add((uint64_t)removed,
+                               std::memory_order_relaxed);
+  return removed;
+}
+
+// LWW geo row replacement (ISSUE 14 conflict policy): set the VALUE
+// part of each id's row wholesale — existing rows keep their optimizer
+// moments/step, fresh rows materialise with zeroed state (no
+// deterministic init: the incoming value IS the row).  Bypasses
+// admission like pts_import, but marks the id admitted (the origin
+// cluster admitted it — a replicated winner must not serve zeros).
+// One applied mutating batch per call (empty calls included: the
+// primary applies the winning subset of a geo_set record even when it
+// is empty, and the replica replay must tick version identically).
+void pts_set_vals(void* h, const int64_t* ids, int64_t n,
+                  const float* vals) {
+  Table* t = (Table*)h;
+  t->version.fetch_add(1, std::memory_order_relaxed);
+  for_each_shard_batch(t, ids, n, [&](int s, const std::vector<int64_t>& pos) {
+    Shard& sh = t->shards[s];
+    uint64_t now = t->clock.load(std::memory_order_relaxed);
+    for (int64_t p : pos) {
+      Slot* sl = t->insert(sh, ids[p]);
+      bool fresh = sl->row < 0;
+      float* r = t->row_of(sh, sl, /*init=*/false);
+      if (fresh) std::memset(r, 0, sizeof(float) * t->stride);
+      std::memcpy(r, vals + (size_t)p * t->dim,
+                  sizeof(float) * t->dim);
+      sl->flags |= kAdmitted;
+      sl->touched = now;
+    }
+  });
 }
 
 // gather rows (lazy init, admission-aware) into out[n, dim]: ONE

@@ -116,13 +116,18 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # serve.spec_verify (ISSUE 11): a speculative-decode verify step IS
 # decode progress — the gateway ticks serve.decode every iteration and
 # additionally samples verify events into the ring
+# online.ingest / ps.ttl_sweep (ISSUE 14): the streaming trainer's
+# event loop and the TTL sweeper ARE the online loop making progress —
+# either going silent is exactly the stall a bundle should autopsy
+# (online.freshness_breach stays a bad kind in tools/postmortem.py).
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
                              "serve.decode", "serve.admit",
                              "serve.spec_verify",
                              "elastic.join", "elastic.reshard",
                              "elastic.resume", "elastic.promote",
                              "ps.replica.attach", "ps.promote",
-                             "ps.geo.push"})
+                             "ps.geo.push", "online.ingest",
+                             "ps.ttl_sweep"})
 
 # typed-failure dumps are rate limited per reason (a retry storm must
 # not turn every PSUnavailable into a bundle) and capped per process

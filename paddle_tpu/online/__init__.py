@@ -1,0 +1,34 @@
+"""paddle_tpu.online — the 24/7 train->serve loop (ISSUE 14).
+
+The paper's defining production workload (SURVEY §5: the brpc PS's
+sync/async/geo-async sparse recsys path) is not a batch job — it is a
+continuously running system: an unbounded event feed trains the sparse
+tables on a primary, read replicas serve the SAME tables to live query
+traffic, stale features expire at the table, remote clusters converge
+through bidirectional geo replication, and the whole loop is held to an
+explicit event-ingested -> servable-at-replica freshness SLO.
+
+This package wires the pieces (every one of which already exists in
+isolation) into that loop:
+
+- :class:`StreamingTrainer` (``streaming.py``) — unbounded-event-feed
+  trainer over the iterable DataLoader path with cursor-exact resume,
+  cursor-derived idempotency stamps (exactly-once across kill/resume),
+  and per-event ingest watermarks stamped through ``push``;
+- :class:`FeatureLifecycle` (``lifecycle.py``) — the TTL sweep driver
+  for ``PSServer.ttl_sweep`` (last-sighting expiry at the native
+  table, replicated evictions, churn metrics);
+- :func:`freshness_objectives` / :class:`FreshnessWatch`
+  (``freshness.py``) — the freshness SLO declared on
+  ``observability/slo.py``'s engine over ``ps_replica_lag_seq`` and
+  the time-based ``ps_replica_lag_seconds`` gauge.
+
+Must stay importable without jax (the trainer imports its device-merge
+helper lazily).
+"""
+from .freshness import FreshnessWatch, freshness_objectives  # noqa: F401
+from .lifecycle import FeatureLifecycle  # noqa: F401
+from .streaming import StreamingTrainer  # noqa: F401
+
+__all__ = ["StreamingTrainer", "FeatureLifecycle", "FreshnessWatch",
+           "freshness_objectives"]

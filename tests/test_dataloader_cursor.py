@@ -137,6 +137,62 @@ def test_iterable_dataset_cursor_resume():
         assert np.array_equal(a, b)
 
 
+class Unbounded(IterableDataset):
+    """An ENDLESS deterministic stream — the online loop's feed shape
+    (ISSUE 14 satellite: the finite-dataset tests above never cover
+    it).  Element i is just i, so duplicates/drops are readable."""
+
+    def __iter__(self):
+        i = 0
+        while True:
+            yield np.asarray([i], np.float32)
+            i += 1
+
+
+def test_unbounded_stream_kill_resume_no_dup_no_drop():
+    """Abandon an UNBOUNDED iterator mid-stream (the kill), resume a
+    FRESH loader from the cursor: the concatenated element stream is
+    exactly 0,1,2,... — no event seen twice, none dropped.  Repeated
+    kill/resume cycles compose."""
+    mk = lambda: DataLoader(Unbounded(), batch_size=3, drop_last=True)
+    got = []
+    cur = None
+    for k in (4, 7, 5):          # three incarnations, killed mid-flight
+        loader = mk()
+        if cur is not None:
+            loader.load_state_dict(cur)
+        it = iter(loader)
+        for _ in range(k):
+            got.append(np.asarray(next(it)._value))
+        it.close()               # the kill: iterator abandoned
+        cur = loader.state_dict()
+        assert cur["epoch"] == 0 and cur["batch"] == len(got)
+    stream = np.concatenate([b.reshape(-1) for b in got])
+    assert np.array_equal(stream, np.arange(len(stream),
+                                            dtype=np.float32))
+
+
+def test_unbounded_stream_resume_replays_nothing_under_prefetch():
+    """The cursor counts batches YIELDED, not prefetched: abandoning
+    mid-stream with the prefetch pipeline full must not advance the
+    cursor past what the consumer saw — the resumed stream continues
+    at exactly the next unseen element."""
+    mk = lambda: DataLoader(Unbounded(), batch_size=2, drop_last=True,
+                            prefetch_factor=4)
+    loader = mk()
+    it = iter(loader)
+    seen = [np.asarray(next(it)._value) for _ in range(5)]
+    it.close()
+    cur = loader.state_dict()
+    assert cur["batch"] == 5     # prefetched-undelivered don't count
+    resumed = mk()
+    resumed.load_state_dict(cur)
+    it2 = iter(resumed)
+    nxt = np.asarray(next(it2)._value).reshape(-1)
+    assert np.array_equal(nxt, np.asarray([10.0, 11.0], np.float32))
+    it2.close()
+
+
 def test_legacy_unseeded_behaviour_untouched():
     """No seed, no cursor calls: repeated full passes keep drawing
     fresh global-RNG permutations (the pre-cursor contract)."""

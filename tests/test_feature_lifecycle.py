@@ -1,0 +1,253 @@
+"""Feature lifecycle at the table (ISSUE 14): TTL/decay eviction.
+
+Acceptance contracts:
+- an expired id is gone EVERYWHERE — pull re-materialises fresh,
+  checkpoints and replica snapshots no longer carry it;
+- a surviving id's value AND per-row optimizer moments are
+  bit-identical across the sweep, across backends, and across the
+  checkpoint round trip;
+- evictions replicate down the mutation stream: a read replica drops
+  the exact same ids and keeps version parity with the primary;
+- churn counters (``ps_feature_admitted`` / ``ps_feature_evicted``)
+  appear on /metrics;
+- :class:`FeatureLifecycle` grandfathers pre-sweeper rows (no tick-0
+  mass eviction) and expires by last sighting, deterministically via
+  an injected clock.
+"""
+import io
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.entry import CountFilterEntry
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSClient, PSServer
+from paddle_tpu.online import FeatureLifecycle
+
+_FAST = dict(connect_timeout=2.0, rpc_timeout=1.0, max_retries=6,
+             backoff_base=0.02, rpc_deadline=20.0)
+
+
+def _backends():
+    # python backend needs init_std=0 for the deterministic-init checks
+    return [dict(use_native=True),
+            dict(use_native=False, init_std=0.0)]
+
+
+def _full_rows(t):
+    """id -> full replication row (value | moments | step) from the
+    snapshot bytes — the bit-identity oracle."""
+    d = np.load(io.BytesIO(t.state_bytes()))
+    ids = d["ids"]
+    rows = np.concatenate([d["vals"], d["opt_state"]], axis=1)
+    return {int(i): rows[k].copy() for k, i in enumerate(ids)}
+
+
+@pytest.mark.parametrize("kw", _backends())
+def test_sweep_evicts_stale_keeps_survivors_bit_exact(kw):
+    t = SparseTable(4, optimizer="adam", lr=0.1, seed=3, **kw)
+    t.set_clock(1000)
+    ids = np.arange(20, dtype=np.int64)
+    for _ in range(3):   # build non-trivial adam moments + steps
+        t.push(ids, np.ones((20, 4), np.float32))
+    t.set_clock(2000)
+    t.pull(np.arange(8, dtype=np.int64))      # refresh 0..7 only
+    before = _full_rows(t)
+    evicted = t.ttl_sweep(1500)
+    assert list(evicted) == list(range(8, 20))
+    assert len(t) == 8 and t.evicted_total == 12
+    after = _full_rows(t)
+    assert sorted(after) == list(range(8))
+    for k, row in after.items():
+        # value AND optimizer moments AND step counter: exact bits
+        assert np.array_equal(row, before[k]), k
+
+
+@pytest.mark.parametrize("kw", _backends())
+def test_expired_id_rematerialises_fresh_and_deterministic(kw):
+    t = SparseTable(4, optimizer="adagrad", lr=0.5, seed=9, **kw)
+    t.set_clock(10)
+    t.push(np.array([7], np.int64), np.ones((1, 4), np.float32))
+    t.set_clock(99)
+    assert list(t.ttl_sweep(50)) == [7]
+    # the evicted id pulls the same deterministic init a fresh table
+    # materialises — no trace of the trained row or its moments
+    fresh = SparseTable(4, optimizer="adagrad", lr=0.5, seed=9, **kw)
+    assert np.array_equal(t.pull(np.array([7], np.int64)),
+                          fresh.pull(np.array([7], np.int64)))
+
+
+@pytest.mark.parametrize("kw", _backends())
+def test_checkpoint_after_sweep_round_trips_exact(kw):
+    t = SparseTable(4, optimizer="adam", lr=0.1, seed=3, **kw)
+    t.set_clock(100)
+    t.push(np.arange(12, dtype=np.int64), np.ones((12, 4), np.float32))
+    t.set_clock(200)
+    t.pull(np.arange(6, dtype=np.int64))
+    t.ttl_sweep(150)
+    before = _full_rows(t)
+    t2 = SparseTable(4, optimizer="adam", lr=0.1, seed=3, **kw)
+    t2.load_state_bytes(t.state_bytes())
+    after = _full_rows(t2)
+    assert sorted(after) == sorted(before) == list(range(6))
+    for k in before:
+        assert np.array_equal(before[k], after[k])
+    assert t2.version == t.version
+
+
+def test_cross_backend_snapshot_after_sweep():
+    """A python replica of a swept native table (and vice versa)
+    inherits the exact surviving rows."""
+    a = SparseTable(4, optimizer="adam", lr=0.1, seed=3, use_native=True)
+    a.set_clock(10)
+    a.push(np.arange(10, dtype=np.int64), np.ones((10, 4), np.float32))
+    a.set_clock(50)
+    a.pull(np.arange(4, dtype=np.int64))
+    a.ttl_sweep(30)
+    b = SparseTable(4, optimizer="adam", lr=0.1, seed=3,
+                    use_native=False)
+    b.load_state_bytes(a.state_bytes())
+    ra, rb = _full_rows(a), _full_rows(b)
+    assert sorted(ra) == sorted(rb) == list(range(4))
+    for k in ra:
+        assert np.array_equal(ra[k], rb[k])
+
+
+def test_entry_counter_slots_expire_and_readmission_restarts():
+    t = SparseTable(4, optimizer="sgd", lr=0.1, seed=0,
+                    entry=CountFilterEntry(3))
+    t.set_clock(10)
+    t.pull(np.array([5], np.int64))   # 1 sighting — counter slot only
+    t.pull(np.array([5], np.int64))   # 2 sightings
+    t.set_clock(99)
+    assert list(t.ttl_sweep(50)) == [5]
+    # the counter was wiped: two more sightings still pull zeros, the
+    # third admits — admission restarts from ZERO after expiry
+    t.set_clock(100)
+    assert np.all(t.pull(np.array([5], np.int64)) == 0.0)
+    assert np.all(t.pull(np.array([5], np.int64)) == 0.0)
+    assert not np.all(t.pull(np.array([5], np.int64)) == 0.0)
+
+
+def test_evict_ids_replay_matches_and_ticks_version():
+    t = SparseTable(4, optimizer="sgd", lr=0.1, seed=0)
+    t.push(np.arange(6, dtype=np.int64), np.ones((6, 4), np.float32))
+    v0 = t.version
+    n = t.evict_ids(np.array([1, 3, 99], np.int64))
+    assert n == 2 and len(t) == 4
+    assert t.version == v0 + 1
+    # absent-id replay still ticks version (parity with the primary's
+    # sweep that produced the record)
+    t.evict_ids(np.array([1], np.int64))
+    assert t.version == v0 + 2
+
+
+def test_primary_sweep_replicates_evictions_to_read_replica():
+    spec = dict(dim=4, optimizer="adagrad", lr=0.1, seed=7)
+    prim = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1")
+    prim.start()
+    rep = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1",
+                   replica_of=f"127.0.0.1:{prim.port}",
+                   replica_mode="read", wm_interval_s=0.05)
+    rep.start()
+    try:
+        assert rep.replica_ready.wait(10.0)
+        w = PSClient([f"127.0.0.1:{prim.port}"], **_FAST)
+        ids = np.arange(30, dtype=np.int64)
+        w.push("emb", ids, np.ones((30, 4), np.float32))
+        # refresh 0..9 at a later tick, then sweep the rest out
+        now = time.time()
+        prim._tables["emb"].set_clock(int((now + 100) * 1000))
+        prim._tables["emb"].pull(np.arange(10, dtype=np.int64))
+        out = prim.ttl_sweep(cutoff=now + 50, now=now + 100)
+        assert out == {"emb": 20}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and len(rep._tables["emb"]) != 10:
+            time.sleep(0.05)
+        assert len(rep._tables["emb"]) == 10
+        # version parity: the audited catch-up invariant includes the
+        # eviction batch
+        assert rep._tables["emb"].version == prim._tables["emb"].version
+        # surviving rows bit-equal primary's
+        a = prim._tables["emb"]._snapshot_arrays(full_state=True)
+        b = rep._tables["emb"]._snapshot_arrays(full_state=True)
+        oa, ob = np.argsort(a["ids"]), np.argsort(b["ids"])
+        assert np.array_equal(a["ids"][oa], b["ids"][ob])
+        assert np.array_equal(a["vals"][oa], b["vals"][ob])
+        assert np.array_equal(a["opt_state"][oa], b["opt_state"][ob])
+        w.close()
+    finally:
+        rep.stop()
+        prim.stop()
+
+
+def test_lifecycle_grandfathers_then_expires_deterministically():
+    """Injected clock: rows created before the sweeper existed age
+    from the sweeper's first pass (touch_all), not from tick zero."""
+    clock = [1000.0]
+    spec = dict(dim=4, optimizer="sgd", lr=0.1, seed=1)
+    srv = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1")
+    srv.start()
+    try:
+        t = srv._tables["emb"]
+        t.push(np.arange(8, dtype=np.int64), np.ones((8, 4), np.float32))
+        fl = FeatureLifecycle(srv, ttl_s=60.0, interval_s=999.0,
+                              time_fn=lambda: clock[0])
+        # first pass primes: nothing evicts even though the rows were
+        # touched long before the sweeper's clock domain existed
+        assert fl.sweep_once() == {"emb": 0}
+        clock[0] = 1030.0                      # inside ttl
+        assert fl.sweep_once() == {"emb": 0}
+        # refresh half at t=1040, sweep at t=1095 (cutoff 1035): the
+        # grandfathered half (stamped 1000 < 1035) expires, the
+        # refreshed half (1040 >= 1035) survives
+        t.set_clock(int(1040.0 * 1000))
+        t.pull(np.arange(4, dtype=np.int64))
+        clock[0] = 1095.0
+        out = fl.sweep_once()
+        assert out == {"emb": 4}, out
+        assert sorted(
+            int(i) for i in
+            t._snapshot_arrays()["ids"]) == [0, 1, 2, 3]
+        assert fl.evicted == 4 and fl.sweeps == 3
+    finally:
+        srv.stop()
+
+
+def test_churn_counters_on_metrics_exposition():
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.observability.metrics import prometheus_text
+    spec = dict(dim=4, optimizer="sgd", lr=0.1, seed=2)
+    srv = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1")
+    srv.start()
+    try:
+        t = srv._tables["emb"]
+        t.push(np.arange(5, dtype=np.int64), np.ones((5, 4), np.float32))
+        now = time.time()
+        t.touch_all(int(now * 1000))
+        srv.ttl_sweep(cutoff=now + 50, now=now + 100)   # evicts all 5
+        text = prometheus_text(monitor.metrics_snapshot())
+        assert "ps_feature_admitted" in text
+        assert "ps_feature_evicted" in text
+    finally:
+        srv.stop()
+
+
+def test_observability_wiring():
+    import os
+    import sys
+    from paddle_tpu.observability.flight_recorder import _PROGRESS_KINDS
+    assert {"ps.ttl_sweep", "online.ingest"} <= set(_PROGRESS_KINDS)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import postmortem
+    assert postmortem._is_bad({"kind": "online.freshness_breach"})
+    from paddle_tpu.analysis import DEFAULT_LINT_PATHS, lint_file
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for m in ("streaming", "lifecycle", "freshness"):
+        p = f"paddle_tpu/online/{m}.py"
+        assert p in DEFAULT_LINT_PATHS
+        assert lint_file(os.path.join(repo, p)) == []

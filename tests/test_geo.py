@@ -170,7 +170,8 @@ def test_geo_python_backend_requires_deterministic_init():
     try:
         bad._tables["emb"].push(np.arange(4, dtype=np.int64),
                                 np.ones((4, 4), np.float32))
-        gp._on_commit("push", "emb", np.arange(4, dtype=np.int64))
+        gp._on_commit({"op": "push", "table": "emb",
+                       "ids": np.arange(4, dtype=np.int64)})
         with pytest.raises(PSError, match="deterministic"):
             gp.flush()
     finally:

@@ -1,0 +1,279 @@
+"""Bidirectional geo with explicit conflict policies (ISSUE 14).
+
+Acceptance contracts, proven PER POLICY on concurrent-write workloads:
+
+- ``geo_policy="add"``: both clusters converge to the additive fixed
+  point — base + every local write + every peer write, each applied
+  exactly once (bit-exact on exact-arithmetic workloads), with echo
+  suppression (a replicated delta never bounces back) and under a
+  seeded lossy/delayed link 0 lost / 0 double-applied;
+- ``geo_policy="lww"``: both clusters converge, per id, to the row of
+  the globally maximal ``(lamport seq, site)`` stamp — bit-exactly —
+  with site as the deterministic tie-break, and the stamp directory
+  survives replication to a promoted standby.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet.geo import GeoPusher
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSClient, PSServer
+
+_FAST = dict(connect_timeout=2.0, rpc_timeout=1.0, max_retries=6,
+             backoff_base=0.02, rpc_deadline=20.0)
+# exact-arithmetic workload: zero init + integer deltas, so the
+# additive fixed point is order-insensitive and bit-checkable
+_SPEC = dict(dim=6, optimizer="sgd", lr=1.0, seed=5, init_std=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _cluster(policy, site):
+    srv = PSServer({"emb": SparseTable(geo_policy=policy, **_SPEC)},
+                   host="127.0.0.1", geo_site=site)
+    srv.start()
+    return srv, f"127.0.0.1:{srv.port}"
+
+
+def _bridge(policy):
+    A, aep = _cluster(policy, "A")
+    B, bep = _cluster(policy, "B")
+    gA = GeoPusher(A, [bep], interval_s=3600.0, **_FAST)  # manual flush
+    gB = GeoPusher(B, [aep], interval_s=3600.0, **_FAST)
+    return A, B, aep, bep, gA, gB
+
+
+def _settle(gA, gB, rounds=8):
+    for _ in range(rounds):
+        gA.flush()
+        gB.flush()
+    assert gA.backlog() == 0 and gB.backlog() == 0
+
+
+def _teardown(*objs):
+    for o in objs:
+        try:
+            if isinstance(o, GeoPusher):
+                o.stop(drain=False)
+            elif isinstance(o, PSClient):
+                o.close()
+            else:
+                o.stop()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# additive merge
+# ---------------------------------------------------------------------------
+
+def test_additive_bidirectional_fixed_point_concurrent_writes():
+    A, B, aep, bep, gA, gB = _bridge("add")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    try:
+        ids = np.arange(40, dtype=np.int64)
+        # concurrent, OVERLAPPING writes (ids 10..29 written both sides)
+        wa.push_delta("emb", ids[:30], np.full((30, 6), 2.0, np.float32))
+        wb.push_delta("emb", ids[10:], np.full((30, 6), 5.0, np.float32))
+        _settle(gA, gB)
+        ra = A._tables["emb"].pull(ids)
+        rb = B._tables["emb"].pull(ids)
+        want = np.zeros((40, 6), np.float32)
+        want[:30] += 2.0
+        want[10:] += 5.0
+        # the fixed point: both sides, every write exactly once
+        assert np.array_equal(ra, rb)
+        assert np.array_equal(ra, want)
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
+def test_additive_echo_suppression_quiesces():
+    """After convergence NOTHING keeps flowing: a replicated delta
+    must not re-dirty the receiving side (the infinite-bounce trap)."""
+    A, B, aep, bep, gA, gB = _bridge("add")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    try:
+        ids = np.arange(8, dtype=np.int64)
+        wa.push_delta("emb", ids, np.ones((8, 6), np.float32))
+        _settle(gA, gB)
+        pushed_a, pushed_b = gA.pushed_ids, gB.pushed_ids
+        # extra rounds move NOTHING
+        for _ in range(4):
+            assert gA.flush() == 0
+            assert gB.flush() == 0
+        assert (gA.pushed_ids, gB.pushed_ids) == (pushed_a, pushed_b)
+        assert not any(gA._inbound.values())
+        assert not any(gB._inbound.values())
+    finally:
+        _teardown(gA, gB, wa, A, B)
+
+
+def test_additive_bidirectional_lossy_link_zero_lost_zero_double():
+    """THE additive chaos bar: both directions ride a seeded
+    lossy/delayed link (delays, dropped acks, cut connections); the
+    idempotent (src, seq) retries mean no delta is lost or applied
+    twice — the exact-arithmetic fixed point is still hit on the bit."""
+    A, B, aep, bep, gA, gB = _bridge("add")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    chaos.install(chaos.plan_from_spec(
+        "seed=11;delay:push_delta:first=1:every=2:times=0:arg=0.002;"
+        "drop:push_delta_reply:first=2:every=3:times=0;"
+        "cut:push_delta:first=7:every=9:times=0"))
+    try:
+        ids = np.arange(50, dtype=np.int64)
+        wa.push_delta("emb", ids[:35], np.full((35, 6), 3.0, np.float32))
+        wb.push_delta("emb", ids[15:], np.full((35, 6), 4.0, np.float32))
+        _settle(gA, gB, rounds=12)
+        st = chaos.active().stats_dict()
+        assert any(k.startswith(("drop", "delay", "cut"))
+                   for k in st), st   # the link really was hostile
+        chaos.uninstall()
+        ra = A._tables["emb"].pull(ids)
+        rb = B._tables["emb"].pull(ids)
+        want = np.zeros((50, 6), np.float32)
+        want[:35] += 3.0
+        want[15:] += 4.0
+        assert np.array_equal(ra, rb)
+        assert np.array_equal(ra, want)   # 0 lost / 0 double-applied
+        assert A.dup_acks + B.dup_acks >= 1   # a retry WAS deduped
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
+# ---------------------------------------------------------------------------
+# last-writer-wins
+# ---------------------------------------------------------------------------
+
+def test_lww_higher_lamport_wins_everywhere():
+    A, B, aep, bep, gA, gB = _bridge("lww")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    try:
+        one = np.array([1], np.int64)
+        # A writes once (lamport 1); B writes twice (lamport 2):
+        # B's stamp (2, "B") is the global max — its ROW must win on
+        # both sides, bit-exactly
+        wa.push_delta("emb", one, np.full((1, 6), 10.0, np.float32))
+        wb.push_delta("emb", one, np.full((1, 6), 1.0, np.float32))
+        wb.push_delta("emb", one, np.full((1, 6), 1.0, np.float32))
+        _settle(gA, gB)
+        ra = A._tables["emb"].pull(one)
+        rb = B._tables["emb"].pull(one)
+        assert np.array_equal(ra, rb)
+        assert np.all(ra == 2.0), ra          # B's row, not A's 10.0
+        assert A._geo_stamps["emb"][1] == (2, "B")
+        assert B._geo_stamps["emb"][1] == (2, "B")
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
+def test_lww_equal_lamport_site_tiebreak_is_deterministic():
+    A, B, aep, bep, gA, gB = _bridge("lww")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    try:
+        one = np.array([2], np.int64)
+        # one write each: both stamps are (1, site) — site "B" > "A"
+        # lexicographically, so B's row wins deterministically
+        wa.push_delta("emb", one, np.full((1, 6), 7.0, np.float32))
+        wb.push_delta("emb", one, np.full((1, 6), 9.0, np.float32))
+        _settle(gA, gB)
+        ra = A._tables["emb"].pull(one)
+        rb = B._tables["emb"].pull(one)
+        assert np.array_equal(ra, rb) and np.all(ra == 9.0)
+        assert A._geo_stamps["emb"][2] == B._geo_stamps["emb"][2] \
+            == (1, "B")
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
+def test_lww_loser_update_is_skipped_not_merged():
+    """A stale geo_set arriving AFTER a newer local write must be
+    dropped whole — LWW never mixes rows."""
+    A, aep = _cluster("lww", "A")
+    w = PSClient([aep], mode="sync", **_FAST)
+    try:
+        one = np.array([3], np.int64)
+        w.push_delta("emb", one, np.full((1, 6), 5.0, np.float32))
+        st = A._geo_stamps["emb"][3]
+        assert st[0] >= 1
+        # a peer's OLDER stamp loses; its value must not land
+        w.geo_set("emb", one, np.full((1, 6), 123.0, np.float32),
+                  np.array([0], np.int64), ["B"])
+        assert np.all(A._tables["emb"].pull(one) == 5.0)
+        assert A._geo_stamps["emb"][3] == st
+        # a NEWER stamp replaces wholesale
+        w.geo_set("emb", one, np.full((1, 6), 42.0, np.float32),
+                  np.array([st[0] + 1], np.int64), ["B"])
+        assert np.all(A._tables["emb"].pull(one) == 42.0)
+        assert A._geo_stamps["emb"][3] == (st[0] + 1, "B")
+    finally:
+        _teardown(w, A)
+
+
+def test_lww_stamp_directory_survives_standby_promotion():
+    """The conflict decisions must outlive the primary: a hot standby
+    inherits the stamp directory (snapshot header) and keeps skipping
+    stale geo_sets after promotion."""
+    prim, pep = _cluster("lww", "P")
+    w = PSClient([pep], **_FAST)
+    one = np.array([4], np.int64)
+    w.push_delta("emb", one, np.full((1, 6), 8.0, np.float32))
+    w.push_delta("emb", one, np.full((1, 6), 8.0, np.float32))
+    stamp = prim._geo_stamps["emb"][4]
+    stby = PSServer({"emb": SparseTable(geo_policy="lww", **_SPEC)},
+                    host="127.0.0.1", replica_of=pep)
+    stby.start()
+    try:
+        assert stby.replica_ready.wait(10.0)
+        prim.stop()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not stby.promoted:
+            time.sleep(0.05)
+        assert stby.promoted
+        assert stby._geo_stamps["emb"][4] == stamp
+        # a stale geo_set against the promoted standby is still skipped
+        w2 = PSClient([f"127.0.0.1:{stby.port}"], **_FAST)
+        w2.geo_set("emb", one, np.full((1, 6), 99.0, np.float32),
+                   np.array([stamp[0] - 1], np.int64), ["B"])
+        assert np.all(stby._tables["emb"].pull(one) == 16.0)
+        w2.close()
+    finally:
+        _teardown(w, stby, prim)
+
+
+def test_lww_stream_replication_keeps_replica_stamps_in_step():
+    """Forwarded records carry their stamp (``gst``): a read replica's
+    stamp directory tracks the primary's without ever minting its own
+    (site divergence would corrupt later conflict decisions)."""
+    prim, pep = _cluster("lww", "P")
+    rep = PSServer({"emb": SparseTable(geo_policy="lww", **_SPEC)},
+                   host="127.0.0.1", replica_of=pep,
+                   replica_mode="read", wm_interval_s=0.05)
+    rep.start()
+    w = PSClient([pep], **_FAST)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        ids = np.arange(5, dtype=np.int64)
+        w.push_delta("emb", ids, np.ones((5, 6), np.float32))
+        w.push_delta("emb", ids[:2], np.ones((2, 6), np.float32))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and rep._stats()["watermark"] < 2:
+            time.sleep(0.05)
+        assert rep._geo_stamps["emb"] == prim._geo_stamps["emb"]
+        # every stamp carries the PRIMARY's site
+        assert all(s[1] == "P"
+                   for s in rep._geo_stamps["emb"].values())
+    finally:
+        _teardown(w, rep, prim)

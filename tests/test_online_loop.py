@@ -1,0 +1,513 @@
+"""The online learning loop (ISSUE 14 tentpole): streaming trainer +
+live-serving replicas + freshness SLO under chaos.
+
+Acceptance contracts:
+- a streaming run with the PRIMARY SIGKILLed mid-stream and a seeded
+  lossy/delayed geo link finishes with 0 lost / 0 double-applied
+  events (exact shadow-table accounting: ``primary.applied`` counts
+  every unique batch exactly once, row values equal the fault-free
+  count), replicas never serve beyond the bounded-staleness contract
+  (zero failed reads through the failover window), and the surviving
+  rows are bit-equal to the fault-free run;
+- a trainer SIGKILLed mid-stream resumes from its cursor checkpoint
+  and the cursor-derived ``(src, seq)`` stamps turn the replayed
+  batches into duplicate acks — no event lost, none double-applied;
+- the freshness pipeline is real: pushes stamped with event-ingest
+  watermarks become the replica-side ``ps_freshness_ms`` histogram
+  and the ``ps_replica_lag_seconds`` gauge, and an injected stall
+  latches ``slo.breach`` + ``online.freshness_breach`` with a flight
+  bundle that ``tools/postmortem.py`` renders breach-first.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.distributed.fleet.geo import GeoPusher
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSClient, PSServer
+from paddle_tpu.framework import monitor
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import IterableDataset
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.online import (FeatureLifecycle, FreshnessWatch,
+                               StreamingTrainer)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FAST = dict(connect_timeout=2.0, rpc_timeout=1.0, max_retries=8,
+             backoff_base=0.02, rpc_deadline=30.0)
+# counting table: sgd lr=1, grad=-1, init 0 -> a row's value equals the
+# number of batches applied to it; loss/double-apply is READABLE
+_COUNT = dict(dim=4, optimizer="sgd", lr=1.0, seed=0, init_std=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture()
+def _metrics():
+    monitor.enable_metrics(True)
+    yield
+    monitor.enable_metrics(os.environ.get("PADDLE_METRICS", "0") == "1")
+
+
+class _Feed(IterableDataset):
+    """Deterministic unbounded feed: batch i touches every id (the
+    counting-table oracle) and stamps its ingest time."""
+
+    def __init__(self, n_ids=32):
+        self.n_ids = n_ids
+
+    def __iter__(self):
+        i = 0
+        while True:
+            yield {"ids": np.arange(self.n_ids, dtype=np.int64),
+                   "ingest_ts": time.time(), "i": i}
+            i += 1
+
+
+def _collate(items):
+    # ingest_ts as a python float: the loader's device transfer narrows
+    # float64 ARRAYS to f32 (±128 s at epoch magnitude)
+    return {"ids": np.concatenate([np.asarray(d["ids"], np.int64)
+                                   for d in items]),
+            "ingest_ts": max(d["ingest_ts"] for d in items)}
+
+
+def _count_step(batch, pull):
+    ids = np.asarray(batch["ids"]).reshape(-1)
+    return ids, np.full((ids.size, 4), -1.0, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the loop feeds replicas + the freshness pipeline
+# ---------------------------------------------------------------------------
+
+def test_streaming_trainer_feeds_replica_freshness(_metrics):
+    prim = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1")
+    prim.start()
+    pep = f"127.0.0.1:{prim.port}"
+    rep = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                   replica_of=pep, replica_mode="read",
+                   wm_interval_s=0.05)
+    rep.start()
+    cli = PSClient([pep], mode="sync", **_FAST)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        h0 = (monitor.metrics_snapshot().get("histograms", {})
+              .get("ps_freshness_ms") or {"count": 0})["count"]
+        tr = StreamingTrainer(
+            DataLoader(_Feed(), batch_size=1, collate_fn=_collate),
+            cli, "emb", _count_step)
+        tr.run(max_batches=20)
+        assert tr.batches == 20 and tr.seq == 20
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and rep._stats()["watermark"] < 20:
+            time.sleep(0.05)
+        st = rep._stats()
+        assert st["watermark"] >= 20
+        assert st["ingest_wm"] > 0
+        # the REAL watermark path fed the freshness histogram
+        snap = monitor.metrics_snapshot()
+        h = snap["histograms"]["ps_freshness_ms"]
+        assert h["count"] - h0 >= 20
+        assert "ps_replica_lag_seconds" in snap["gauges"]
+        # bounded read serves the trained rows from the replica
+        rd = PSClient([pep], mode="read", max_lag=64,
+                      read_replicas=[f"127.0.0.1:{rep.port}"], **_FAST)
+        vals = rd.pull("emb", np.arange(32, dtype=np.int64))
+        assert np.all(vals == 20.0)
+        rd.close()
+        # online.ingest rode the flight ring (stall-watchdog progress)
+        kinds = {e.get("kind") for e in flight_recorder.events()}
+        assert "online.ingest" in kinds
+    finally:
+        cli.close()
+        rep.stop()
+        prim.stop()
+
+
+# ---------------------------------------------------------------------------
+# trainer SIGKILL + cursor resume: exactly-once
+# ---------------------------------------------------------------------------
+
+_TRAINER_PROC_SRC = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+import numpy as np
+from paddle_tpu.distributed.fleet.ps_service import PSClient
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import IterableDataset
+from paddle_tpu.online import StreamingTrainer
+
+class Feed(IterableDataset):
+    def __iter__(self):
+        while True:
+            yield {"ids": np.arange(32, dtype=np.int64)}
+
+def collate(items):
+    return {"ids": np.concatenate([np.asarray(d["ids"], np.int64)
+                                   for d in items])}
+
+sleep_s = float(cfg.get("sleep", 0.0))
+
+def step(batch, pull):
+    if sleep_s:
+        time.sleep(sleep_s)
+    ids = np.asarray(batch["ids"]).reshape(-1)
+    return ids, np.full((ids.size, 4), -1.0, np.float32)
+
+cli = PSClient([cfg["ep"]], mode="sync", connect_timeout=2.0,
+               rpc_timeout=2.0, max_retries=6, backoff_base=0.02,
+               rpc_deadline=20.0)
+tr = StreamingTrainer(
+    DataLoader(Feed(), batch_size=1, collate_fn=collate),
+    cli, "emb", step, src="stream-acc", state_path=cfg["state"],
+    ckpt_every=int(cfg.get("ckpt_every", 7)))
+tr.run(max_batches=max(0, int(cfg["until_seq"]) - tr.seq))
+print(json.dumps({"seq": tr.seq, "dups": tr.dup_acks,
+                  "batches": tr.batches}), flush=True)
+cli.close()
+"""
+
+
+def test_trainer_sigkill_resume_exactly_once(tmp_path):
+    until = 40
+    prim = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1")
+    prim.start()
+    ep = f"127.0.0.1:{prim.port}"
+    state = str(tmp_path / "cursor.json")
+    cfg = {"ep": ep, "state": state, "until_seq": until,
+           "sleep": 0.02, "ckpt_every": 7}
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    try:
+        p1 = subprocess.Popen(
+            [sys.executable, "-c", _TRAINER_PROC_SRC, _REPO,
+             json.dumps(cfg)], env=env, stdout=subprocess.PIPE,
+            text=True)
+        # SIGKILL mid-stream, at a point that is NOT a checkpoint
+        # boundary (ckpt_every=7) so the resume provably replays
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            a = prim.applied
+            if a >= 15 and 2 <= a % 7 <= 5:
+                os.kill(p1.pid, signal.SIGKILL)
+                break
+            time.sleep(0.005)
+        p1.wait(timeout=10)
+        assert p1.returncode != 0          # it really was killed
+        applied_at_kill = prim.applied
+        assert applied_at_kill < until
+        assert os.path.exists(state)
+        # resume: replays the post-checkpoint window as duplicates,
+        # then continues to the target
+        cfg2 = dict(cfg, sleep=0.0)
+        out = subprocess.run(
+            [sys.executable, "-c", _TRAINER_PROC_SRC, _REPO,
+             json.dumps(cfg2)], env=env, capture_output=True,
+            text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["seq"] == until
+        # exactly-once, by the server's own accounting: every unique
+        # batch applied ONCE (duplicates acked, not applied) ...
+        assert prim.applied == until
+        assert res["dups"] >= 1 or prim.dup_acks >= 1
+        # ... and by the data: row values equal the fault-free count
+        got = prim._tables["emb"].pull(np.arange(32, dtype=np.int64))
+        assert np.all(got == float(until)), got[:, 0]
+    finally:
+        prim.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance: primary SIGKILL + lossy geo link mid-stream
+# ---------------------------------------------------------------------------
+
+_SERVER_PROC_SRC = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+cfg = json.loads(sys.argv[2])
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.distributed.fleet.ps_service import PSServer
+srv = PSServer({"emb": SparseTable(**cfg["spec"])}, host="127.0.0.1")
+srv.start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+srv._stop.wait()
+"""
+
+
+def test_chaos_primary_sigkill_lossy_geo_acceptance(_metrics):
+    """THE ISSUE 14 chaos bar (docstring at the top of this file)."""
+    steps, kill_at = 60, 20
+    max_lag, stale_after = 8, 1.0
+    ids = np.arange(32, dtype=np.int64)
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    prim_proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_PROC_SRC, _REPO,
+         json.dumps({"spec": _COUNT})], env=env,
+        stdout=subprocess.PIPE, text=True)
+    prim_ep = (f"127.0.0.1:"
+               f"{json.loads(prim_proc.stdout.readline())['port']}")
+    stby = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                    replica_of=prim_ep)
+    stby.start()
+    group = f"{prim_ep}|127.0.0.1:{stby.port}"
+    rep = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1",
+                   replica_of=group, replica_mode="read",
+                   stale_after_s=stale_after, wm_interval_s=0.05)
+    rep.start()
+    remote = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1")
+    remote.start()
+    gp = None
+    try:
+        assert stby.replica_ready.wait(15.0)
+        assert rep.replica_ready.wait(15.0)
+        # the geo pusher rides the STANDBY (applies the primary's
+        # stream -> its commit listener sees every mutation; after
+        # promotion it keeps feeding from direct writes) over a seeded
+        # lossy/delayed/cut link
+        chaos.install(chaos.plan_from_spec(
+            "seed=13;delay:push_delta:first=1:every=3:times=0:arg=0.002;"
+            "drop:push_delta_reply:first=2:every=4:times=0;"
+            "cut:push_delta:first=9:every=13:times=0"))
+        gp = GeoPusher(stby, [f"127.0.0.1:{remote.port}"],
+                       interval_s=0.02, **_FAST).start()
+
+        # bounded readers hammer the replica throughout the failover;
+        # acked history (ts, count) comes from the trainer's progress
+        acked = [(time.monotonic(), 0)]
+        read_errors, violations = [], []
+        stop = threading.Event()
+
+        def reader():
+            rd = PSClient([group], mode="read", max_lag=max_lag,
+                          read_replicas=[f"127.0.0.1:{rep.port}"],
+                          **_FAST)
+            try:
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        vals = rd.pull("emb", ids)
+                    except Exception as e:      # noqa: BLE001
+                        read_errors.append(repr(e))
+                        return
+                    a_old = 0
+                    for ts, cnt in acked:
+                        if ts <= t0 - stale_after:
+                            a_old = cnt
+                    vmin = float(vals.min())    # row value == applied count
+                    if vmin < a_old - max_lag:
+                        violations.append((vmin, a_old))
+                    time.sleep(0.002)
+            finally:
+                rd.close()
+
+        rth = threading.Thread(target=reader, daemon=True)
+        rth.start()
+
+        cli = PSClient([group], mode="sync", **_FAST)
+        killed = False
+
+        def step(batch, pull):
+            time.sleep(0.004)
+            return _count_step(batch, pull)
+
+        tr = StreamingTrainer(
+            DataLoader(_Feed(), batch_size=1, collate_fn=_collate),
+            cli, "emb", step, src="stream-chaos")
+        th = threading.Thread(target=tr.run,
+                              kwargs={"max_batches": steps},
+                              daemon=True)
+        th.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            acked.append((time.monotonic(), tr.batches))
+            if not killed and tr.batches >= kill_at:
+                os.kill(prim_proc.pid, signal.SIGKILL)  # mid-stream
+                prim_proc.wait(timeout=10)
+                killed = True
+            if not th.is_alive():
+                break
+            time.sleep(0.01)
+        th.join(timeout=10)
+        assert not th.is_alive() and tr.batches == steps
+        assert killed and stby.promoted
+        acked.append((time.monotonic(), tr.batches))
+
+        # replica converges on the promoted standby's stream
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline \
+                and rep._stats()["watermark"] < steps:
+            time.sleep(0.05)
+        time.sleep(3 * 0.002 + 0.1)
+        stop.set()
+        rth.join(timeout=10)
+
+        # geo: drain over the hostile link, then verify exact delivery
+        gp.drain(timeout=60.0)
+        st = chaos.active().stats_dict()
+        assert any(k.startswith(("drop", "delay", "cut"))
+                   for k in st), st
+        chaos.uninstall()
+
+        # 0 lost / 0 double-applied, three ways: the promoted
+        # standby's applied count, the exact row values, and the
+        # remote cluster's bit-equality after the lossy link
+        assert stby.applied == steps
+        local = stby._tables["emb"].pull(ids)
+        assert np.all(local == float(steps)), local[:, 0]
+        remote_rows = remote._tables["emb"].pull(ids)
+        assert np.array_equal(remote_rows, local)
+        assert remote.dup_acks >= 1      # the dedup really fired
+        # bounded-staleness contract held through the failover
+        assert not read_errors, read_errors
+        assert not violations, violations[:5]
+        # freshness flowed end to end (iwm-stamped records applied at
+        # the read replica)
+        h = monitor.metrics_snapshot()["histograms"]["ps_freshness_ms"]
+        assert h["count"] >= 1
+        cli.close()
+    finally:
+        chaos.uninstall()
+        if gp is not None:
+            gp.stop(drain=False)
+        prim_proc.kill()
+        prim_proc.wait(timeout=10)
+        rep.stop()
+        stby.stop()
+        remote.stop()
+
+
+# ---------------------------------------------------------------------------
+# freshness SLO breach -> flight bundle -> postmortem breach-first
+# ---------------------------------------------------------------------------
+
+class _SlowTable(SparseTable):
+    """A table whose apply stalls — the injected replica stall."""
+
+    def push(self, ids, grads):
+        time.sleep(0.25)
+        super().push(ids, grads)
+
+
+def test_freshness_breach_bundle_and_postmortem(tmp_path, monkeypatch,
+                                                _metrics):
+    monkeypatch.setenv("PADDLE_TRACE_DIR", str(tmp_path))
+    monkeypatch.setattr(flight_recorder, "_dumps_on", True)
+    prim = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1")
+    prim.start()
+    pep = f"127.0.0.1:{prim.port}"
+    rep = PSServer({"emb": _SlowTable(**_COUNT)}, host="127.0.0.1",
+                   replica_of=pep, replica_mode="read",
+                   wm_interval_s=0.05)
+    rep.start()
+    cli = PSClient([pep], mode="sync", **_FAST)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        n0 = len(flight_recorder.bundle_paths())
+        tr = StreamingTrainer(
+            DataLoader(_Feed(), batch_size=1, collate_fn=_collate),
+            cli, "emb", _count_step)
+        tr.run(max_batches=25)   # the slow replica builds real lag
+        watch = FreshnessWatch(max_lag_seq=4, max_lag_seconds=0.5)
+        breached = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and not breached:
+            breached = any(not s["ok"] for s in watch.evaluate())
+            time.sleep(0.1)
+        assert breached, "the stalled replica never breached the SLO"
+        # latched: slo.breach + the online marker, plus a bundle
+        kinds = [e.get("kind") for e in flight_recorder.events()]
+        assert "slo.breach" in kinds
+        assert "online.freshness_breach" in kinds
+        assert len(flight_recorder.bundle_paths()) > n0
+    finally:
+        cli.close()
+        rep.stop()
+        prim.stop()
+    # postmortem renders the breach sorted FIRST among the bad events
+    out = tmp_path / "pm.json"
+    rep_txt = tmp_path / "pm.txt"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "postmortem.py"),
+         "--dir", str(tmp_path), "-o", str(out),
+         "--report", str(rep_txt)],
+        capture_output=True, text=True, cwd=_REPO)
+    assert r.returncode == 0, r.stderr
+    txt = rep_txt.read_text()
+    assert "slo.breach" in txt
+    bad = [ln for ln in txt.splitlines() if "<-- BAD" in ln]
+    assert bad, "no BAD-marked events in the postmortem report"
+    assert any("breach" in ln for ln in bad), bad[:5]
+
+
+# ---------------------------------------------------------------------------
+# the full loop composes: trainer + TTL sweeper + replica, live
+# ---------------------------------------------------------------------------
+
+def test_full_loop_with_ttl_sweeper(_metrics):
+    """Streaming + concurrent TTL sweeps + replica reads coexist: the
+    sweeper never evicts live-refreshed ids, and the replica tracks
+    both the pushes and the evictions."""
+    spec = dict(dim=4, optimizer="adagrad", lr=0.1, seed=7)
+    prim = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1")
+    prim.start()
+    pep = f"127.0.0.1:{prim.port}"
+    rep = PSServer({"emb": SparseTable(**spec)}, host="127.0.0.1",
+                   replica_of=pep, replica_mode="read",
+                   wm_interval_s=0.05)
+    rep.start()
+    cli = PSClient([pep], mode="sync", **_FAST)
+    try:
+        assert rep.replica_ready.wait(10.0)
+        # seed ids the stream will NOT refresh
+        cli.push("emb", np.arange(100, 110, dtype=np.int64),
+                 np.ones((10, 4), np.float32))
+        sweeper = FeatureLifecycle(prim, ttl_s=0.4,
+                                   interval_s=0.1).start()
+
+        def slow_step(b, pull):
+            # ~1.0 s of streaming in total: several sweep intervals
+            # pass, the streamed ids stay refreshed, seeded ones expire
+            time.sleep(0.05)
+            ids = np.asarray(b["ids"]).reshape(-1)
+            return ids, np.ones((ids.size, 4), np.float32)
+
+        tr = StreamingTrainer(
+            DataLoader(_Feed(), batch_size=1, collate_fn=_collate),
+            cli, "emb", slow_step)
+        tr.run(max_batches=20)
+        sweeper.stop()
+        live = prim._tables["emb"]._snapshot_arrays()["ids"]
+        assert set(range(32)) <= set(int(i) for i in live)
+        assert not (set(range(100, 110))
+                    & set(int(i) for i in live)), sorted(live)
+        assert sweeper.evicted >= 10
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and (
+                rep._tables["emb"].version
+                != prim._tables["emb"].version):
+            time.sleep(0.05)
+        assert rep._tables["emb"].version == prim._tables["emb"].version
+        rep_ids = rep._tables["emb"]._snapshot_arrays()["ids"]
+        assert sorted(int(i) for i in rep_ids) \
+            == sorted(int(i) for i in live)
+    finally:
+        cli.close()
+        rep.stop()
+        prim.stop()

@@ -599,6 +599,123 @@ def test_segment_sum_feeds_device_cache_push():
 
 
 # ---------------------------------------------------------------------
+# kernel 4b: SORTED-segment variant for vocab-scale nseg (ISSUE 14
+# satellite — PR 13's named follow-up)
+# ---------------------------------------------------------------------
+
+def test_segment_sum_sorted_registered_with_ref():
+    ks = kreg.kernels()
+    assert "segment_sum_sorted" in ks
+    assert ks["segment_sum_sorted"].tolerance
+    assert callable(ks["segment_sum_sorted"].xla_ref_fn)
+
+
+def test_segment_sum_sorted_vocab_scale_parity():
+    """The point of the variant: nseg far beyond what the sequential
+    kernel's whole-output-in-VMEM budget allows, exact vs the XLA
+    reference (per-segment accumulation order equals row order)."""
+    from paddle_tpu.ops.pallas.segment_sum import (
+        _eligible, segment_sum_sorted_pallas, segment_sum_sorted_ref)
+    rng = np.random.default_rng(21)
+    nseg, n, dim = 200_000, 256, 16
+    assert not _eligible(np.zeros((n, dim), np.float32), None, nseg), \
+        "vocab-scale nseg should NOT be sequential-kernel eligible"
+    seg = np.sort(rng.integers(0, nseg, n)).astype(np.int64)
+    g = _rand(rng, n, dim)
+    ref = segment_sum_sorted_ref(jnp.asarray(g), jnp.asarray(seg), nseg)
+    ker = segment_sum_sorted_pallas(jnp.asarray(g), jnp.asarray(seg),
+                                    nseg, interpret=True)
+    assert ker.shape == (nseg, dim)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(ker),
+                               atol=1e-6)
+
+
+def test_segment_sum_sorted_integer_grads_bit_exact():
+    from paddle_tpu.ops.pallas.segment_sum import (
+        segment_sum_sorted_pallas, segment_sum_sorted_ref)
+    rng = np.random.default_rng(22)
+    for nseg, n in ((6000, 64), (513, 9), (4096, 8)):
+        seg = np.sort(rng.integers(0, nseg, n)).astype(np.int64)
+        g = rng.integers(-50, 50, (n, 5)).astype(np.float32)
+        ref = segment_sum_sorted_ref(jnp.asarray(g), jnp.asarray(seg),
+                                     nseg)
+        ker = segment_sum_sorted_pallas(jnp.asarray(g),
+                                        jnp.asarray(seg), nseg,
+                                        interpret=True)
+        assert np.array_equal(np.asarray(ref), np.asarray(ker)), nseg
+
+
+def test_merge_segments_picks_kernel_by_segment_count():
+    """The streaming trainer's pre-merge dispatch: recsys-scale nseg
+    takes the sequential kernel, vocab-scale the sorted one — and both
+    produce the reference merge (stable sort preserves within-segment
+    row order, so integer grads stay bit-exact)."""
+    from paddle_tpu.ops.pallas.segment_sum import (SORTED_NSEG_MIN,
+                                                   merge_segments)
+    kreg.reset_dispatch_counts()
+    rng = np.random.default_rng(23)
+    # small: sequential kernel
+    ids = rng.integers(0, 40, 128)
+    uniq, inv = np.unique(ids, return_inverse=True)
+    g = rng.integers(-8, 8, (128, 4)).astype(np.float32)
+    out = np.asarray(merge_segments(g, inv, int(uniq.size)))
+    want = np.zeros((uniq.size, 4), np.float32)
+    np.add.at(want, inv, g)
+    assert np.array_equal(out, want)
+    assert kreg.dispatch_counts("segment_sum"), \
+        kreg.dispatch_counts()
+    assert not kreg.dispatch_counts("segment_sum_sorted")
+    # vocab-scale: sorted kernel (UNSORTED inverse goes in — the
+    # helper sorts)
+    nseg = SORTED_NSEG_MIN + 1000
+    inv2 = rng.integers(0, nseg, 128).astype(np.int64)
+    g2 = rng.integers(-8, 8, (128, 4)).astype(np.float32)
+    out2 = np.asarray(merge_segments(g2, inv2, nseg))
+    want2 = np.zeros((nseg, 4), np.float32)
+    np.add.at(want2, inv2, g2)
+    assert np.array_equal(out2, want2)
+    assert kreg.dispatch_counts("segment_sum_sorted"), \
+        kreg.dispatch_counts()
+
+
+def test_streaming_trainer_device_merge_matches_numpy():
+    """StreamingTrainer(device_merge=True) pre-merges duplicate ids
+    through the pallas tier; the pushed (ids, grads) must equal the
+    numpy merge bit-for-bit (integer grads)."""
+    from paddle_tpu.online.streaming import StreamingTrainer
+
+    class _Sink:
+        def __init__(self):
+            self.calls = []
+
+        def push_stamped(self, table, ids, grads, seq, src=None,
+                         wm=None):
+            self.calls.append((np.asarray(ids), np.asarray(grads)))
+            return True
+
+        def pull(self, table, ids):
+            return np.zeros((np.asarray(ids).size, 4), np.float32)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 100, 8192).astype(np.int64)
+    grads = rng.integers(-4, 4, (8192, 4)).astype(np.float32)
+
+    def run(device_merge):
+        sink = _Sink()
+        tr = StreamingTrainer(
+            [ {"ids": ids} ], sink, "emb",
+            lambda b, pull: (b["ids"], grads),
+            merge_duplicates=True, device_merge=device_merge)
+        tr.run(max_batches=1)
+        return sink.calls[0]
+
+    i1, g1 = run(False)
+    i2, g2 = run(True)
+    assert np.array_equal(i1, i2)
+    assert np.array_equal(g1, g2)
+
+
+# ---------------------------------------------------------------------
 # GraftLint: pallas custom calls are kernels, not host callbacks
 # ---------------------------------------------------------------------
 

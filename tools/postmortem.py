@@ -60,11 +60,14 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 # check miscounted and shed one admission — shed-class anomaly.
 # fleet.straggler / fleet.stale: the aggregator's view of a process
 # falling behind or going dark.
+# online.freshness_breach (ISSUE 14): the online loop's end-to-end
+# freshness SLO failed — a stalled stream's autopsy starts there.
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
               "ps.replica_error", "serve.shed", "serve.evict",
               "elastic.leave", "ps.read_stale_exhausted",
               "slo.breach", "serve.admit_rollback",
-              "fleet.straggler", "fleet.stale"}
+              "fleet.straggler", "fleet.stale",
+              "online.freshness_breach"}
 
 
 def _is_bad(ev: dict) -> bool:

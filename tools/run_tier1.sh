@@ -96,6 +96,13 @@ if [ "$CHAOS" -eq 1 ]; then
     # flagging, SLO burn-rate breaches dumping flight bundles, and the
     # per-request trace lanes — the whole e2e runs subprocess PS
     # servers and an artificially delayed replica.
+    # test_online_loop.py / test_feature_lifecycle.py /
+    # test_geo_conflict.py are the ONLINE LEARNING LOOP suite (ISSUE
+    # 14): streaming trainer kill/resume exactly-once (cursor-derived
+    # idempotency stamps + primary SIGKILL + lossy geo link, shadow-
+    # table accounting), TTL eviction replicated down the mutation
+    # stream, and the bidirectional conflict policies (additive /
+    # last-writer-wins) converging to their fixed points.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
@@ -104,7 +111,8 @@ if [ "$CHAOS" -eq 1 ]; then
         tests/test_geo.py tests/test_coordinator_ha.py \
         tests/test_serving_ps.py tests/test_prefix_cache.py \
         tests/test_spec_decode.py tests/test_kv_int8.py \
-        tests/test_fleet_observatory.py \
+        tests/test_fleet_observatory.py tests/test_online_loop.py \
+        tests/test_feature_lifecycle.py tests/test_geo_conflict.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
