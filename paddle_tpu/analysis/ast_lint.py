@@ -91,6 +91,14 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/online/streaming.py",
     "paddle_tpu/online/lifecycle.py",
     "paddle_tpu/online/freshness.py",
+    # ISSUE 15: the auto-sharding planner (SpecLayout + search +
+    # calibration — the verify path builds/compiles steps, so the
+    # tracing-hazard rules apply)
+    "paddle_tpu/distributed/planner/__init__.py",
+    "paddle_tpu/distributed/planner/spec_layout.py",
+    "paddle_tpu/distributed/planner/memory_model.py",
+    "paddle_tpu/distributed/planner/search.py",
+    "paddle_tpu/distributed/planner/calibrate.py",
     # ISSUE 13: the Pallas kernel tier (registry locking + kernels)
     "paddle_tpu/ops/pallas/__init__.py",
     "paddle_tpu/ops/pallas/registry.py",

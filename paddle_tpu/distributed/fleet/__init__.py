@@ -54,3 +54,8 @@ __all__ += ["elastic", "ElasticCoordinator", "ElasticClient",
 from . import geo  # noqa: F401,E402
 from .geo import GeoPusher  # noqa: F401,E402
 __all__ += ["geo", "GeoPusher"]
+# auto-sharding planner (ISSUE 15): fleet.auto(model, chips=N) returns
+# the ranked, memory-predicted (optionally XLA-verified) mesh plans
+from ..planner import auto  # noqa: E402
+from ..planner.search import Plan, Planner  # noqa: E402
+__all__ += ["auto", "Plan", "Planner"]

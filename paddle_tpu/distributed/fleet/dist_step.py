@@ -387,19 +387,11 @@ def param_partition_spec(value, mesh, annotated: Optional[P],
     the largest remaining dim that the 'fsdp' axis divides (the reference's
     sharding_optimizer partitions whole params by numel round-robin,
     sharding/shard.py — per-dim sharding is the XLA-friendly equivalent).
-    """
-    ndim = len(value.shape)
-    spec = list(annotated) if annotated is not None else [None] * ndim
-    spec += [None] * (ndim - len(spec))
-    fsdp = mesh.shape.get("fsdp", 1)
-    if zero3 and fsdp > 1:
-        dims = sorted(range(ndim), key=lambda d: -value.shape[d])
-        for d in dims:
-            if spec[d] is None and value.shape[d] % fsdp == 0 \
-                    and value.shape[d] >= fsdp:
-                spec[d] = "fsdp"
-                break
-    return P(*spec)
+    The derivation itself lives in SpecLayout (ISSUE 15): the planner
+    scores candidate meshes with the identical rule."""
+    from ..planner.spec_layout import get_layout
+    fsdp = mesh.shape.get("fsdp", 1) if zero3 else 1
+    return get_layout().zero3_augment(tuple(value.shape), annotated, fsdp)
 
 
 class DistributedTrainStep:
@@ -543,34 +535,37 @@ class DistributedTrainStep:
 
     def _opt_state_specs(self, opt_state, pspecs):
         """Moment tensors follow their parameter's spec; under ZeRO-1/2
-        (params replicated) moments still shard over 'fsdp'."""
+        (params replicated) moments still shard over 'fsdp' (the
+        'optimizer moments' role of the SpecLayout registry)."""
+        from ..planner.spec_layout import get_layout
+        lay = get_layout()
         mesh = self._mesh
-        zero = self._zero_stage >= 1
+        fsdp = mesh.shape.get("fsdp", 1)
         out = []
         for name, st in zip(self._param_names, opt_state):
             p = self._params[name]
             d = {}
             for k, v in st.items():
                 if hasattr(v, "shape") and v.shape == p._value.shape:
-                    d[k] = pspecs[name] if self._zero_stage >= 3 else \
-                        (param_partition_spec(v, mesh,
-                                              getattr(p, "dist_spec", None),
-                                              zero3=True) if zero
-                         else pspecs[name])
+                    d[k] = lay.moment_spec(
+                        tuple(v.shape), getattr(p, "dist_spec", None),
+                        pspecs[name], self._zero_stage, fsdp)
                 else:
-                    d[k] = P()
+                    d[k] = lay.replicated()
             out.append(d)
         return out
 
     def _batch_spec_tree(self, vals):
+        from ..planner.spec_layout import get_layout
+        lay = get_layout()
         data_axes = mesh_mod.data_axes(self._mesh)
         nshard = int(np.prod([self._mesh.shape[a] for a in data_axes]))
 
         def spec(v):
             if hasattr(v, "ndim") and v.ndim >= 1 \
                     and v.shape[0] % nshard == 0:
-                return P(data_axes, *([None] * (v.ndim - 1)))
-            return P()
+                return lay.batch(v.ndim, data_axes)
+            return lay.replicated()
         return jax.tree_util.tree_map(spec, vals)
 
     def _shardings(self, tree_of_specs):
@@ -615,12 +610,12 @@ class DistributedTrainStep:
                 "strategy.dgc cannot combine with float16 loss scaling or "
                 "gradient_merge (the reference treats DGC as its own meta "
                 "optimizer too)")
-        if self._guard_health and (self._use_dgc or k_steps > 1):
+        if self._guard_health and self._use_dgc:
             raise NotImplementedError(
-                "guard_health covers the plain and fp16-loss-scaling "
-                "steps (bf16 AMP / ZeRO / TP / PP); DGC and "
-                "gradient_merge accumulate state a per-microbatch "
-                "health vector would misrepresent")
+                "guard_health covers the plain, fp16-loss-scaling and "
+                "gradient_merge steps (bf16 AMP / ZeRO / TP / PP); "
+                "DGC's error-feedback accumulators still need a "
+                "health-vector design (ROADMAP)")
 
         def _amp_cast(tree):
             return jax.tree_util.tree_map(
@@ -882,9 +877,25 @@ class DistributedTrainStep:
                 return loss, new_p, nbufs, new_s
             donate = (0, 1, 2)
         else:
+            guard_health = self._guard_health
+
             def step(pvals, bufs, opt_state, accum, i, lr, key, args):
                 loss, nbufs, grads = grads_of(pvals, bufs, key, args)
                 accum = jax.tree_util.tree_map(jnp.add, accum, grads)
+                if guard_health:
+                    # ISSUE 15 satellite (ROADMAP gap): the health
+                    # vector is computed over the POST-ADD accumulator
+                    # — the per-microbatch vector FOLDED across the
+                    # accumulation window.  A poisoned microbatch
+                    # taints the accumulated gradient until the window
+                    # applies-and-zeroes, so TrainGuard sees exactly
+                    # the state the optimizer is about to consume at
+                    # the apply tick, and the vector resets with the
+                    # window.  Loss is the current microbatch's.
+                    from ...train_guard import fused_health
+                    health = fused_health(
+                        jax.tree_util.tree_leaves(accum), loss=loss,
+                        precise=False)
                 do_apply = (i + 1) % k_steps == 0
 
                 def apply_branch(op):
@@ -903,6 +914,8 @@ class DistributedTrainStep:
                 new_p, accum, new_s = jax.lax.cond(
                     do_apply, apply_branch, skip_branch,
                     (pvals, accum, opt_state))
+                if guard_health:
+                    return loss, new_p, nbufs, new_s, accum, health
                 return loss, new_p, nbufs, new_s, accum
             donate = (0, 1, 2, 3)
 
@@ -970,6 +983,8 @@ class DistributedTrainStep:
             gspecs = pspecs  # accumulators shard like their params
             in_specs += [gspecs, P(), P(), P(), bspec]
             out_specs += [gspecs]
+            if self._guard_health:
+                out_specs += [P()]   # the folded health vector (f32[3])
         else:
             in_specs += [P(), P(), bspec]
             if self._guard_health:
@@ -1239,6 +1254,10 @@ class DistributedTrainStep:
             elif self._use_dgc:
                 (loss, new_p, new_b, new_s, self._dgc_state,
                  self._key_dev, self._step_dev) = self._compiled(*call_args)
+            elif self._k_steps > 1 and self._guard_health:
+                (loss, new_p, new_b, new_s, self._accum,
+                 self.last_health, self._key_dev,
+                 self._step_dev) = self._compiled(*call_args)
             elif self._k_steps > 1:
                 (loss, new_p, new_b, new_s, self._accum,
                  self._key_dev, self._step_dev) = self._compiled(*call_args)

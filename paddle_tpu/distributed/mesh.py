@@ -22,6 +22,11 @@ ep    expert parallel (MoE)
 ``init_mesh`` builds the global mesh once from degrees; everything else
 (fleet strategies, parallel layers, collective API) reads it through
 ``get_mesh()``.
+
+Spec construction lives in ONE place (ISSUE 15): this module mints no
+PartitionSpecs of its own — ``batch_spec`` and the per-dim constraint
+helpers delegate to :mod:`paddle_tpu.distributed.planner.spec_layout`,
+the canonical role registry the auto-sharding planner shares.
 """
 from __future__ import annotations
 
@@ -33,14 +38,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .planner.spec_layout import AXES, get_layout as _layout
+
 __all__ = [
     "AXES", "init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
     "data_axes", "batch_spec", "named_sharding", "maybe_constrain",
     "reform_mesh",
 ]
-
-# canonical axis order: batch-like axes first, then model axes
-AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 
 _global_mesh: Optional[Mesh] = None
 
@@ -129,8 +133,9 @@ def data_axes(mesh: Optional[Mesh] = None):
 
 
 def batch_spec(ndim: int, mesh: Optional[Mesh] = None) -> PartitionSpec:
-    """PartitionSpec sharding dim0 over the data axes."""
-    return PartitionSpec(data_axes(mesh), *([None] * (ndim - 1)))
+    """PartitionSpec sharding dim0 over the data axes (the 'batch'
+    activation role of the SpecLayout registry)."""
+    return _layout().batch(ndim, data_axes(mesh))
 
 
 def named_sharding(spec: PartitionSpec,
@@ -160,15 +165,14 @@ def constrain_dim(x, dim: int, axis):
         return x
     try:
         if isinstance(x, jax.core.Tracer):
-            spec = [PartitionSpec.UNCONSTRAINED] * x.ndim
-            spec[dim] = axis
+            spec = _layout().dim_spec(x.ndim, dim, axis,
+                                      unconstrained_rest=True)
             return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, PartitionSpec(*spec)))
+                x, NamedSharding(mesh, spec))
         # concrete array: actually lay it out (UNCONSTRAINED is only
         # meaningful under jit; eager device_put needs explicit Nones)
-        spec = [None] * x.ndim
-        spec[dim] = axis
-        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+        spec = _layout().dim_spec(x.ndim, dim, axis)
+        return jax.device_put(x, NamedSharding(mesh, spec))
     except ValueError:
         return x
 
@@ -192,9 +196,7 @@ def maybe_constrain(x, spec: Optional[PartitionSpec]):
                 x, NamedSharding(mesh, spec))
         # concrete: UNCONSTRAINED is only meaningful under jit — map those
         # entries to None (replicated) for an actual device_put layout
-        concrete_spec = PartitionSpec(
-            *(None if s is PartitionSpec.UNCONSTRAINED else s
-              for s in spec))
-        return jax.device_put(x, NamedSharding(mesh, concrete_spec))
+        return jax.device_put(
+            x, NamedSharding(mesh, _layout().concrete(spec)))
     except (ValueError, KeyError):
         return x

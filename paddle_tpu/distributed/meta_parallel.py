@@ -26,6 +26,7 @@ import paddle_tpu.nn.functional as F
 from ..nn.initializer import Constant, Normal, XavierNormal
 from ..nn.layer.layers import Layer, Parameter
 from . import mesh as mesh_mod
+from .planner.spec_layout import get_layout as _layout
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
@@ -69,9 +70,10 @@ class ColumnParallelLinear(Layer):
         self.gather_output = gather_output
         init = weight_attr if callable(weight_attr) else XavierNormal()
         self.weight = mark_sharding(
-            Parameter(init((in_features, out_features))), P(None, "tp"))
+            Parameter(init((in_features, out_features))),
+            _layout().param_spec("col_linear"))
         self.bias = (mark_sharding(Parameter(Constant(0.0)((out_features,))),
-                                   P("tp"))
+                                   _layout().param_spec("col_bias"))
                      if has_bias else None)
 
     def forward(self, x):
@@ -83,9 +85,10 @@ class ColumnParallelLinear(Layer):
             # leading dims UNCONSTRAINED: a None there would force the
             # batch replicated, clobbering its dp/fsdp sharding with a
             # full reshard inside compiled programs
-            lead = [P.UNCONSTRAINED] * (v.ndim - 1)
-            spec = (P(*lead, None) if self.gather_output
-                    else P(*lead, "tp"))
+            axis = (None if self.gather_output
+                    else _layout().act_axis("col_out"))
+            spec = _layout().dim_spec(v.ndim, v.ndim - 1, axis,
+                                      unconstrained_rest=True)
             return mesh_mod.maybe_constrain(v, spec)
 
         out = _apply(_constrain, y)
@@ -94,9 +97,10 @@ class ColumnParallelLinear(Layer):
             # eager mode must really gather (docstring contract: result
             # replicated for host reads); the autograd tape is already
             # recorded, so resharding the forward value is grad-neutral
-            lead = [None] * (out._value.ndim - 1)
-            out._value = mesh_mod.maybe_constrain(out._value,
-                                                  P(*lead, None))
+            out._value = mesh_mod.maybe_constrain(
+                out._value,
+                _layout().dim_spec(out._value.ndim,
+                                   out._value.ndim - 1, None))
         return out
 
 
@@ -116,7 +120,8 @@ class RowParallelLinear(Layer):
         self.input_is_parallel = input_is_parallel
         init = weight_attr if callable(weight_attr) else XavierNormal()
         self.weight = mark_sharding(
-            Parameter(init((in_features, out_features))), P("tp", None))
+            Parameter(init((in_features, out_features))),
+            _layout().param_spec("row_linear"))
         self.bias = Parameter(Constant(0.0)((out_features,))) \
             if has_bias else None
 
@@ -139,7 +144,8 @@ class VocabParallelEmbedding(Layer):
         self._embedding_dim = embedding_dim
         init = weight_attr if callable(weight_attr) else Normal(0.0, 0.02)
         self.weight = mark_sharding(
-            Parameter(init((num_embeddings, embedding_dim))), P("tp", None))
+            Parameter(init((num_embeddings, embedding_dim))),
+            _layout().param_spec("embedding"))
 
     def forward(self, x):
         return F.embedding(x, self.weight)

@@ -26,9 +26,9 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_mod
+from .planner.spec_layout import get_layout as _layout
 
 __all__ = ["gpipe_spmd", "pipeline_apply", "num_stages",
            "one_f_one_b_spmd", "pipeline_train_1f1b", "schedule_ticks",
@@ -241,13 +241,13 @@ def pipeline_train_1f1b(stage_fn: Callable, stacked_params: Any, hidden,
 
         return one_f_one_b_spmd(stage_fn, params, xm, cot, num_stages=S)
 
-    p_spec = _tmap(lambda v: P(*(("pp",) + (None,) * (v.ndim - 1))),
-                   stacked_params)
-    rep_x = _tmap(lambda v: P(), x_mb)
-    rep_y = _tmap(lambda v: P(), y_mb)
-    sm = jax.shard_map(mapped, mesh=mesh, axis_names={"pp"},
+    lay = _layout()
+    p_spec = _tmap(lambda v: lay.stack(None, v.ndim), stacked_params)
+    rep_x = _tmap(lambda v: lay.replicated(), x_mb)
+    rep_y = _tmap(lambda v: lay.replicated(), y_mb)
+    sm = jax.shard_map(mapped, mesh=mesh, axis_names={lay.stack_axis},
                        in_specs=(p_spec, rep_x, rep_y),
-                       out_specs=(P(), p_spec, rep_x),
+                       out_specs=(lay.replicated(), p_spec, rep_x),
                        check_vma=False)
     loss, dstacked, dx_mb = jax.jit(sm)(stacked_params, x_mb, y_mb)
     dhidden = dx_mb.reshape((B,) + tuple(dx_mb.shape[2:]))
@@ -308,10 +308,10 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, hidden,
     def mapped(params, pl):
         return gpipe_spmd(sf, params, pl, num_stages=S)
 
-    p_spec = _tmap(lambda v: P(*(("pp",) + (None,) * (v.ndim - 1))),
-                   stacked_params)
-    rep = _tmap(lambda v: P(), payload)
-    sm = jax.shard_map(mapped, mesh=mesh, axis_names={"pp"},
+    lay = _layout()
+    p_spec = _tmap(lambda v: lay.stack(None, v.ndim), stacked_params)
+    rep = _tmap(lambda v: lay.replicated(), payload)
+    sm = jax.shard_map(mapped, mesh=mesh, axis_names={lay.stack_axis},
                        in_specs=(p_spec, rep), out_specs=rep,
                        check_vma=False)
     # partial-manual shard_map only has a jit lowering path (the eager

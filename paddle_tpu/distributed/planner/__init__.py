@@ -1,0 +1,54 @@
+"""paddle_tpu.distributed.planner — auto-sharding planner (ISSUE 15).
+
+Two halves:
+
+* :mod:`spec_layout` — SpecLayout, the ONE registry of canonical
+  per-tensor-role PartitionSpecs over the named ``data/fsdp/tp/sp/pp``
+  mesh axes.  ``mesh.py`` / ``meta_parallel.py`` / ``pipeline.py`` and
+  the model code consume it; nothing else hand-builds specs.
+* :mod:`search` (+ :mod:`memory_model`, :mod:`calibrate`) — the
+  planner: enumerate valid ``pp x fsdp x tp x sp`` factorizations of a
+  chip count, score each with a fast analytic memory/collective model,
+  verify the top-k by AOT lower-and-memory-analyze (the
+  ``compile_abstract`` + XLA memory-analysis path the MULTICHIP
+  dryruns use — no devices needed), and return a ranked list of
+  lowerable configs with predicted per-device peak HBM and a
+  FITS/EXCEEDS verdict.  Exposed as ``fleet.auto(...)`` and the
+  ``tools/plan.py`` CLI.
+
+This ``__init__`` keeps the heavy half lazy (PEP 562): ``mesh.py``
+imports :mod:`spec_layout` through the package, and the search half
+imports ``mesh``/``dist_step`` — eager imports would cycle.
+"""
+from __future__ import annotations
+
+from . import spec_layout  # noqa: F401  (light; mesh.py depends on it)
+from .spec_layout import (  # noqa: F401
+    ACT_ROLES, AXES, PARAM_ROLES, SpecLayout, get_layout, set_layout,
+)
+
+__all__ = [
+    "AXES", "PARAM_ROLES", "ACT_ROLES", "SpecLayout", "get_layout",
+    "set_layout",
+    # lazy (PEP 562): the planner half
+    "ModelSpec", "TrainSpec", "MemoryBreakdown", "Plan", "Planner",
+    "auto", "enumerate_meshes", "PROXY_SUITE", "Calibration",
+    "CalibrationError",
+]
+
+_LAZY = {
+    "ModelSpec": "memory_model", "TrainSpec": "memory_model",
+    "MemoryBreakdown": "memory_model", "PROXY_SUITE": "memory_model",
+    "Plan": "search", "Planner": "search", "auto": "search",
+    "enumerate_meshes": "search",
+    "Calibration": "calibrate", "CalibrationError": "calibrate",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

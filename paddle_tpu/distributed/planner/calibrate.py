@@ -1,0 +1,134 @@
+"""Calibration hook — predicted-vs-observed peak memory, measured.
+
+The planner's analytic model (:mod:`memory_model`) is an estimate; the
+flight recorder's compile observatory (ISSUE 7) logs XLA's own memory
+analysis for every executable a run actually built.  This module closes
+the loop: it reads ``compile_log()`` records — through the **versioned
+memory schema** (``flight_recorder.MEM_SCHEMA_VERSION`` /
+``MEM_SCHEMA_KEYS``, ISSUE 15 satellite) — and turns them into
+
+* an error report (median/max relative error of a predicted peak vs the
+  observed peaks), so the model's accuracy is *measured and reported,
+  not assumed*, and
+* a ``temp_scale`` correction the planner can apply to the activation
+  half of subsequent analytic scores (state bytes are exact by
+  construction; only the temp half is estimated).
+
+Schema discipline: a record that carries ANY ``*_bytes`` count must
+carry the full ``MEM_SCHEMA_KEYS`` set and the matching
+``mem_schema`` version.  A field rename or version bump upstream makes
+:class:`Calibration` raise :class:`CalibrationError` instead of
+silently zeroing the calibration (the failure mode this schema exists
+to prevent; drift test in tests/test_flight_recorder.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Calibration", "CalibrationError", "CalibrationReport"]
+
+
+class CalibrationError(RuntimeError):
+    """A compile-log record does not match the memory schema the
+    calibration consumes (renamed/missing keys or a version bump) —
+    raised loudly so drift can't silently zero the calibration."""
+
+
+@dataclasses.dataclass
+class CalibrationReport:
+    n_observations: int
+    predicted_peak_bytes: int
+    median_rel_err: Optional[float]    # (observed - predicted)/observed
+    max_abs_rel_err: Optional[float]
+    temp_scale: float                  # correction for analytic temps
+
+    def asdict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def _validate(rec: dict) -> Optional[dict]:
+    """Return the record's byte counts if it carries memory info; None
+    if it carries none; raise CalibrationError on schema drift."""
+    from ...observability import flight_recorder as _fr
+    byte_keys = [k for k in rec if k.endswith("_bytes")]
+    if not byte_keys:
+        return None
+    ver = rec.get("mem_schema")
+    if ver != _fr.MEM_SCHEMA_VERSION:
+        raise CalibrationError(
+            f"compile-log record {rec.get('program')!r}/"
+            f"{rec.get('cause')!r} carries byte counts but mem_schema="
+            f"{ver!r} (expected {_fr.MEM_SCHEMA_VERSION}); the "
+            "recorder's schema moved — update planner/calibrate.py "
+            "alongside it")
+    missing = [k for k in _fr.MEM_SCHEMA_KEYS if k not in rec]
+    if missing:
+        raise CalibrationError(
+            f"compile-log record {rec.get('program')!r}/"
+            f"{rec.get('cause')!r} is missing schema keys {missing} — "
+            "a field rename upstream would silently zero the "
+            "calibration; fix the record writer or bump the schema")
+    return {k: int(rec[k]) for k in _fr.MEM_SCHEMA_KEYS}
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Observed per-executable memory from real compile trajectories."""
+
+    observations: List[Dict] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_compile_log(cls, records: Optional[Sequence[dict]] = None,
+                         program: Optional[str] =
+                         "DistributedTrainStep",
+                         cause: Optional[str] = None) -> "Calibration":
+        """Build from flight-recorder compile records (default: this
+        process's ``compile_log(resolve=True)``).  ``program``/``cause``
+        filter which records count (None = any)."""
+        if records is None:
+            from ...observability.flight_recorder import compile_log
+            records = compile_log(resolve=True)
+        obs = []
+        for rec in records:
+            if program is not None and rec.get("program") != program:
+                continue
+            if cause is not None and rec.get("cause") != cause:
+                continue
+            mem = _validate(rec)
+            if mem is None:
+                continue
+            mem["program"] = rec.get("program")
+            mem["cause"] = rec.get("cause")
+            obs.append(mem)
+        return cls(observations=obs)
+
+    # -- reporting ----------------------------------------------------
+    def report(self, predicted_peak_bytes: int,
+               predicted_temp_bytes: Optional[int] = None
+               ) -> CalibrationReport:
+        """Predicted-vs-observed error + the temp correction.
+
+        ``temp_scale`` solves ``pred_args + s * pred_temps ==
+        median(observed_peak)`` when the temp split is given (args are
+        exact accounting), else falls back to the peak ratio."""
+        peaks = [o["peak_bytes"] for o in self.observations
+                 if o["peak_bytes"] > 0]
+        if not peaks:
+            return CalibrationReport(0, int(predicted_peak_bytes),
+                                     None, None, 1.0)
+        errs = [(p - predicted_peak_bytes) / p for p in peaks]
+        med_peak = statistics.median(peaks)
+        if predicted_temp_bytes and predicted_temp_bytes > 0:
+            pred_args = predicted_peak_bytes - predicted_temp_bytes
+            scale = max(0.1, (med_peak - pred_args)
+                        / predicted_temp_bytes)
+        else:
+            scale = med_peak / max(predicted_peak_bytes, 1)
+        return CalibrationReport(
+            n_observations=len(peaks),
+            predicted_peak_bytes=int(predicted_peak_bytes),
+            median_rel_err=float(statistics.median(errs)),
+            max_abs_rel_err=float(max(abs(e) for e in errs)),
+            temp_scale=float(scale))

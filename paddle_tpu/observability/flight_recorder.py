@@ -85,7 +85,7 @@ __all__ = ["FlightRecorder", "Watchdog", "enabled", "dumps_enabled",
            "progress_age", "note_compile", "compile_log", "dump",
            "maybe_dump", "events", "in_flight", "clear",
            "install_handlers", "bundle_paths", "enable_from_env",
-           "recorder"]
+           "recorder", "MEM_SCHEMA_VERSION", "MEM_SCHEMA_KEYS"]
 
 _lock = threading.Lock()
 
@@ -140,6 +140,19 @@ _COMPILE_LOG_CAP = 256
 _MEM_ATTRS = ("argument_size_in_bytes", "output_size_in_bytes",
               "temp_size_in_bytes", "alias_size_in_bytes",
               "generated_code_size_in_bytes")
+
+# ---- compile-log memory schema (ISSUE 15 satellite) ------------------
+# Machine-readable contract for the byte counts a compile-log record
+# carries.  Consumers (the planner's calibration hook,
+# distributed/planner/calibrate.py) key on MEM_SCHEMA_KEYS and check
+# ``mem_schema == MEM_SCHEMA_VERSION`` — a field rename or semantics
+# change MUST bump the version so downstream readers fail loudly
+# instead of silently zeroing their calibration (shape-drift test:
+# tests/test_flight_recorder.py).  Every record that carries ANY byte
+# count carries ALL of MEM_SCHEMA_KEYS (absent analysis attrs emit 0).
+MEM_SCHEMA_VERSION = 1
+MEM_SCHEMA_KEYS = ("argument_bytes", "output_bytes", "temp_bytes",
+                   "alias_bytes", "peak_bytes")
 
 
 def _dir() -> str:
@@ -365,6 +378,12 @@ def _mem_stats(compiled) -> Optional[dict]:
                          + out.get("output_bytes", 0)
                          + out.get("temp_bytes", 0)
                          - out.get("alias_bytes", 0))
+    # stable schema (MEM_SCHEMA_KEYS): every byte-carrying record has
+    # the full key set + version stamp, so calibration readers can
+    # detect drift instead of silently reading zeros
+    for k in MEM_SCHEMA_KEYS:
+        out.setdefault(k, 0)
+    out["mem_schema"] = MEM_SCHEMA_VERSION
     return out
 
 

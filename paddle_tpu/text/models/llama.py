@@ -30,10 +30,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 import paddle_tpu.nn.functional as F
 from ...distributed import mesh as mesh_mod
+from ...distributed.planner.spec_layout import get_layout as _layout
 from ...distributed.meta_parallel import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
 from ...framework.core import Tensor, _apply
@@ -190,7 +190,7 @@ class LlamaAttention(Layer):
             qh = _rope(qh, pos, c.rope_theta)
             kh = _rope(kh, pos, c.rope_theta)
             # heads stay sharded over 'tp' through the attention
-            qh = mesh_mod.constrain_dim(qh, 2, "tp")
+            qh = mesh_mod.constrain_dim(qh, 2, _layout().act_axis("attn_heads"))
             if c.kv_heads != c.num_attention_heads:
                 rep = c.num_attention_heads // c.kv_heads
                 kh = jnp.repeat(kh, rep, axis=2)
@@ -243,7 +243,7 @@ class LlamaAttention(Layer):
             vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
             qh = _rope(qh, pos, c.rope_theta)
             kh = _rope(kh, pos, c.rope_theta)
-            qh = mesh_mod.constrain_dim(qh, 2, "tp")  # heads stay sharded
+            qh = mesh_mod.constrain_dim(qh, 2, _layout().act_axis("attn_heads"))  # heads stay sharded
             bidx = jnp.arange(B)[:, None]
             kbuf = kbuf.at[bidx, pos].set(kh.astype(kbuf.dtype))
             vbuf = vbuf.at[bidx, pos].set(vh.astype(vbuf.dtype))
@@ -348,7 +348,7 @@ class LlamaAttention(Layer):
             vh = vv.reshape(B, S, c.kv_heads, c.head_dim)
             qh = _rope(qh, pos, c.rope_theta)
             kh = _rope(kh, pos, c.rope_theta)
-            qh = mesh_mod.constrain_dim(qh, 2, "tp")  # heads stay sharded
+            qh = mesh_mod.constrain_dim(qh, 2, _layout().act_axis("attn_heads"))  # heads stay sharded
             # scatter this call's K/V into the pools: physical block =
             # table[logical block], offset = pos % block_size; masked
             # writes divert to the trash block (0, 0)
@@ -528,8 +528,7 @@ class StackedLlamaDecoder(Layer):
                 stacked = Parameter(jnp.stack(vals))
             ann = getattr(dict(proto.named_parameters())[n], "dist_spec",
                           None)
-            spec = P("pp", *(tuple(ann) if ann is not None
-                             else (None,) * (stacked._value.ndim - 1)))
+            spec = _layout().stack(ann, stacked._value.ndim)
             mark_sharding(stacked, spec)
             self.add_parameter(n.replace(".", "__"), stacked)
 
@@ -615,8 +614,21 @@ class LlamaModel(Layer):
         hidden = self.embed_tokens(input_ids)
         if c.compute_dtype:
             hidden = hidden.astype(c.compute_dtype)
+        # re-anchor the batch sharding on the embedded activations
+        # (ISSUE 15, found by the planner's verify phase): on non-pp
+        # hybrid meshes XLA's propagation otherwise GUESSES from the
+        # gather output and replicated the ENTIRE activation path —
+        # full-batch scores/logits on every device (measured: a
+        # 16-row proxy on fsdp8 spent 224 MiB/device of temps where
+        # sharded accounting says 26).  pipeline.py's split() applies
+        # the same cure after its microbatch reshape, for the same
+        # documented reason.  No live data axis -> identity, so
+        # single-device programs are bit-identical.
+        hidden = _apply(lambda v: mesh_mod.constrain_dim(
+            v, 0, _layout().act_axis("batch")), hidden)
         if c.sequence_parallel:
-            hidden = _apply(lambda v: mesh_mod.constrain_dim(v, 1, "sp"),
+            hidden = _apply(lambda v: mesh_mod.constrain_dim(
+                v, 1, _layout().act_axis("seq")),
                             hidden)
         if caches is not None:
             if self.decoder is not None:
@@ -782,7 +794,8 @@ class LlamaForCausalLM(Layer):
 
         def make():
             buf = jnp.zeros(shape, dt)
-            return mesh_mod.constrain_dim(buf, 2, "tp")
+            return mesh_mod.constrain_dim(
+                buf, 2, _layout().act_axis("kv_heads"))
 
         return [{"k": make(), "v": make()}
                 for _ in range(c.num_hidden_layers)]
@@ -825,7 +838,8 @@ class LlamaForCausalLM(Layer):
 
         def make():
             buf = jnp.zeros(shape, dt)
-            return mesh_mod.constrain_dim(buf, 2, "tp")
+            return mesh_mod.constrain_dim(
+                buf, 2, _layout().act_axis("kv_heads"))
 
         def make_scale():
             return jnp.zeros(shape[:2], jnp.float32)

@@ -199,16 +199,110 @@ def test_fp16_static_scaling_guard_health_runs():
     assert h.shape == (3,) and float(h[1]) == 0.0
 
 
-def test_guard_health_still_rejected_under_gradient_merge():
+def test_guard_health_gradient_merge_folds_across_window():
+    """ISSUE 15 satellite (carried TrainGuard gap): guard_health now
+    composes with gradient_merge.  The health vector is computed over
+    the POST-ADD accumulator — a poisoned microbatch taints the whole
+    remaining window, and the vector resets when the window applies
+    and zeroes.  lr=0 keeps the weights untouched so the window-reset
+    semantics are observable."""
     s = DistributedStrategy()
     s.gradient_merge = True
-    s.gradient_merge_configs = {"k_steps": 2}
-    m, opt = _build()
+    s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    paddle.seed(3)
+    m = nn.Linear(16, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=m.parameters())
     mesh = mesh_mod.init_mesh({"dp": -1})
     step = DistributedTrainStep(m, _loss(m), opt, s, mesh=mesh,
                                 guard_health=True)
+    xs, ys = _data(6)
+    # (a) nonfinite fold: poison microbatch 2 -> the POST-ADD
+    # accumulator is tainted for the whole of window 2 (calls 2 AND
+    # 3).  Only the first two windows are asserted: at the apply tick
+    # the un-guarded step really does consume the poisoned window
+    # (p - lr*NaN is NaN even at lr=0) — recovery is TrainGuard's
+    # rewind policy, exactly as on the plain path.
+    xs_nan = [x.copy() for x in xs]
+    xs_nan[2][0, 0] = np.nan
+    bad = []
+    for x, y in zip(xs_nan[:4], ys[:4]):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        h = np.asarray(step.last_health)
+        assert h.shape == (3,)
+        bad.append(bool(h[1] > 0))
+    assert bad == [False, False, True, True], bad
+    # (b) window reset: a fresh step, FINITE gradient spike in
+    # microbatch 2; lr=0 keeps weights untouched, so window 3's norm
+    # dropping back proves the accumulator (and the folded vector)
+    # reset at the window boundary
+    paddle.seed(3)
+    m2 = nn.Linear(16, 4)
+    o2 = paddle.optimizer.SGD(learning_rate=0.0,
+                              parameters=m2.parameters())
+    step2 = DistributedTrainStep(m2, _loss(m2), o2, s, mesh=mesh_mod.
+                                 get_mesh(), guard_health=True)
+    xs_sp = [x.copy() for x in xs]
+    xs_sp[2] = xs_sp[2] + 1e4
+    norms = []
+    for x, y in zip(xs_sp, ys):
+        step2(paddle.to_tensor(x), paddle.to_tensor(y))
+        h = np.asarray(step2.last_health)
+        assert float(h[1]) == 0.0          # finite throughout
+        norms.append(float(h[0]))
+    assert norms[2] > 100 * norms[1]       # spike visible in-window
+    assert norms[3] > 100 * norms[1]       # still folded at apply
+    assert norms[4] < norms[2] / 100       # window 3 reset clean
+    assert norms[5] < norms[2] / 100
+
+
+def test_guard_health_gradient_merge_still_matches_big_batch():
+    """guard_health must not perturb the gradient-merge math: k_steps
+    micro-steps with the guard compiled in == one big-batch step."""
+    xs, ys = _data(4, 8)
+    paddle.seed(9)
+    m1 = nn.Linear(16, 4)
+    o1 = paddle.optimizer.SGD(learning_rate=0.1,
+                              parameters=m1.parameters())
+    X = np.concatenate(xs), np.concatenate(ys)
+    loss = ((m1(paddle.to_tensor(X[0]))
+             - paddle.to_tensor(X[1])) ** 2).mean()
+    loss.backward()
+    o1.step()
+
+    paddle.seed(9)
+    m2 = nn.Linear(16, 4)
+    o2 = paddle.optimizer.SGD(learning_rate=0.1,
+                              parameters=m2.parameters())
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DistributedTrainStep(m2, _loss(m2), o2, s, mesh=mesh,
+                                guard_health=True)
+    for x, y in zip(xs, ys):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert float(np.asarray(step.last_health)[1]) == 0.0
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_allclose(np.asarray(p1._value),
+                                   np.asarray(p2._value),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_guard_health_dgc_still_rejected():
+    from paddle_tpu.distributed.fleet.dist_step import (
+        DistributedTrainStep as DTS)
+    s = DistributedStrategy()
+    s.dgc = True
+    paddle.seed(3)
+    m = nn.Linear(16, 4)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=m.parameters())
+    mesh = mesh_mod.init_mesh({"dp": -1})
+    step = DTS(m, _loss(m), opt, s, mesh=mesh, guard_health=True)
     xs, ys = _data(1)
-    with pytest.raises(NotImplementedError, match="gradient_merge"):
+    with pytest.raises(NotImplementedError, match="DGC"):
         step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
 
 

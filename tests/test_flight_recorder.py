@@ -337,6 +337,41 @@ def test_compile_observatory_classifies_retraces():
     assert all("peak_bytes" in e and "argument_bytes" in e
                and "output_bytes" in e for e in resolved)
     assert all(e["peak_bytes"] > 0 for e in resolved)
+    # ISSUE 15 satellite: every byte-carrying record speaks the
+    # versioned memory schema — the calibration hook's contract
+    for e in resolved:
+        assert e["mem_schema"] == fl.MEM_SCHEMA_VERSION
+        for k in fl.MEM_SCHEMA_KEYS:
+            assert k in e and isinstance(e[k], int), (k, e)
+
+
+def test_compile_log_memory_schema_shape_drift_detected():
+    """A future rename of the arg/temp/peak byte keys (or a version
+    bump) must make the planner's calibration consumer raise LOUDLY —
+    a silently-zeroed calibration is the failure mode the versioned
+    schema exists to prevent."""
+    import pytest
+    from paddle_tpu.distributed.planner.calibrate import (
+        Calibration, CalibrationError)
+    good = {"program": "DistributedTrainStep", "cause": "abstract",
+            "mem_schema": fl.MEM_SCHEMA_VERSION}
+    good.update({k: 10 for k in fl.MEM_SCHEMA_KEYS})
+    assert Calibration.from_compile_log([good]).observations
+    # simulate the recorder renaming a schema field WITHOUT bumping
+    # the version: consumer must raise, never read zeros
+    renamed = dict(good)
+    renamed["args_bytes"] = renamed.pop("argument_bytes")
+    with pytest.raises(CalibrationError, match="missing schema keys"):
+        Calibration.from_compile_log([renamed])
+    # version bump without a consumer update: same contract
+    bumped = dict(good)
+    bumped["mem_schema"] = fl.MEM_SCHEMA_VERSION + 1
+    with pytest.raises(CalibrationError, match="mem_schema"):
+        Calibration.from_compile_log([bumped])
+    # REAL records from this process's log satisfy the consumer
+    cal = Calibration.from_compile_log(fl.compile_log(resolve=True))
+    assert all(set(fl.MEM_SCHEMA_KEYS) <= set(o) and
+               o["peak_bytes"] >= 0 for o in cal.observations)
 
 
 def test_dist_step_records_step_events_and_health():

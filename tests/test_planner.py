@@ -1,0 +1,304 @@
+"""Auto-sharding planner (ISSUE 15 tentpole, half 2).
+
+The load-bearing pins:
+
+* **MULTICHIP_r05 regression** — given the 7B/8-chip config, the
+  planner's ANALYTIC model (no compile, milliseconds) ranks
+  bf16-moments pp2xfsdp4 FITS (~14.1 GiB) and fp32-moments EXCEEDS
+  (~17.3 GiB) against a v5e 16 GiB budget — the exact verdicts the
+  XLA-dryrun ground truth recorded (MULTICHIP_r05.json), within 5%.
+* **small-proxy verify** — ``Planner.plan(verify_top_k=k)`` returns
+  only plans that actually LOWER via ``compile_abstract``, each
+  carrying XLA's own memory analysis as its predicted peak.
+* **calibration** — predicted-vs-observed error is measured from real
+  flight-recorder compile records through the versioned memory schema,
+  and schema drift raises instead of silently zeroing.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.planner.calibrate import (Calibration,
+                                                      CalibrationError)
+from paddle_tpu.distributed.planner.memory_model import (
+    PROXY_SUITE, ModelSpec, TrainSpec, analytic_memory, proxy_specs)
+from paddle_tpu.distributed.planner.search import (Planner,
+                                                   PlannerError, auto,
+                                                   enumerate_meshes)
+
+GIB = 1024.0 ** 3
+
+# Llama-2-7B geometry — the __graft_entry__._dryrun_7b_one config
+LLAMA_7B = ModelSpec(name="llama7b", hidden=4096, intermediate=11008,
+                     layers=32, heads=32, kv_heads=32, vocab=32000,
+                     max_seq=2048, scan_layers=True)
+
+# MULTICHIP_r05.json ground truth (XLA memory analysis, recorded):
+#   8 chips pp2xfsdp4, bf16 AMP, ZeRO-3, batch 8 x seq 2048:
+#     moments float32  -> peak 17.32 GiB  EXCEEDS v5e 16 GiB
+#     moments bfloat16 -> peak 14.09 GiB  FITS
+#   16 chips pp2xfsdp8, moments float32, batch 16 -> 10.11 GiB FITS
+R05_FP32_PEAK_GIB = 17.32
+R05_BF16_PEAK_GIB = 14.09
+R05_16C_PEAK_GIB = 10.11
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(None)
+
+
+# ----------------------------------------------------------------------
+# enumeration
+# ----------------------------------------------------------------------
+
+def test_enumerate_covers_r05_meshes_and_respects_validity():
+    ts = TrainSpec(batch=8, seq=2048, amp_dtype="bfloat16")
+    degs = enumerate_meshes(8, LLAMA_7B, ts)
+    tags = {tuple(sorted((k, v) for k, v in d.items() if v > 1))
+            for d in degs}
+    assert (("fsdp", 4), ("pp", 2)) in tags
+    assert (("fsdp", 8),) in tags
+    # every candidate multiplies to the chip count
+    for d in degs:
+        n = 1
+        for v in d.values():
+            n *= v
+        assert n == 8, d
+
+
+def test_enumerate_validity_constraints():
+    # heads=6: tp=4 invalid (6 % 4), tp=2 valid
+    ms = ModelSpec(name="m", hidden=96, intermediate=192, layers=4,
+                   heads=6, kv_heads=6, vocab=128, max_seq=64,
+                   scan_layers=True)
+    ts = TrainSpec(batch=8, seq=64, amp_dtype=None)
+    degs = enumerate_meshes(8, ms, ts)
+    tps = {d["tp"] for d in degs}
+    assert 2 in tps and 4 not in tps
+    # scan_layers=False: pp candidates excluded entirely
+    ms2 = ModelSpec(name="m2", hidden=96, intermediate=192, layers=4,
+                    heads=8, kv_heads=8, vocab=128, max_seq=64,
+                    scan_layers=False)
+    assert all(d["pp"] == 1 for d in enumerate_meshes(8, ms2, ts))
+    with pytest.raises(PlannerError, match="chips"):
+        enumerate_meshes(0, ms, ts)
+
+
+# ----------------------------------------------------------------------
+# MULTICHIP_r05 regression pin (analytic model vs recorded XLA truth)
+# ----------------------------------------------------------------------
+
+def test_7b_8chip_verdicts_reproduce_multichip_r05():
+    for mdt, obs_gib, want in (("float32", R05_FP32_PEAK_GIB,
+                                "EXCEEDS"),
+                               ("bfloat16", R05_BF16_PEAK_GIB,
+                                "FITS")):
+        ts = TrainSpec(batch=8, seq=2048, amp_dtype="bfloat16",
+                       moments_dtype=mdt, zero_stage=3)
+        plan = Planner(LLAMA_7B, ts, hbm_gib=16.0).score(
+            {"pp": 2, "fsdp": 4})
+        got_gib = plan.analytic_peak_bytes / GIB
+        assert plan.verdict == want, (mdt, got_gib, plan.verdict)
+        rel = abs(got_gib - obs_gib) / obs_gib
+        assert rel <= 0.05, (
+            f"{mdt}: analytic {got_gib:.2f} GiB vs recorded r05 "
+            f"{obs_gib} GiB = {100 * rel:.1f}% off (>5%)")
+
+
+def test_7b_16chip_row_within_ten_percent():
+    ts = TrainSpec(batch=16, seq=2048, amp_dtype="bfloat16",
+                   moments_dtype="float32", zero_stage=3)
+    plan = Planner(LLAMA_7B, ts, hbm_gib=16.0).score(
+        {"pp": 2, "fsdp": 8})
+    got = plan.analytic_peak_bytes / GIB
+    assert plan.verdict == "FITS"
+    assert abs(got - R05_16C_PEAK_GIB) / R05_16C_PEAK_GIB <= 0.10, got
+
+
+def test_7b_auto_ranks_r05_mesh_fits_under_bf16_moments():
+    plans = auto(LLAMA_7B, chips=8, hbm_gib=16.0,
+                 moments_dtype="bfloat16", amp_dtype="bfloat16",
+                 batch=8, seq=2048)
+    by_tag = {p.tag: p for p in plans}
+    assert by_tag["pp2xfsdp4"].verdict == "FITS"
+    # the r05 mesh ranks among the FITS plans, ahead of every EXCEEDS
+    idx = [p.tag for p in plans].index("pp2xfsdp4")
+    assert all(p.fits for p in plans[:idx + 1]), \
+        [(p.tag, p.verdict) for p in plans[:idx + 1]]
+    # fp32 moments: the same mesh must EXCEED — and no 8-chip pp x
+    # fsdp plan fits at all (the r05 finding that motivated bf16
+    # moments)
+    plans32 = auto(LLAMA_7B, chips=8, hbm_gib=16.0,
+                   moments_dtype="float32", amp_dtype="bfloat16",
+                   batch=8, seq=2048)
+    by_tag = {p.tag: p for p in plans32}
+    assert by_tag["pp2xfsdp4"].verdict == "EXCEEDS"
+
+
+def test_exact_state_accounting_matches_r05_args():
+    """The state half of the analytic model is EXACT dtype-width
+    accounting: the r05 dryrun's argument bytes (9.78 / 6.52 GiB) must
+    land within 1%."""
+    for mdt, obs_args in (("float32", 9.78), ("bfloat16", 6.52)):
+        ts = TrainSpec(batch=8, seq=2048, amp_dtype="bfloat16",
+                       moments_dtype=mdt, zero_stage=3)
+        mb = analytic_memory(LLAMA_7B, ts, {"pp": 2, "fsdp": 4})
+        got = mb.arg_bytes / GIB
+        assert abs(got - obs_args) / obs_args <= 0.01, (mdt, got)
+
+
+def test_7b_param_inventory_matches_model():
+    assert abs(LLAMA_7B.n_params() - 6.738e9) / 6.738e9 < 0.001
+
+
+# ----------------------------------------------------------------------
+# small-proxy verify: top plans actually lower
+# ----------------------------------------------------------------------
+
+def test_proxy_top_plans_lower_and_carry_xla_peaks():
+    ms, ts = proxy_specs(PROXY_SUITE[0])
+    pl = Planner(ms, ts, hbm_gib=16.0)
+    plans = pl.plan(8, verify_top_k=2)
+    assert len(plans) == 2
+    for p in plans:
+        assert p.verified and p.verify_error is None
+        assert p.verified_peak_bytes and p.verified_peak_bytes > 0
+        # a verified plan's predicted peak IS XLA's own analysis
+        assert p.predicted_peak_bytes == p.verified_peak_bytes
+        mem = p.verified_mem
+        assert mem["peak_bytes"] == (
+            mem["argument_bytes"] + mem["temp_bytes"]
+            + max(mem["output_bytes"] - mem["alias_bytes"], 0))
+        # analytic-phase estimate: tiny-proxy regime worst case —
+        # regression ceiling measured in PERF round 18 (~13-26%)
+        rel = abs(p.analytic_peak_bytes - p.verified_peak_bytes) \
+            / p.verified_peak_bytes
+        assert rel <= 0.40, (p.tag, rel)
+    # every rejected candidate carries its typed lowering error
+    for r in pl.rejected:
+        assert r.verify_error
+
+
+def test_rejected_pp_plans_are_dropped_not_returned():
+    """On this container pp>1 cannot lower (jaxlib 0.4.37 PartitionId
+    env limit, same as the 8 pipeline tier-1 failures) — the planner
+    must DROP those candidates and still return lowerable plans."""
+    ms, ts = proxy_specs(PROXY_SUITE[0])
+    pl = Planner(ms, ts)
+    plans = pl.plan(8, verify_top_k=1)
+    assert plans and all(p.verified for p in plans)
+    assert all(p.degrees.get("pp", 1) == 1 for p in plans)
+
+
+# ----------------------------------------------------------------------
+# calibration through the versioned compile-log schema
+# ----------------------------------------------------------------------
+
+def _schema_record(peak=100, args=40, temps=60, **kw):
+    rec = {"program": "DistributedTrainStep", "cause": "abstract",
+           "mem_schema": 1, "argument_bytes": args, "output_bytes": 0,
+           "temp_bytes": temps, "alias_bytes": 0, "peak_bytes": peak}
+    rec.update(kw)
+    return rec
+
+
+def test_calibration_measures_error_and_fits_temp_scale():
+    ms, ts = proxy_specs(PROXY_SUITE[0])
+    pl = Planner(ms, ts)
+    plan = pl.score({"fsdp": 8})
+    # observed peak = args exact + temps 2x the analytic estimate
+    obs = plan.memory.arg_bytes + 2 * plan.memory.temp_bytes
+    rep = pl.calibrate(plan, records=[_schema_record(
+        peak=obs, args=plan.memory.arg_bytes,
+        temps=2 * plan.memory.temp_bytes)])
+    assert rep.n_observations == 1
+    assert rep.median_rel_err == pytest.approx(
+        (obs - plan.analytic_peak_bytes) / obs)
+    assert rep.temp_scale == pytest.approx(2.0, rel=1e-6)
+    # the planner installed the correction: re-scoring now matches
+    assert pl.temp_scale == pytest.approx(2.0, rel=1e-6)
+    cal = pl.score({"fsdp": 8})
+    assert cal.analytic_peak_bytes == pytest.approx(obs, rel=0.01)
+
+
+def test_calibration_reads_real_compile_log_after_verify():
+    """End to end: verify compiles through compile_abstract, whose
+    flight-recorder compile record (memory schema v1) feeds the
+    calibration hook — predicted-vs-observed error is MEASURED from a
+    real record, not assumed."""
+    from paddle_tpu.observability import flight_recorder as fr
+    fr.clear()
+    ms, ts = proxy_specs(PROXY_SUITE[0])
+    pl = Planner(ms, ts)
+    p = pl.score({"fsdp": 8})
+    pl.verify(p)
+    assert p.verified, p.verify_error
+    rep = pl.calibrate(p)   # records=None -> this process's log
+    assert rep.n_observations >= 1
+    assert rep.median_rel_err is not None
+    # calibrated analytic peak should land within 2% of the observed
+    # (one-point fit on the same config — this asserts the plumbing,
+    # cross-config generalization is measured in bench round 18)
+    cal = pl.score({"fsdp": 8})
+    rel = abs(cal.analytic_peak_bytes - p.verified_peak_bytes) \
+        / p.verified_peak_bytes
+    assert rel <= 0.02, rel
+
+
+def test_calibration_schema_drift_raises():
+    # renamed key -> loud error, never a silent zero
+    bad = _schema_record()
+    del bad["argument_bytes"]
+    bad["args_bytes"] = 40
+    with pytest.raises(CalibrationError, match="missing schema keys"):
+        Calibration.from_compile_log([bad])
+    # version bump -> loud error
+    with pytest.raises(CalibrationError, match="mem_schema"):
+        Calibration.from_compile_log([_schema_record(mem_schema=2)])
+    # records with NO byte counts are skipped, not errors
+    cal = Calibration.from_compile_log(
+        [{"program": "DistributedTrainStep", "cause": "first_build",
+          "wall_ms": 1.0}])
+    assert cal.observations == []
+
+
+# ----------------------------------------------------------------------
+# fleet surface + flight event
+# ----------------------------------------------------------------------
+
+def test_fleet_auto_exported_and_emits_plan_choose():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.observability import flight_recorder as fr
+    fr.clear()
+    assert fleet.auto is auto
+    plans = fleet.auto(LLAMA_7B, chips=8, moments_dtype="bfloat16",
+                       amp_dtype="bfloat16", batch=8, seq=2048)
+    assert plans
+    evs = [e for e in fr.events() if e.get("kind") == "plan.choose"]
+    assert evs, "auto() must record a plan.choose flight event"
+    ev = evs[-1]
+    assert ev["mesh"] == plans[0].tag
+    assert ev["verdict"] == plans[0].verdict
+    assert ev["n_plans"] == len(plans)
+
+
+def test_auto_accepts_llama_config():
+    from paddle_tpu.text.models import llama_tiny
+    cfg = llama_tiny(scan_layers=True, num_hidden_layers=2)
+    plans = auto(cfg, chips=8, batch=16, amp_dtype=None)
+    assert plans and all(p.chips == 8 for p in plans)
+    # amp "auto" reads the config's compute dtype (tiny default bf16)
+    plans_auto = auto(cfg, chips=8, batch=16)
+    assert plans_auto[0].train.amp_dtype == "bfloat16"
+
+
+def test_plan_asdict_round_trips_json():
+    import json
+    ms, ts = proxy_specs(PROXY_SUITE[0])
+    p = Planner(ms, ts).score({"fsdp": 8})
+    d = json.loads(json.dumps(p.asdict()))
+    assert d["mesh"] == "fsdp8" and d["verdict"] in ("FITS", "EXCEEDS")
+    assert d["memory"]["peak_bytes"] == p.analytic_peak_bytes

@@ -147,6 +147,16 @@ if [ "$TRACE" -eq 1 ]; then
     rm -rf "$TRACE_DIR"
 fi
 
+# Auto-sharding planner smoke (ISSUE 15): every run proves the planner
+# still returns a non-empty ranked plan list whose top-k all LOWER via
+# compile_abstract + XLA memory analysis (the CLI re-execs itself under
+# an 8-device virtual CPU mesh).  Cheap (~30 s) and catches both a
+# broken SpecLayout derivation and a verify-path regression.
+echo "== tier-1 planner smoke: tools/plan.py --verify"
+env JAX_PLATFORMS=cpu python tools/plan.py --model proxy_fsdp \
+    --chips 8 --verify --top-k 2 --json > /dev/null
+rc6=$?
+
 rc5=0
 if [ "$LINT" -eq 1 ]; then
     # GraftLint gate: pillar 2 (lock-order + tracing-hazard AST lint
@@ -162,9 +172,9 @@ if [ "$LINT" -eq 1 ]; then
 fi
 
 echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3," \
-     "trace rc=$rc4, lint rc=$rc5"
+     "trace rc=$rc4, lint rc=$rc5, plan rc=$rc6"
 if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ] \
-        || [ "$rc4" -ne 0 ] || [ "$rc5" -ne 0 ]; then
+        || [ "$rc4" -ne 0 ] || [ "$rc5" -ne 0 ] || [ "$rc6" -ne 0 ]; then
     echo "== tier-1 FAILED (any pass being red fails the gate)"
     exit 1
 fi
