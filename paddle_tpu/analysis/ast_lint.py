@@ -91,6 +91,10 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/online/streaming.py",
     "paddle_tpu/online/lifecycle.py",
     "paddle_tpu/online/freshness.py",
+    # ISSUE 16: the tiered PS table (pin/resolve shared-lock protocol
+    # around raw arena addresses) and the pull-dequant kernel entry
+    "paddle_tpu/distributed/fleet/ps.py",
+    "paddle_tpu/ops/pallas/pull_dequant.py",
     # ISSUE 15: the auto-sharding planner (SpecLayout + search +
     # calibration — the verify path builds/compiles steps, so the
     # tracing-hazard rules apply)

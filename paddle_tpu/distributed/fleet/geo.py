@@ -264,10 +264,16 @@ class GeoPusher:
                     cur = (src_t.pull(ids) if ids.size else
                            np.zeros((0, src_t.dim), np.float32))
                     if policy == "lww":
-                        st = self._server._geo_stamps.get(table, {})
-                        stamps = [st.get(int(k),
-                                         (0, self._server.geo_site))
-                                  for k in ids.tolist()]
+                        # stamps live in the table's native directory
+                        # (ISSUE 16); -1 = never stamped -> default to
+                        # (0, our site) exactly like the old dict .get
+                        sq, si = src_t.geo_get(ids)
+                        stamps = [
+                            (int(sq[i]),
+                             self._server._site_name(int(si[i])))
+                            if sq[i] >= 0
+                            else (0, self._server.geo_site)
+                            for i in range(ids.size)]
                     with self._lock:
                         inbound = self._inbound.pop(table, [])
                 try:

@@ -64,6 +64,13 @@ class RemoteTable:
     def pull(self, ids: np.ndarray) -> np.ndarray:
         return self._client.pull(self.name, ids)
 
+    def pull_q8(self, ids: np.ndarray):
+        """int8 wire pull (ISSUE 16): per-row quantized rows straight
+        off the q8 wire — ``(codes int8 [n, dim], scales f32 [n])``
+        aligned to ``ids`` order.  The device cache's miss fill feeds
+        these to the on-device pull_dequant kernel."""
+        return self._client.pull_q8(self.name, ids)
+
     def push(self, ids: np.ndarray, grads: np.ndarray):
         ids = np.asarray(ids).reshape(-1)
         self._client.push(self.name, ids,
@@ -191,11 +198,24 @@ class DeviceCachedTable:
 
     def __init__(self, table: SparseTable, capacity: int,
                  optimizer: str = "sgd", lr: float = 0.01,
-                 eps: float = 1e-6):
+                 eps: float = 1e-6, wire: str = "f32"):
         import jax.numpy as jnp
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"device cache optimizer must be sgd|adagrad, "
                              f"got {optimizer!r}")
+        # miss-fill wire (ISSUE 16): "q8" ships int8 codes + per-row
+        # scales from the host/PS table and reconstructs ON DEVICE via
+        # the ops/pallas pull_dequant kernel — a serving cache pays 1/4
+        # of the row bytes per miss on both the PS link and the
+        # host->device copy.  Lossy by design (scale = amax/127), so
+        # the TRAINING default stays exact f32.
+        if wire not in ("f32", "q8"):
+            raise ValueError(f"wire must be f32|q8, got {wire!r}")
+        if wire == "q8" and not hasattr(table, "pull_q8"):
+            raise ValueError(
+                f"wire='q8' needs a table with pull_q8 (got "
+                f"{type(table).__name__})")
+        self._wire = wire
         self._table = table
         self._cap = int(capacity)
         self._dim = table.dim
@@ -283,6 +303,30 @@ class DeviceCachedTable:
                                        np.asarray(slots).tolist())]:
             del self._plans[key]
 
+    def _fill_rows(self, miss_ids: np.ndarray, nsp: int):
+        """Miss-fill rows padded to ``nsp`` slots: returns (device
+        ``[nsp, dim]`` f32 install payload, host np rows for ``_orig``).
+        On the q8 wire the install payload is reconstructed on device
+        by the pull_dequant kernel; the host copy uses the numpy
+        dequant — bit-exact equal by the kernel's tolerance-0 contract,
+        so delta write-back stays exact."""
+        import jax.numpy as jnp
+        k = len(miss_ids)
+        if self._wire == "q8":
+            from ...ops.pallas import registry as _preg
+            from .ps import dequantize_rows_q8
+            codes, scales = self._table.pull_q8(miss_ids)
+            dev = _preg.dispatch("pull_dequant", codes, scales)
+            rows = dequantize_rows_q8(np.asarray(codes, np.int8),
+                                      np.asarray(scales, np.float32))
+            dev_p = jnp.zeros((nsp, self._dim),
+                              jnp.float32).at[:k].set(dev)
+            return dev_p, rows
+        rows = self._table.pull(miss_ids)
+        rows_p = np.zeros((nsp, self._dim), np.float32)
+        rows_p[:k] = rows
+        return jnp.asarray(rows_p), rows
+
     # -- admission / eviction -----------------------------------------
     def _admit(self, miss_ids: np.ndarray, pinned: set) -> np.ndarray:
         """Allocate slots for ``miss_ids`` (evicting LRU slots not pinned
@@ -318,11 +362,9 @@ class DeviceCachedTable:
             np.int64)
         if evict:
             self._write_back(np.asarray(evict, np.int64))
-        rows = self._table.pull(miss_ids)
         sp = self._pad_slots(slots)
-        rows_p = np.zeros((len(sp), self._dim), np.float32)
-        rows_p[:len(slots)] = rows
-        self._buf = self._buf.at[jnp.asarray(sp)].set(jnp.asarray(rows_p))
+        rows_p, rows = self._fill_rows(miss_ids, len(sp))
+        self._buf = self._buf.at[jnp.asarray(sp)].set(rows_p)
         if self._acc is not None:
             self._acc = self._acc.at[jnp.asarray(sp)].set(0.0)
         self._orig[slots] = rows
@@ -411,12 +453,9 @@ class DeviceCachedTable:
                 self._write_back_rows(ev_slots, ev_ids)
             if miss_pos.size:
                 miss_slots = slots[miss_pos]
-                rows = self._table.pull(uniq[miss_pos])
                 sp = self._pad_slots(miss_slots)
-                rows_p = np.zeros((len(sp), self._dim), np.float32)
-                rows_p[:len(miss_slots)] = rows
-                self._buf = self._buf.at[jnp.asarray(sp)].set(
-                    jnp.asarray(rows_p))
+                rows_p, rows = self._fill_rows(uniq[miss_pos], len(sp))
+                self._buf = self._buf.at[jnp.asarray(sp)].set(rows_p)
                 if self._acc is not None:
                     self._acc = self._acc.at[jnp.asarray(sp)].set(0.0)
                 self._orig[miss_slots] = rows

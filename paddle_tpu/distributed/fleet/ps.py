@@ -25,16 +25,68 @@ implementation and the no-toolchain fallback (``use_native=False``).
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["SparseTable", "PSRuntime"]
+__all__ = ["SparseTable", "PSRuntime", "quantize_rows_q8",
+           "dequantize_rows_q8", "sendv_addrs"]
 
 
 _OPT_CODES = {"sgd": 0, "adagrad": 1, "adam": 2}
 _ENTRY_NONE, _ENTRY_COUNT, _ENTRY_PROB = 0, 1, 2
+
+
+def quantize_rows_q8(rows: np.ndarray):
+    """Per-row symmetric int8 quantization — the NumPy reference the
+    native ``pts_pull_q8`` is bit-identical to (float32 ``amax/127``
+    scale, float32 division, ties-to-even rounding, clip to ±127).
+    All-zero rows get scale 0 / codes 0.  Returns ``(codes int8,
+    scales float32)``."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    amax = np.abs(rows).max(axis=1) if rows.size else \
+        np.zeros(rows.shape[0], np.float32)
+    scales = (amax / np.float32(127.0)).astype(np.float32)
+    codes = np.zeros(rows.shape, np.int8)
+    nz = scales > 0
+    if nz.any():
+        codes[nz] = np.clip(np.rint(rows[nz] / scales[nz, None]),
+                            -127, 127).astype(np.int8)
+    return codes, scales
+
+
+def dequantize_rows_q8(codes: np.ndarray, scales: np.ndarray):
+    """Host-side dequant reference: one float32 multiply per element —
+    the exact math the ops/pallas pull_dequant kernel reproduces
+    on-device (tolerance 0.0 in the registry)."""
+    return codes.astype(np.float32) * np.asarray(
+        scales, np.float32)[:, None]
+
+
+def sendv_addrs(fd: int, addrs: np.ndarray, row_bytes: int,
+                hdr: bytes, inv: np.ndarray,
+                timeout_ms: int = -1) -> Optional[int]:
+    """Native scatter-gather send of a zc pull reply: ``hdr`` + ``inv``
+    bytes, then one iovec per contiguous run of the address-sorted
+    rows (address 0 = a zeros row), looping ``sendmsg`` with IOV_MAX
+    batching, EINTR retry, partial-send advance and poll-on-EAGAIN.
+    Returns bytes sent (negative = -errno), or None when the native
+    core is unavailable."""
+    import ctypes
+    from paddle_tpu.native import ps_core
+    lib = ps_core()
+    if lib is None:
+        return None
+    addrs = np.ascontiguousarray(addrs, np.uint64)
+    inv = np.ascontiguousarray(inv, np.int32)
+    return int(lib.pts_sendv_addrs(
+        int(fd),
+        addrs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        addrs.size, int(row_bytes), hdr, len(hdr),
+        inv.ctypes.data_as(ctypes.c_void_p), inv.nbytes,
+        int(timeout_ms)))
 
 
 class SparseTable:
@@ -122,6 +174,9 @@ class SparseTable:
         # churn state — ISSUE 14)
         self._clock = 0
         self._touched: Dict[int, int] = {}
+        # geo LWW stamp fallback (native tables keep stamps in the slot
+        # directory — ISSUE 16); values are (lamport seq, site idx)
+        self._geo_stamps: Dict[int, tuple] = {}
         self._py_admitted_total = 0
         self._py_evicted_total = 0
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
@@ -483,6 +538,8 @@ class SparseTable:
             self._steps.pop(k, None)
             self._seen.pop(k, None)
             self._touched.pop(k, None)
+            # geo stamps live and die with the slot (native parity)
+            self._geo_stamps.pop(k, None)
             self._admitted.discard(k)
         self._admitted_arr = None
 
@@ -533,6 +590,232 @@ class SparseTable:
         if self._native is not None:
             return int(self._lib.pts_evicted_total(self._native))
         return self._py_evicted_total
+
+    # -- tiered hot/cold spill storage (ISSUE 16) -----------------------
+    def enable_spill(self, spill_dir: str) -> bool:
+        """Attach per-shard mmap spill files under ``spill_dir`` (created
+        fresh, truncating leftovers).  Once enabled, :meth:`spill_sweep`
+        demotes cold rows out of the RAM arena instead of evicting them,
+        and pulls transparently promote them back.  Native backend only —
+        the Python dict fallback stays RAM-resident (returns False)."""
+        if self._native is None:
+            return False
+        os.makedirs(str(spill_dir), exist_ok=True)
+        return int(self._lib.pts_enable_spill(
+            self._native, str(spill_dir).encode())) == 0
+
+    def recover_spill(self, spill_dir: str) -> int:
+        """Re-attach EXISTING spill files (crash recovery): every
+        committed cold row re-seats as a spilled slot, admitted, aging
+        from the current clock.  Records whose commit mark never landed
+        (SIGKILL mid-demote) are reclaimed as free space — the
+        payload-before-id write order makes this safe.  Returns rows
+        recovered (-1 when unavailable)."""
+        if self._native is None:
+            return -1
+        return int(self._lib.pts_spill_recover(
+            self._native, str(spill_dir).encode()))
+
+    def spill_sweep(self, cutoff: int) -> int:
+        """Demote-instead-of-evict: move every row whose last sighting
+        predates ``cutoff`` (same temperature signal as
+        :meth:`ttl_sweep` — the PR 14 lifecycle ticks) from the RAM
+        arena to the shard's spill file.  Pure placement, no value
+        change: not a mutating batch, nothing to replicate.  Returns
+        rows demoted (-1 when spill is not enabled)."""
+        if self._native is None:
+            return -1
+        return int(self._lib.pts_spill_sweep(self._native, int(cutoff)))
+
+    def spill_advise(self):
+        """Flush spill pages and drop them from this process's resident
+        set (msync + MADV_DONTNEED) — cold rows stop counting against
+        RSS, which is what makes rows-beyond-RAM honest."""
+        if self._native is not None:
+            self._lib.pts_spill_advise(self._native)
+
+    @property
+    def spill_enabled(self) -> bool:
+        return (self._native is not None
+                and int(self._lib.pts_spill_enabled(self._native)) == 1)
+
+    def spill_stats(self) -> dict:
+        """``{hot, cold, promoted, demoted}`` row counts — hot/cold are
+        the live split, promoted/demoted are lifetime tier-crossing
+        totals (the churn signal tools/profile_ps.py --tier reports)."""
+        if self._native is None:
+            return dict(hot=len(self._rows), cold=0, promoted=0,
+                        demoted=0)
+        import ctypes
+        out = np.zeros(4, np.uint64)
+        self._lib.pts_spill_stats(self._native,
+                                  self._c(out, ctypes.c_uint64))
+        return dict(hot=int(out[0]), cold=int(out[1]),
+                    promoted=int(out[2]), demoted=int(out[3]))
+
+    # -- SIMD fused push (ISSUE 16) -------------------------------------
+    @staticmethod
+    def simd_available() -> bool:
+        """True when the native core compiled with AVX2 on this host."""
+        from ...native import ps_core
+        try:
+            lib = ps_core()
+        except Exception:
+            return False
+        return lib is not None and int(lib.pts_simd_available()) == 1
+
+    @staticmethod
+    def set_simd(on: bool):
+        """Process-wide toggle between the AVX2 and scalar optimizer
+        paths — bit-exact by construction (same evaluation order, FP
+        contraction disabled), which the parity suite asserts."""
+        from ...native import ps_core
+        lib = ps_core()
+        if lib is not None:
+            lib.pts_set_simd(1 if on else 0)
+
+    # -- int8 wire rows (ISSUE 16) --------------------------------------
+    def pull_q8(self, ids: np.ndarray):
+        """Pull with per-row symmetric int8 quantization: returns
+        ``(codes[n, dim] int8, scales[n] float32)`` where
+        ``codes * scale`` reconstructs the row to ~0.4% of its amax.
+        Same admission/sighting semantics as :meth:`pull`; all-zero and
+        non-admitted rows ship ``scale == 0``.  Native and Python
+        backends are bit-identical (ties-to-even rounding both sides)."""
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        if self._native is not None and (self._entry is None
+                                         or self._native_entry):
+            codes = np.empty((ids.size, self.dim), np.int8)
+            scales = np.empty(ids.size, np.float32)
+            self._lib.pts_pull_q8(
+                self._native, self._c(ids, ctypes.c_int64), ids.size,
+                self._c(codes, ctypes.c_int8),
+                self._c(scales, ctypes.c_float))
+            return codes, scales
+        rows = self.pull(ids)
+        return quantize_rows_q8(rows)
+
+    # -- geo LWW stamp directory (ISSUE 16) -----------------------------
+    # The per-id (lamport seq, site) stamps that order geo "lww" writes
+    # used to live in a server-side Python dict; at spill scale that
+    # dict is a second vocabulary-sized index, so the native core keeps
+    # the stamps inside the slot directory itself.  Sites are interned
+    # to int32 indices by the caller (PSServer owns idx <-> site-string;
+    # the string order is what tiebreaks, so interning preserves it only
+    # through the caller's comparison — the table just stores ints).
+    def geo_get(self, ids: np.ndarray):
+        """Per-id stamps as ``(seqs int64, site_idx int32)``; unstamped
+        ids report ``(-1, -1)``.  Never materialises rows."""
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        seqs = np.empty(ids.size, np.int64)
+        sites = np.empty(ids.size, np.int32)
+        if self._native is not None:
+            self._lib.pts_geo_get(
+                self._native, self._c(ids, ctypes.c_int64), ids.size,
+                self._c(seqs, ctypes.c_int64),
+                self._c(sites, ctypes.c_int32))
+            return seqs, sites
+        with self._lock:
+            for i, k in enumerate(ids.tolist()):
+                seqs[i], sites[i] = self._geo_stamps.get(k, (-1, -1))
+        return seqs, sites
+
+    def geo_put(self, ids: np.ndarray, seqs: np.ndarray,
+                sites: np.ndarray):
+        """Commit WINNING stamps (the LWW comparison already happened in
+        the caller, where site strings live).  Stamps survive demotion
+        (the slot stays) and drop with eviction, like the row."""
+        import ctypes
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        seqs = np.ascontiguousarray(np.asarray(seqs).reshape(-1), np.int64)
+        sites = np.ascontiguousarray(
+            np.asarray(sites).reshape(-1), np.int32)
+        if self._native is not None:
+            self._lib.pts_geo_put(
+                self._native, self._c(ids, ctypes.c_int64), ids.size,
+                self._c(seqs, ctypes.c_int64),
+                self._c(sites, ctypes.c_int32))
+            return
+        with self._lock:
+            for k, sq, st in zip(ids.tolist(), seqs.tolist(),
+                                 sites.tolist()):
+                self._geo_stamps[k] = (sq, st)
+
+    def geo_export(self):
+        """All stamped ids as ``(ids, seqs, site_idx)`` — the replica
+        attach handshake ships these so a promoted standby keeps
+        resolving geo conflicts exactly where the primary left off."""
+        import ctypes
+        if self._native is not None:
+            n = int(self._lib.pts_geo_export(self._native, None, None,
+                                             None, 0))
+            ids = np.empty(max(n, 1), np.int64)
+            seqs = np.empty(max(n, 1), np.int64)
+            sites = np.empty(max(n, 1), np.int32)
+            w = int(self._lib.pts_geo_export(
+                self._native, self._c(ids, ctypes.c_int64),
+                self._c(seqs, ctypes.c_int64),
+                self._c(sites, ctypes.c_int32), n)) if n else 0
+            return ids[:w], seqs[:w], sites[:w]
+        with self._lock:
+            ids = np.fromiter(self._geo_stamps, np.int64,
+                              len(self._geo_stamps))
+            seqs = np.asarray([self._geo_stamps[int(k)][0] for k in ids],
+                              np.int64)
+            sites = np.asarray(
+                [self._geo_stamps[int(k)][1] for k in ids], np.int32)
+        return ids, seqs, sites
+
+    # -- zero-copy pull service hooks (ISSUE 16) ------------------------
+    def pin_read(self) -> bool:
+        """Take the table's shared read pin: until :meth:`unpin_read`,
+        no mutator may move or rewrite row bytes, so addresses from
+        :meth:`resolve` stay valid and torn-free for a scatter-gather
+        send.  Pin and unpin MUST happen on the same thread."""
+        if self._native is None:
+            return False
+        self._lib.pts_pin_read(self._native)
+        return True
+
+    def unpin_read(self):
+        if self._native is not None:
+            self._lib.pts_unpin_read(self._native)
+
+    def resolve(self, ids: np.ndarray):
+        """Raw arena addresses (uint64; 0 = not admitted) for PRE-DEDUPED
+        ids — pull admission/sighting semantics, spilled rows promote.
+        Caller holds the read pin.  None on the Python backend."""
+        import ctypes
+        if self._native is None:
+            return None
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        addrs = np.empty(ids.size, np.uint64)
+        self._lib.pts_resolve(self._native,
+                              self._c(ids, ctypes.c_int64), ids.size,
+                              self._c(addrs, ctypes.c_uint64))
+        return addrs
+
+    def pull_plan(self, ids: np.ndarray):
+        """One-call send plan for the zc wire: dedup the RAW id batch,
+        resolve uniques (promoting spilled rows), sort by arena address
+        (non-admitted 0s first).  Returns ``(inv int32[n], addrs
+        uint64[m])`` with ``inv`` mapping each input position to its
+        row's rank in ``addrs`` — everything the service layer needs to
+        scatter-gather the reply with zero staging.  Caller holds the
+        read pin.  None on the Python backend."""
+        import ctypes
+        if self._native is None:
+            return None
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        inv = np.empty(ids.size, np.int32)
+        addrs = np.empty(ids.size, np.uint64)
+        m = self._lib.pts_pull_plan(self._native,
+                                    self._c(ids, ctypes.c_int64), ids.size,
+                                    self._c(inv, ctypes.c_int32),
+                                    self._c(addrs, ctypes.c_uint64))
+        return inv, addrs[:m]
 
     def config_arrays(self) -> dict:
         """The table's construction config as npz-storable scalars —

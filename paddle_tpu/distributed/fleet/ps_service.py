@@ -103,6 +103,7 @@ surviving workers so the job stops instead of silently shrinking.
 """
 from __future__ import annotations
 
+import errno
 import itertools
 import os
 import pickle
@@ -118,6 +119,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import chaos as _chaos
+from . import ps as _ps
 from ...framework import monitor as _monitor
 from ...observability import flight_recorder as _flight
 from ...observability import trace as _trace
@@ -126,6 +128,8 @@ __all__ = ["PSServer", "PSClient", "PSError", "PSConnectError",
            "PSUnavailable"]
 
 _HDR = struct.Struct("!I")
+# pre-pickled pull2 reply headers keyed by (n_ids, n_unique, dim)
+_PULL2_HDR_CACHE = {}
 
 # observability (ISSUE 5): every RPC carries an optional trace context
 # under this header key — [trace_id, span_id] of the client-side span —
@@ -189,8 +193,15 @@ _MUTATING_OPS = ("push", "push_delta", "geo_set", "register", "barrier")
 # rows the snapshot/stream has not caught up to, and applying writes
 # would diverge from the primary (split brain).  stats/stop/heartbeat/
 # replicate stay allowed.
-_GATED_OPS = ("pull", "push", "push_delta", "geo_set", "barrier",
-              "register", "unregister", "worker_barrier")
+_GATED_OPS = ("pull", "pull2", "pull_q8", "push", "push_delta",
+              "geo_set", "barrier", "register", "unregister",
+              "worker_barrier")
+
+# pull variants (ISSUE 16): "pull2" answers with deduped rows + an
+# inverse map, streamed zero-copy straight out of the native arena;
+# "pull_q8" ships int8 codes + per-row scales (the client or the
+# device dequantizes).  Both obey the same staleness gate as "pull".
+_PULL_OPS = ("pull", "pull2", "pull_q8")
 
 
 def _expects_reply(msg) -> bool:
@@ -199,8 +210,8 @@ def _expects_reply(msg) -> bool:
     op = msg.get("op")
     if op in ("push", "push_delta", "geo_set"):
         return bool(msg.get("sync"))
-    return op in ("pull", "barrier", "register", "unregister",
-                  "worker_barrier", "stats", "stop")
+    return op in ("pull", "pull2", "pull_q8", "barrier", "register",
+                  "unregister", "worker_barrier", "stats", "stop")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -268,22 +279,46 @@ def _frame_bytes(obj) -> bytes:
                     + [a.tobytes() for a in arrays if a.nbytes])
 
 
+# sendmsg is limited to IOV_MAX iovecs per call (1024 on Linux) — a
+# bigger batch fails with EMSGSIZE, which the zero-copy pull path (one
+# iovec per arena row) would hit on any large pull
+_IOV_MAX = 1024
+
+
 def _sendall_vec(sock, views):
     """sendall for a list of buffers without concatenating them (one
-    syscall per sendmsg window, zero staging copies)."""
-    while views:
+    syscall per <=IOV_MAX sendmsg window, zero staging copies).
+
+    Capability is probed ONCE up front: the no-``sendmsg`` fallback is
+    a per-view ``sendall`` — byte-identical wire output, since the
+    frame is defined as the concatenation of the views either way.
+    Partial sends (full socket buffer) consume from the front of the
+    view list and re-enter; EINTR retries the same window (PEP 475
+    covers most of it, but a handler that swallows the signal can
+    still surface InterruptedError here)."""
+    views = [v for v in views if len(v)]   # a 0-length view would make
+    if not hasattr(sock, "sendmsg"):       # the consume loop spin
+        for v in views:
+            sock.sendall(v)
+        return
+    i, n = 0, len(views)
+    while i < n:
         try:
-            sent = sock.sendmsg(views)
-        except AttributeError:      # platform without sendmsg
-            for v in views:
-                sock.sendall(v)
-            return
-        while sent > 0 and views:
-            if sent >= len(views[0]):
-                sent -= len(views[0])
-                views.pop(0)
+            sent = sock.sendmsg(views[i:i + _IOV_MAX])
+        except InterruptedError:
+            continue
+        # consume by CURSOR, not pop(0): a fully-sent window advances
+        # in O(window), where popping each view from the front of a
+        # long list would be quadratic in the iovec count
+        while sent > 0:
+            lv = len(views[i])
+            if sent >= lv:
+                sent -= lv
+                i += 1
             else:
-                views[0] = views[0][sent:]
+                # partial view: memoryview first so slicing a bytes /
+                # ctypes part re-references instead of copying
+                views[i] = memoryview(views[i])[sent:]
                 sent = 0
 
 
@@ -692,7 +727,16 @@ class PSServer:
         # keeps deciding conflicts exactly like the dead primary.
         self.geo_site = geo_site or f"site-{os.getpid()}-{self.port}"
         self._geo_clock = 0
-        self._geo_stamps: Dict[str, Dict[int, Tuple[int, str]]] = {}
+        # ISSUE 16: the stamps themselves moved into the table (a
+        # vocab-scale directory in ps_core.cc next to the slots — a
+        # Python dict of per-id tuples cannot ride along to spill
+        # scale).  The server keeps only a site-name intern pool
+        # (native slots store an int32 site index) plus the set of
+        # tables that ever minted a stamp; ``_geo_stamps`` survives as
+        # a read-only materializing property for tests and debugging.
+        self._geo_sites: List[str] = []
+        self._geo_site_idx: Dict[str, int] = {}
+        self._geo_tables: set = set()
         # admitted-churn publication cursor (PSServer.ttl_sweep)
         self._admitted_published: Dict[str, int] = {}
         # commit listeners (geo tier): fn(op, table, ids) called under
@@ -774,7 +818,7 @@ class PSServer:
                 # un-promoted replica may serve it iff fresh enough
                 # (checked in the handler); anything else gated stays
                 # refused — the split-brain guard is unchanged
-                bounded_read = (op == "pull"
+                bounded_read = (op in _PULL_OPS
                                 and msg.get("max_lag") is not None
                                 and self._serve_reads)
                 if (self.role == "replica" and not self.promoted
@@ -801,7 +845,7 @@ class PSServer:
                 if srv_sp is not None:
                     srv_sp.__enter__()
                 try:
-                    if op == "pull":
+                    if op in _PULL_OPS:
                         stale = None
                         if self.role == "replica" and not self.promoted:
                             lag, fresh = self._read_lag()
@@ -816,6 +860,10 @@ class PSServer:
                                          "replica stream is not fresh"}
                         if stale is not None:
                             _send_msg(conn, stale)
+                        elif op == "pull2":
+                            self._send_pull2(conn, msg)
+                        elif op == "pull_q8":
+                            self._send_pull_q8(conn, msg)
                         elif self._coalescer is not None:
                             _send_msg(conn, {"vals": self._coalescer.pull(
                                 msg["table"], msg["ids"])})
@@ -909,6 +957,134 @@ class PSServer:
             if not handed_off:
                 conn.close()
 
+    # -- batched pull wire paths (ISSUE 16) ------------------------------
+    def _send_pull2(self, conn, msg):
+        """Zero-copy batched pull reply: dedup the requested ids, pin
+        the table against row movement, resolve each unique id to its
+        raw arena address, and scatter-gather the rows straight onto
+        the socket — the reply frame is ``{inv, vals_uniq}`` in the
+        standard out-of-band array format (the receiver cannot tell it
+        was never staged).  A pull of N rows costs O(unique-rows /
+        IOV_MAX) syscalls and ZERO staging copies server-side.
+
+        The shared read pin (held across plan + send) is what makes
+        the raw addresses safe: mutators that move or rewrite row bytes
+        take the pin exclusively, so the bytes on the wire are a
+        consistent snapshot.  Non-admitted ids resolve to address 0 and
+        ship a zeros row.  Python-backend tables (and chaos runs, whose
+        fault plans intercept whole frames) fall back to a staged copy
+        with the IDENTICAL wire format.
+
+        The fast path is two native calls: ``pull_plan`` (dedup +
+        resolve + address-sort + rank, one pass in C — rows ship in
+        ARENA order with ``inv`` remapped to match, so physically
+        adjacent rows coalesce into one iovec) and ``sendv_addrs``
+        (iovec build + the sendmsg loop).  Doing the plan and the
+        gather list in python costs more than the row copy it avoids
+        at serving batch sizes."""
+        t = self._table(msg["table"])
+        ids = np.ascontiguousarray(
+            np.asarray(msg["ids"]).reshape(-1), np.int64)
+        dim = int(t.dim)
+
+        def _staged():
+            uniq, inv = np.unique(ids, return_inverse=True)
+            _send_msg(conn, {"inv": np.ascontiguousarray(inv, np.int32),
+                             "vals_uniq": t.pull(uniq)})
+
+        if _chaos.active() is not None or not getattr(
+                t, "pin_read", lambda: False)():
+            _staged()
+            return
+        try:
+            plan = t.pull_plan(ids)
+            if plan is None:        # native plan unavailable: stage
+                _staged()
+                return
+            inv2, addrs = plan
+            m = int(addrs.size)
+            # the reply header depends only on (n, m, dim); serving
+            # traffic repeats those shapes constantly, so the pickled
+            # bytes are cached (bounded: shapes are few)
+            key = (int(inv2.size), m, dim)
+            pre = _PULL2_HDR_CACHE.get(key)
+            if pre is None:
+                hdr = {"__arrays__": [("inv", "<i4", (key[0],)),
+                                      ("vals_uniq", "<f4", (m, dim))]}
+                data = pickle.dumps(hdr,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                pre = _HDR.pack(len(data)) + data
+                if len(_PULL2_HDR_CACHE) > 4096:
+                    _PULL2_HDR_CACHE.clear()
+                _PULL2_HDR_CACHE[key] = pre
+            to = conn.gettimeout()
+            sent = _ps.sendv_addrs(
+                conn.fileno(), addrs, dim * 4,
+                pre, inv2,
+                -1 if to is None else int(to * 1000))
+            if sent is not None and sent < 0:
+                if -sent in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    raise socket.timeout("pull2 sendv timed out")
+                raise OSError(-sent, os.strerror(-sent))
+        finally:
+            t.unpin_read()
+        _monitor.stat_add("ps_server_pull2")
+
+    def _send_pull_q8(self, conn, msg):
+        """int8 wire pull reply: ``{inv, codes, scales}`` — per-row
+        symmetrically quantized unique rows (scale = amax/127, codes
+        int8).  ~4x fewer payload bytes per unique row than the f32
+        row path; the client (or the device, via the ops/pallas
+        pull-dequant kernel) reconstructs ``codes * scale``."""
+        t = self._table(msg["table"])
+        ids = np.ascontiguousarray(
+            np.asarray(msg["ids"]).reshape(-1), np.int64)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        codes, scales = t.pull_q8(uniq)
+        _send_msg(conn, {"inv": np.ascontiguousarray(inv, np.int32),
+                         "codes": codes, "scales": scales})
+        _monitor.stat_add("ps_server_pull_q8")
+
+    # -- geo stamp directory (ISSUE 16: native, vocab-scale) -------------
+    def _site_idx(self, site: str) -> int:
+        """Intern a site name -> stable int32 index (native slots store
+        the index; the wire and tests speak site STRINGS)."""
+        i = self._geo_site_idx.get(site)
+        if i is None:
+            i = len(self._geo_sites)
+            self._geo_sites.append(site)
+            self._geo_site_idx[site] = i
+        return i
+
+    def _site_name(self, idx: int) -> str:
+        return self._geo_sites[idx] if 0 <= idx < len(self._geo_sites) \
+            else ""
+
+    @property
+    def _geo_stamps(self) -> Dict[str, Dict[int, Tuple[int, str]]]:
+        """Materialize the per-table LWW stamp directories out of the
+        tables (read-only snapshot; the live stamps migrated into
+        ps_core.cc slot metadata in ISSUE 16).  Kept because tests and
+        operators introspect ``server._geo_stamps[table][id]``."""
+        out: Dict[str, Dict[int, Tuple[int, str]]] = {}
+        for name in self._geo_tables:
+            t = self._tables.get(name)
+            if t is None:
+                continue
+            ids, seqs, sites = t.geo_export()
+            out[name] = {int(k): (int(s), self._site_name(int(si)))
+                         for k, s, si in zip(ids, seqs, sites)}
+        return out
+
+    def _geo_stamp_ids(self, t, name: str, ids, gst: Tuple[int, str]):
+        """Stamp ``ids`` of table ``name`` with one (seq, site) pair."""
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        t.geo_put(ids,
+                  np.full(ids.size, int(gst[0]), np.int64),
+                  np.full(ids.size, self._site_idx(str(gst[1])),
+                          np.int32))
+        self._geo_tables.add(name)
+
     # -- idempotency + replication --------------------------------------
     def _record_seq(self, msg) -> bool:
         """Record (src, seq) of a non-table mutating RPC (register /
@@ -969,9 +1145,7 @@ class PSServer:
                     msg["gst"] = [gst[0], gst[1]]
                 if gst[0] > self._geo_clock:
                     self._geo_clock = gst[0]
-                st = self._geo_stamps.setdefault(msg["table"], {})
-                for k in np.asarray(msg["ids"]).reshape(-1).tolist():
-                    st[int(k)] = gst
+                self._geo_stamp_ids(t, msg["table"], msg["ids"], gst)
             self.applied += 1
             # ingest watermark (ISSUE 14): a push stamped with the
             # event's ingest time makes end-to-end freshness measurable
@@ -1029,15 +1203,25 @@ class PSServer:
             ids.size, int(t.dim))
         seqs = np.asarray(msg["seqs"]).reshape(-1).astype(np.int64)
         sites = [str(s) for s in (msg.get("sites") or [])]
-        st = self._geo_stamps.setdefault(msg["table"], {})
+        # stored stamps come from the table's native directory (ISSUE
+        # 16); tiebreak stays the (seq, site-STRING) tuple compare the
+        # Python dict used, so cross-site decisions are unchanged
+        cur_sq, cur_si = t.geo_get(ids)
         win = []
         for i, k in enumerate(ids.tolist()):
             stamp = (int(seqs[i]), sites[i])
             if stamp[0] > self._geo_clock:
                 self._geo_clock = stamp[0]
-            if stamp > st.get(k, (-1, "")):
-                st[k] = stamp
+            cur = (int(cur_sq[i]), self._site_name(int(cur_si[i]))) \
+                if cur_sq[i] >= 0 else (-1, "")
+            if stamp > cur:
                 win.append(i)
+        if win:
+            site_idx = np.asarray([self._site_idx(sites[i])
+                                   for i in win], np.int32)
+            t.geo_put(np.ascontiguousarray(ids[win]),
+                      np.ascontiguousarray(seqs[win]), site_idx)
+            self._geo_tables.add(msg["table"])
         wi = np.asarray(win, np.int64)
         out = dict(msg)
         out["ids"] = np.ascontiguousarray(ids[wi])
@@ -1141,11 +1325,19 @@ class PSServer:
             seqs = {s: w.export() for s, w in self._seqs.items()}
             head = self.applied
             geo = None
-            if self._geo_stamps or self._geo_clock:
-                geo = {"clock": self._geo_clock,
-                       "stamps": {n: [[k, s[0], s[1]]
-                                      for k, s in d.items()]
-                                  for n, d in self._geo_stamps.items()}}
+            if self._geo_tables or self._geo_clock:
+                # wire shape unchanged from the dict era: site STRINGS
+                # (the int32 intern indices are a local encoding)
+                stamps = {}
+                for n in sorted(self._geo_tables):
+                    t = self._tables.get(n)
+                    if t is None:
+                        continue
+                    gi, gs, gsi = t.geo_export()
+                    stamps[n] = [[int(k), int(s),
+                                  self._site_name(int(si))]
+                                 for k, s, si in zip(gi, gs, gsi)]
+                geo = {"clock": self._geo_clock, "stamps": stamps}
             rep["lock"].acquire()
             self._replicas.append(rep)
         try:
@@ -1365,9 +1557,19 @@ class PSServer:
                     # must decide conflicts exactly like the primary did
                     self._geo_clock = max(self._geo_clock,
                                           int(g.get("clock", 0)))
-                    self._geo_stamps = {
-                        n: {int(k): (int(a), str(b)) for k, a, b in rows}
-                        for n, rows in g.get("stamps", {}).items()}
+                    # restore into the tables' native stamp directories
+                    # (tables were already restored above, so stamping
+                    # after the pts_clear-based table load is safe)
+                    for n, rows in g.get("stamps", {}).items():
+                        t = self._tables.get(n)
+                        if t is None or not rows:
+                            continue
+                        t.geo_put(
+                            np.asarray([r[0] for r in rows], np.int64),
+                            np.asarray([r[1] for r in rows], np.int64),
+                            np.asarray([self._site_idx(str(r[2]))
+                                        for r in rows], np.int32))
+                        self._geo_tables.add(n)
             self._watermark = self._head = int(head.get("head", 0))
             self._last_stream = time.monotonic()
             # snapshot == caught up as of the primary's clock in the
@@ -1564,6 +1766,20 @@ class PSServer:
             if t is None or not hasattr(t, "ttl_sweep"):
                 continue
             t.set_clock(int(now * 1000.0))
+            if getattr(t, "spill_enabled", False):
+                # tiered table (ISSUE 16): the lifecycle tick is the
+                # temperature signal — cold rows DEMOTE to the mmap
+                # spill tier instead of evicting.  Demotion is local
+                # placement (rows stay pullable, values unchanged), so
+                # no version tick and no replicated ``evict`` record.
+                with self._apply_lock:
+                    d = t.spill_sweep(int(float(cutoff) * 1000.0))
+                if d:
+                    _monitor.stat_add("ps_feature_demoted", d)
+                _flight.record("ps.spill_sweep", table=name, demoted=d,
+                               cutoff=float(cutoff), rows=len(t))
+                out[name] = 0
+                continue
             with self._apply_lock:
                 ev = t.ttl_sweep(int(float(cutoff) * 1000.0))
                 n = int(ev.size)
@@ -1772,7 +1988,8 @@ class PSClient:
                  max_retries: Optional[int] = None,
                  backoff_base: Optional[float] = None,
                  rpc_deadline: Optional[float] = None,
-                 read_replicas=None, max_lag: Optional[int] = None):
+                 read_replicas=None, max_lag: Optional[int] = None,
+                 pull_wire: Optional[str] = None):
         self._ep_lists: List[List[Tuple[str, int]]] = []
         for e in endpoints:
             if isinstance(e, (list, tuple)):
@@ -1851,6 +2068,17 @@ class PSClient:
         self._geo_k = geo_k_steps
         self._geo_acc: Dict[str, Dict[int, np.ndarray]] = {}
         self._geo_pushes = 0
+        # pull wire format (ISSUE 16): "row" = classic per-request f32
+        # rows; "zc" = deduped {inv, vals_uniq} answered by the
+        # server's zero-copy scatter-gather path; "q8" = deduped int8
+        # codes + per-row scales (~4x fewer payload bytes per unique
+        # row).  All three return identical f32 values from pull()
+        # except q8, which is lossy by design (serving tier).
+        wire = (pull_wire if pull_wire is not None
+                else os.environ.get("PADDLE_PS_PULL_WIRE", "row"))
+        if wire not in ("row", "zc", "q8"):
+            raise ValueError(f"pull_wire must be row|zc|q8, got {wire!r}")
+        self._pull_wire = wire
         # serving read tier (ISSUE 10): per-shard replica sets + rings
         self._max_lag = None if max_lag is None else int(max_lag)
         self._read_sets: Optional[List[List[dict]]] = None
@@ -2017,16 +2245,16 @@ class PSClient:
             return vals
         if len(self._socks) == 1 or ids.size == 0:
             # empty pulls still round-trip so the (0, dim) shape comes back
-            return self._rpc(0, self._pull_msg(table, ids),
-                             reply=True)["vals"]
+            return self._pull_post(self._rpc(0, self._pull_msg(table, ids),
+                                             reply=True))
         shard = self._shard(ids)
         vals = None
         for r in range(len(self._socks)):
             m = shard == r
             if not m.any():
                 continue
-            v = self._rpc(r, self._pull_msg(table, ids[m]),
-                          reply=True)["vals"]
+            v = self._pull_post(self._rpc(r, self._pull_msg(table, ids[m]),
+                                          reply=True))
             if vals is None:
                 vals = np.empty((ids.size, v.shape[1]), np.float32)
             vals[m] = v
@@ -2036,10 +2264,62 @@ class PSClient:
         """A bounded-read client stamps max_lag on EVERY pull — on the
         primary it is a no-op, and during a failover window it lets the
         caught-up-but-unpromoted standby answer instead of refusing."""
-        msg = {"op": "pull", "table": table, "ids": ids}
+        op = {"row": "pull", "zc": "pull2", "q8": "pull_q8"}[
+            self._pull_wire]
+        msg = {"op": op, "table": table, "ids": ids}
         if self._max_lag is not None:
             msg["max_lag"] = self._max_lag
         return msg
+
+    def _pull_post(self, rep: dict) -> np.ndarray:
+        """Decode one pull reply into dense f32 rows, whatever the wire
+        format: classic ``vals``; zero-copy ``{inv, vals_uniq}`` (the
+        server shipped unique rows once, scatter back out); or int8
+        ``{inv, codes, scales}`` (dequantize ``codes * scale`` —
+        on-device serving paths dispatch the same math through the
+        ops/pallas pull_dequant kernel instead)."""
+        if "vals" in rep:
+            return rep["vals"]
+        inv = np.asarray(rep["inv"]).reshape(-1)
+        if "vals_uniq" in rep:
+            u = np.asarray(rep["vals_uniq"], np.float32)
+        else:
+            codes = np.asarray(rep["codes"], np.int8)
+            scales = np.asarray(rep["scales"], np.float32)
+            u = codes.astype(np.float32) * scales[:, None]
+        return np.ascontiguousarray(u[inv])
+
+    def pull_q8(self, table: str, ids):
+        """Raw int8 wire pull: ``(codes int8 [n, dim], scales f32 [n])``
+        aligned to ``ids`` order, WITHOUT dequantizing — for consumers
+        that reconstruct on device (the heter cache's pull_dequant
+        kernel), so the 4x byte saving survives past this client."""
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        msg = {"op": "pull_q8", "table": table, "ids": ids}
+        if self._max_lag is not None:
+            msg["max_lag"] = self._max_lag
+        if len(self._socks) == 1 or ids.size == 0:
+            rep = self._rpc(0, msg, reply=True)
+            inv = np.asarray(rep["inv"]).reshape(-1)
+            return (np.ascontiguousarray(
+                        np.asarray(rep["codes"], np.int8)[inv]),
+                    np.ascontiguousarray(
+                        np.asarray(rep["scales"], np.float32)[inv]))
+        shard = self._shard(ids)
+        codes = None
+        scales = np.empty(ids.size, np.float32)
+        for r in range(len(self._socks)):
+            m = shard == r
+            if not m.any():
+                continue
+            rep = self._rpc(r, dict(msg, ids=ids[m]), reply=True)
+            inv = np.asarray(rep["inv"]).reshape(-1)
+            c = np.asarray(rep["codes"], np.int8)[inv]
+            if codes is None:
+                codes = np.empty((ids.size, c.shape[1]), np.int8)
+            codes[m] = c
+            scales[m] = np.asarray(rep["scales"], np.float32)[inv]
+        return codes, scales
 
     # -- read fan-out (ISSUE 10) ----------------------------------------
     def _read_pull_shard(self, rank: int, table: str,

@@ -54,8 +54,12 @@ def load_library(name: str) -> Optional[ctypes.CDLL]:
             # per-process temp name: concurrent workers with a cold cache
             # must not os.replace a half-written .so over each other
             tmp = f"{out}.{os.getpid()}.tmp"
-            cmd = [cxx, "-O3", "-march=native", "-std=c++17", "-shared",
-                   "-fPIC", "-pthread", src, "-o", tmp]
+            # -ffp-contract=off: the SIMD fused-push path (ISSUE 16) is
+            # bit-exact with the scalar path only if neither is allowed
+            # to contract a*b+c into an FMA
+            cmd = [cxx, "-O3", "-march=native", "-ffp-contract=off",
+                   "-std=c++17", "-shared", "-fPIC", "-pthread", src,
+                   "-o", tmp]
             try:
                 r = subprocess.run(cmd, capture_output=True, text=True,
                                    timeout=300)
@@ -132,6 +136,37 @@ def ps_core() -> Optional[ctypes.CDLL]:
     lib.pts_evict.argtypes = [c.c_void_p, i64p, c.c_int64]
     lib.pts_set_vals.argtypes = [c.c_void_p, i64p, c.c_int64, f32p]
     lib.ps_segsum_inv.argtypes = [i64p, c.c_int64, c.c_int, f32p, f32p]
+    # tiered spill + zero-copy pull + int8 wire + geo stamps (ISSUE 16)
+    u64p = c.POINTER(c.c_uint64)
+    i32p = c.POINTER(c.c_int32)
+    i8p = c.POINTER(c.c_int8)
+    lib.pts_simd_available.restype = c.c_int
+    lib.pts_simd_available.argtypes = []
+    lib.pts_set_simd.argtypes = [c.c_int]
+    lib.pts_enable_spill.restype = c.c_int
+    lib.pts_enable_spill.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pts_spill_enabled.restype = c.c_int
+    lib.pts_spill_enabled.argtypes = [c.c_void_p]
+    lib.pts_spill_sweep.restype = c.c_int64
+    lib.pts_spill_sweep.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pts_spill_recover.restype = c.c_int64
+    lib.pts_spill_recover.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pts_spill_stats.argtypes = [c.c_void_p, u64p]
+    lib.pts_spill_advise.argtypes = [c.c_void_p]
+    lib.pts_pin_read.argtypes = [c.c_void_p]
+    lib.pts_unpin_read.argtypes = [c.c_void_p]
+    lib.pts_resolve.argtypes = [c.c_void_p, i64p, c.c_int64, u64p]
+    lib.pts_pull_plan.restype = c.c_int64
+    lib.pts_pull_plan.argtypes = [c.c_void_p, i64p, c.c_int64, i32p, u64p]
+    lib.pts_sendv_addrs.restype = c.c_int64
+    lib.pts_sendv_addrs.argtypes = [
+        c.c_int, u64p, c.c_int64, c.c_int64, c.c_void_p, c.c_int64,
+        c.c_void_p, c.c_int64, c.c_int64]
+    lib.pts_pull_q8.argtypes = [c.c_void_p, i64p, c.c_int64, i8p, f32p]
+    lib.pts_geo_get.argtypes = [c.c_void_p, i64p, c.c_int64, i64p, i32p]
+    lib.pts_geo_put.argtypes = [c.c_void_p, i64p, c.c_int64, i64p, i32p]
+    lib.pts_geo_export.restype = c.c_int64
+    lib.pts_geo_export.argtypes = [c.c_void_p, i64p, i64p, i32p, c.c_int64]
     lib._pts_ready = True
     return lib
 

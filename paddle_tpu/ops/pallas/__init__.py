@@ -18,6 +18,9 @@ Kernels (see each module's docstring for the tolerance contract):
   KV pools once, per-(block, slot) scales applied inside the gather
 - ``segment_sum`` — device-side fused sparse-grad merge mirroring
   ``native/ps_core.cc``'s ``ps_segsum_inv``
+- ``pull_dequant`` — on-device reconstruction of int8 PS pull rows
+  (the tiered PS q8 wire's egress saving carried through the
+  host->device copy)
 
 Escape hatch: ``PADDLE_PALLAS=0`` routes everything to the XLA
 references; ``PADDLE_PALLAS_<KERNEL>=pallas|xla_ref|interpret``
@@ -30,6 +33,8 @@ from .int8_matmul import int8_matmul_pallas, int8_matmul_ref  # noqa: F401
 from .kv_attention import (int8_paged_attention,  # noqa: F401
                            paged_attention_ref)
 from .opt_apply import opt_apply_pallas, opt_apply_ref  # noqa: F401
+from .pull_dequant import (pull_dequant_pallas,  # noqa: F401
+                           pull_dequant_ref)
 from .registry import (dispatch, dispatch_counts, kernels,  # noqa: F401
                        reset_dispatch_counts, resolve, set_mode)
 from .segment_sum import segment_sum_pallas, segment_sum_ref  # noqa: F401

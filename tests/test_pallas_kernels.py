@@ -55,7 +55,7 @@ def _rand(rng, *shape):
 def test_registry_lists_every_kernel_with_tolerance():
     ks = kreg.kernels()
     for name in ("flash_attention", "opt_apply", "int8_matmul",
-                 "int8_kv_attention", "segment_sum"):
+                 "int8_kv_attention", "segment_sum", "pull_dequant"):
         assert name in ks, sorted(ks)
         assert ks[name].tolerance, name
         assert callable(ks[name].xla_ref_fn)
@@ -788,3 +788,34 @@ def test_flash_attention_dispatch_counter_and_xla_ref_route():
                                atol=2e-4, rtol=1e-3)
     c = kreg.dispatch_counts("flash_attention")
     assert c.get("xla_ref", 0) == 1 and c.get("interpret", 0) == 1, c
+
+
+def test_pull_dequant_interpret_bit_exact_vs_ref():
+    """int8 -> f32 conversion is exact and each output element is one
+    f32 multiply of identical operands: kernel == xla_ref == the PS
+    quantizer's own numpy dequant, bit for bit (tolerance 0.0)."""
+    from paddle_tpu.distributed.fleet.ps import (dequantize_rows_q8,
+                                                 quantize_rows_q8)
+    from paddle_tpu.ops.pallas.pull_dequant import (pull_dequant_pallas,
+                                                    pull_dequant_ref)
+    rng = np.random.default_rng(16)
+    rows = (rng.standard_normal((37, 24)) * 3).astype(np.float32)
+    rows[5] = 0.0  # all-zero row ships scale 0
+    codes, scales = quantize_rows_q8(rows)
+    ref = np.asarray(pull_dequant_ref(jnp.asarray(codes),
+                                      jnp.asarray(scales)))
+    ker = np.asarray(pull_dequant_pallas(jnp.asarray(codes),
+                                         jnp.asarray(scales),
+                                         interpret=True))
+    assert np.array_equal(ker, ref)
+    assert np.array_equal(ref, dequantize_rows_q8(codes, scales))
+    assert np.array_equal(ker[5], np.zeros(24, np.float32))
+    # empty batch keeps its shape through the registry path
+    kreg.set_mode("pull_dequant", "interpret")
+    try:
+        empty = kreg.dispatch("pull_dequant",
+                              np.zeros((0, 24), np.int8),
+                              np.zeros(0, np.float32))
+        assert np.asarray(empty).shape == (0, 24)
+    finally:
+        kreg.set_mode("pull_dequant", None)

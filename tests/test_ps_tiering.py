@@ -1,0 +1,231 @@
+"""Tiered hot/cold PS storage (ISSUE 16): placement must be invisible.
+
+The tentpole contract: demoting a row to the mmap spill tier (and
+promoting it back) is PURE placement — every observable (pull values,
+push/push_delta math, checkpoint bytes, replica snapshots) is
+bit-identical whether a row lives in the RAM arena or the spill file.
+Plus the crash contract: a SIGKILL at any moment mid-sweep leaves the
+spill file recoverable, with every committed record bit-exact and
+half-written records reclaimed (payload-before-commit-mark ordering).
+
+Also pins the SIMD fused-push toggle: the AVX2 path preserves the
+scalar evaluation order with FP contraction disabled, so both paths
+produce bit-identical tables.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.ps import SparseTable
+from paddle_tpu.native import ps_core
+
+requires_native = pytest.mark.skipif(ps_core() is None,
+                                     reason="no C++ toolchain")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CFG = dict(dim=8, optimizer="adam", lr=0.01, seed=3, init_std=0.05)
+_FUTURE = lambda: int(time.time() * 1000) + 60_000  # noqa: E731
+
+
+def _spilled_twin(tmp_path, ids, name="spill"):
+    """(plain, tiered) same-seed tables; the tiered one has every row
+    demoted to the spill file."""
+    a = SparseTable(**_CFG)
+    b = SparseTable(**_CFG)
+    assert b.enable_spill(str(tmp_path / name))
+    a.pull(ids)
+    b.pull(ids)
+    assert b.spill_sweep(_FUTURE()) == ids.size
+    assert b.spill_stats()["cold"] == ids.size
+    return a, b
+
+
+@requires_native
+def test_pull_parity_across_tiers(tmp_path):
+    ids = np.arange(500, dtype=np.int64)
+    a, b = _spilled_twin(tmp_path, ids)
+    # cold pull == hot pull, and the pull PROMOTED the touched rows
+    probe = np.array([0, 7, 499, 7], np.int64)
+    np.testing.assert_array_equal(b.pull(probe), a.pull(probe))
+    st = b.spill_stats()
+    assert st["promoted"] == 3 and st["hot"] == 3
+    # untouched rows stay cold; full-table parity regardless of mix
+    np.testing.assert_array_equal(b.pull(ids), a.pull(ids))
+
+
+@requires_native
+@pytest.mark.parametrize("op", ["push", "push_delta"])
+def test_push_parity_across_tiers(tmp_path, op):
+    ids = np.arange(200, dtype=np.int64)
+    a, b = _spilled_twin(tmp_path, ids, name=op)
+    g = np.random.RandomState(1).randn(50, _CFG["dim"]).astype(np.float32)
+    sub = np.arange(0, 200, 4, dtype=np.int64)
+    getattr(a, op)(sub, g)
+    getattr(b, op)(sub, g)  # rows promote, then the same math applies
+    np.testing.assert_array_equal(b.pull(ids), a.pull(ids))
+    # stateful-optimizer moments advanced identically: a second push
+    # diverges immediately if the first one's state differed
+    getattr(a, op)(sub, -g)
+    getattr(b, op)(sub, -g)
+    np.testing.assert_array_equal(b.pull(ids), a.pull(ids))
+
+
+@requires_native
+def test_checkpoint_bit_exact_and_format_unchanged(tmp_path):
+    ids = np.arange(300, dtype=np.int64)
+    a, b = _spilled_twin(tmp_path, ids)
+    b.pull(ids[:100])  # mixed placement: 100 hot, 200 cold
+    a.save(str(tmp_path / "a"))
+    b.save(str(tmp_path / "b"))
+    da = np.load(str(tmp_path / "a.npz"))
+    db = np.load(str(tmp_path / "b.npz"))
+    # the npz checkpoint format is UNCHANGED by tiering: same keys,
+    # same bytes, no placement leakage
+    assert sorted(da.files) == sorted(db.files)
+    for k in da.files:
+        np.testing.assert_array_equal(da[k], db[k])
+    # and a checkpoint saved by a never-tiered table (the pre-tiering
+    # on-disk format) loads into a spill-enabled table bit-exact
+    c = SparseTable(**_CFG)
+    assert c.enable_spill(str(tmp_path / "c_spill"))
+    c.load(str(tmp_path / "a"))
+    np.testing.assert_array_equal(c.pull(ids), a.pull(ids))
+
+
+@requires_native
+def test_replica_snapshot_parity_across_tiers(tmp_path):
+    ids = np.arange(256, dtype=np.int64)
+    a, b = _spilled_twin(tmp_path, ids)
+    g = np.random.RandomState(2).randn(ids.size,
+                                       _CFG["dim"]).astype(np.float32)
+    a.push(ids, g)
+    b.push(ids, g)
+    b.spill_sweep(_FUTURE())  # re-demote: snapshot reads the cold tier
+    ra = SparseTable(**_CFG)
+    rb = SparseTable(**_CFG)
+    ra.load_state_bytes(a.state_bytes())
+    rb.load_state_bytes(b.state_bytes())
+    np.testing.assert_array_equal(rb.pull(ids), ra.pull(ids))
+    # optimizer state crossed too: post-handoff applies stay identical
+    ra.push(ids, g)
+    rb.push(ids, g)
+    np.testing.assert_array_equal(rb.pull(ids), ra.pull(ids))
+
+
+@requires_native
+def test_ttl_sweep_demotes_instead_of_evicting(tmp_path):
+    t = SparseTable(**_CFG)
+    assert t.enable_spill(str(tmp_path / "ttl"))
+    ids = np.arange(100, dtype=np.int64)
+    vals = t.pull(ids).copy()
+    n = len(t)
+    assert t.spill_sweep(_FUTURE()) == 100
+    # nothing evicted: the id set is intact, values come back from the
+    # cold tier unchanged, and stats account for the move
+    assert len(t) == n
+    st = t.spill_stats()
+    assert st == {"hot": 0, "cold": 100, "promoted": 0, "demoted": 100}
+    np.testing.assert_array_equal(t.pull(ids), vals)
+    assert t.spill_stats()["promoted"] == 100
+
+
+@requires_native
+def test_spill_recovery_bit_exact(tmp_path):
+    sdir = str(tmp_path / "rec")
+    ids = np.arange(1000, dtype=np.int64)
+    oracle = SparseTable(**_CFG)
+    t = SparseTable(**_CFG)
+    assert t.enable_spill(sdir)
+    g = np.random.RandomState(4).randn(ids.size,
+                                       _CFG["dim"]).astype(np.float32)
+    for tab in (oracle, t):
+        tab.pull(ids)
+        tab.push(ids, g)
+    t.spill_sweep(_FUTURE())
+    del t
+    r = SparseTable(**_CFG)
+    assert r.recover_spill(sdir) == ids.size
+    np.testing.assert_array_equal(r.pull(ids), oracle.pull(ids))
+    # recovered rows carry optimizer state: the next push stays exact
+    r.push(ids, g)
+    oracle.push(ids, g)
+    np.testing.assert_array_equal(r.pull(ids), oracle.pull(ids))
+
+
+_KILL_CHILD = r"""
+import sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from paddle_tpu.distributed.fleet.ps import SparseTable
+t = SparseTable(dim=8, optimizer="adam", lr=0.01, seed=3, init_std=0.05)
+assert t.enable_spill({sdir!r})
+ids = np.arange(5000, dtype=np.int64)
+t.pull(ids)
+g = np.random.RandomState(4).randn(5000, 8).astype(np.float32)
+t.push(ids, g)
+t.spill_sweep(int(time.time() * 1000) + 60_000)
+print("SWEEPING", flush=True)
+while True:  # promote/demote churn until SIGKILLed mid-sweep
+    t.pull(ids)
+    t.spill_sweep(int(time.time() * 1000) + 60_000)
+"""
+
+
+@requires_native
+def test_sigkill_mid_sweep_recovers_committed_rows(tmp_path):
+    """SIGKILL while demotion churn is rewriting spill records: every
+    record the recovery accepts must be bit-exact (the commit mark
+    lands after the payload, so torn records are invisible)."""
+    sdir = str(tmp_path / "kill")
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         _KILL_CHILD.format(repo=_REPO, sdir=sdir)],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "SWEEPING"
+        time.sleep(0.15)  # land mid promote/demote churn
+    finally:
+        p.kill()
+        p.wait()
+    r = SparseTable(**_CFG)
+    ids = np.arange(5000, dtype=np.int64)
+    recovered = r.recover_spill(sdir)
+    assert 0 <= recovered <= ids.size
+    # oracle = the child's deterministic history (same seed, same push)
+    oracle = SparseTable(**_CFG)
+    oracle.pull(ids)
+    g = np.random.RandomState(4).randn(5000, 8).astype(np.float32)
+    oracle.push(ids, g)
+    r.save(str(tmp_path / "r"))
+    d = np.load(str(tmp_path / "r.npz"))
+    got_ids = np.asarray(d["ids"], np.int64)
+    assert got_ids.size == recovered
+    if recovered:
+        np.testing.assert_array_equal(
+            np.asarray(d["vals"], np.float32), oracle.pull(got_ids))
+
+
+@requires_native
+def test_simd_toggle_is_bit_exact():
+    if not SparseTable.simd_available():
+        pytest.skip("native core built without AVX2")
+    ids = np.arange(333, dtype=np.int64)
+    g = np.random.RandomState(5).randn(ids.size, 8).astype(np.float32)
+    out = {}
+    try:
+        for simd in (True, False):
+            SparseTable.set_simd(simd)
+            t = SparseTable(**_CFG)
+            t.pull(ids)
+            for _ in range(3):
+                t.push(ids, g)
+                t.push_delta(ids, g * 0.5)
+            out[simd] = t.pull(ids)
+    finally:
+        SparseTable.set_simd(True)
+    np.testing.assert_array_equal(out[True], out[False])

@@ -157,8 +157,92 @@ def profile_backend(use_native: bool, smoke: bool):
     }
 
 
+def profile_tier(smoke: bool):
+    """Tiered-storage profile (ISSUE 16): where does a pull's time go
+    once rows live across the hot arena and the mmap spill tier?
+
+    Builds a spill-enabled table, demotes everything, then replays a
+    Zipf stream three ways — all-hot, all-cold, and mixed — reporting
+    per-placement pull cost plus the promotion churn ``spill_stats``
+    observed along the way.  One JSON line; no server, no sockets:
+    this isolates the storage tier from the wire.
+    """
+    import tempfile
+
+    from paddle_tpu.distributed.fleet.ps import SparseTable
+
+    dim = int(os.environ.get("PROFILE_DIM", "16" if smoke else "64"))
+    batch = int(os.environ.get("PROFILE_BATCH",
+                               "256" if smoke else "2048"))
+    steps = int(os.environ.get("PROFILE_STEPS", "10" if smoke else "100"))
+    vocab = 20_000 if smoke else 400_000
+    hot_n = max(1000, vocab // 20)
+
+    t = SparseTable(dim, optimizer="sgd", lr=0.05, seed=7)
+    if not t.is_native:
+        return {"mode": "tier", "skipped": "no C++ toolchain"}
+    tmp = tempfile.mkdtemp(prefix="pts_tierprof_")
+    if not t.enable_spill(tmp):
+        return {"mode": "tier", "skipped": "spill unavailable"}
+    rng = np.random.RandomState(11)
+    all_ids = np.arange(vocab, dtype=np.int64)
+    for lo in range(0, vocab, 65536):
+        t.pull(all_ids[lo:lo + 65536])
+    hot_ids = all_ids[:hot_n]
+
+    def reset():
+        t.spill_sweep(int(time.time() * 1000) + 10_000)
+        t.spill_advise()
+
+    def run(make_batch, promote_hot):
+        reset()
+        if promote_hot:
+            t.pull(hot_ids)
+        s0 = t.spill_stats()
+        ts = []
+        for _ in range(steps):
+            b = make_batch()
+            a = time.perf_counter()
+            t.pull(b)
+            ts.append(time.perf_counter() - a)
+        s1 = t.spill_stats()
+        arr = np.asarray(ts)
+        return {
+            "p50_us": round(float(np.percentile(arr, 50)) * 1e6, 1),
+            "p99_us": round(float(np.percentile(arr, 99)) * 1e6, 1),
+            "pulls_s": round(batch * steps / float(arr.sum()), 0),
+            "promoted": int(s1["promoted"] - s0["promoted"]),
+            "hot_after": int(s1["hot"]), "cold_after": int(s1["cold"]),
+        }
+
+    def zipf_hot():
+        return hot_ids[np.minimum(rng.zipf(1.3, batch) - 1, hot_n - 1)]
+
+    def uniform_cold():
+        return rng.randint(hot_n, vocab, batch).astype(np.int64)
+
+    def mixed():
+        b = zipf_hot()
+        b[:batch // 10] = rng.randint(0, vocab, batch // 10)
+        return b
+
+    out = {
+        "mode": "tier", "rows_total": vocab, "emb_dim": dim,
+        "batch": batch, "steps": steps, "hot_set": hot_n,
+        "hot": run(zipf_hot, True),
+        "cold": run(uniform_cold, False),
+        "mixed": run(mixed, True),
+    }
+    out["cold_over_hot_p50"] = round(
+        out["cold"]["p50_us"] / max(out["hot"]["p50_us"], 1e-9), 2)
+    return out
+
+
 def main():
     smoke = "--smoke" in sys.argv or os.environ.get("BENCH_SMOKE") == "1"
+    if "--tier" in sys.argv or os.environ.get("PROFILE_MODE") == "tier":
+        print(json.dumps(profile_tier(smoke)), flush=True)
+        return
     out = []
     for use_native in (False, True):
         r = profile_backend(use_native, smoke)

@@ -157,6 +157,31 @@ env JAX_PLATFORMS=cpu python tools/plan.py --model proxy_fsdp \
     --chips 8 --verify --top-k 2 --json > /dev/null
 rc6=$?
 
+# Tiered-PS smoke (ISSUE 16): the ps_scale bench arm at smoke scale —
+# spill build + SIGKILL-free recovery parity + the zc/row/q8 wire
+# round trips over a live server.  Gates on MECHANISM (recovery count,
+# q8 bit-parity flag), not throughput: smoke-sized rows are too small
+# for the zc byte advantage and one-core timings are noise at this
+# duration.  Catches a broken spill format, a wire-shape regression,
+# or a dequant-parity break in ~15 s.
+echo "== tier-1 ps_scale smoke: bench.py ps_scale (smoke)"
+env JAX_PLATFORMS=cpu BENCH_METRICS=ps_scale BENCH_SMOKE=1 \
+    BENCH_CHILD=1 python bench.py > /tmp/ps_scale_smoke.json 2>/dev/null
+rc7=$?
+if [ "$rc7" -eq 0 ]; then
+    python - <<'EOF'
+import json
+r = json.loads(open("/tmp/ps_scale_smoke.json").read().strip()
+               .splitlines()[-1])
+ok = (r.get("recovered_rows") == r.get("rows_total")
+      and r.get("q8_parity_bitexact") is True
+      and r.get("q8_egress_ratio", 0) >= 1.8)
+print("ps_scale smoke:", "OK" if ok else f"FAILED: {r}")
+raise SystemExit(0 if ok else 1)
+EOF
+    rc7=$?
+fi
+
 rc5=0
 if [ "$LINT" -eq 1 ]; then
     # GraftLint gate: pillar 2 (lock-order + tracing-hazard AST lint
@@ -172,9 +197,10 @@ if [ "$LINT" -eq 1 ]; then
 fi
 
 echo "== tier-1: file-order rc=$rc1, shuffled rc=$rc2, chaos rc=$rc3," \
-     "trace rc=$rc4, lint rc=$rc5, plan rc=$rc6"
+     "trace rc=$rc4, lint rc=$rc5, plan rc=$rc6, ps_scale rc=$rc7"
 if [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ] || [ "$rc3" -ne 0 ] \
-        || [ "$rc4" -ne 0 ] || [ "$rc5" -ne 0 ] || [ "$rc6" -ne 0 ]; then
+        || [ "$rc4" -ne 0 ] || [ "$rc5" -ne 0 ] || [ "$rc6" -ne 0 ] \
+        || [ "$rc7" -ne 0 ]; then
     echo "== tier-1 FAILED (any pass being red fails the gate)"
     exit 1
 fi
