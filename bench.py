@@ -1369,6 +1369,105 @@ def _bench_online(smoke, peak_tflops):
     }
 
 
+def _bench_elastic(smoke, peak_tflops):
+    """Elastic data-plane engine A/B (ISSUE 17): the same world-1
+    deterministic run — in-process coordinator, linear model over a
+    flat vector, bootstrap save + restore + train + one streamed
+    checkpoint — once on the HOST engine (PR 9 flat-numpy reference)
+    and once on the DEVICE engine (compiled slot-ordered reduce +
+    fused opt_apply + streamed/ ranged checkpoints, the new default).
+    Reported: steps/s per engine, the reshard-window decomposition
+    (restore ms, compile ms, bytes) off the flight ring, and the
+    device path's measured staging peak (the O(max shard) meter).
+
+    Honesty note: on this single-core CPU host the device engine pays
+    jit dispatch per step against numpy's in-cache loops, and world-1
+    makes every exchange a loopback self-gather — the A/B bounds
+    engine overhead, it does not demonstrate TPU speedup (re-measure
+    on real chips)."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet.elastic import (ElasticCoordinator,
+                                                      ElasticTrainer)
+    from paddle_tpu.io.dataloader import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+    from paddle_tpu.observability import flight_recorder as _flight
+
+    numel = 20_000 if smoke else 200_000
+    steps = 6 if smoke else 30
+
+    class Xs(Dataset):
+        def __init__(self, n=64):
+            rng = np.random.default_rng(5)
+            self.x = rng.standard_normal(n).astype(np.float32)
+
+        def __len__(self):
+            return self.x.size
+
+        def __getitem__(self, i):
+            return self.x[i]
+
+    def grad(params, batch):
+        s = np.float32(np.mean(batch))
+        return {"w": (params["w"] * np.float32(1e-3)
+                      + s * np.float32(1e-2)).astype(np.float32),
+                "b": np.asarray(s, np.float32).reshape(())}
+
+    def run(engine):
+        coord = ElasticCoordinator(expected_world=1).start()
+        with tempfile.TemporaryDirectory() as ck:
+            loader = DataLoader(Xs(), batch_size=8, shuffle=True,
+                                seed=3, drop_last=True)
+            tr = ElasticTrainer(
+                {"w": np.zeros(numel - 1, np.float32),
+                 "b": np.zeros((), np.float32)},
+                grad, loader, ckpt_dir=ck, optimizer="adam", lr=0.01,
+                micro_batches=2, ckpt_every=steps,
+                coordinator=f"127.0.0.1:{coord.port}",
+                expected_world=1, client_timeout=60.0, engine=engine)
+            n0 = len(_flight.events()) if _flight.enabled() else 0
+            t0 = _time.perf_counter()
+            tr.run(steps)
+            wall = _time.perf_counter() - t0
+            evs = _flight.events()[n0:] if _flight.enabled() else []
+        coord.stop()
+        restore_ms = sum(e.get("ms", 0.0) for e in evs
+                         if e.get("kind") == "elastic.reshard")
+        compile_ms = sum(e.get("ms", 0.0) for e in evs
+                         if e.get("kind") == "elastic.reshard.compile")
+        rbytes = sum(e.get("bytes", 0) for e in evs
+                     if e.get("kind") == "elastic.reshard")
+        return {"steps_per_s": steps / wall, "wall_s": wall,
+                "restore_ms": restore_ms, "compile_ms": compile_ms,
+                "reshard_bytes": rbytes,
+                "meter_peak_bytes": tr.reshard_meter.peak_bytes}
+
+    host = run("host")
+    dev = run("device")
+    return {
+        "metric": "elastic_engine",
+        "value": round(dev["steps_per_s"], 2),
+        "unit": "steps_per_s_device_engine_world1",
+        "vs_baseline": None,
+        "host_steps_per_s": round(host["steps_per_s"], 2),
+        "device_vs_host_x": round(dev["steps_per_s"]
+                                  / host["steps_per_s"], 3),
+        "numel": numel, "steps": steps,
+        "restore_ms": {"host": round(host["restore_ms"], 2),
+                       "device": round(dev["restore_ms"], 2)},
+        "device_compile_ms": round(dev["compile_ms"], 2),
+        "device_reshard_bytes": dev["reshard_bytes"],
+        "device_meter_peak_bytes": dev["meter_peak_bytes"],
+        "host_meter_peak_bytes": host["meter_peak_bytes"],
+        "note": ("1-core CPU + world-1 loopback: bounds engine "
+                 "overhead only — compiled-path wins need real chips "
+                 "(TPU re-measure flagged)"),
+    }
+
+
 def _bench_plan(smoke, peak_tflops):
     """Auto-sharding planner (ISSUE 15): per-proxy wall time of the
     ANALYTIC phase (pure python: enumerate + score every valid mesh)
@@ -2350,7 +2449,7 @@ def _bench_kernels(smoke, peak_tflops):
 # annotated with every trial's value and the spread.
 _TUNNEL_TRIALS = {"wide_deep": 3, "infer": 3, "serve": 3,
                   "llama_serve": 3, "llama_gateway": 3, "ps_read": 3,
-                  "kernels": 3, "online": 3, "plan": 3}
+                  "kernels": 3, "online": 3, "plan": 3, "elastic": 3}
 
 
 def _flatten(out):
@@ -2438,7 +2537,8 @@ def main():
     default = ("resnet,bert,llama,llama_long,llama_8k,wide_deep,infer,"
                "serve,llama_serve,llama_gateway,kernels")
     known = set(default.split(",")) | {"ps_scaling", "ps_read",
-                                       "ps_scale", "online", "plan"}
+                                       "ps_scale", "online", "plan",
+                                       "elastic"}
     which = [w.strip() for w in
              os.environ.get("BENCH_METRICS", default).split(",")
              if w.strip()] or default.split(",")
@@ -2601,6 +2701,8 @@ def _main():
         results.append(_bench_online(smoke, peak))
     if "plan" in which:
         results.append(_bench_plan(smoke, peak))
+    if "elastic" in which:
+        results.append(_bench_elastic(smoke, peak))
     if not results:  # unknown names: still honor the one-JSON-line contract
         results.append(_bench_resnet(smoke, peak))
 

@@ -95,6 +95,9 @@ DEFAULT_LINT_PATHS = (
     # around raw arena addresses) and the pull-dequant kernel entry
     "paddle_tpu/distributed/fleet/ps.py",
     "paddle_tpu/ops/pallas/pull_dequant.py",
+    # ISSUE 17: the device-native elastic engine (jit reduce + fused
+    # apply compiled per mesh generation — tracing-hazard territory)
+    "paddle_tpu/distributed/fleet/elastic_engine.py",
     # ISSUE 15: the auto-sharding planner (SpecLayout + search +
     # calibration — the verify path builds/compiles steps, so the
     # tracing-hazard rules apply)

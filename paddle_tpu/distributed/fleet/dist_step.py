@@ -522,6 +522,12 @@ class DistributedTrainStep:
         self._amp_state = None
         from .strategy import warn_noop_toggles
         warn_noop_toggles(self._strategy)
+        # per-mesh recompile hook (ISSUE 17): an elastic reform_mesh()
+        # drops this step's compiled program so the next call re-lays
+        # and recompiles for the new world (weakly held — registering
+        # does not pin the step alive)
+        self.reforms = 0
+        mesh_mod.on_reform(self.reform)
 
     # sharding derivation ---------------------------------------------
     def _param_specs(self) -> Dict[str, P]:
@@ -1077,6 +1083,34 @@ class DistributedTrainStep:
                     for n, v in param_vals.items()}
                 for ax in ("u", "v")}
         return opt_state
+
+    def reform(self, mesh=None):
+        """Adopt the (re-formed) global mesh: drop the compiled program
+        and every mesh-derived cache, so the next call re-lays params
+        and optimizer state on the new topology and recompiles for it.
+        Logical state (params, moments, rng chain, step counter) is
+        preserved — this invalidates LAYOUT, not values.  Called
+        automatically by ``mesh.reform_mesh()`` via the ``on_reform``
+        registry; safe to call by hand after installing a mesh."""
+        self._mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+        self._compiled = None
+        self._lr_cache = None
+        if self._accum is not None or self._dgc_state is not None:
+            # accumulators are created once in _ensure_built; re-lay
+            # them here or they would pin the dead mesh's sharding
+            pspecs = self._param_specs()
+
+            def relay(d):
+                return {n: jax.device_put(
+                    v, NamedSharding(self._mesh, pspecs[n]))
+                    for n, v in d.items()}
+
+            if self._accum is not None:
+                self._accum = relay(self._accum)
+            if self._dgc_state is not None:
+                self._dgc_state = {ax: relay(d)
+                                   for ax, d in self._dgc_state.items()}
+        self.reforms += 1
 
     def _assemble_call_args(self, param_vals, buffer_vals, opt_state,
                             lr, key, arg_vals) -> tuple:

@@ -118,6 +118,7 @@ postmortem bad kind), the ``elastic_transitions`` counter and the
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import re
@@ -130,9 +131,10 @@ import numpy as np
 
 from ...framework import monitor as _monitor
 from ...observability import flight_recorder as _flight
-from ..checkpoint import CheckpointManager
+from ..checkpoint import CheckpointManager, StreamedArray
 from .. import mesh as mesh_mod
 from . import chaos as _chaos
+from .elastic_engine import DeviceZeroEngine, ReshardMeter
 from .dist_step import (flatten_zero_state, unflatten_zero_state,
                         zero_shard_ranges)
 from .ps_service import _parse_ep, _recv_msg, _send_msg_raw
@@ -224,6 +226,9 @@ class ElasticCoordinator:
         # bootstraps step 0).  ``ckpt_dir`` derives it automatically by
         # scanning the CheckpointManager directory on (re)start.
         self._ckpt_step: Optional[int] = ckpt_step
+        # per-generation snapshot of _ckpt_step handed to members (see
+        # _reform_locked — all of gen N must agree on the resume point)
+        self._gen_ckpt_step: Optional[int] = ckpt_step
         self._ckpt_dir = ckpt_dir
         self._rounds: Dict[Tuple[int, str], _Round] = {}
         self._last_step = -1
@@ -368,6 +373,12 @@ class ElasticCoordinator:
         self._members.update(self._pending)
         self._pending.clear()
         self._gen += 1
+        # snapshot the resume point PER GENERATION: every member of gen
+        # N must see the SAME ckpt_step, or they disagree about the
+        # bootstrap barrier (a register reply delayed past rank 0's
+        # first ckpt report would see a live ckpt_step its peers read
+        # as None — two members in one barrier, one skipping it: hang)
+        self._gen_ckpt_step = self._ckpt_step
         for r, uid in enumerate(sorted(self._members)):
             self._members[uid].rank = r
         self._rounds.clear()
@@ -391,7 +402,7 @@ class ElasticCoordinator:
             return {"status": "evicted"}
         return {"status": "reform", "gen": self._gen, "rank": m.rank,
                 "world": len(self._members),
-                "ckpt_step": self._ckpt_step}
+                "ckpt_step": self._gen_ckpt_step}
 
     def _on_disconnect(self, uid, reason: str):
         with self._cond:
@@ -979,7 +990,8 @@ class ElasticTrainer:
                  expected_world: Optional[int] = None,
                  client_timeout: float = 120.0,
                  role_maker: Optional[ElasticRoleMaker] = None,
-                 fused_optimizer: Optional[bool] = None):
+                 fused_optimizer: Optional[bool] = None,
+                 engine: Optional[str] = None):
         flat0, meta = flatten_zero_state(
             {k: np.asarray(v, np.float32) for k, v in params.items()})
         self._init_flat = flat0.astype(np.float32)
@@ -1000,6 +1012,33 @@ class ElasticTrainer:
                                           momentum=momentum,
                                           lr_schedule=lr_schedule,
                                           fused=fused_optimizer)
+        # engine selection (ISSUE 17): "device" (default) runs the
+        # compiled slot-ordered reduce + fused opt_apply and streams
+        # checkpoints range-wise; "host" is the PR 9 flat-numpy
+        # reference path.  Run-scoped: the engines differ ~1 ulp on
+        # XLA-CPU FMA-contracted elements (ops/pallas/opt_apply.py),
+        # so bit-contracts hold within an engine, never across.
+        eng = (engine or os.environ.get("PADDLE_ELASTIC_ENGINE")
+               or "device")
+        if eng not in ("device", "host"):
+            raise ValueError(
+                f"engine must be 'device' or 'host', got {eng!r}")
+        self.engine = eng
+        if eng == "device":
+            # the fused kernel is the DEFAULT on the device path; an
+            # explicit fused_optimizer=False / PADDLE_ELASTIC_FUSED=0
+            # still forces the numpy reference math (escape hatch)
+            if fused_optimizer is None and \
+                    os.environ.get("PADDLE_ELASTIC_FUSED") is None:
+                self._opt.fused = True
+            self._engine: Optional[DeviceZeroEngine] = \
+                DeviceZeroEngine(self._micro, self._numel)
+        else:
+            self._engine = None
+        # per-trainer staging meter (models per-HOST accounting — the
+        # in-process multi-rank tests would alias a process-global one);
+        # peak_bytes is the O(max shard) bound tests assert on
+        self.reshard_meter = ReshardMeter()
         self._mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep)
         self._ckpt_every = int(ckpt_every)
         self._endpoint = coordinator
@@ -1043,6 +1082,7 @@ class ElasticTrainer:
             os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         self._client = ElasticClient(endpoint,
                                      timeout=self._client_timeout)
+        self._finished = False
         info = self._client.register(expected)
         while True:
             try:
@@ -1056,6 +1096,18 @@ class ElasticTrainer:
         rank = int(info["rank"])
         world = int(info["world"])
         ckpt_step = info.get("ckpt_step")
+        if self._finished:
+            # teardown cascade: each peer's leave() reforms the
+            # shrinking survivor world, but this trainer already ran
+            # its steps and passed a completion fence — resharding
+            # here would be pure waste (a full restore + recompile
+            # per surviving rank per leave; at world 1 the restore
+            # stages 2x the FULL vector, busting the O(max shard)
+            # staging bound).  Hold the new generation's fence so
+            # peers still draining don't hang on the barrier, then go.
+            self._exchange(gen, total, "done", {})
+            self._client.leave()
+            return self.params()
         mesh_mod.reform_mesh()
         self._role_maker.update_membership(rank, world, gen)
         self.transitions.append({"gen": gen, "rank": rank,
@@ -1067,13 +1119,18 @@ class ElasticTrainer:
             # barrier makes it durable before anyone trains (identical
             # re-saves after a reform mid-bootstrap are atomic no-ops)
             if rank == 0:
-                self._save_checkpoint(0, bootstrap=True)
+                self._save_checkpoint(0, bootstrap=True, world=world)
                 self._report_ckpt(0)
             self._exchange(gen, 0, "bootstrap", {})
             ckpt_step = 0
         start = self._restore(int(ckpt_step), rank, world, gen)
         my_slots = zero_shard_ranges(self._micro, world)[rank]
         lo, hi = zero_shard_ranges(self._numel, world)[rank]
+        if self._engine is not None:
+            # per-mesh recompile hook: the reshard window ends with the
+            # compiled programs rebuilt for the NEW (world, shard) —
+            # steady-state steps never pay a compile
+            self._engine.rebuild(self._opt, world, rank, lo, hi, gen)
         for step in range(start, total):
             _chaos.maybe_kill_worker()
             batch = self._next_batch()
@@ -1084,10 +1141,17 @@ class ElasticTrainer:
             for rp in reps:
                 merged.update(rp)
             # world-size-invariant reduction: fixed slot order, every
-            # worker sums the same byte-identical wire copies
-            gsum = np.zeros(self._numel, np.float32)
-            for s in range(self._micro):
-                gsum += merged[f"g{s}"]
+            # worker sums the same byte-identical wire copies (device
+            # engine: ONE compiled statically-unrolled program — the
+            # world size never enters it, so bit-equality across ranks
+            # AND worlds holds exactly as in the host loop)
+            if self._engine is not None:
+                gsum = self._engine.reduce(
+                    [merged[f"g{s}"] for s in range(self._micro)])
+            else:
+                gsum = np.zeros(self._numel, np.float32)
+                for s in range(self._micro):
+                    gsum += merged[f"g{s}"]
             new_shard = self._opt.update(self._flat[lo:hi], gsum[lo:hi])
             reps = self._exchange(gen, step, "params",
                                   {"p": new_shard})
@@ -1105,24 +1169,67 @@ class ElasticTrainer:
         # member of this generation reaches the fence (a rejoiner that
         # restored the final checkpoint runs zero steps and lands here
         # too), so nobody leaves before the report is durable.
+        # set BEFORE the fence: a peer's leave can reform the generation
+        # while our done-exchange is in flight, and the re-entry must
+        # already know the steps + final checkpoint round are behind us
+        self._finished = True
         self._exchange(gen, total, "done", {})
         self._client.leave()
         return self.params()
 
     # -- state ----------------------------------------------------------
-    def _save_checkpoint(self, done: int, bootstrap: bool = False):
-        if bootstrap:
-            flat = self._init_flat.copy()
-            slots = {k: np.zeros(self._numel, np.float32)
-                     for k in self._opt.SLOTS}
-            t = 0
-            cursor = self._loader.state_dict()
+    def _view_chunks(self, src: np.ndarray, ranges):
+        """Zero-arg chunk factory over VIEWS of a resident flat vector,
+        staged (and metered) one shard range at a time at write time."""
+        src = np.asarray(src, np.float32)
+
+        def chunks():
+            for a, b in ranges:
+                with self.reshard_meter.hold(src[a:b]) as c:
+                    yield c
+        return chunks
+
+    def _zero_chunks(self, ranges):
+        """Bootstrap slots, materialized one shard range at a time —
+        rank 0 never allocates a full ``numel`` zero vector per slot."""
+        def chunks():
+            for a, b in ranges:
+                with self.reshard_meter.hold(
+                        np.zeros(b - a, np.float32)) as c:
+                    yield c
+        return chunks
+
+    def _save_checkpoint(self, done: int, bootstrap: bool = False,
+                         world: Optional[int] = None, opt_streams=None):
+        cursor = self._loader.state_dict()
+        flat = self._init_flat if bootstrap else self._flat
+        t = 0 if bootstrap else self._opt.t
+        if self._engine is not None:
+            # streamed path (ISSUE 17): every array leaf goes to disk
+            # shard-by-shard through StreamedArray — the on-disk bytes
+            # are IDENTICAL to the concat path (same .npy payload, same
+            # index; tests prove byte equality), only the staging
+            # changes: O(max shard), not O(numel * slots).
+            assert world is not None, "device-path save needs the world"
+            ranges = zero_shard_ranges(self._numel, world)
+            model_leaf: Any = StreamedArray(
+                (self._numel,), np.float32,
+                self._view_chunks(flat, ranges))
+            if opt_streams is None:
+                # bootstrap runs on rank 0 ALONE, before the barrier —
+                # no exchange rounds, just streamed zeros
+                opt_streams = {k: StreamedArray(
+                    (self._numel,), np.float32, self._zero_chunks(ranges))
+                    for k in self._opt.SLOTS}
+            opt: Any = opt_streams
         else:
-            flat, slots, t = self._flat, None, self._opt.t
-            cursor = self._loader.state_dict()
+            model_leaf = np.asarray(flat, np.float32)
+            opt = ({k: np.zeros(self._numel, np.float32)
+                    for k in self._opt.SLOTS} if bootstrap
+                   else self._full_slots)
         state = {
-            "model": {"flat": np.asarray(flat, np.float32)},
-            "opt": slots if slots is not None else self._full_slots,
+            "model": {"flat": model_leaf},
+            "opt": opt,
             "meta": {"step": int(done), "opt_t": int(t),
                      "epoch": int(cursor["epoch"]),
                      "batch": int(cursor["batch"])},
@@ -1133,39 +1240,132 @@ class ElasticTrainer:
             self._mgr.unpin(s)
 
     def _checkpoint_round(self, gen, step, rank, world, done):
-        payload = {f"s:{k}": v for k, v in self._opt.state().items()}
-        reps = self._exchange(gen, step, "ckpt", payload)
+        if self._engine is None:
+            payload = {f"s:{k}": v
+                       for k, v in self._opt.state().items()}
+            reps = self._exchange(gen, step, "ckpt", payload)
+            if rank == 0:
+                self._full_slots = {
+                    k: np.concatenate([np.asarray(reps[r][f"s:{k}"],
+                                                  np.float32)
+                                       for r in range(world)])
+                    for k in self._opt.SLOTS}
+                self._save_checkpoint(done)
+                self._report_ckpt(done)
+            return
+        # device path (ISSUE 17): slot state moves range-wise — one
+        # coordinator round per (slot, owner rank), tag "ckpt:{k}:{r}"
+        # (distinct tags are distinct barriers) — and rank 0 consumes
+        # each round INSIDE the streamed writer, so no rank ever stages
+        # more than one shard of any slot.  Every rank must run the
+        # identical round sequence: SLOTS order, then owner rank
+        # 0..world-1; rank 0's rounds fire lazily from the chunk
+        # generators in exactly that order because the state dict
+        # writes model||flat (no rounds) first, then slots in SLOTS
+        # order.  A Reform mid-round unwinds through the writer: the
+        # index is never written, so the torn step stays invisible and
+        # the deterministic replay re-saves identical bytes.
+        my = self._opt.state()
+        moved = {"bytes": 0}
+
+        def slot_chunks(k):
+            def chunks():
+                for r in range(world):
+                    reps = self._exchange(
+                        gen, step, f"ckpt:{k}:{r}",
+                        {"s": my[k]} if r == rank else {})
+                    c = np.asarray(reps[r]["s"], np.float32)
+                    moved["bytes"] += int(c.nbytes)
+                    with self.reshard_meter.hold(c):
+                        yield c
+            return chunks
+
         if rank == 0:
-            self._full_slots = {
-                k: np.concatenate([np.asarray(reps[r][f"s:{k}"],
-                                              np.float32)
-                                   for r in range(world)])
-                for k in self._opt.SLOTS}
-            self._save_checkpoint(done)
+            streams = {k: StreamedArray((self._numel,), np.float32,
+                                        slot_chunks(k))
+                       for k in self._opt.SLOTS}
+            self._save_checkpoint(done, world=world,
+                                  opt_streams=streams)
+        else:
+            for k in self._opt.SLOTS:
+                for r in range(world):
+                    self._exchange(gen, step, f"ckpt:{k}:{r}",
+                                   {"s": my[k]} if r == rank else {})
+                    if r == rank:
+                        moved["bytes"] += int(my[k].nbytes)
+        _flight.record("elastic.reshard.exchange", step=int(done),
+                       gen=int(gen), rank=int(rank), world=int(world),
+                       bytes=int(moved["bytes"]),
+                       rounds=len(self._opt.SLOTS) * world)
+        if rank == 0:
             self._report_ckpt(done)
 
     def _restore(self, ckpt_step: int, rank: int, world: int, gen: int):
         t0 = time.perf_counter()
-        st = self._mgr.restore(ckpt_step)
-        flat = np.asarray(st["model"]["flat"], np.float32)
-        if flat.size != self._numel:
-            raise RuntimeError(
-                f"checkpoint step {ckpt_step} holds {flat.size} "
-                f"parameters, this trainer expects {self._numel}")
-        meta = st["meta"]
         lo, hi = zero_shard_ranges(self._numel, world)[rank]
-        slots = {k: np.asarray(v, np.float32)[lo:hi].copy()
-                 for k, v in st.get("opt", {}).items()}
-        self._opt.load(slots, t=meta["opt_t"])
-        self._flat = flat.copy()
+        if self._engine is None:
+            st = self._mgr.restore(ckpt_step)
+            flat = np.asarray(st["model"]["flat"], np.float32)
+            if flat.size != self._numel:
+                raise RuntimeError(
+                    f"checkpoint step {ckpt_step} holds {flat.size} "
+                    f"parameters, this trainer expects {self._numel}")
+            meta = st["meta"]
+            slots = {k: np.asarray(v, np.float32)[lo:hi].copy()
+                     for k, v in st.get("opt", {}).items()}
+            self._opt.load(slots, t=meta["opt_t"])
+            self._flat = flat.copy()
+            nbytes = int(flat.nbytes) + sum(
+                int(np.asarray(v).nbytes)
+                for v in st.get("opt", {}).values())
+        else:
+            # ranged path (ISSUE 17): slots come back as O(shard)
+            # mmap ranged reads and the replica is assembled range-wise
+            # — the restore MACHINERY never stages more than a shard
+            # (the replica itself is full-size by the grad_fn host
+            # contract; that is the bound the meter test pins down)
+            shape, _ = self._mgr.entry_meta(ckpt_step,
+                                            ("model", "flat"))
+            if len(shape) != 1 or int(shape[0]) != self._numel:
+                raise RuntimeError(
+                    f"checkpoint step {ckpt_step} holds shape {shape} "
+                    f"parameters, this trainer expects ({self._numel},)")
+            meta = self._mgr.restore(ckpt_step, names=["meta"])["meta"]
+            nbytes = 0
+            with contextlib.ExitStack() as held:
+                slots = {}
+                for k in self._opt.SLOTS:
+                    arr = self._mgr.restore_range(ckpt_step,
+                                                  ("opt", k), lo, hi)
+                    held.enter_context(self.reshard_meter.hold(arr))
+                    slots[k] = np.asarray(arr, np.float32)
+                    nbytes += int(arr.nbytes)
+                # load() copies the shard into live state while the
+                # staging is still held — the meter sees staging only
+                self._opt.load(slots, t=meta["opt_t"])
+            flat = np.empty(self._numel, np.float32)
+            for a, b in zero_shard_ranges(self._numel, world):
+                with self.reshard_meter.hold(
+                        self._mgr.restore_range(
+                            ckpt_step, ("model", "flat"), a, b)) as c:
+                    flat[a:b] = c
+                    nbytes += int(c.nbytes)
+            self._flat = flat
+            _flight.record(
+                "elastic.reshard.load",
+                ms=round((time.perf_counter() - t0) * 1e3, 3),
+                bytes=int(nbytes), gen=int(gen), world=int(world),
+                rank=int(rank), step=int(ckpt_step))
         self._loader.load_state_dict({"epoch": meta["epoch"],
                                       "batch": meta["batch"],
                                       "seed": self._loader.seed})
         self._bit = None
         ms = (time.perf_counter() - t0) * 1e3
         _monitor.hist_observe("reshard_ms", ms)
+        _monitor.hist_observe("reshard_bytes", float(nbytes))
         _flight.record("elastic.reshard", ms=round(ms, 3), gen=int(gen),
-                       world=int(world), step=int(meta["step"]))
+                       world=int(world), step=int(meta["step"]),
+                       bytes=int(nbytes), engine=self.engine)
         _flight.record("elastic.resume", gen=int(gen), rank=int(rank),
                        world=int(world), step=int(meta["step"]))
         return int(meta["step"])

@@ -47,8 +47,15 @@ needed, the machinery is symmetric.  Two things make it sound:
     the ``cur - mirror`` delta is always exactly the unshipped local
     writes — neither echoing a peer delta back nor missing one).
     Fixed point: both sides hold base + all local writes + all peer
-    writes, each applied exactly once (bit-equal across sites for
-    order-insensitive payloads, e.g. integer-valued f32);
+    writes, each applied exactly once.  Bit equality across sites —
+    also for INEXACT payloads (ISSUE 17) — is enforced by a residual
+    verify pass: once a table's local traffic quiesces, the AUTHORITY
+    side (greater ``geo_site``, the LWW tie-break direction) pulls the
+    peer's actual rows for every id that took part in a cross-site
+    merge and ships the Sterbenz-exact ``cur - peer`` difference until
+    the bits match — closing the ±1 ulp drift a local commit racing a
+    peer's ship loop can leave behind (the mirror replays the peer's
+    apply chain in commit order, so it cannot see that race);
   - ``"lww"`` — last-writer-wins per ``(lamport seq, site)`` stamp:
     local writes mint stamps on the server (the stamp directory
     replicates to standbys), the pusher ships ABSOLUTE rows via
@@ -115,7 +122,12 @@ class GeoPusher:
         # the pusher starts must queue a backlog, not kill the ctor
         self._client = client
         self._endpoints = endpoints
-        self._src = src or f"geo-{server.port}"
+        # site-named src when the server has one: the peer learns our
+        # site from the prefix-stripped src, which the cross-site
+        # residual verify pass (ISSUE 17) needs to elect its authority
+        site = getattr(server, "geo_site", None)
+        self._src = src or (f"geo-{site}" if site is not None
+                            else f"geo-{server.port}")
         self._client_kw = dict(client_kw)
         self._own_client = client is None
         self._tables = None if tables is None else set(tables)
@@ -131,6 +143,22 @@ class GeoPusher:
         # atomically with the row read)
         self._peer_prefix = "geo-"
         self._inbound: Dict[str, List] = {}
+        # cross-site residual verify (ISSUE 17): ids whose rows took
+        # part in a cross-site additive merge (shipped or inbound) and
+        # still await a bit-equality check against the PEER'S ACTUAL
+        # rows.  The mirror replays the peer's apply chain in commit
+        # order, so serialized flushes converge bit-exactly — but a
+        # local commit racing inside the peer's ship loop leaves the
+        # receiver's row ±1 ulp off the shipper's mirror with nothing
+        # ever re-reading the real bits.  The AUTHORITY side (greater
+        # geo_site, the LWW tie-break direction — one side only, so
+        # corrections cannot bounce) drains this set once local traffic
+        # quiesces: pull the peer's rows, ship the Sterbenz-exact
+        # ``cur - peer`` residual, done when the bits match.
+        self._xsite: Dict[str, set] = {}
+        self._peer_site: Optional[str] = None
+        self.verified_ids = 0
+        self.corrected_ids = 0
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._flush_lock = threading.Lock()   # flush() is not reentrant
@@ -185,10 +213,14 @@ class GeoPusher:
             # mirror advances in step with the table; LWW geo_sets need
             # nothing (the stamp directory already decided).
             if op == "push_delta":
+                ids = np.array(rec["ids"], np.int64).reshape(-1)
                 with self._lock:
+                    if self._peer_site is None:
+                        self._peer_site = src[len(self._peer_prefix):]
                     self._inbound.setdefault(table, []).append(
-                        (np.array(rec["ids"], np.int64).reshape(-1),
-                         np.array(rec["deltas"], np.float32)))
+                        (ids, np.array(rec["deltas"], np.float32)))
+                    self._xsite.setdefault(table, set()).update(
+                        ids.tolist())
             return
         with self._lock:
             self._dirty.setdefault(table, set()).update(
@@ -196,7 +228,25 @@ class GeoPusher:
 
     def backlog(self) -> int:
         with self._lock:
-            return sum(len(s) for s in self._dirty.values())
+            n = sum(len(s) for s in self._dirty.values())
+            # unverified cross-site ids count only on the side that
+            # will actually drain them, so drain() forces the verify
+            # pass to completion without wedging the non-authority
+            if self._is_authority():
+                n += sum(len(s) for s in self._xsite.values())
+            return n
+
+    def _is_authority(self) -> bool:
+        """True iff this side runs the cross-site residual verify:
+        deterministically the GREATER geo_site (the same direction as
+        the LWW site tie-break).  False until the peer's site is known
+        (nothing cross-site has landed yet) or when the local server
+        has no site name (unidirectional deployments: no verify, no
+        behavior change)."""
+        mine = getattr(self._server, "geo_site", None)
+        peer = self._peer_site
+        return (mine is not None and peer is not None
+                and str(mine) > str(peer))
 
     # -- flush ----------------------------------------------------------
     def _mirror(self, table: str) -> SparseTable:
@@ -307,9 +357,91 @@ class GeoPusher:
                     _flight.record("ps.geo.push", table=table,
                                    n=int(n_pushed), policy=policy,
                                    backlog=self.backlog())
+                # ship rounds are exactly the drift window the verify
+                # pass exists for: anything we just pushed awaits a
+                # cross-site bit check (authority side only)
+                if n_pushed and policy == "add" and ids.size:
+                    with self._lock:
+                        if self._is_authority():
+                            self._xsite.setdefault(table, set()).update(
+                                ids.tolist())
+            total += self._verify_pass()
             if _monitor.metrics_enabled():
                 _monitor.gauge_set("ps_geo_backlog_ids", self.backlog())
             return total
+
+    def _verify_pass(self) -> int:
+        """Authority-side stage of flush(): bit-verify quiesced
+        cross-site ids against the peer's ACTUAL rows (see the _xsite
+        comment in __init__).  Runs only for tables with no local
+        dirty/inbound traffic — during active shipping the rows differ
+        legitimately, and correcting then would just thrash."""
+        if not self._is_authority():
+            mine = getattr(self._server, "geo_site", None)
+            with self._lock:
+                # non-authority (or unidentifiable) side never drains
+                # the set — drop it instead of growing without bound
+                if self._xsite and (mine is None
+                                    or self._peer_site is not None):
+                    self._xsite.clear()
+            return 0
+        with self._lock:
+            quiet = [t for t in list(self._xsite)
+                     if self._xsite.get(t)
+                     and not self._dirty.get(t)
+                     and not self._inbound.get(t)]
+        corrected = 0
+        for table in quiet:
+            corrected += self._verify_xsite(table)
+        return corrected
+
+    def _verify_xsite(self, table: str) -> int:
+        with self._lock:
+            pend = self._xsite.get(table) or set()
+            take = [pend.pop() for _ in range(min(len(pend),
+                                                  self._rate))]
+        if not take:
+            return 0
+        ids = np.asarray(sorted(take), np.int64)
+        try:
+            mirror = self._mirror(table)
+            # peer pull FIRST, then the local read: a local commit
+            # landing in between joins the residual harmlessly — the
+            # mirror advances by exactly what ships, so the normal
+            # path's ``cur - mirror`` still covers only unshipped
+            # writes (nothing double-applies)
+            peer_rows = self._ensure_client().pull(table, ids)
+            src_t = self._server._tables[table]
+            with self._server._apply_lock:
+                cur = src_t.pull(ids)
+            resid = (cur - peer_rows).astype(np.float32)
+            bad = np.flatnonzero(np.any(resid != 0, axis=1))
+            self.verified_ids += int(ids.size - bad.size)
+            if bad.size == 0:
+                return 0
+            sub_ids = np.ascontiguousarray(ids[bad])
+            sub = np.ascontiguousarray(resid[bad])
+            self._ensure_client().push_delta(table, sub_ids, sub,
+                                             sync=True)
+            mirror.push_delta(sub_ids, sub)
+        except (PSError, PSUnavailable):
+            self.push_failures += 1
+            _monitor.stat_add("ps_geo_push_failures")
+            with self._lock:
+                self._xsite.setdefault(table, set()).update(take)
+            raise
+        self.corrected_ids += int(bad.size)
+        _monitor.stat_add("ps_geo_xsite_corrections", int(bad.size))
+        _flight.record("ps.geo.push", table=table, n=int(bad.size),
+                       policy="add-xsite-residual",
+                       backlog=self.backlog())
+        # a correction is Sterbenz-exact for ulp-scale gaps but a
+        # racing write can reopen one: re-queue until the pull comes
+        # back bit-equal
+        with self._lock:
+            self._xsite.setdefault(table, set()).update(
+                sub_ids.tolist())
+        return int(bad.size)
 
     def _ship_lww(self, table: str, ids: np.ndarray, cur: np.ndarray,
                   stamps) -> int:

@@ -43,10 +43,44 @@ from .planner.spec_layout import AXES, get_layout as _layout
 __all__ = [
     "AXES", "init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
     "data_axes", "batch_spec", "named_sharding", "maybe_constrain",
-    "reform_mesh",
+    "reform_mesh", "on_reform",
 ]
 
 _global_mesh: Optional[Mesh] = None
+
+# per-mesh recompile hooks (ISSUE 17): owners of compiled programs
+# (DistributedTrainStep) register here so an elastic reform_mesh()
+# invalidates them in one place instead of every driver knowing every
+# owner.  Weak references: a registered step must not be kept alive —
+# dead entries are pruned at fire time.
+_reform_hooks: list = []
+
+
+def on_reform(hook) -> None:
+    """Register a callable invoked with the NEW mesh after every
+    :func:`reform_mesh`.  Bound methods are held weakly (a registered
+    owner stays collectable); other callables are held strongly."""
+    import weakref
+    try:
+        ref = weakref.WeakMethod(hook)
+    except TypeError:
+        ref = (lambda h=hook: h)
+    _reform_hooks.append(ref)
+
+
+def _fire_reform(mesh: Mesh) -> None:
+    dead = []
+    for ref in list(_reform_hooks):
+        hook = ref()
+        if hook is None:
+            dead.append(ref)
+            continue
+        hook(mesh)
+    for ref in dead:
+        try:
+            _reform_hooks.remove(ref)
+        except ValueError:
+            pass
 
 
 def init_mesh(degrees: Optional[Dict[str, int]] = None,
@@ -97,11 +131,15 @@ def reform_mesh(degrees: Optional[Dict[str, int]] = None,
     this is the site where the runtime re-initialises the coordination
     service for the surviving hosts; in single-host worlds it
     re-derives the all-``dp`` mesh.  Compiled programs holding the old
-    mesh must be rebuilt by their owners (DistributedTrainStep compiles
-    per-mesh; the elastic trainer re-enters its generation loop)."""
+    mesh must be rebuilt by their owners: every hook registered via
+    :func:`on_reform` fires with the new mesh (DistributedTrainStep
+    registers its ``reform`` method, dropping its compiled program so
+    the next call re-lays params and recompiles for the new world)."""
     set_mesh(None)
-    return init_mesh(degrees if degrees is not None else {"dp": -1},
+    mesh = init_mesh(degrees if degrees is not None else {"dp": -1},
                      devices=devices)
+    _fire_reform(mesh)
+    return mesh
 
 
 def set_mesh(mesh: Optional[Mesh]):

@@ -120,10 +120,18 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # event loop and the TTL sweeper ARE the online loop making progress —
 # either going silent is exactly the stall a bundle should autopsy
 # (online.freshness_breach stays a bad kind in tools/postmortem.py).
+# elastic.reshard.exchange/load/compile (ISSUE 17): the reshard
+# decomposition — range-wise slot exchange, ranged checkpoint reads,
+# per-mesh recompile.  Each sub-phase can individually dominate a
+# transition (a big model's compile, a cold disk's load), so each is
+# its own heartbeat with byte counts for the postmortem to apportion.
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
                              "serve.decode", "serve.admit",
                              "serve.spec_verify",
                              "elastic.join", "elastic.reshard",
+                             "elastic.reshard.exchange",
+                             "elastic.reshard.load",
+                             "elastic.reshard.compile",
                              "elastic.resume", "elastic.promote",
                              "ps.replica.attach", "ps.promote",
                              "ps.geo.push", "online.ingest",

@@ -81,6 +81,18 @@ class StreamingTrainer:
 
     ``state_path``: where the cursor checkpoint lives; None disables
     checkpointing (a restart then replays from the stream head).
+
+    ``dense_step(batch)`` (ISSUE 17): the DENSE half of the model,
+    trained through the same compiled engine the elastic data plane
+    runs (a bound ``DistributedTrainStep.step`` — or any closure over
+    the fused ``opt_apply`` path).  Called once per consumed batch,
+    after the sparse push.  Semantics are AT-LEAST-ONCE across a
+    kill/resume: dense updates carry no idempotency stamp, so the few
+    batches between the last cursor checkpoint and the crash re-apply
+    on replay — for SGD-family dense updates that is a bounded,
+    decaying perturbation, and the sparse side's exactly-once is
+    untouched.  Callers needing exact dense replay should checkpoint
+    dense state together with the cursor (``ckpt_every``-aligned).
     """
 
     def __init__(self, loader, client, table: str,
@@ -90,7 +102,8 @@ class StreamingTrainer:
                  ckpt_every: int = 64,
                  ingest_ts_fn: Optional[Callable] = None,
                  merge_duplicates: bool = True,
-                 device_merge: bool = False):
+                 device_merge: bool = False,
+                 dense_step: Optional[Callable] = None):
         self._loader = loader
         self._client = client
         self._table = str(table)
@@ -101,6 +114,8 @@ class StreamingTrainer:
         self._ingest_ts_fn = ingest_ts_fn
         self._merge = bool(merge_duplicates)
         self._device_merge = bool(device_merge)
+        self._dense_step = dense_step
+        self.dense_steps = 0     # dense-engine steps this process
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -160,6 +175,12 @@ class StreamingTrainer:
                 # without re-applying — exactly-once held
                 self.dup_acks += 1
                 _monitor.stat_add("online_replayed_batches")
+            if self._dense_step is not None:
+                # dense half through the shared compiled engine
+                # (at-least-once on replay — see class docstring)
+                self._dense_step(batch)
+                self.dense_steps += 1
+                _monitor.stat_add("online_dense_steps")
             self.batches += 1
             self.events += n_events
             _monitor.stat_add("online_events", n_events)

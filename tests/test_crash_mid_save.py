@@ -77,6 +77,140 @@ def test_sigkill_mid_save_keeps_previous_step_restorable(tmp_path):
     assert state["step"] == 1
 
 
+# ISSUE 17: the same contract for the elastic trainer's STREAMED saves.
+# The child runs a world-1 device-engine elastic run whose step-2 save
+# streams shard-by-shard through exchange-fed chunk generators; the
+# bomb SIGKILLs the process as an OPTIMIZER shard file of step 2 is
+# being published — i.e. genuinely mid-stream: the flat param file has
+# landed, some slot shards have not, and the index (the commit record)
+# never will.
+_ELASTIC_CHILD_SRC = r"""
+import os, signal, sys
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.fleet.elastic import (ElasticCoordinator,
+                                                  ElasticTrainer)
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+
+class S(Dataset):
+    def __init__(self):
+        rng = np.random.default_rng(7)
+        self.x = rng.standard_normal((64, 4)).astype(np.float32)
+        self.y = (self.x @ np.arange(1, 5, dtype=np.float32)
+                  ).astype(np.float32)
+
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def grad_fn(params, batch):
+    x, y = batch
+    err = (x @ params["w"] + params["b"] - y).astype(np.float32)
+    n = np.float32(x.shape[0])
+    return {"w": (x.T @ err / n).astype(np.float32),
+            "b": np.asarray(err.sum() / n, np.float32).reshape(())}
+
+
+real_replace = os.replace
+def bomb(src, dst):
+    base = os.path.basename(dst)
+    if "step_2" in dst and "opt" in base:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return real_replace(src, dst)
+ckpt.os.replace = bomb
+
+coord = ElasticCoordinator(expected_world=1).start()
+loader = DataLoader(S(), batch_size=16, shuffle=True, seed=11,
+                    drop_last=True)
+tr = ElasticTrainer(
+    {"w": np.zeros(4, np.float32), "b": np.zeros((), np.float32)},
+    grad_fn, loader, ckpt_dir=sys.argv[2], optimizer="adam",
+    micro_batches=2, ckpt_every=2,
+    coordinator=f"127.0.0.1:{coord.port}", expected_world=1,
+    client_timeout=30.0)
+assert tr.engine == "device"
+tr.run(2)
+raise SystemExit("unreachable: the step-2 streamed save must have died")
+"""
+
+
+def test_sigkill_mid_streamed_elastic_save(tmp_path):
+    d = str(tmp_path / "eck")
+    r = subprocess.run([sys.executable, "-c", _ELASTIC_CHILD_SRC,
+                        _REPO, d],
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+
+    # the torn step really is mid-stream on disk: shard .npy files
+    # (the flat params at least) but NO commit record
+    step2 = os.path.join(d, "step_2")
+    assert os.path.isdir(step2)
+    assert not os.path.exists(os.path.join(step2,
+                                           "checkpoint.index.json"))
+    assert any(f.endswith(".npy") or f.endswith(".npy.tmp")
+               for f in os.listdir(step2)), os.listdir(step2)
+
+    # invisible to the manager; the bootstrap step stays restorable
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    mgr = CheckpointManager(d, max_to_keep=3)
+    assert mgr.all_steps() == [0]
+    st = mgr.restore(0)
+    assert st["meta"]["step"] == 0
+    np.testing.assert_array_equal(st["model"]["flat"],
+                                  np.zeros(5, np.float32))
+
+    # a rerun of the SAME deterministic problem over the same directory
+    # resumes from step 0, replays, and re-saves step 2 OVER the torn
+    # leftovers (identical bytes by determinism — the overwrite is a
+    # re-commit, not a divergence), publishing the index this time
+    sys.path.insert(0, _REPO)
+    from paddle_tpu.distributed.fleet.elastic import (ElasticCoordinator,
+                                                      ElasticTrainer)
+    from paddle_tpu.io.dataloader import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class S(Dataset):                  # mirrors the child's dataset
+        def __init__(self):
+            rng = np.random.default_rng(7)
+            self.x = rng.standard_normal((64, 4)).astype(np.float32)
+            self.y = (self.x @ np.arange(1, 5, dtype=np.float32)
+                      ).astype(np.float32)
+
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def grad_fn(params, batch):
+        x, y = batch
+        err = (x @ params["w"] + params["b"] - y).astype(np.float32)
+        n = np.float32(x.shape[0])
+        return {"w": (x.T @ err / n).astype(np.float32),
+                "b": np.asarray(err.sum() / n, np.float32).reshape(())}
+
+    coord = ElasticCoordinator(expected_world=1, ckpt_dir=d).start()
+    loader = DataLoader(S(), batch_size=16, shuffle=True, seed=11,
+                        drop_last=True)
+    tr = ElasticTrainer(
+        {"w": np.zeros(4, np.float32), "b": np.zeros((), np.float32)},
+        grad_fn, loader, ckpt_dir=d, optimizer="adam",
+        micro_batches=2, ckpt_every=2,
+        coordinator=f"127.0.0.1:{coord.port}", expected_world=1,
+        client_timeout=30.0)
+    tr.run(2)
+    coord.stop()
+    assert 2 in mgr.all_steps()
+    assert mgr.restore(2)["meta"]["step"] == 2
+
+
 def test_torn_shard_file_fails_loudly_not_garbage(tmp_path):
     """A shard file torn AFTER the index landed (lost fsync) must raise,
     not hand back np.empty garbage as weights."""

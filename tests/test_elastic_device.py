@@ -1,0 +1,400 @@
+"""Device-native elastic data plane (ISSUE 17).
+
+The PR 9 contracts — world-invariant trajectories, bit-identical
+checkpoints, N->M reshard as a pure function — are re-asserted here
+with the COMPILED engine as the default: slot-ordered reduction as one
+jitted program, the optimizer routed through the fused ``opt_apply``
+kernel, checkpoints streamed shard-by-shard, restores as ranged reads.
+Plus the new guarantees: the host path stays selectable (run-scoped),
+streamed checkpoints are byte-identical to the concat format, and the
+reshard/checkpoint machinery never stages more than O(max shard) on
+one host (asserted via the trainer's ReshardMeter).
+"""
+import gc
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.distributed.checkpoint import (  # noqa: E402
+    CheckpointManager, save_state_dict)
+from paddle_tpu.distributed import mesh as mesh_mod  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
+    ElasticCoordinator, ElasticTrainer)
+from paddle_tpu.framework import monitor as _monitor  # noqa: E402
+from paddle_tpu.io.dataloader import DataLoader  # noqa: E402
+from paddle_tpu.io.dataset import Dataset  # noqa: E402
+from paddle_tpu.observability import flight_recorder  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import elastic_worker  # noqa: E402
+
+
+def _make_trainer(ckpt, ep, world, grad_fn=None, **kw):
+    loader = DataLoader(elastic_worker.RegressionSet(), batch_size=16,
+                        shuffle=True, seed=11, drop_last=True)
+    defaults = dict(ckpt_dir=ckpt, optimizer="adam", lr=0.05,
+                    micro_batches=4, ckpt_every=2, coordinator=ep,
+                    expected_world=world, client_timeout=60.0)
+    defaults.update(kw)
+    return ElasticTrainer(
+        {"w": np.zeros(elastic_worker.DIM, np.float32),
+         "b": np.zeros((), np.float32)},
+        grad_fn or elastic_worker.grad_fn, loader, **defaults)
+
+
+def _run_world(ckpt, world, steps, grad_fn=None, coord=None, **kw):
+    own = coord is None
+    if own:
+        coord = ElasticCoordinator(expected_world=world).start()
+    ep = f"127.0.0.1:{coord.port}"
+    trainers = [_make_trainer(ckpt, ep, world, grad_fn=grad_fn, **kw)
+                for _ in range(world)]
+    results = [None] * world
+    errs = [None] * world
+
+    def go(i):
+        try:
+            results[i] = trainers[i].run(steps)
+        except BaseException as e:  # surfaced below
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,), daemon=True)
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(not t.is_alive() for t in ts), "elastic run hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    if own:
+        coord.stop()
+    return results, trainers, coord
+
+
+# ---------------------------------------------------------------------------
+# engine selection + device-path world invariance
+# ---------------------------------------------------------------------------
+
+def test_device_engine_is_default_and_world_invariant(tmp_path):
+    """The compiled engine is the DEFAULT, it routes the optimizer
+    through the fused kernel, and the PR 9 bar holds on it: a world-1
+    and a world-2 run produce bit-identical final weights."""
+    (r1,), (t1,), _ = _run_world(str(tmp_path / "ck1"), 1, 8)
+    r2, t2s, _ = _run_world(str(tmp_path / "ck2"), 2, 8)
+    assert t1.engine == "device" and t1._engine is not None
+    assert t1._opt.fused is True           # opt_apply is the default
+    assert t1._engine.compiles >= 1        # per-generation rebuild ran
+    for tr in t2s:
+        assert tr._engine.compiles >= 1
+        assert tr._engine.world == 2
+    for r in r2:
+        assert np.array_equal(r["w"], r1["w"])
+        assert np.array_equal(r["b"], r1["b"])
+    h = _monitor.get_histogram("reshard_bytes")
+    assert h is not None and h.snapshot()["count"] > 0
+
+
+def test_host_engine_stays_selectable(tmp_path, monkeypatch):
+    """engine='host' (or PADDLE_ELASTIC_ENGINE=host) selects the PR 9
+    flat-numpy reference path — run-scoped, still world-invariant."""
+    (r1,), (t1,), _ = _run_world(str(tmp_path / "h1"), 1, 6,
+                                 engine="host")
+    r2, t2s, _ = _run_world(str(tmp_path / "h2"), 2, 6, engine="host")
+    assert t1.engine == "host" and t1._engine is None
+    for r in r2:
+        assert np.array_equal(r["w"], r1["w"])
+        assert np.array_equal(r["b"], r1["b"])
+    monkeypatch.setenv("PADDLE_ELASTIC_ENGINE", "host")
+    ep_coord = ElasticCoordinator(expected_world=1).start()
+    tr = _make_trainer(str(tmp_path / "h3"),
+                       f"127.0.0.1:{ep_coord.port}", 1)
+    ep_coord.stop()
+    assert tr.engine == "host" and tr._engine is None
+    with pytest.raises(ValueError, match="engine"):
+        _make_trainer(str(tmp_path / "h4"), "127.0.0.1:1", 1,
+                      engine="gpu")
+
+
+def test_checkpoints_bit_identical_across_engines_is_not_promised():
+    """Documentation pin: the engine choice is RUN-scoped.  This test
+    exists to fail loudly if someone 'simplifies' the knob away —
+    ElasticTrainer must keep accepting both engines."""
+    import inspect
+    sig = inspect.signature(ElasticTrainer.__init__)
+    assert "engine" in sig.parameters
+    assert sig.parameters["engine"].default is None
+
+
+# ---------------------------------------------------------------------------
+# streamed checkpoints: byte identity with the concat format
+# ---------------------------------------------------------------------------
+
+def _dir_bytes(d):
+    out = {}
+    for f in sorted(os.listdir(d)):
+        with open(os.path.join(d, f), "rb") as fh:
+            out[f] = fh.read()
+    return out
+
+
+def test_streamed_checkpoint_bytes_equal_concat_format(tmp_path):
+    """A step dir written by the device path's streamed writer is
+    byte-identical — every shard file AND the index — to the same
+    state written through the plain concat ``save_state_dict``: the
+    on-disk format did not move, only the peak memory did."""
+    ck = str(tmp_path / "ck")
+    _run_world(ck, 2, 4)                      # streamed saves at 0,2,4
+    mgr = CheckpointManager(ck)
+    for step in (0, 4):                       # bootstrap + steady-state
+        st = mgr.restore(step)                # full concat load
+        ref = str(tmp_path / f"ref_{step}")
+        save_state_dict(st, ref)              # pre-PR concat writer
+        got = _dir_bytes(os.path.join(ck, f"step_{step}"))
+        want = _dir_bytes(ref)
+        assert sorted(got) == sorted(want)
+        for f in want:
+            assert got[f] == want[f], f"{f} diverged at step {step}"
+
+
+def test_device_restore_reads_ranges_not_full_vectors(tmp_path):
+    """N->M reshard through the ranged-restore path: a world-3 resume
+    from a world-2 run's pinned step reaches the same final state as
+    an uninterrupted run — with ranged reads only."""
+    ck = str(tmp_path / "ck")
+    _run_world(ck, 2, 6)
+    coord = ElasticCoordinator(expected_world=3, ckpt_step=6).start()
+    r3, trainers, _ = _run_world(ck, 3, 10, coord=coord)
+    coord.stop()
+    for tr in trainers:
+        assert tr.transitions[0]["resume_step"] == 6
+    (ref,), _, _ = _run_world(str(tmp_path / "ref"), 1, 10)
+    for r in r3:
+        assert np.array_equal(r["w"], ref["w"])
+        assert np.array_equal(r["b"], ref["b"])
+
+
+# ---------------------------------------------------------------------------
+# the O(max shard) bound, asserted
+# ---------------------------------------------------------------------------
+
+_BIG = 30_000 - 1     # +1 scalar bias -> numel = 30_000
+
+
+class _BigSet(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(5)
+        self.x = rng.standard_normal(n).astype(np.float32)
+
+    def __len__(self):
+        return self.x.size
+
+    def __getitem__(self, i):
+        return self.x[i]
+
+
+def _big_grad(params, batch):
+    s = np.float32(np.mean(batch))
+    return {"w": (params["w"] * np.float32(1e-3)
+                  + s * np.float32(1e-2)).astype(np.float32),
+            "b": np.asarray(s, np.float32).reshape(())}
+
+
+def test_reshard_and_ckpt_peak_host_bytes_bounded(tmp_path):
+    """The tentpole's memory contract: across bootstrap save, restore
+    and the streamed checkpoint round, the reshard/checkpoint machinery
+    of EVERY rank stages at most O(max shard) — strictly less than one
+    full flat vector — measured by the per-trainer ReshardMeter.  (The
+    model replica itself is full-size by the grad_fn host contract;
+    the bound governs the plumbing.)"""
+    world, numel = 3, _BIG + 1
+    coord = ElasticCoordinator(expected_world=world).start()
+    ep = f"127.0.0.1:{coord.port}"
+    trainers = []
+    for _ in range(world):
+        loader = DataLoader(_BigSet(), batch_size=8, shuffle=True,
+                            seed=3, drop_last=True)
+        trainers.append(ElasticTrainer(
+            {"w": np.zeros(_BIG, np.float32),
+             "b": np.zeros((), np.float32)},
+            _big_grad, loader, ckpt_dir=str(tmp_path / "ck"),
+            optimizer="adam", lr=0.01, micro_batches=2, ckpt_every=2,
+            coordinator=ep, expected_world=world, client_timeout=60.0))
+    errs = [None] * world
+
+    def go(i):
+        try:
+            trainers[i].run(2)
+        except BaseException as e:
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,), daemon=True)
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(not t.is_alive() for t in ts), "big elastic run hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    coord.stop()
+    shard_bytes = -(-numel // world) * 4
+    full_bytes = numel * 4
+    for tr in trainers:
+        peak = tr.reshard_meter.peak_bytes
+        assert tr.reshard_meter.total_bytes > 0
+        # adam holds both slot-shard reads concurrently through load()
+        # — that is the worst case, and it is 2 shards, not a vector
+        assert peak <= 2 * shard_bytes + 4096, (peak, shard_bytes)
+        assert peak < full_bytes, (peak, full_bytes)
+
+
+# ---------------------------------------------------------------------------
+# per-mesh recompile hook: reform_mesh -> DistributedTrainStep.reform
+# ---------------------------------------------------------------------------
+
+def test_reform_hook_recompiles_dist_step():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                              DistributedTrainStep)
+    mesh_mod.set_mesh(None)
+    try:
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 2))
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=m.parameters())
+
+        def loss_fn(x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        mesh = mesh_mod.init_mesh({"dp": -1})
+        step = DistributedTrainStep(m, loss_fn, opt,
+                                    DistributedStrategy(), mesh=mesh)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(8, 2)).astype(np.float32))
+        l0 = float(step(x, y))
+        assert step._compiled is not None
+        # the elastic transition: reform_mesh() must invalidate the
+        # compiled program THROUGH the hook, not via driver plumbing
+        mesh_mod.reform_mesh()
+        assert step.reforms == 1
+        assert step._compiled is None
+        l1 = float(step(x, y))          # recompiles against the new mesh
+        assert step._compiled is not None
+        assert np.isfinite(l1) and l1 <= l0
+        # dead owners are pruned, not called: drop the step and reform
+        del step
+        gc.collect()
+        mesh_mod.reform_mesh()          # must not raise on a dead ref
+    finally:
+        mesh_mod.set_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder reshard decomposition
+# ---------------------------------------------------------------------------
+
+def test_reshard_flight_decomposition_recorded(tmp_path):
+    """One elastic run leaves the full decomposition in the ring:
+    exchange (with byte counts), load (ranged-read bytes), compile
+    (per-mesh rebuild) — all progress kinds."""
+    if not flight_recorder.enabled():
+        pytest.skip("flight recorder ring disabled in this env")
+    _run_world(str(tmp_path / "ck"), 2, 4)
+    evs = flight_recorder.events()
+    by_kind = {}
+    for e in evs:
+        by_kind.setdefault(e.get("kind"), []).append(e)
+    for kind in ("elastic.reshard.exchange", "elastic.reshard.load",
+                 "elastic.reshard.compile", "elastic.reshard"):
+        assert by_kind.get(kind), f"missing {kind} events"
+    assert all(e["bytes"] >= 0 for e in
+               by_kind["elastic.reshard.exchange"])
+    assert all(e["bytes"] > 0 for e in by_kind["elastic.reshard.load"])
+    assert all(e["shard_len"] > 0 for e in
+               by_kind["elastic.reshard.compile"])
+    # the summary event now carries bytes + engine for postmortems
+    assert any("bytes" in e and e.get("engine") == "device"
+               for e in by_kind["elastic.reshard"])
+    from paddle_tpu.observability.flight_recorder import _PROGRESS_KINDS
+    assert {"elastic.reshard.exchange", "elastic.reshard.load",
+            "elastic.reshard.compile"} <= set(_PROGRESS_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# teardown + rendezvous races the big-model bound test smoked out
+# ---------------------------------------------------------------------------
+
+def test_no_teardown_reshard_cascade(tmp_path):
+    """A finished run must END, not reshard: each graceful leave()
+    reforms the shrinking survivor world, and before the _finished
+    fence-reentry guard the survivors resharded at every world on the
+    way down (full restore + recompile per rank per leave; at world 1
+    the restore stages 2x the FULL vector, busting the staging bound).
+    With no membership churn every trainer sees exactly ONE
+    generation."""
+    world = 3
+    coord = ElasticCoordinator(expected_world=world).start()
+    ep = f"127.0.0.1:{coord.port}"
+    trainers = [_make_trainer(str(tmp_path / "ck"), ep, world)
+                for _ in range(world)]
+    errs = [None] * world
+
+    def go(i):
+        try:
+            trainers[i].run(4)
+        except BaseException as e:
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,), daemon=True)
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert all(not t.is_alive() for t in ts), "teardown hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    coord.stop()
+    for tr in trainers:
+        assert tr._finished is True
+        # one generation entered, zero teardown re-reshards
+        assert len(tr.transitions) == 1, tr.transitions
+        assert tr._engine is not None and tr._engine.compiles == 1
+
+
+def test_generation_info_is_a_consistent_snapshot():
+    """Every member of generation N must receive the SAME ckpt_step:
+    the coordinator snapshots it at reform time rather than reading
+    the live value, otherwise a register reply delayed past rank 0's
+    first checkpoint report sees ckpt_step=0 while its gen-1 peers saw
+    None — one member skips the bootstrap barrier its peers are
+    holding, and the rendezvous deadlocks."""
+    coord = ElasticCoordinator(expected_world=1)
+    with coord._cond:
+        coord._pending[0] = type("M", (), {"uid": 0, "rank": 0,
+                                           "conn": None,
+                                           "last_seen": 0.0})()
+        coord._reform_locked()
+        # rank 0 reports a checkpoint mid-generation: the LIVE value
+        # moves, the generation's handed-out snapshot must not
+        coord._ckpt_step = 0
+        assert coord._info_locked(0)["ckpt_step"] is None
+        # ... until the next reform snapshots it for the NEW gen
+        coord._pending[1] = type("M", (), {"uid": 1, "rank": 0,
+                                           "conn": None,
+                                           "last_seen": 0.0})()
+        coord._reform_locked()
+        assert coord._info_locked(0)["ckpt_step"] == 0
+        assert coord._info_locked(1)["ckpt_step"] == 0

@@ -117,6 +117,87 @@ def test_additive_echo_suppression_quiesces():
         _teardown(gA, gB, wa, A, B)
 
 
+def test_additive_bidirectional_inexact_payload_bit_equality():
+    """ISSUE 17: each site applies {local writes, peer deltas} in its
+    own commit order, so inexact (non-representable-sum) payloads
+    historically landed within ±1 ulp of each other but NOT bit-equal.
+    The authority-side cross-site residual pass (Sterbenz, the same
+    mechanism ``_ship`` has used against its mirror since PR 10) must
+    close the gap: after settling, both sites hold IDENTICAL BITS."""
+    A, B, aep, bep, gA, gB = _bridge("add")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    try:
+        rng = np.random.default_rng(17)
+        ids = np.arange(32, dtype=np.int64)
+        # irrational-ish f32 payloads whose pairwise sums round, written
+        # concurrently and OVERLAPPING (ids 8..23 from both sides), with
+        # ship rounds interleaved between write bursts so each site
+        # accumulates the same set of deltas in a different order
+        for _ in range(3):
+            da = (rng.standard_normal((24, 6)) * 0.1).astype(np.float32)
+            db = (rng.standard_normal((24, 6)) * 0.1).astype(np.float32)
+            wa.push_delta("emb", ids[:24], da)
+            wb.push_delta("emb", ids[8:], db)
+            gA.flush()
+            gB.flush()
+        _settle(gA, gB, rounds=12)
+        ra = A._tables["emb"].pull(ids)
+        rb = B._tables["emb"].pull(ids)
+        assert np.allclose(ra, rb, rtol=1e-5)     # value sanity
+        # THE bar: identical bits on both sites, not just allclose
+        assert np.array_equal(ra, rb), \
+            (ra.view(np.int32) - rb.view(np.int32))
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
+def test_additive_residual_verify_repairs_silent_ulp_drift():
+    """The race the verify pass exists for: a commit landing inside
+    the peer's ship-loop window leaves the receiver's row ±1 ulp off
+    the shipper's MIRROR — both mirrors still match their own tables,
+    backlog hits 0, and the drift is permanent because nothing
+    re-reads the actual cross-site bits.  Simulate it by nudging a
+    follower row behind the commit feed's back (a direct table write
+    raises no commit record, exactly like the race), then prove the
+    authority's verify pass detects and repairs it to bit equality."""
+    A, B, aep, bep, gA, gB = _bridge("add")
+    wa = PSClient([aep], mode="sync", **_FAST)
+    wb = PSClient([bep], mode="sync", **_FAST)
+    try:
+        rng = np.random.default_rng(3)
+        ids = np.arange(10, dtype=np.int64)
+        wa.push_delta("emb", ids,
+                      (rng.standard_normal((10, 6)) * 7.3)
+                      .astype(np.float32))
+        wb.push_delta("emb", ids[3:],
+                      (rng.standard_normal((7, 6)) * 0.13)
+                      .astype(np.float32))
+        _settle(gA, gB)
+        # the silent ulp nudge on the NON-authority site ("A" < "B"):
+        # invisible to A's dirty set and to B's mirror
+        row = A._tables["emb"].pull(ids[:1])
+        drift = np.nextafter(row, np.full_like(row, np.inf)) - row
+        A._tables["emb"].push_delta(ids[:1], drift)
+        assert not np.array_equal(A._tables["emb"].pull(ids),
+                                  B._tables["emb"].pull(ids))
+        # a later write re-enters the id into the cross-site pending
+        # set (any real race is created BY a ship round, so the id is
+        # always re-touched); the authority verify then repairs it
+        wb.push_delta("emb", ids[:4], np.ones((4, 6), np.float32))
+        _settle(gA, gB)
+        ra = A._tables["emb"].pull(ids)
+        rb = B._tables["emb"].pull(ids)
+        assert np.array_equal(ra, rb)
+        assert gB.corrected_ids >= 1          # the repair really ran
+        assert gB.verified_ids >= 1
+        # and it quiesces: further rounds move nothing
+        for _ in range(3):
+            assert gA.flush() == 0 and gB.flush() == 0
+    finally:
+        _teardown(gA, gB, wa, wb, A, B)
+
+
 def test_additive_bidirectional_lossy_link_zero_lost_zero_double():
     """THE additive chaos bar: both directions ride a seeded
     lossy/delayed link (delays, dropped acks, cut connections); the

@@ -138,6 +138,44 @@ def test_streaming_trainer_feeds_replica_freshness(_metrics):
         prim.stop()
 
 
+def test_streaming_trainer_dense_half_through_fused_engine(_metrics):
+    """ISSUE 17: the online loop trains DENSE params through the same
+    compiled engine the elastic data plane runs — `dense_step` fires
+    once per consumed batch, after the sparse push, and routes through
+    the fused ``opt_apply`` kernel."""
+    from paddle_tpu.distributed.fleet.dist_step import (
+        fused_optimizer_apply)
+    prim = PSServer({"emb": SparseTable(**_COUNT)}, host="127.0.0.1")
+    prim.start()
+    cli = PSClient([f"127.0.0.1:{prim.port}"], mode="sync", **_FAST)
+    try:
+        dense = {"w": np.zeros(16, np.float32), "t": 0}
+
+        def dense_step(batch):
+            dense["t"] += 1
+            p, _ = fused_optimizer_apply(
+                "sgd", dense["w"], np.ones(16, np.float32), {},
+                t=dense["t"], lr=np.float32(0.5))
+            dense["w"] = np.asarray(p, np.float32)
+
+        before = monitor.stat_get("online_dense_steps")
+        tr = StreamingTrainer(
+            DataLoader(_Feed(), batch_size=1, collate_fn=_collate),
+            cli, "emb", _count_step, dense_step=dense_step)
+        tr.run(max_batches=5)
+        assert tr.dense_steps == 5 and dense["t"] == 5
+        # 5 sgd steps, lr .5, grad 1: exactly -2.5 (binary-exact values)
+        np.testing.assert_array_equal(
+            dense["w"], np.full(16, -2.5, np.float32))
+        assert monitor.stat_get("online_dense_steps") - before == 5
+        # the sparse half is untouched: counting rows saw 5 batches
+        vals = cli.pull("emb", np.arange(32, dtype=np.int64))
+        assert np.all(vals == 5.0)
+    finally:
+        cli.close()
+        prim.stop()
+
+
 # ---------------------------------------------------------------------------
 # trainer SIGKILL + cursor resume: exactly-once
 # ---------------------------------------------------------------------------

@@ -286,7 +286,10 @@ def _fmt_ev(ev: dict, t0_us: float, off: float) -> str:
     extra = {k: v for k, v in ev.items()
              if k not in ("t", "kind", "ts_us")}
     mark = " <-- BAD" if _is_bad(ev) else ""
-    return f"  +{rel:10.4f}s  {ev.get('kind', '?'):<12} " \
+    # width fits the longest reshard sub-kind (ISSUE 17):
+    # "elastic.reshard.exchange" — byte-counted decomposition events
+    # (exchange/load/compile) land in the same column as their parent
+    return f"  +{rel:10.4f}s  {ev.get('kind', '?'):<24} " \
            f"{json.dumps(extra, sort_keys=True, default=str)}{mark}"
 
 

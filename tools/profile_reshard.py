@@ -1,0 +1,165 @@
+"""Reshard wall time + peak host staging per world transition.
+
+ISSUE 17 made the elastic reshard/checkpoint machinery stream: slot
+state moves range-wise through per-(slot, rank) exchange rounds,
+checkpoints are written shard-by-shard, restores are ranged reads —
+the claim being that NO host ever stages more than O(max shard) while
+resharding, regardless of how the world changes.  This profile runs
+real N->M transitions (in-process coordinator + threads, the same
+harness the tests use) and reports, per transition and per rank:
+
+  reshard_ms        the restore window (ranged reads + loader rewind),
+                    from the trainer's ``elastic.reshard`` flight event
+  compile_ms        the per-mesh recompile (``elastic.reshard.compile``)
+  peak_bytes        that rank's ReshardMeter high-water mark — the
+                    number the O(max shard) contract bounds
+  bound_bytes       max-shard bytes * 2 (the adam worst case: both slot
+                    shards staged concurrently through opt.load)
+
+One JSON line per transition plus a summary line.  A peak above the
+bound is printed as ``"over_bound": true`` — the profile is the tool
+to catch a regression the unit bound-test's fixed sizes might miss.
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_reshard.py [--smoke]
+Env: PROFILE_NUMEL, PROFILE_STEPS, PROFILE_TRANSITIONS
+     (e.g. "1:3,3:2,2:4" — world FROM trains first, world TO resumes).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _mk_trainer(ckpt, ep, world, numel, engine=None):
+    from paddle_tpu.distributed.fleet.elastic import ElasticTrainer
+    from paddle_tpu.io.dataloader import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Xs(Dataset):
+        def __init__(self, n=64):
+            rng = np.random.default_rng(5)
+            self.x = rng.standard_normal(n).astype(np.float32)
+
+        def __len__(self):
+            return self.x.size
+
+        def __getitem__(self, i):
+            return self.x[i]
+
+    def grad(params, batch):
+        s = np.float32(np.mean(batch))
+        return {"w": (params["w"] * np.float32(1e-3)
+                      + s * np.float32(1e-2)).astype(np.float32),
+                "b": np.asarray(s, np.float32).reshape(())}
+
+    loader = DataLoader(Xs(), batch_size=8, shuffle=True, seed=3,
+                        drop_last=True)
+    kw = {} if engine is None else {"engine": engine}
+    return ElasticTrainer(
+        {"w": np.zeros(numel - 1, np.float32),
+         "b": np.zeros((), np.float32)},
+        grad, loader, ckpt_dir=ckpt, optimizer="adam", lr=0.01,
+        micro_batches=2, ckpt_every=2, coordinator=ep,
+        expected_world=world, client_timeout=60.0, **kw)
+
+
+def _run_world(ckpt, world, steps, numel, coord=None):
+    from paddle_tpu.distributed.fleet.elastic import ElasticCoordinator
+    own = coord is None
+    if own:
+        coord = ElasticCoordinator(expected_world=world).start()
+    ep = f"127.0.0.1:{coord.port}"
+    trainers = [_mk_trainer(ckpt, ep, world, numel)
+                for _ in range(world)]
+    errs = [None] * world
+
+    def go(i):
+        try:
+            trainers[i].run(steps)
+        except BaseException as e:
+            errs[i] = e
+
+    ts = [threading.Thread(target=go, args=(i,), daemon=True)
+          for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    for e in errs:
+        if e is not None:
+            raise e
+    if own:
+        coord.stop()
+    return trainers
+
+
+def profile_transition(n_from, n_to, numel, steps):
+    from paddle_tpu.distributed.fleet.elastic import ElasticCoordinator
+    from paddle_tpu.observability import flight_recorder as _flight
+
+    with tempfile.TemporaryDirectory() as ck:
+        _run_world(ck, n_from, steps, numel)
+        n0 = len(_flight.events()) if _flight.enabled() else 0
+        coord = ElasticCoordinator(expected_world=n_to,
+                                   ckpt_step=steps).start()
+        t0 = time.perf_counter()
+        trainers = _run_world(ck, n_to, steps + 2, numel, coord=coord)
+        wall = time.perf_counter() - t0
+        coord.stop()
+        evs = _flight.events()[n0:] if _flight.enabled() else []
+    reshard_ms = [round(e.get("ms", 0.0), 3) for e in evs
+                  if e.get("kind") == "elastic.reshard"]
+    compile_ms = [round(e.get("ms", 0.0), 3) for e in evs
+                  if e.get("kind") == "elastic.reshard.compile"]
+    shard_bytes = -(-numel // n_to) * 4
+    bound = 2 * shard_bytes + 4096
+    peaks = [int(t.reshard_meter.peak_bytes) for t in trainers]
+    return {
+        "transition": f"{n_from}->{n_to}",
+        "numel": numel,
+        "resume_step": steps,
+        "wall_s": round(wall, 3),
+        "reshard_ms": reshard_ms,
+        "compile_ms": compile_ms,
+        "peak_bytes_per_rank": peaks,
+        "bound_bytes": bound,
+        "full_vector_bytes": numel * 4,
+        "over_bound": any(p > bound for p in peaks),
+    }
+
+
+def main():
+    smoke = "--smoke" in sys.argv[1:] or \
+        os.environ.get("BENCH_SMOKE") == "1"
+    numel = int(os.environ.get("PROFILE_NUMEL",
+                               "30000" if smoke else "300000"))
+    steps = int(os.environ.get("PROFILE_STEPS", "2" if smoke else "4"))
+    spec = os.environ.get("PROFILE_TRANSITIONS",
+                          "1:2" if smoke else "1:3,3:2,2:4")
+    rows = []
+    for pair in spec.split(","):
+        a, b = pair.split(":")
+        row = profile_transition(int(a), int(b), numel, steps)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    print(json.dumps({
+        "summary": "reshard_profile",
+        "numel": numel,
+        "transitions": [r["transition"] for r in rows],
+        "max_peak_bytes": max(p for r in rows
+                              for p in r["peak_bytes_per_rank"]),
+        "any_over_bound": any(r["over_bound"] for r in rows),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,12 @@ if [ "$CHAOS" -eq 1 ]; then
     # table accounting), TTL eviction replicated down the mutation
     # stream, and the bidirectional conflict policies (additive /
     # last-writer-wins) converging to their fixed points.
+    # test_elastic_device.py is the DEVICE-NATIVE ELASTIC ENGINE suite
+    # (ISSUE 17): compiled-SPMD reduce world-invariance, streamed
+    # checkpoint byte-equality vs the concat format, ranged N->M
+    # restores, the O(max shard) host-staging bound, and reform-hook
+    # recompiles; test_crash_mid_save.py also gained the SIGKILL-mid-
+    # streamed-save torn-step test.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
@@ -113,6 +119,7 @@ if [ "$CHAOS" -eq 1 ]; then
         tests/test_spec_decode.py tests/test_kv_int8.py \
         tests/test_fleet_observatory.py tests/test_online_loop.py \
         tests/test_feature_lifecycle.py tests/test_geo_conflict.py \
+        tests/test_elastic_device.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
