@@ -114,6 +114,11 @@ DEFAULT_LINT_PATHS = (
     "paddle_tpu/ops/pallas/int8_matmul.py",
     "paddle_tpu/ops/pallas/kv_attention.py",
     "paddle_tpu/ops/pallas/segment_sum.py",
+    # ISSUE 18: the inference gateway (router ring lock -> per-replica
+    # client lock hierarchy, declared in-file; migration runs on the
+    # scheduler thread so the server lock graph is unchanged)
+    "paddle_tpu/inference/gateway.py",
+    "paddle_tpu/inference/migration.py",
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

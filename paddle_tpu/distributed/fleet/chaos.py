@@ -37,7 +37,15 @@ Fault kinds (``Fault.kind``):
           ``kill:worker:every=K`` kills each incarnation after K steps
           and the run finishes iff checkpoints land more often than
           kills.  Fired via ``maybe_kill_worker()`` from the elastic
-          step loop.
+          step loop.  With any other ``op`` (ISSUE 18: ``gen_step``)
+          the SAME kind targets the GATEWAY site instead: the
+          generation scheduler fires ``maybe_kill_replica()`` once per
+          decode/verify step, so ``kill:gen_step:first=N`` SIGKILLs a
+          serving replica mid-decode at exactly step N — the router
+          failover acceptance fault.  Cut/slow/drop on the gateway RPC
+          link need no new site: the gateway protocol (``gen_submit``
+          / ``gen_poll`` / ...) rides the PS framing layer, so the
+          existing send/connect sites match its ops directly.
   nan     NUMERIC site (PR 4): inject NaN into a matching array stream.
           ``op`` names the stream — ``grad`` (parameter gradients, hook
           in train_guard), ``batch`` (input rows, hook in hapi/Model and
@@ -78,7 +86,8 @@ import time
 from typing import List, Optional
 
 __all__ = ["Fault", "FaultPlan", "install", "uninstall", "active",
-           "named_plan", "plan_from_spec", "maybe_kill_worker"]
+           "named_plan", "plan_from_spec", "maybe_kill_worker",
+           "maybe_kill_replica"]
 
 # frames the protocol never answers: safe to duplicate on the wire
 _ONE_WAY_OPS = {"heartbeat"}
@@ -120,7 +129,10 @@ class Fault:
         if self.kind == "crash":
             return "serve"
         if self.kind == "kill":
-            return "elastic"
+            # kill:worker stays the ISSUE 9 elastic fault; any other
+            # op is a serving-replica kill (ISSUE 18 gateway site)
+            return "elastic" if self.op in ("*", "worker") \
+                else "gateway"
         if self.kind in ("nan", "inf"):
             return "numeric"
         return "send"
@@ -270,6 +282,18 @@ class FaultPlan:
             return f
         return None
 
+    def match_gateway(self, op: str = "gen_step") -> Optional[Fault]:
+        """Gateway-site hook (:func:`maybe_kill_replica`): consult the
+        schedule for stream ``op`` (``gen_step`` — the match counter
+        advances exactly once per decode/verify step of this replica's
+        scheduler), so ``first=N`` SIGKILLs the replica mid-decode at
+        step N.  Returns the firing Fault (kind ``kill``) or None; the
+        caller delivers the signal."""
+        f = self._match("gateway", op)
+        if f is not None and f.kind == "kill":
+            return f
+        return None
+
     def on_serve(self, msg):
         """Server-side hook, called once per received request."""
         op = msg.get("op", "?") if isinstance(msg, dict) else "?"
@@ -312,6 +336,22 @@ def named_plan(name: str, seed: int = 0) -> FaultPlan:
     elif name.startswith("crash@"):
         faults = [Fault("crash", op="push", first=int(name[6:]))]
     # -- elastic plans (ISSUE 9, fleet/elastic.py) ----------------------
+    # -- gateway plans (ISSUE 18, inference/gateway.py) ------------------
+    elif name.startswith("gw_kill@"):
+        # SIGKILL this serving replica mid-decode at scheduler step N —
+        # the router must complete every affected stream token-identical
+        # via re-prefill + replay on a surviving replica
+        faults = [Fault("kill", op="gen_step", first=int(name[8:]))]
+    elif name == "gw_flaky":
+        # survivable gateway-link noise: slow poll frames plus periodic
+        # mid-frame cuts on the poll stream — the router's one-shot RPC
+        # health/backoff path must absorb both without a client-visible
+        # error (cut => reconnect or failover, both token-identical)
+        faults = [
+            Fault("delay", op="gen_poll", first=3, every=5, times=0,
+                  arg=0.002),
+            Fault("cut", op="gen_poll", first=7, every=11, times=0),
+        ]
     elif name.startswith("kill_worker@every="):
         # SIGKILL this worker at its K-th executed step, then every K
         # after that, forever (each launcher restart re-arms the plan
@@ -339,7 +379,8 @@ def named_plan(name: str, seed: int = 0) -> FaultPlan:
                         every=1, times=4, arg=1)]
     else:
         raise ValueError(f"unknown chaos plan {name!r} (flaky, dup, "
-                         f"lost_ack, crash@N, kill_worker@every=K, "
+                         f"lost_ack, crash@N, gw_kill@N, gw_flaky, "
+                         f"kill_worker@every=K, "
                          f"nan_grad@N, inf_grad@N, nan_batch@N, "
                          f"diverge@N)")
     return FaultPlan(faults, seed=seed, name=name)
@@ -355,6 +396,22 @@ def maybe_kill_worker(op: str = "worker"):
     if plan is None:
         return
     f = plan.match_elastic(op)
+    if f is not None:
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_replica(op: str = "gen_step"):
+    """Generation-scheduler hook (ISSUE 18): SIGKILL the current
+    serving replica process when the active plan schedules a ``kill``
+    fault for this decode step.  SIGKILL for the same reason as
+    :func:`maybe_kill_worker` — the gateway must see exactly what a
+    machine-level replica loss delivers (a dead socket mid-stream),
+    not an orderly shutdown."""
+    plan = active()
+    if plan is None:
+        return
+    f = plan.match_gateway(op)
     if f is not None:
         import signal
         os.kill(os.getpid(), signal.SIGKILL)

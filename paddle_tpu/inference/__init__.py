@@ -35,6 +35,9 @@ __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PredictorServer", "GenerationServer", "GenerationStream",
            "PrefixCache", "ServeError", "ServerOverloaded",
            "UpstreamUnavailable", "ServerClosed", "RequestTimeout",
+           "ServerDraining", "GatewayRouter", "LocalReplica",
+           "RemoteReplica", "GenerationRpcServer", "ReplicaLost",
+           "MigrationUnsupported",
            "enable_compile_cache"]
 
 
@@ -659,9 +662,12 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+from .gateway import (GatewayRouter, GenerationRpcServer,  # noqa: E402
+                      LocalReplica, RemoteReplica, ReplicaLost)
 from .generation_server import (GenerationServer,  # noqa: E402
                                 GenerationStream)
+from .migration import MigrationUnsupported  # noqa: E402
 from .prefix_cache import PrefixCache  # noqa: E402
 from .serving import (PredictorServer, RequestTimeout,  # noqa: E402
-                      ServeError, ServerClosed, ServerOverloaded,
-                      UpstreamUnavailable)
+                      ServeError, ServerClosed, ServerDraining,
+                      ServerOverloaded, UpstreamUnavailable)

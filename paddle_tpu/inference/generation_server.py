@@ -123,10 +123,27 @@ from ..observability import trace as _trace
 from ..observability.request_trace import RequestTrace
 from .prefix_cache import PrefixCache
 from .serving import (RequestTimeout, ServeError, ServerClosed,
-                      ServerOverloaded)
+                      ServerDraining, ServerOverloaded)
 
 __all__ = ["GenerationServer", "GenerationStream", "ServeError",
-           "ServerOverloaded", "ServerClosed", "RequestTimeout"]
+           "ServerOverloaded", "ServerClosed", "ServerDraining",
+           "RequestTimeout"]
+
+_chaos_mod = None
+
+
+def _gw_chaos():
+    """Lazy handle on :mod:`~paddle_tpu.distributed.fleet.chaos` (the
+    package root has loaded it long before any server runs, so this is
+    a cached-global lookup per decode step, not an import)."""
+    global _chaos_mod
+    if _chaos_mod is None:
+        try:
+            from ..distributed.fleet import chaos as _c
+        except Exception:        # pragma: no cover - import-order guard
+            return None
+        _chaos_mod = _c
+    return _chaos_mod
 
 # one serve.decode ring event per this many decode steps: the ring is
 # postmortem context, not a per-token log (progress() still ticks the
@@ -388,7 +405,13 @@ class GenerationServer:
                                   index_enabled=self._prefix_on,
                                   first_block=1)
         self._running = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
+        # scheduler command queue (ISSUE 18): cancel/export/import
+        # mutate sequence + slot state that _decode_once snapshots
+        # without the lock, so they run ON the scheduler thread between
+        # steps rather than growing the lock graph
+        self._cmds: _queue.Queue = _queue.Queue()
         self._rid = 0
         self._arrival = 0
         self._compiles = 0
@@ -404,6 +427,8 @@ class GenerationServer:
             "spec_verify_steps": 0, "draft_steps": 0,
             "spec_proposed": 0, "spec_accepted": 0,
             "admit_rollbacks": 0, "spec_index_withheld_tokens": 0,
+            "shed_draining": 0, "migrated_in": 0, "migrated_out": 0,
+            "cancelled": 0,
             "prefill_bucket_hits": {b: 0 for b in self._buckets},
         }
 
@@ -620,6 +645,7 @@ class GenerationServer:
             self._build_programs()
         if prewarm:
             self._prewarm()
+        self._draining = False
         self._running = True
         self._thread = threading.Thread(target=self._loop,
                                         name="generation-server",
@@ -697,6 +723,9 @@ class GenerationServer:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        # commands enqueued in the stop window would otherwise strand
+        # their callers: the scheduler thread is gone, so run them here
+        self._drain_cmds()
         with self._lock:
             leftovers = list(self._waiting) + list(self._active.values())
             self._waiting.clear()
@@ -705,6 +734,78 @@ class GenerationServer:
             if seq.rt is not None:
                 seq.rt.finish("server_stopped")
             seq.stream._fail(ServerClosed("server stopped"))
+
+    def drain_begin(self):
+        """Stop admitting NEW requests (``submit`` raises
+        :class:`ServerDraining`) while the scheduler keeps running what
+        it already owns — the first half of a graceful drain; KV
+        migration / ``stop(drain=True)`` is the second."""
+        with self._cond:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- scheduler command queue (ISSUE 18) ---------------------------
+    def _run_on_scheduler(self, fn, timeout: float = 30.0):
+        """Run ``fn()`` on the scheduler thread between steps (sequence
+        and slot state is only coherent there — _decode_once indexes
+        its snapshot by ``seq.slot`` without holding the lock).  Runs
+        inline when the scheduler is not running (stopped server) or
+        when already ON the scheduler thread."""
+        if not self._running or self._thread is None \
+                or threading.current_thread() is self._thread:
+            return fn()
+        box: Dict = {}
+        done = threading.Event()
+        with self._cond:
+            self._cmds.put((fn, box, done))
+            self._cond.notify_all()
+        if not done.wait(timeout):
+            raise ServeError("scheduler command timed out "
+                             f"after {timeout}s")
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("val")
+
+    def _drain_cmds(self):
+        while True:
+            try:
+                fn, box, done = self._cmds.get_nowait()
+            except _queue.Empty:
+                return
+            try:
+                box["val"] = fn()
+            except BaseException as e:   # noqa: BLE001 — to the caller
+                box["exc"] = e
+            finally:
+                done.set()
+
+    def cancel(self, request_id: int, reason: str = "cancelled") -> bool:
+        """Remove a request (waiting or active) WITHOUT failing its
+        stream: blocks + slot free immediately and the stream ends with
+        ``finish_reason == reason``.  Returns False when the request is
+        unknown (already finished).  Runs on the scheduler thread."""
+        def _do():
+            with self._lock:
+                seq = next((s for s in self._waiting
+                            if s.rid == request_id), None)
+                if seq is not None:
+                    self._waiting.remove(seq)
+                else:
+                    seq = next((s for s in self._active.values()
+                                if s.rid == request_id), None)
+            if seq is None:
+                return False
+            self._release(seq)
+            with self._lock:
+                self._stats["cancelled"] += 1
+            if seq.rt is not None:
+                seq.rt.finish(reason, tokens=len(seq.generated))
+            seq.stream._end(reason)
+            return True
+        return self._run_on_scheduler(_do)
 
     def __enter__(self) -> "GenerationServer":
         return self.start()
@@ -723,7 +824,9 @@ class GenerationServer:
                eos_token_id: Optional[int] = None,
                seed: Optional[int] = None, priority: int = 0,
                timeout_s: Optional[float] = None,
-               tenant: Optional[str] = None) -> GenerationStream:
+               tenant: Optional[str] = None,
+               replay_tokens: Optional[Sequence[int]] = None,
+               ) -> GenerationStream:
         """Enqueue one generation request; returns a
         :class:`GenerationStream` that yields tokens as decode steps
         complete.  ``priority``: lower = more important (evicted last).
@@ -736,9 +839,18 @@ class GenerationServer:
         also counts into the untagged ``serve_tokens_in/out`` totals,
         so all-tagged traffic's tenant series sum EXACTLY to the
         totals.  Raises :class:`ServerOverloaded` at the waiting-queue
-        cap."""
-        if not self._running:
-            raise ServerClosed("server not started")
+        cap, :class:`ServerDraining` on a draining server and
+        :class:`ServerClosed` on a stopped one — all IMMEDIATELY, from
+        under the scheduler lock, so a submit racing ``stop()`` can
+        never enqueue a stream that will never start.
+
+        ``replay_tokens`` (ISSUE 18 failover recovery): tokens this
+        request's stream ALREADY emitted elsewhere — admission re-runs
+        the prompt through prefill, then replays them through the
+        normal decode path without re-emitting (``check_replay``
+        asserts each one), and new tokens continue the stream from
+        there.  The caller must pass the ORIGINAL request's explicit
+        ``seed`` for the replayed stream to be the same RNG stream."""
         p = np.asarray(prompt.numpy() if hasattr(prompt, "numpy")
                        else prompt).astype(np.int32).reshape(-1)
         if p.size < 1:
@@ -749,13 +861,33 @@ class GenerationServer:
             raise ValueError(
                 f"prompt ({p.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_model_len={self._max_len}")
+        replay = [int(t) for t in replay_tokens] if replay_tokens \
+            else []
+        if len(replay) >= max_new_tokens:
+            raise ValueError(
+                f"replay_tokens ({len(replay)}) must be shorter than "
+                f"max_new_tokens ({max_new_tokens}) — that stream is "
+                "already complete")
         if do_sample and float(temperature) == 0.0:
             do_sample = False      # temperature 0.0 IS greedy (exact)
         to = self._timeout_s if timeout_s is None else float(timeout_s)
         with self._cond:
-            if len(self._waiting) >= self._max_waiting:
+            # liveness checks INSIDE the lock: ``stop()`` flips
+            # _running and sweeps leftovers under this same lock, so a
+            # racing submit either lands before the sweep (its stream
+            # fails typed) or observes the stop here — the pre-ISSUE-18
+            # lock-free check let it enqueue AFTER the sweep, leaving a
+            # stream nothing would ever end (caller hung to deadline)
+            if not self._running:
+                raise ServerClosed(
+                    "server not running — submit refused (the stream "
+                    "could never start)")
+            if self._draining:
+                self._stats["shed_draining"] += 1
+                shed = ("draining", len(self._waiting))
+            elif len(self._waiting) >= self._max_waiting:
                 self._stats["shed_overload"] += 1
-                shed_depth = len(self._waiting)
+                shed = ("overload", len(self._waiting))
             else:
                 self._rid += 1
                 self._arrival += 1
@@ -767,6 +899,8 @@ class GenerationServer:
                               top_k, top_p, key_data, priority,
                               self._arrival, time.monotonic() + to,
                               tenant=tenant)
+                if replay:
+                    seq.generated = list(replay)
                 if _trace.enabled():
                     seq.rt = RequestTrace("gen", seq.rid, tenant)
                     seq.rt.instant("submit", prompt_len=seq.L,
@@ -775,15 +909,20 @@ class GenerationServer:
                 self._waiting.append(seq)
                 self._stats["submitted"] += 1
                 self._cond.notify_all()
-                shed_depth = None
-        if shed_depth is not None:
-            _monitor.stat_add("serve_shed_overload")
+                shed = None
+        if shed is not None:
+            reason, depth = shed
+            _monitor.stat_add("serve_shed_" + reason)
             if tenant is not None:
                 _monitor.stat_add("serve_tenant_sheds",
                                   labels={"tenant": tenant,
-                                          "reason": "overload"})
-            _flight.record("serve.shed", reason="overload",
-                           depth=shed_depth, server="generation")
+                                          "reason": reason})
+            _flight.record("serve.shed", reason=reason,
+                           depth=depth, server="generation")
+            if reason == "draining":
+                raise ServerDraining(
+                    "server is draining — submit this request to "
+                    "another replica") from None
             _flight.maybe_dump("ServerOverloaded")
             raise ServerOverloaded(
                 f"waiting-queue cap {self._max_waiting} reached; "
@@ -814,6 +953,7 @@ class GenerationServer:
                  for k, v in self._stats.items()}
             s["waiting"] = len(self._waiting)
             s["active"] = len(self._active)
+            s["draining"] = self._draining
             cache = self._cache.snapshot()
             records = list(self._compile_records)
         # "free" keeps its ISSUE 8 meaning — allocatable right now —
@@ -861,10 +1001,12 @@ class GenerationServer:
     def _loop(self):
         try:
             while True:
+                self._drain_cmds()
                 with self._cond:
                     if not self._running:
                         return
-                    if not self._active and not self._waiting:
+                    if not self._active and not self._waiting \
+                            and self._cmds.empty():
                         self._cond.wait(timeout=0.05)
                         continue
                 self._expire_waiting()
@@ -1130,10 +1272,13 @@ class GenerationServer:
             self._post_prefill(seq, int(first[i]), bucket)
 
     def _post_prefill(self, seq: _GenSeq, first: int, bucket: int):
-        readmit = seq.evictions > 0
+        # a replay-submitted request (ISSUE 18 failover: generated
+        # pre-seeded, zero evictions) takes the same no-re-emit path as
+        # a re-admission; "readmitted" keeps counting evictions only
+        readmit = seq.evictions > 0 or bool(seq.generated)
         with self._lock:
             self._stats["admitted"] += 1
-            self._stats["readmitted"] += int(readmit)
+            self._stats["readmitted"] += int(seq.evictions > 0)
             # index the prompt's full blocks for future sharing; the
             # aliased ones are already indexed (insert is idempotent)
             self._cache.insert(seq.prompt.tolist(), seq.blocks)
@@ -1370,6 +1515,13 @@ class GenerationServer:
             _monitor.hist_observe("decode_step_ms", dt_ms)
             _monitor.gauge_set("serve_gen_active", len(self._active))
             _monitor.gauge_set("serve_gen_free_blocks", free_now)
+        # gateway chaos site (ISSUE 18): a seeded ``kill:gen_step``
+        # plan SIGKILLs this replica process at an exact decode step —
+        # the acceptance fault for router failover.  No plan installed
+        # => one cached-module call per step.
+        ch = _gw_chaos()
+        if ch is not None:
+            ch.maybe_kill_replica()
 
     # -- speculative decode -------------------------------------------
     def _spec_once(self):

@@ -58,7 +58,8 @@ from ..observability import trace as _trace
 from ..observability.request_trace import RequestTrace
 
 __all__ = ["PredictorServer", "ServeError", "ServerOverloaded",
-           "ServerClosed", "RequestTimeout", "UpstreamUnavailable"]
+           "ServerClosed", "ServerDraining", "RequestTimeout",
+           "UpstreamUnavailable"]
 
 
 class ServeError(RuntimeError):
@@ -73,6 +74,15 @@ class ServerOverloaded(ServeError):
 
 class ServerClosed(ServeError):
     """The server was stopped before (or while) handling the request."""
+
+
+class ServerDraining(ServeError):
+    """The server is draining toward removal (ISSUE 18): it refuses NEW
+    admissions while live sequences run to completion or migrate.
+    Clients (and the gateway router) treat it like a shed targeted at
+    one replica: retry a DIFFERENT replica immediately — unlike
+    :class:`ServerOverloaded` there is no point backing off and
+    retrying here."""
 
 
 class RequestTimeout(ServeError, TimeoutError):

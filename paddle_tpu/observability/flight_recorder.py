@@ -125,7 +125,12 @@ _DEFAULT_MAX_BYTES = 256 * 1024
 # per-mesh recompile.  Each sub-phase can individually dominate a
 # transition (a big model's compile, a cold disk's load), so each is
 # its own heartbeat with byte counts for the postmortem to apportion.
+# gw.route (ISSUE 18): every gateway placement — initial routes,
+# failover re-routes and drain re-homes all pass through it, so a
+# router that stops placing IS the stall a bundle should autopsy
+# (gw.failover / gw.drain stay bad kinds in tools/postmortem.py).
 _PROGRESS_KINDS = frozenset({"step", "rpc", "serve.batch", "ps.apply",
+                             "gw.route",
                              "serve.decode", "serve.admit",
                              "serve.spec_verify",
                              "elastic.join", "elastic.reshard",

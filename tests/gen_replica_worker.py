@@ -1,0 +1,59 @@
+"""Subprocess replica for the gateway chaos tests: one tiny-llama
+``GenerationServer`` behind ``GenerationRpcServer``, weights seeded
+identically to the in-process reference (``paddle.seed(0)`` + the same
+config), so token streams are comparable across the process boundary.
+
+Launched by ``tests/test_gateway.py`` with ``PADDLE_CHAOS`` set
+only in the doomed replica's environment — the fault plan installs at
+import inside THIS process and ``plan=gw_kill@N`` SIGKILLs it on its
+N-th decode step, mid-stream, exactly like a machine loss.
+
+Prints one JSON line (``{"port": ..., "pid": ...}``) when serving.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=4)
+    ap.add_argument("--max-model-len", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (GenerationRpcServer,
+                                      GenerationServer)
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    srv = GenerationServer(m, num_slots=args.slots,
+                           block_size=args.block_size,
+                           max_model_len=args.max_model_len,
+                           check_replay=True, max_prefill_batch=1,
+                           prefix_cache=True,
+                           request_timeout_s=120.0).start()
+    rpc = GenerationRpcServer(srv)
+    print(json.dumps({"port": rpc.port, "pid": os.getpid()}),
+          flush=True)
+    while rpc._running:
+        time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

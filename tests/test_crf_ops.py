@@ -225,7 +225,9 @@ def test_bilstm_crf_tagger_trains_and_decodes():
     x_t = paddle.to_tensor(xs)
     y_t = paddle.to_tensor(ys)
     first = None
-    for step in range(60):
+    # 30 steps converges with wide margin (nll/first ~0.02 vs the 0.25
+    # threshold, decode acc 1.0); each eager step costs ~1s on CPU.
+    for step in range(30):
         h, _ = lstm(emb(x_t))
         em = proj(h)
         nll = F.linear_chain_crf(em, y_t, crf_w).mean()

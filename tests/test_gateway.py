@@ -1,0 +1,609 @@
+"""ISSUE 18 acceptance: the inference gateway — prefix-affinity
+routing, health-checked failover with re-prefill recovery, KV
+migration for graceful drain, and deadline-aware admission.
+
+The invariant under test everywhere: the client-visible stream NEVER
+errors on replica loss — it stalls for the failover window and resumes
+token-identical (greedy AND seeded sampling), zero tokens lost, zero
+duplicated.  Every comparison is against a fault-free run on a single
+ample reference server with the same seeded weights, so equality IS
+the lost/dup audit.
+
+Compiles dominate on this 1-core container (~5 s per server vs ~0.1 s
+per test body), so the three replica servers are MODULE-scoped and
+shared: each test builds its own cheap router/replica layer on top,
+and a "kill" is a pure partition (``owns_server=False``) — the router
+sees a dead replica, the warm server survives for the next test.
+Tests needing thrash-sized pools share the module's scarce pair.
+
+The chaos acceptance gate (SIGKILL a subprocess replica mid-decode,
+then drain a second replica mid-traffic, every stream token-identical)
+is the LAST test in this module — it consumes shared state.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import chaos
+from paddle_tpu.inference import (GatewayRouter, GenerationRpcServer,
+                                  GenerationServer, LocalReplica,
+                                  RemoteReplica, RequestTimeout,
+                                  ServerClosed, ServerDraining,
+                                  ServerOverloaded)
+
+
+def _mk_model():
+    # every replica gets its OWN model instance (concurrent schedulers
+    # must not share parameter objects), seeded identically so token
+    # streams are comparable across replicas and the reference
+    paddle.seed(0)
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+_AMPLE = dict(num_slots=8, block_size=4, max_model_len=32,
+              check_replay=True, max_prefill_batch=1,
+              prefix_cache=True, request_timeout_s=120.0)
+
+
+@pytest.fixture(scope="module")
+def servers():
+    srvs = [GenerationServer(_mk_model(), **_AMPLE).start()
+            for _ in range(3)]
+    yield srvs
+    for s in srvs:
+        s.stop()
+
+
+def _prompts(seed=0, lens=(5, 9, 3, 12), prefix=()):
+    rng = np.random.RandomState(seed)
+    out = []
+    for l in lens:
+        p = rng.randint(1, 64, (l,)).astype("int32")
+        if prefix:
+            p = np.concatenate(
+                [np.asarray(prefix, np.int32), p]).astype("int32")
+        out.append(p)
+    return out
+
+
+def _kws(n, max_new=16):
+    """Mixed workload: even streams greedy, odd streams seeded
+    sampling — failover must be token-identical for BOTH."""
+    return [dict(max_new_tokens=max_new, seed=1000 + i,
+                 **({"do_sample": True, "temperature": 0.9, "top_k": 8}
+                    if i % 2 else {}))
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Fault-free oracle: serial runs on one ample server."""
+    srv = GenerationServer(_mk_model(), **_AMPLE).start()
+
+    def run(prompts, kws):
+        return [srv.submit(p, **kw).result(timeout=120)
+                for p, kw in zip(prompts, kws)]
+    yield run
+    srv.stop()
+
+
+def _wait_idle(servers, timeout=30):
+    """Let orphaned sequences on partitioned (not stopped) servers run
+    out so the next test starts from an idle warm fleet."""
+    deadline = time.monotonic() + timeout
+    for s in servers:
+        while True:
+            st = s.stats()
+            if st["active"] == 0 and st["waiting"] == 0:
+                break
+            assert time.monotonic() < deadline, \
+                "shared server never went idle after the test"
+            time.sleep(0.002)
+
+
+@pytest.fixture(scope="module")
+def scarce_pair():
+    """Two replicas with thrash-sized pools, shared by the eviction /
+    RPC / drain tests below (the drain test poisons them and is
+    defined LAST among their users — in-module order is definition
+    order, the shuffled tier-1 pass shuffles at file granularity)."""
+    skw = dict(_AMPLE)
+    skw.update(num_blocks=14, num_slots=4, max_model_len=24)
+    srvs = [GenerationServer(_mk_model(), **skw).start()
+            for _ in range(2)]
+    yield srvs
+    for s in srvs:
+        s.stop()
+
+
+class _Trio:
+    """Router + replica layer over the SHARED module servers.  kill()
+    on these replicas is a partition, not a process death — the server
+    keeps decoding its orphans, and ``close()`` waits for the fleet to
+    go idle so the next test starts clean."""
+
+    def __init__(self, servers, **router_kw):
+        self.servers = servers
+        self.reps = [LocalReplica(f"r{i}", s, owns_server=False)
+                     for i, s in enumerate(servers)]
+        rkw = dict(block_size=_AMPLE["block_size"], seed=0,
+                   request_timeout_s=60.0)
+        rkw.update(router_kw)
+        self.router = GatewayRouter(self.reps, **rkw).start()
+
+    def replica(self, name):
+        return self.router._replicas[name]
+
+    def close(self):
+        self.router.stop()
+        _wait_idle(self.servers)
+
+
+@pytest.fixture
+def trio(servers):
+    t = _Trio(servers)
+    yield t
+    t.close()
+
+
+# -- routing ------------------------------------------------------------
+
+def test_prefix_affinity_routing(trio):
+    prompts = _prompts(seed=3, lens=(8,) * 16 + (11,) * 16)
+    owners = [trio.router.route_owner(p) for p in prompts]
+    # deterministic: the same prompt always routes to the same replica
+    assert owners == [trio.router.route_owner(p) for p in prompts]
+    # spread: the ring actually distributes across replicas
+    assert len(set(owners)) >= 2
+    # session affinity: the route key is the FIRST block's chain hash,
+    # so a conversation growing by whole turns keeps its replica
+    for p, owner in zip(prompts, owners):
+        grown = np.concatenate(
+            [p, np.arange(1, 6, dtype=np.int32)]).astype("int32")
+        assert trio.router.route_owner(grown) == owner
+
+
+def test_router_lifecycle_typed_errors(servers):
+    t = _Trio(servers)
+    try:
+        with pytest.raises(ValueError):
+            t.router.submit(np.zeros((0,), np.int32))
+    finally:
+        t.close()
+    with pytest.raises(ServerClosed):
+        t.router.submit(np.array([1, 2, 3], np.int32))
+
+
+def test_fanout_token_equality(trio, ref):
+    prompts = _prompts(seed=0, lens=(5, 9, 3, 12, 7, 6))
+    kws = _kws(6)
+    expect = ref(prompts, kws)
+    streams = [trio.router.submit(p, **kw)
+               for p, kw in zip(prompts, kws)]
+    outs = [s.result(timeout=60) for s in streams]
+    assert outs == expect
+    st = trio.router.stats()
+    assert st["finished"] == 6 and st["failovers"] == 0
+
+
+# -- failover -----------------------------------------------------------
+
+def test_failover_mid_stream_token_identical(trio, ref):
+    prompts = _prompts(seed=1, lens=(5, 9, 3, 12))
+    kws = _kws(4, max_new=18)
+    expect = ref(prompts, kws)
+    victim = trio.router.route_owner(prompts[0])
+    streams = [trio.router.submit(p, **kw)
+               for p, kw in zip(prompts, kws)]
+    time.sleep(0.01)
+    trio.replica(victim).kill()
+    outs = [s.result(timeout=60) for s in streams]
+    assert outs == expect, "failover lost/duplicated/diverged tokens"
+    st = trio.router.stats()
+    assert st["failovers"] >= 1
+    assert victim in st["down"] or st["routed"].get(victim, 0) >= 1
+
+
+def test_failover_mid_eviction_replay(scarce_pair, ref):
+    """Replica death while its pool is thrashing: prompts share their
+    first block so they ALL route to one oversubscribed replica, which
+    must be evicting when it dies — failover re-prefills conversations
+    that were themselves mid-eviction-replay."""
+    reps = [LocalReplica(f"r{i}", s, owns_server=False)
+            for i, s in enumerate(scarce_pair)]
+    router = GatewayRouter(reps, block_size=4, seed=0,
+                           request_timeout_s=60.0).start()
+    try:
+        common = (7, 11, 13, 3)     # one full block -> one ring slot
+        prompts = _prompts(seed=2, lens=(2, 6, 1, 4), prefix=common)
+        kws = _kws(4, max_new=12)
+        expect = ref(prompts, kws)
+        victim = router.route_owner(prompts[0])
+        assert all(router.route_owner(p) == victim for p in prompts)
+        streams = [router.submit(p, **kw)
+                   for p, kw in zip(prompts, kws)]
+        evicted0 = router._replicas[victim].server.stats()["evicted"]
+        deadline = time.monotonic() + 30
+        vsrv = router._replicas[victim].server
+        while vsrv.stats()["evicted"] == evicted0:
+            assert time.monotonic() < deadline, \
+                "pool was never exhausted — eviction untested"
+            time.sleep(0.0002)
+        router._replicas[victim].kill()
+        outs = [s.result(timeout=60) for s in streams]
+        assert outs == expect
+        assert router.stats()["failovers"] >= 1
+    finally:
+        router.stop()
+        _wait_idle(scarce_pair)
+
+
+def test_failover_shared_prefix_warm_survivor(trio, ref):
+    """100%-shared prefix: when the failover target already holds the
+    prompt's blocks (a prior conversation), re-prefill aliases them —
+    observable as a prefix-cache hit on the survivor."""
+    router = trio.router
+    prompt = None
+    for seed in range(200):
+        (cand,) = _prompts(seed=100 + seed, lens=(8,))
+        with router._lock:
+            order = router._candidates(router._route_pos(cand))
+        if len(order) >= 2:
+            prompt, owner, backup = cand, order[0], order[1]
+            break
+    assert prompt is not None
+    kw = dict(max_new_tokens=24, seed=4242, do_sample=True,
+              temperature=0.9, top_k=8)
+    (expect,) = ref([prompt], [kw])
+    # warm the survivor: run the same conversation there directly so
+    # its prefix cache holds the prompt's blocks
+    warm = trio.replica(backup).server.submit(
+        np.asarray(prompt), **kw).result(timeout=60)
+    assert warm == expect
+    hits0 = trio.replica(backup).server.stats()["prefix_hits"]
+    stream = router.submit(prompt, **kw)
+    time.sleep(0.008)
+    trio.replica(owner).kill()
+    assert stream.result(timeout=60) == expect
+    assert trio.replica(backup).server.stats()["prefix_hits"] > hits0, \
+        "failover re-prefill missed the survivor's warm blocks"
+
+
+def test_double_failure_token_identical(trio, ref):
+    """The second replica dies DURING re-prefill recovery: the ring
+    rotates again and the stream still completes token-identical."""
+    router = trio.router
+    prompts = _prompts(seed=4, lens=(6,))
+    kw = dict(max_new_tokens=25, seed=77, do_sample=True,
+              temperature=0.9, top_k=8)
+    (expect,) = ref(prompts, [kw])
+    first = router.route_owner(prompts[0])
+    stream = router.submit(prompts[0], **kw)
+    time.sleep(0.006)
+    trio.replica(first).kill()
+    # the moment the router re-homes the request, kill the new home
+    second = None
+    deadline = time.monotonic() + 30
+    while second in (None, first):
+        assert time.monotonic() < deadline, "failover never re-placed"
+        with router._lock:
+            req = router._reqs.get(stream.request_id)
+            second = req.replica if req is not None else None
+        if req is None:     # already finished on the second home
+            break
+        time.sleep(0.0002)
+    if second is not None and second != first:
+        trio.replica(second).kill()
+    assert stream.result(timeout=60) == expect
+    assert router.stats()["failovers"] >= 1
+
+
+# -- deadline-aware admission ------------------------------------------
+
+def test_tenant_budget_shed_typed(servers):
+    t = _Trio(servers, tenant_budgets={"acme": 40})
+    try:
+        p = np.array([1, 2, 3, 4, 5], np.int32)
+        s1 = t.router.submit(p, max_new_tokens=25, tenant="acme")
+        with pytest.raises(ServerOverloaded):
+            t.router.submit(p, max_new_tokens=25, tenant="acme")
+        s1.result(timeout=60)
+        # budget is in-flight, not cumulative: capacity returns
+        s3 = t.router.submit(p, max_new_tokens=25, tenant="acme")
+        s3.result(timeout=60)
+        assert t.router.stats()["sheds"]["tenant_budget"] == 1
+    finally:
+        t.close()
+
+
+def test_pressure_shed_is_deadline_ordered(servers, ref):
+    """At max_pending the request with the MOST remaining deadline is
+    the one shed — a tight-deadline late arrival takes the slot of a
+    slack early one, not the other way round."""
+    t = _Trio(servers, max_pending=1)
+    try:
+        prompts = _prompts(seed=6, lens=(5, 7))
+        kws = _kws(2, max_new=25)
+        expect = ref(prompts, kws)
+        slack = t.router.submit(prompts[0], timeout_s=300.0, **kws[0])
+        tight = t.router.submit(prompts[1], timeout_s=30.0, **kws[1])
+        assert tight.result(timeout=60) == expect[1]
+        with pytest.raises(ServerOverloaded):
+            slack.result(timeout=60)
+        assert t.router.stats()["sheds"]["pressure"] == 1
+    finally:
+        t.close()
+
+
+def test_failover_keeps_original_deadline(trio):
+    """A failed-over request's deadline is anchored at the ORIGINAL
+    submit: re-routing must not grant it a fresh budget."""
+    router = trio.router
+    (p,) = _prompts(seed=7, lens=(5,))
+    t0 = time.monotonic()
+    stream = router.submit(p, max_new_tokens=25, timeout_s=9.0)
+    victim = None
+    with router._lock:
+        req = router._reqs.get(stream.request_id)
+        deadline0 = req.deadline
+    time.sleep(0.004)
+    with router._lock:
+        req = router._reqs.get(stream.request_id)
+        victim = req.replica if req is not None else None
+    if victim is not None:
+        trio.replica(victim).kill()
+    stream.result(timeout=60)
+    if req is not None:
+        # the record is gone, but the captured deadline pins the epoch
+        assert abs(deadline0 - (t0 + 9.0)) < 0.25
+        assert req.deadline == deadline0
+
+
+def test_deadline_exhaustion_typed(servers):
+    """No live replica at all: the stream fails with RequestTimeout at
+    its original deadline, typed, not a hang."""
+    t = _Trio(servers)
+    try:
+        for rep in t.reps:
+            rep.kill()
+        time.sleep(0.02)
+        (p,) = _prompts(seed=8, lens=(4,))
+        with pytest.raises((ServerOverloaded, RequestTimeout)):
+            s = t.router.submit(p, max_new_tokens=8, timeout_s=0.6)
+            s.result(timeout=30)
+    finally:
+        t.close()
+
+
+# -- RPC replicas + graceful drain (scarce_pair users; the drain test
+# -- poisons the pair, so it is defined last among them) ----------------
+
+def test_rpc_replica_roundtrip(scarce_pair, ref):
+    rpc = GenerationRpcServer(scarce_pair[0])
+    rep = RemoteReplica("w0", "127.0.0.1", rpc.port)
+    try:
+        assert rep.ping() == {"ok": True, "draining": False}
+        (p,) = _prompts(seed=9, lens=(6,))
+        kw = dict(max_new_tokens=12, seed=5)
+        (expect,) = ref([p], [kw])
+        rep.submit(1, p, kw)
+        got = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            (res,) = rep.poll([(1, len(got))])
+            got.extend(res["toks"])
+            if res["done"]:
+                break
+            time.sleep(0.002)
+        assert got == expect
+    finally:
+        rpc.stop()
+        _wait_idle(scarce_pair[:1])
+
+
+def test_drain_migrates_then_drain_all_typed(scarce_pair, ref):
+    """drain(victim) mid-traffic migrates its live conversations (KV
+    or replay) token-identically and closes admission typed — on
+    thrash-sized pools, so sequences can be mid-eviction-replay when
+    their home drains; draining EVERY replica makes the router itself
+    refuse typed, and the typed errors cross the RPC wire AS their
+    type.  Drained servers never come back: this test consumes the
+    module's scarce pair."""
+    reps = [LocalReplica(f"r{i}", s, owns_server=False)
+            for i, s in enumerate(scarce_pair)]
+    router = GatewayRouter(reps, block_size=4, seed=0,
+                           request_timeout_s=60.0).start()
+    try:
+        prompts = _prompts(seed=5, lens=(5, 9, 3, 12))
+        kws = _kws(4, max_new=10)    # 12 + 10 <= scarce max_model_len
+        expect = ref(prompts, kws)
+        victim = router.route_owner(prompts[0])
+        streams = [router.submit(p, **kw)
+                   for p, kw in zip(prompts, kws)]
+        time.sleep(0.006)
+        router.drain(victim)
+        outs = [s.result(timeout=60) for s in streams]
+        assert outs == expect
+        st = router.stats()
+        assert victim in st["draining"] and victim not in st["ring"]
+        # admission is closed at the drained replica itself, typed —
+        # directly AND across the wire (ping reports it too)
+        vsrv = router._replicas[victim].server
+        with pytest.raises(ServerDraining):
+            vsrv.submit(np.asarray(prompts[0]), max_new_tokens=4)
+        wrpc = GenerationRpcServer(vsrv)
+        wrep = RemoteReplica("w", "127.0.0.1", wrpc.port)
+        try:
+            assert wrep.ping()["draining"] is True
+            with pytest.raises(ServerDraining):
+                wrep.submit(9, prompts[0], dict(max_new_tokens=4))
+        finally:
+            wrpc.stop()
+        # the router keeps serving (and avoids the drained replica)
+        s2 = router.submit(prompts[0], **kws[0])
+        assert s2.result(timeout=60) == expect[0]
+        assert router.stats()["routed"].get(victim, 0) \
+            == st["routed"].get(victim, 0)
+        # drain the rest: no capacity anywhere -> typed at submit
+        for name in list(router._replicas):
+            if name not in router.stats()["draining"]:
+                router.drain(name)
+        with pytest.raises(ServerDraining):
+            router.submit(np.array([1, 2, 3], np.int32),
+                          max_new_tokens=4)
+    finally:
+        router.stop()
+
+
+def test_gateway_under_flaky_link_chaos(servers, ref):
+    """gw_flaky: seeded delays + repeated cuts on the poll link.  Cut
+    sockets surface as ReplicaLost, the router fails over (the replica
+    process itself is healthy), and every stream must still be
+    token-identical — link chaos can cost latency, never tokens."""
+    rpcs = [GenerationRpcServer(s) for s in servers[:2]]
+    reps = [RemoteReplica(f"w{i}", "127.0.0.1", r.port)
+            for i, r in enumerate(rpcs)]
+    reps.append(LocalReplica("w2", servers[2], owns_server=False))
+    prompts = _prompts(seed=10, lens=(5, 9, 3, 12))
+    kws = _kws(4, max_new=16)
+    expect = ref(prompts, kws)
+    chaos.install(chaos.named_plan("gw_flaky", seed=3))
+    router = None
+    try:
+        router = GatewayRouter(reps, block_size=4, seed=0,
+                               request_timeout_s=60.0).start()
+        streams = [router.submit(p, **kw)
+                   for p, kw in zip(prompts, kws)]
+        outs = [s.result(timeout=60) for s in streams]
+        assert outs == expect
+    finally:
+        chaos.uninstall()
+        if router is not None:
+            router.stop()
+        for r in rpcs:
+            r.stop()
+
+
+def test_gateway_stop_fails_streams_typed(servers):
+    t = _Trio(servers)
+    (p,) = _prompts(seed=11, lens=(5,))
+    stream = t.router.submit(p, max_new_tokens=25)
+    t.close()
+    try:
+        stream.result(timeout=10)
+    except ServerClosed:
+        pass    # stopped mid-flight: typed, not a hang
+
+
+# -- chaos acceptance (ISSUE 18): SIGKILL a replica mid-decode ----------
+#
+# 8 concurrent streams x 3 replicas, one replica SIGKILLed mid-decode
+# by a seeded fault plan, then a second replica gracefully drained
+# mid-traffic — every client stream must be np.array_equal to its
+# fault-free run (greedy AND seeded sampling): zero lost tokens, zero
+# duplicated.  The doomed replica is a real SUBPROCESS
+# (tests/gen_replica_worker.py) with plan=gw_kill@N in its own
+# PADDLE_CHAOS: the kill fires inside its scheduler loop as SIGKILL,
+# so the router sees exactly what a machine loss delivers — a dead
+# socket mid-stream, no goodbye.  Defined LAST: phase 2 drains shared
+# server "b" permanently.
+
+import json          # noqa: E402
+import os            # noqa: E402
+import subprocess    # noqa: E402
+import sys           # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "gen_replica_worker.py")
+
+
+def _workload(n=8, seed=7):
+    """Ring-aware workload: half the prompts are CHOSEN (by walking
+    the seeded rng) to consistent-hash onto the doomed replica, so the
+    kill is guaranteed to hit live streams.  Pure function of the
+    replica names + seed — the reference run and the chaos run build
+    the identical list without sharing any live state."""
+    class _Stub:
+        def __init__(self, name):
+            self.name = name
+
+    probe = GatewayRouter([_Stub(nm) for nm in ("doomed", "b", "c")],
+                          block_size=4, seed=seed)
+    rng = np.random.RandomState(seed)
+    doomed, other = [], []
+    while len(doomed) < n // 2 or len(other) < n - n // 2:
+        p = rng.randint(1, 64,
+                        (int(rng.randint(3, 13)),)).astype("int32")
+        bucket = (doomed if probe.route_owner(p) == "doomed"
+                  else other)
+        if len(bucket) < (n // 2 if bucket is doomed else n - n // 2):
+            bucket.append(p)
+    work = []
+    for i, p in enumerate(doomed + other):
+        # doomed-bound prompts come first and sampling alternates, so
+        # the killed replica carries greedy AND seeded-sampled streams
+        kw = dict(max_new_tokens=16, seed=1000 + i)
+        if i % 2:
+            kw.update(do_sample=True, temperature=0.9, top_k=8)
+        work.append((p, kw))
+    return work
+
+
+def _spawn_doomed(kill_step=12, seed=7):
+    env = dict(os.environ)
+    env["PADDLE_CHAOS"] = f"plan=gw_kill@{kill_step};seed={seed}"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen([sys.executable, _WORKER],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    info = json.loads(proc.stdout.readline())
+    return proc, info["port"]
+
+
+def test_sigkill_and_drain_all_streams_token_identical(servers, ref):
+    work = _workload()
+    expect = ref([p for p, _ in work], [kw for _, kw in work])
+    proc, port = _spawn_doomed()
+    reps = [RemoteReplica("doomed", "127.0.0.1", port),
+            LocalReplica("b", servers[0], owns_server=False),
+            LocalReplica("c", servers[1], owns_server=False)]
+    router = GatewayRouter(reps, block_size=4, seed=7,
+                           request_timeout_s=120.0).start()
+    try:
+        # phase 1: the doomed replica SIGKILLs itself on its 12th
+        # scheduler step — late enough that its submit replies escaped
+        # (the streams are PLACED), early enough to be mid-decode
+        streams = [router.submit(p, **kw) for p, kw in work]
+        outs = [s.result(timeout=120) for s in streams]
+        for i, (o, r) in enumerate(zip(outs, expect)):
+            assert np.array_equal(o, r), \
+                f"stream {i}: {o} != fault-free {r}"
+        st = router.stats()
+        assert st["failovers"] >= 1, \
+            "the kill never hit an active stream — chaos untested"
+        assert proc.wait(timeout=30) == -9    # actually SIGKILLed
+
+        # phase 2: gracefully drain a SECOND replica mid-traffic;
+        # conversations migrate (KV or replay) with the same bar
+        streams = [router.submit(p, **kw) for p, kw in work]
+        time.sleep(0.01)
+        router.drain("b")
+        outs = [s.result(timeout=120) for s in streams]
+        for i, (o, r) in enumerate(zip(outs, expect)):
+            assert np.array_equal(o, r), \
+                f"post-drain stream {i}: {o} != fault-free {r}"
+        st = router.stats()
+        assert "b" in st["draining"] and "b" not in st["ring"]
+    finally:
+        router.stop()
+        if proc.poll() is None:
+            proc.kill()

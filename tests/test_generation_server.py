@@ -307,3 +307,127 @@ def test_scan_layers_raises_typed_error():
     with pytest.raises(NotImplementedError, match="scan_layers=False"):
         m.model(paddle.to_tensor(np.ones((1, 2), np.int32)),
                 caches=[None, None])
+
+
+# -- ISSUE 18 satellites: typed stop/drain admission + deadline epoch --
+
+def test_replay_drain_stop_admission_lifecycle_typed(lm):
+    """ISSUE 18 satellites on ONE server (compiles dominate on this
+    1-core box), in lifecycle order:
+
+    1. replay_tokens — the gateway failover primitive at the server
+       boundary: a submit carrying ``replay_tokens`` re-prefills,
+       replays through the normal decode path WITHOUT re-emitting, and
+       continues the stream token-identically (greedy AND seeded
+       sampling); ``len(replay) >= max_new_tokens`` is a ValueError.
+    2. drain_begin — live sequences run to completion, NEW admission
+       raises typed ServerDraining and bumps the shed counter.
+    3. stop — submit after stop() used to check ``_running`` OUTSIDE
+       the scheduler lock, so a submit racing stop could enqueue a
+       stream that never starts and hang the caller until its
+       deadline.  The check now lives under the lock: stopped server
+       => typed ServerClosed, immediately."""
+    import time
+    from paddle_tpu.inference import ServerDraining
+    srv = GenerationServer(lm, num_slots=2, block_size=4,
+                           max_model_len=32, max_prefill_batch=1,
+                           check_replay=True, request_timeout_s=60.0)
+    srv.start()
+    p = _prompts(seed=14, lens=(6,))[0]
+    for kw in (dict(max_new_tokens=12),
+               dict(max_new_tokens=12, do_sample=True,
+                    temperature=0.9, top_k=8)):
+        full = srv.submit(p, seed=321, **kw).result(timeout=60)
+        resumed = srv.submit(p, seed=321, replay_tokens=full[:5],
+                             **kw).result(timeout=60)
+        assert resumed == full[5:], "replay re-emitted or diverged"
+    with pytest.raises(ValueError, match="replay"):
+        srv.submit(p, max_new_tokens=4, replay_tokens=[1, 2, 3, 4])
+
+    live = srv.submit(p, max_new_tokens=8)       # admitted pre-drain
+    srv.drain_begin()
+    assert srv.draining and srv.stats()["draining"]
+    with pytest.raises(ServerDraining):
+        srv.submit(p, max_new_tokens=4)
+    assert srv.stats()["shed_draining"] == 1
+    # live sequences run to completion; only NEW admission closes
+    assert len(live.result(timeout=60)) == 8
+
+    srv.stop()
+    t0 = time.monotonic()
+    with pytest.raises(ServerClosed):
+        srv.submit(np.ones(4, np.int32), max_new_tokens=4)
+    assert time.monotonic() - t0 < 5.0, \
+        "submit-after-stop blocked instead of failing typed"
+
+
+def test_submit_stop_race_no_hung_streams(lm):
+    """Hammer the submit/stop race: every submit must either raise a
+    typed error or return a stream that terminates."""
+    import threading
+    import time
+    srv = GenerationServer(lm, num_slots=2, block_size=4,
+                           max_model_len=32, max_prefill_batch=1,
+                           request_timeout_s=60.0)
+    srv.start()
+    streams, errors = [], []
+
+    def spam():
+        p = np.ones(4, np.int32)
+        for _ in range(200):
+            try:
+                streams.append(srv.submit(p, max_new_tokens=2))
+            except ServerClosed:
+                errors.append(1)
+
+    t = threading.Thread(target=spam)
+    t.start()
+    time.sleep(0.05)
+    srv.stop()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    for s in streams:       # accepted => must terminate, never hang
+        try:
+            s.result(timeout=30)
+        except (ServerClosed, RequestTimeout):
+            pass
+
+
+def test_eviction_deadline_epoch_is_submit_time(lm):
+    """ISSUE 18 satellite pin: time spent evicted-awaiting-readmission
+    counts against the ORIGINAL deadline exactly once — re-admission
+    must not re-anchor it.  Sampled live: every sequence observed
+    mid-run (including ones that have been evicted) carries
+    ``deadline == t_submit + timeout_s`` to within clock noise."""
+    import time
+    srv = GenerationServer(lm, num_slots=4, block_size=4,
+                           max_model_len=24, num_blocks=14,
+                           check_replay=True, request_timeout_s=120.0)
+    srv.start()
+    try:
+        T = 77.0
+        prompts = _prompts(seed=1, lens=(6, 10, 4, 8))
+        streams = [srv.submit(p, seed=100 + i, max_new_tokens=12,
+                              timeout_s=T)
+                   for i, p in enumerate(prompts)]
+        saw_evicted = False
+        deadline = time.monotonic() + 60
+        while any(s.finish_reason is None and s._exc is None
+                  for s in streams):
+            assert time.monotonic() < deadline
+            with srv._lock:
+                seqs = list(srv._active.values()) + list(srv._waiting)
+            for seq in seqs:
+                saw_evicted = saw_evicted or seq.evictions > 0
+                assert abs(seq.deadline - (seq.t_submit + T)) < 0.25, \
+                    "deadline drifted from the submit epoch"
+            # coarse sampling: a tighter loop steals the 1-core GIL
+            # from the scheduler and doubles the test's wall time
+            time.sleep(0.002)
+        assert srv.stats()["evicted"] > 0, \
+            "pool was never exhausted — eviction untested"
+        assert saw_evicted, "never sampled an evicted-and-waiting seq"
+        for s in streams:
+            s.result(timeout=60)
+    finally:
+        srv.stop()

@@ -442,7 +442,18 @@ def test_delayed_replica_link_never_fails_reads():
         for step in range(1, 11):
             _push_n(w, 1, ids)               # writer is serial + sync,
             vals = rd.pull("emb", ids)       # so at most ONE record is
-            assert float(vals.min()) >= step - 1, (step, vals)  # in flight
+            # in flight — but the one-record bound also needs the
+            # replica's apply thread to get scheduled between the
+            # delayed records, which a loaded 1-core box can deny for
+            # a beat; the freshness gate is time+seq, so a transient
+            # extra record of staleness is within contract.  Reads
+            # must never FAIL; the bound must hold after a short poll.
+            give_up = time.monotonic() + 2.0
+            while (float(vals.min()) < step - 1
+                   and time.monotonic() < give_up):
+                time.sleep(0.01)
+                vals = rd.pull("emb", ids)
+            assert float(vals.min()) >= step - 1, (step, vals)
             assert float(vals.max()) <= step
         chaos.uninstall()
         deadline = time.monotonic() + 10.0

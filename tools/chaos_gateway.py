@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Run concurrent generation streams through the gateway router while
+a seeded fault plan SIGKILLs a replica mid-decode, and audit that every
+client stream completed token-identical to a fault-free run.
+
+Replicas are launched as SUBPROCESSES (this same file, ``--serve``)
+behind ``GenerationRpcServer``; the doomed one carries the fault plan
+in ``PADDLE_CHAOS`` so the kill fires inside its scheduler loop — the
+router sees exactly what a machine loss delivers: a dead socket
+mid-stream.  The fault-free expectation is computed in-process first on
+a single ample server (same seeded weights), so the comparison counts
+precisely: a lost token, a duplicated token, or a diverged sample all
+fail ``np.array_equal``.
+
+Two phases, one session:
+
+  kill    submit N streams, the doomed replica dies mid-decode on its
+          K-th step (``plan=gw_kill@K``) — every stream must finish
+          token-equal and ``gw`` failovers must be >= 1
+  drain   submit N more, gracefully ``drain()`` a surviving replica
+          mid-traffic — sequences migrate (KV or replay) token-equal
+
+Examples::
+
+    python tools/chaos_gateway.py
+    python tools/chaos_gateway.py --replicas 3 --streams 8 --kill-step 6
+
+Exit status 0 iff every stream in both phases matched the fault-free
+reference exactly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# the tiny deterministic model every process builds: same seed, same
+# weights, so token streams are comparable across process boundaries
+_MODEL = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=64)
+_SERVER = dict(num_slots=8, block_size=4, max_model_len=32,
+               check_replay=True, max_prefill_batch=1,
+               request_timeout_s=120.0, prefix_cache=True)
+
+
+def _build_server():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import GenerationServer
+    from paddle_tpu.text.models import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny(**_MODEL))
+    m.eval()
+    return GenerationServer(m, **_SERVER)
+
+
+def _serve():
+    """Replica mode: serve one GenerationServer over RPC until the
+    driver stops it (or chaos kills us — that is the point)."""
+    from paddle_tpu.inference import GenerationRpcServer
+    srv = _build_server().start()
+    rpc = GenerationRpcServer(srv)
+    print(json.dumps({"port": rpc.port, "pid": os.getpid()}),
+          flush=True)
+    while rpc._running:
+        time.sleep(0.2)
+
+
+def _spawn_replica(chaos_spec=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_CHAOS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if chaos_spec:
+        env["PADDLE_CHAOS"] = chaos_spec
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    info = json.loads(proc.stdout.readline())
+    return proc, info["port"]
+
+
+def _workload(streams, seed):
+    """(prompt, kwargs) per stream: mixed lengths, half greedy, half
+    seeded sampling — both must survive failover token-identical."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(streams):
+        p = rng.randint(1, _MODEL["vocab_size"],
+                        (int(rng.randint(3, 13)),)).astype("int32")
+        kw = dict(max_new_tokens=16, seed=1000 + i)
+        if i % 2:
+            kw.update(do_sample=True, temperature=0.9, top_k=8)
+        out.append((p, kw))
+    return out
+
+
+def _run_wave(router, work):
+    streams = [router.submit(p, **kw) for p, kw in work]
+    return [st.result(timeout=120) for st in streams]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="gateway chaos audit: SIGKILL + drain, "
+                    "token-equality as the pass bar")
+    ap.add_argument("--serve", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--streams", type=int, default=8)
+    ap.add_argument("--kill-step", type=int, default=6,
+                    help="doomed replica dies on its Nth decode step")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    if args.serve:
+        _serve()
+        return 0
+
+    from paddle_tpu.inference import GatewayRouter, RemoteReplica
+
+    work = _workload(args.streams, args.seed)
+    print(f"[ref] fault-free run, {args.streams} streams ...",
+          flush=True)
+    ref_srv = _build_server().start()
+    refs = []
+    for p, kw in work:
+        refs.append(ref_srv.submit(p, **kw).result(timeout=120))
+    ref_srv.stop()
+
+    chaos_spec = f"plan=gw_kill@{args.kill_step};seed={args.seed}"
+    print(f"[spawn] {args.replicas} replicas "
+          f"(replica 0 doomed: {chaos_spec})", flush=True)
+    procs, reps = [], []
+    for i in range(args.replicas):
+        proc, port = _spawn_replica(chaos_spec if i == 0 else None)
+        procs.append(proc)
+        reps.append(RemoteReplica(f"r{i}", "127.0.0.1", port))
+    router = GatewayRouter(reps, block_size=_SERVER["block_size"],
+                           seed=args.seed,
+                           request_timeout_s=120.0).start()
+
+    bad = 0
+    try:
+        print("[kill] wave 1: doomed replica will die mid-decode",
+              flush=True)
+        outs = _run_wave(router, work)
+        for i, (o, r) in enumerate(zip(outs, refs)):
+            if not np.array_equal(o, r):
+                bad += 1
+                print(f"  stream {i}: MISMATCH {o} != {r}",
+                      flush=True)
+        st = router.stats()
+        print(f"  failovers={st['failovers']} routed={st['routed']}",
+              flush=True)
+        if st["failovers"] < 1:
+            bad += 1
+            print("  FAIL: kill never hit an active stream "
+                  "(raise --streams or lower --kill-step)",
+                  flush=True)
+
+        # the ring drops DRAINING replicas, not dead ones: skip the
+        # doomed r0 or the drain would just failover around a corpse
+        survivors = [n for n in st["ring"] if n != "r0"]
+        victim = survivors[0]
+        print(f"[drain] wave 2 with drain({victim}) mid-traffic",
+              flush=True)
+        streams2 = [router.submit(p, **kw) for p, kw in work]
+        time.sleep(0.01)
+        moved = router.drain(victim)
+        outs2 = [s.result(timeout=120) for s in streams2]
+        for i, (o, r) in enumerate(zip(outs2, refs)):
+            if not np.array_equal(o, r):
+                bad += 1
+                print(f"  stream {i}: MISMATCH {o} != {r}",
+                      flush=True)
+        st = router.stats()
+        print(f"  migrated={st['migrated']} (moved {moved} live) "
+              f"failovers={st['failovers']}", flush=True)
+    finally:
+        router.stop()
+        for rep in reps:
+            try:
+                rep.stop_remote()
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    ok = bad == 0
+    print(json.dumps({"ok": ok, "mismatches": bad,
+                      "failovers": st["failovers"],
+                      "migrated": st["migrated"]}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

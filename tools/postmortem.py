@@ -62,12 +62,16 @@ import trace_merge  # noqa: E402  (read_sink / solve_offsets reused)
 # falling behind or going dark.
 # online.freshness_breach (ISSUE 14): the online loop's end-to-end
 # freshness SLO failed — a stalled stream's autopsy starts there.
+# gw.failover / gw.drain (ISSUE 18): a replica died mid-stream (the
+# gateway re-prefilled its conversations elsewhere) or was gracefully
+# drained — either way conversations MOVED, which is where a serving
+# postmortem looks first (gw.route stays a progress kind).
 _BAD_KINDS = {"rpc.error", "divergence", "stall", "chaos",
               "ps.replica_error", "serve.shed", "serve.evict",
               "elastic.leave", "ps.read_stale_exhausted",
               "slo.breach", "serve.admit_rollback",
               "fleet.straggler", "fleet.stale",
-              "online.freshness_breach"}
+              "online.freshness_breach", "gw.failover", "gw.drain"}
 
 
 def _is_bad(ev: dict) -> bool:

@@ -109,6 +109,12 @@ if [ "$CHAOS" -eq 1 ]; then
     # restores, the O(max shard) host-staging bound, and reform-hook
     # recompiles; test_crash_mid_save.py also gained the SIGKILL-mid-
     # streamed-save torn-step test.
+    # test_gateway.py is the INFERENCE FEDERATION suite (ISSUE 18):
+    # prefix-affinity routing, replica SIGKILL mid-decode (subprocess,
+    # seeded gw_kill plan) with every stream finishing token-identical
+    # to the fault-free run, KV-migration drain mid-traffic,
+    # flaky-link (gw_flaky) cut/delay survival, and deadline-ordered
+    # shedding at the router.
     echo "== tier-1 chaos pass: fault injection suite"
     env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_chaos_harness.py tests/test_ps_fault_tolerance.py \
@@ -119,7 +125,7 @@ if [ "$CHAOS" -eq 1 ]; then
         tests/test_spec_decode.py tests/test_kv_int8.py \
         tests/test_fleet_observatory.py tests/test_online_loop.py \
         tests/test_feature_lifecycle.py tests/test_geo_conflict.py \
-        tests/test_elastic_device.py \
+        tests/test_elastic_device.py tests/test_gateway.py \
         "${PYARGS[@]}" -p no:randomly
     rc3=$?
 fi
